@@ -1,0 +1,22 @@
+"""dynamo_tpu — a TPU-native distributed LLM inference serving framework.
+
+A from-scratch re-design of the capabilities of NVIDIA Dynamo
+(reference: zifeng175mo/dynamo @ 2025-07-04) for TPU hardware:
+
+- OpenAI-compatible HTTP frontend with SSE streaming (``dynamo_tpu.llm.http_service``)
+- Distributed runtime: namespace/component/endpoint discovery with leases and
+  watches, request plane + streaming response plane (``dynamo_tpu.runtime``)
+- KV-cache-aware routing over a global radix index fed by worker KV events
+  (``dynamo_tpu.llm.kv_router``)
+- Disaggregated prefill/decode with a shared prefill queue and host-staged
+  ICI/DCN KV block transfer (``dynamo_tpu.llm.disagg``, ``dynamo_tpu.llm.kvbm``)
+- An in-tree JAX/XLA engine: pjit tensor parallelism over a device mesh,
+  paged KV cache, bucketed continuous batching, Pallas attention kernels
+  (``dynamo_tpu.engine``, ``dynamo_tpu.models``, ``dynamo_tpu.ops``)
+
+The compute path is JAX/XLA/Pallas; the runtime is asyncio + a small native
+data plane. Nothing here is a translation of the reference's CUDA/Rust code —
+see SURVEY.md at the repo root for the capability map this build follows.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,179 @@
+"""Preprocessor: OpenAI request -> BackendInput (template, tokenize, stops).
+
+This is the forward half of the request pipeline. It renders the chat
+template (jinja2), tokenizes, assembles sampling/stop conditions, and attaches
+requested annotations (``formatted_prompt``, ``token_ids``).
+
+Reference capability: lib/llm/src/preprocessor.rs:63-359 (OpenAIPreprocessor,
+prompt templating, stop-condition assembly, annotations).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jinja2
+
+from .model_card import CHATML_TEMPLATE, ModelDeploymentCard
+from .protocols.common import (
+    BackendInput,
+    OutputOptions,
+    SamplingOptions,
+    StopConditions,
+)
+from .protocols.openai import ChatCompletionRequest, CompletionRequest, ProtocolError
+from .tokenizer import Tokenizer, load_tokenizer
+
+_JINJA_ENV = jinja2.Environment(
+    loader=jinja2.BaseLoader(), trim_blocks=False, lstrip_blocks=False,
+    # chat templates use tojson and raise_exception
+    extensions=[],
+)
+_JINJA_ENV.globals["raise_exception"] = lambda msg: (_ for _ in ()).throw(
+    ProtocolError(f"chat template error: {msg}")
+)
+
+
+@dataclass
+class PreprocessedRequest:
+    backend_input: BackendInput
+    formatted_prompt: Optional[str]
+    annotations: Dict[str, Any]
+
+
+class Preprocessor:
+    """Stateless per-model preprocessor bound to a card + tokenizer."""
+
+    def __init__(self, card: ModelDeploymentCard, tokenizer: Optional[Tokenizer] = None):
+        self.card = card
+        self.tokenizer = tokenizer or load_tokenizer(card.tokenizer)
+        src = card.chat_template or CHATML_TEMPLATE
+        self._template = _JINJA_ENV.from_string(src)
+
+    # ------------------------------------------------------------------
+    def render_chat(self, messages: List[Dict[str, Any]],
+                    tools: Optional[List[Dict[str, Any]]] = None) -> str:
+        try:
+            return self._template.render(
+                messages=messages,
+                tools=tools,
+                add_generation_prompt=True,
+                bos_token="",
+                eos_token="",
+            )
+        except jinja2.TemplateError as e:
+            raise ProtocolError(f"chat template failed: {e}") from e
+
+    # ------------------------------------------------------------------
+    def preprocess_chat(self, req: ChatCompletionRequest) -> PreprocessedRequest:
+        if bool(req.ext.get("use_raw_prompt")) and req.messages:
+            # raw-prompt escape hatch: single user message passed through untemplated
+            prompt = "".join(str(m.get("content", "")) for m in req.messages)
+        else:
+            prompt = self.render_chat(req.messages, req.raw.get("tools"))
+        token_ids = self.tokenizer.encode(prompt)
+        bi = self._assemble(
+            token_ids,
+            model=req.model,
+            max_tokens=req.max_tokens,
+            stop=req.stop,
+            temperature=req.temperature,
+            top_p=req.top_p,
+            top_k=req.top_k,
+            n=req.n,
+            seed=req.seed,
+            frequency_penalty=req.frequency_penalty,
+            presence_penalty=req.presence_penalty,
+            min_tokens=req.min_tokens,
+            ignore_eos=req.ignore_eos,
+            logprobs=(req.top_logprobs if req.top_logprobs is not None else 0)
+            if req.logprobs else None,
+        )
+        annotations = self._annotations(req.ext, prompt, token_ids)
+        bi.annotations = annotations
+        return PreprocessedRequest(bi, prompt, annotations)
+
+    def preprocess_completion(self, req: CompletionRequest) -> PreprocessedRequest:
+        prompt: Optional[str]
+        if isinstance(req.prompt, str):
+            prompt = req.prompt
+            token_ids = self.tokenizer.encode(prompt)
+        elif isinstance(req.prompt, list) and all(isinstance(x, int) for x in req.prompt):
+            prompt = None
+            token_ids = list(req.prompt)
+            if any(t < 0 or t >= 1 << 32 for t in token_ids):
+                raise ProtocolError("token ids must be in [0, 2^32)")
+        else:
+            raise ProtocolError("prompt must be a string or a list of token ids")
+        bi = self._assemble(
+            token_ids,
+            model=req.model,
+            max_tokens=req.max_tokens,
+            stop=req.stop,
+            temperature=req.temperature,
+            top_p=req.top_p,
+            top_k=req.top_k,
+            n=req.n,
+            seed=req.seed,
+            min_tokens=req.min_tokens,
+            ignore_eos=req.ignore_eos,
+            logprobs=req.logprobs,
+            echo=req.echo,
+        )
+        annotations = self._annotations(req.ext, prompt, token_ids)
+        bi.annotations = annotations
+        return PreprocessedRequest(bi, prompt, annotations)
+
+    # ------------------------------------------------------------------
+    def _assemble(self, token_ids: List[int], *, model: str,
+                  max_tokens: Optional[int], stop: List[str],
+                  temperature: Optional[float], top_p: Optional[float],
+                  top_k: Optional[int], n: int, seed: Optional[int],
+                  frequency_penalty: Optional[float] = None,
+                  presence_penalty: Optional[float] = None,
+                  min_tokens: Optional[int] = None, ignore_eos: bool = False,
+                  logprobs: Optional[int] = None, echo: bool = False) -> BackendInput:
+        ctx = self.card.context_length
+        if len(token_ids) >= ctx:
+            raise ProtocolError(
+                f"prompt of {len(token_ids)} tokens exceeds the model context "
+                f"length of {ctx}"
+            )
+        budget = ctx - len(token_ids)
+        mt = min(max_tokens, budget) if max_tokens is not None else budget
+        if max_tokens is not None and max_tokens < 1:
+            raise ProtocolError("max_tokens must be >= 1")
+        return BackendInput(
+            token_ids=token_ids,
+            sampling=SamplingOptions(
+                temperature=temperature,
+                top_p=top_p,
+                top_k=top_k,
+                frequency_penalty=frequency_penalty,
+                presence_penalty=presence_penalty,
+                seed=seed,
+                n=n,
+            ),
+            stop=StopConditions(
+                max_tokens=mt,
+                stop=list(stop),
+                min_tokens=min_tokens,
+                ignore_eos=ignore_eos,
+            ),
+            output=OutputOptions(logprobs=logprobs, echo=echo),
+            eos_token_ids=list(self.card.eos_token_ids),
+            model=model,
+            mdc_sum=self.card.mdc_sum,
+        )
+
+    @staticmethod
+    def _annotations(ext: Dict[str, Any], prompt: Optional[str],
+                     token_ids: List[int]) -> Dict[str, Any]:
+        want = set(ext.get("annotations", []) or [])
+        out: Dict[str, Any] = {}
+        if "formatted_prompt" in want and prompt is not None:
+            out["formatted_prompt"] = prompt
+        if "token_ids" in want:
+            out["token_ids"] = token_ids
+        return out

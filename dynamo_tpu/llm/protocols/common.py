@@ -1,0 +1,127 @@
+"""Engine-agnostic internal request/response protocol.
+
+The preprocessor lowers OpenAI requests into :class:`BackendInput` (token ids +
+sampling + stop conditions); engines stream back :class:`EngineOutput` deltas.
+Reference capability: lib/llm/src/protocols/common.rs and
+lib/llm/src/protocols/common/llm_backend.rs:1-126.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, asdict
+from typing import Any, Dict, List, Optional
+
+
+class FinishReason(str, enum.Enum):
+    EOS = "eos"          # hit an end-of-sequence token
+    STOP = "stop"        # hit a stop string/token from the request
+    LENGTH = "length"    # hit max_tokens / context limit
+    CANCELLED = "cancelled"
+    ERROR = "error"
+
+    def to_openai(self) -> str:
+        if self in (FinishReason.EOS, FinishReason.STOP):
+            return "stop"
+        if self is FinishReason.LENGTH:
+            return "length"
+        return "stop" if self is FinishReason.CANCELLED else "error"
+
+
+@dataclass
+class SamplingOptions:
+    temperature: Optional[float] = None  # None/0 => greedy
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    repetition_penalty: Optional[float] = None
+    seed: Optional[int] = None
+    n: int = 1
+
+    @property
+    def greedy(self) -> bool:
+        return not self.temperature or self.temperature <= 0.0
+
+
+@dataclass
+class StopConditions:
+    max_tokens: Optional[int] = None
+    stop: List[str] = field(default_factory=list)          # stop strings
+    stop_token_ids: List[int] = field(default_factory=list)
+    min_tokens: Optional[int] = None
+    ignore_eos: bool = False
+
+
+@dataclass
+class OutputOptions:
+    logprobs: Optional[int] = None
+    echo: bool = False  # completions-style prompt echo
+
+
+@dataclass
+class BackendInput:
+    """What an engine consumes: pure tokens + generation config."""
+
+    token_ids: List[int]
+    sampling: SamplingOptions = field(default_factory=SamplingOptions)
+    stop: StopConditions = field(default_factory=StopConditions)
+    output: OutputOptions = field(default_factory=OutputOptions)
+    eos_token_ids: List[int] = field(default_factory=list)
+    model: Optional[str] = None
+    mdc_sum: Optional[str] = None  # model deployment card checksum
+    annotations: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "BackendInput":
+        return cls(
+            token_ids=list(d["token_ids"]),
+            sampling=SamplingOptions(**d.get("sampling", {})),
+            stop=StopConditions(**d.get("stop", {})),
+            output=OutputOptions(**d.get("output", {})),
+            eos_token_ids=list(d.get("eos_token_ids", [])),
+            model=d.get("model"),
+            mdc_sum=d.get("mdc_sum"),
+            annotations=dict(d.get("annotations", {})),
+        )
+
+
+@dataclass
+class EngineOutput:
+    """One streamed step from a core engine: newly generated token ids (and
+    optionally text, if the engine detokenizes itself)."""
+
+    token_ids: List[int] = field(default_factory=list)
+    text: Optional[str] = None
+    cum_log_prob: Optional[float] = None
+    logprobs: Optional[List[Dict[str, float]]] = None
+    finish_reason: Optional[FinishReason] = None
+    # engine-side bookkeeping surfaced for routing/metrics
+    kv_prefix_hit_tokens: Optional[int] = None
+    index: int = 0  # choice index for n>1
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_reason is not None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = asdict(self)
+        if self.finish_reason is not None:
+            d["finish_reason"] = self.finish_reason.value
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EngineOutput":
+        fr = d.get("finish_reason")
+        return cls(
+            token_ids=list(d.get("token_ids", [])),
+            text=d.get("text"),
+            cum_log_prob=d.get("cum_log_prob"),
+            logprobs=d.get("logprobs"),
+            finish_reason=FinishReason(fr) if fr else None,
+            kv_prefix_hit_tokens=d.get("kv_prefix_hit_tokens"),
+            index=d.get("index", 0),
+        )

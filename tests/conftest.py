@@ -1,0 +1,47 @@
+"""Test fixtures.
+
+All tests run on a virtual 8-device CPU mesh (no TPU needed) and fully
+offline. The real-TPU path is exercised by bench.py / __graft_entry__.py.
+"""
+
+import os
+import sys
+
+# Must be set before jax import: 8 virtual CPU devices for sharding tests.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
+os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+# Echo engines: no artificial delay in tests.
+os.environ.setdefault("DYN_TOKEN_ECHO_DELAY_MS", "0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests with asyncio.run (no pytest-asyncio in image)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture
+def byte_card():
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    return ModelDeploymentCard.synthetic("echo-test")

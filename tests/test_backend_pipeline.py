@@ -1,0 +1,56 @@
+"""Core pipeline: preprocessor -> backend(core engine) -> text deltas."""
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.engines import EchoCoreEngine
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import Preprocessor
+from dynamo_tpu.llm.protocols.common import BackendInput, FinishReason, StopConditions
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.runtime.engine import Context, collect
+
+
+def make_input(text: str, **stop_kw) -> BackendInput:
+    tok = ByteTokenizer()
+    return BackendInput(
+        token_ids=tok.encode(text),
+        stop=StopConditions(**stop_kw) if stop_kw else StopConditions(),
+        eos_token_ids=tok.eos_token_ids,
+    )
+
+
+async def test_echo_roundtrip():
+    backend = Backend(EchoCoreEngine(delay_s=0), ByteTokenizer())
+    outs = await collect(backend.generate(make_input("hello world"), Context()))
+    text = "".join(o.text or "" for o in outs)
+    assert text == "hello world"
+    assert outs[-1].finish_reason == FinishReason.LENGTH
+
+
+async def test_max_tokens():
+    backend = Backend(EchoCoreEngine(delay_s=0), ByteTokenizer())
+    outs = await collect(
+        backend.generate(make_input("hello world", max_tokens=5), Context())
+    )
+    assert "".join(o.text or "" for o in outs) == "hello"
+
+
+async def test_stop_sequence_truncates():
+    backend = Backend(EchoCoreEngine(delay_s=0), ByteTokenizer())
+    outs = await collect(
+        backend.generate(make_input("abc STOP def", stop=["STOP"]), Context())
+    )
+    assert "".join(o.text or "" for o in outs) == "abc "
+    assert outs[-1].finish_reason == FinishReason.STOP
+
+
+async def test_cancellation():
+    backend = Backend(EchoCoreEngine(delay_s=0), ByteTokenizer())
+    ctx = Context()
+    texts = []
+    n = 0
+    async for o in backend.generate(make_input("a" * 100), ctx):
+        texts.append(o.text or "")
+        n += 1
+        if n == 3:
+            ctx.stop_generating()
+    assert n < 100  # stream ended early

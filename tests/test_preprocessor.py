@@ -1,0 +1,82 @@
+import pytest
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import Preprocessor
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    ProtocolError,
+)
+
+
+@pytest.fixture
+def prep(byte_card):
+    return Preprocessor(byte_card)
+
+
+def chat_req(**kw):
+    d = {
+        "model": "echo-test",
+        "messages": [{"role": "user", "content": "hi there"}],
+    }
+    d.update(kw)
+    return ChatCompletionRequest.from_dict(d)
+
+
+def test_chat_templating_chatml(prep):
+    pr = prep.preprocess_chat(chat_req())
+    assert "<|im_start|>user" in pr.formatted_prompt
+    assert pr.formatted_prompt.endswith("<|im_start|>assistant\n")
+    assert pr.backend_input.token_ids
+    assert pr.backend_input.eos_token_ids
+
+
+def test_raw_prompt_ext(prep):
+    pr = prep.preprocess_chat(chat_req(ext={"use_raw_prompt": True}))
+    assert pr.formatted_prompt == "hi there"
+
+
+def test_annotations(prep):
+    pr = prep.preprocess_chat(
+        chat_req(ext={"annotations": ["formatted_prompt", "token_ids"]})
+    )
+    assert "formatted_prompt" in pr.annotations
+    assert pr.annotations["token_ids"] == pr.backend_input.token_ids
+
+
+def test_max_tokens_clamped_to_context(prep, byte_card):
+    pr = prep.preprocess_chat(chat_req(max_tokens=10**9))
+    assert (
+        pr.backend_input.stop.max_tokens
+        == byte_card.context_length - len(pr.backend_input.token_ids)
+    )
+
+
+def test_context_overflow_rejected(byte_card):
+    byte_card.context_length = 8
+    prep = Preprocessor(byte_card)
+    with pytest.raises(ProtocolError):
+        prep.preprocess_chat(chat_req())
+
+
+def test_completion_string_and_tokens(prep):
+    pr = prep.preprocess_completion(
+        CompletionRequest.from_dict({"model": "m", "prompt": "abc"})
+    )
+    assert pr.backend_input.token_ids == [97, 98, 99]
+    pr2 = prep.preprocess_completion(
+        CompletionRequest.from_dict({"model": "m", "prompt": [1, 2, 3]})
+    )
+    assert pr2.backend_input.token_ids == [1, 2, 3]
+
+
+def test_stop_strings_propagate(prep):
+    pr = prep.preprocess_chat(chat_req(stop="DONE"))
+    assert pr.backend_input.stop.stop == ["DONE"]
+
+
+def test_bad_requests():
+    with pytest.raises(ProtocolError):
+        ChatCompletionRequest.from_dict({"model": "m", "messages": []})
+    with pytest.raises(ProtocolError):
+        CompletionRequest.from_dict({"model": "m"})

@@ -1,0 +1,101 @@
+"""Regression tests for the round-1 review findings."""
+
+import pytest
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.engines import EchoCoreEngine
+from dynamo_tpu.llm.preprocessor import Preprocessor
+from dynamo_tpu.llm.protocols.common import (
+    BackendInput,
+    FinishReason,
+    StopConditions,
+)
+from dynamo_tpu.llm.protocols.openai import CompletionRequest, ProtocolError
+from dynamo_tpu.llm.tokenizer import ByteTokenizer, DecodeStream
+from dynamo_tpu.llm.tokens import hash_tokens
+from dynamo_tpu.runtime.engine import Context, collect
+
+
+async def run(text, **stop_kw):
+    tok = ByteTokenizer()
+    bi = BackendInput(token_ids=tok.encode(text), stop=StopConditions(**stop_kw))
+    backend = Backend(EchoCoreEngine(delay_s=0), tok)
+    outs = await collect(backend.generate(bi, Context()))
+    return "".join(o.text or "" for o in outs), outs[-1].finish_reason
+
+
+async def test_min_tokens_suppresses_stop():
+    text, fin = await run("ab STOP cdefgh", stop=["STOP"], min_tokens=100)
+    assert text == "ab STOP cdefgh"  # stop ignored until min_tokens reached
+    assert fin == FinishReason.LENGTH
+
+
+async def test_stop_after_min_tokens_still_fires():
+    text, fin = await run("abcdefgh STOP xyz", stop=["STOP"], min_tokens=2)
+    assert text == "abcdefgh " and fin == FinishReason.STOP
+
+
+async def test_decode_stream_flush_on_finish():
+    # generation ends mid-codepoint: the torn byte must still be emitted
+    tok = ByteTokenizer()
+    ids = tok.encode("hé")  # 3 bytes: h, 0xC3, 0xA9
+    bi = BackendInput(token_ids=ids, stop=StopConditions(max_tokens=2))
+    backend = Backend(EchoCoreEngine(delay_s=0), tok)
+    outs = await collect(backend.generate(bi, Context()))
+    text = "".join(o.text or "" for o in outs)
+    assert text == tok.decode(ids[:2])  # == 'h�'
+
+
+def test_decode_stream_flush_api():
+    tok = ByteTokenizer()
+    ds = DecodeStream(tok)
+    parts = [ds.step(t) for t in tok.encode("你好")[:-1]]  # torn tail
+    tail = ds.flush()
+    assert "".join(parts) + tail == tok.decode(tok.encode("你好")[:-1])
+
+
+async def test_echo_empty_and_zero_budget():
+    tok = ByteTokenizer()
+    backend = Backend(EchoCoreEngine(delay_s=0), tok)
+    # empty prompt: must finish cleanly, not CANCELLED
+    outs = await collect(
+        backend.generate(BackendInput(token_ids=[]), Context())
+    )
+    assert outs[-1].finish_reason == FinishReason.LENGTH
+    # wire-level max_tokens=0 (bypassing preprocessor validation): no echo
+    bi = BackendInput(token_ids=tok.encode("abc"), stop=StopConditions(max_tokens=0))
+    outs = await collect(backend.generate(bi, Context()))
+    assert "".join(o.text or "" for o in outs) == ""
+
+
+def test_token_id_range_validated():
+    prep = Preprocessor.__new__(Preprocessor)  # not needed; use real one
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    prep = Preprocessor(ModelDeploymentCard.synthetic("t"))
+    with pytest.raises(ProtocolError):
+        prep.preprocess_completion(
+            CompletionRequest.from_dict({"model": "m", "prompt": [-1, 5]})
+        )
+    with pytest.raises(ProtocolError):
+        prep.preprocess_completion(
+            CompletionRequest.from_dict({"model": "m", "prompt": [1 << 33]})
+        )
+
+
+def test_hash_tokens_never_raises():
+    assert hash_tokens([-1]) == hash_tokens([0xFFFFFFFF])
+
+
+def test_chat_logprobs_default():
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+
+    prep = Preprocessor(ModelDeploymentCard.synthetic("t"))
+    pr = prep.preprocess_chat(
+        ChatCompletionRequest.from_dict(
+            {"model": "m", "messages": [{"role": "user", "content": "x"}],
+             "logprobs": True}
+        )
+    )
+    assert pr.backend_input.output.logprobs == 0  # sampled-token logprobs

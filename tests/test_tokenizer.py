@@ -1,0 +1,58 @@
+from dynamo_tpu.llm.tokenizer import (
+    ByteTokenizer,
+    DecodeStream,
+    StopSequenceDecoder,
+)
+
+
+def test_byte_roundtrip():
+    tok = ByteTokenizer()
+    s = "hello, wörld! 你好"
+    assert tok.decode(tok.encode(s)) == s
+
+
+def test_decode_stream_multibyte():
+    tok = ByteTokenizer()
+    s = "héllo 你好 end"
+    ids = tok.encode(s)
+    ds = DecodeStream(tok)
+    out = "".join(ds.step(t) for t in ids)
+    assert out == s  # no torn UTF-8 sequences despite byte-at-a-time feed
+
+
+def test_decode_stream_with_prompt_offset():
+    tok = ByteTokenizer()
+    prompt = tok.encode("prompt: ")
+    gen = tok.encode("reply")
+    ds = DecodeStream(tok, prompt)
+    out = "".join(ds.step(t) for t in gen)
+    assert out == "reply"  # prompt tokens never leak into the stream
+
+
+def test_stop_decoder_full_match():
+    sd = StopSequenceDecoder(["STOP"])
+    vis, stopped = sd.feed("hello STOP world")
+    assert vis == "hello " and stopped
+
+
+def test_stop_decoder_jail_across_chunks():
+    sd = StopSequenceDecoder(["STOP"])
+    v1, s1 = sd.feed("abc ST")
+    assert v1 == "abc " and not s1  # "ST" jailed
+    v2, s2 = sd.feed("OP tail")
+    assert v2 == "" and s2
+
+
+def test_stop_decoder_jail_released():
+    sd = StopSequenceDecoder(["STOP"])
+    v1, _ = sd.feed("abc ST")
+    v2, s2 = sd.feed("ILL here")
+    assert v1 + v2 == "abc STILL here" and not s2
+    assert sd.flush() == ""
+
+
+def test_stop_decoder_flush_tail():
+    sd = StopSequenceDecoder(["END"])
+    v, _ = sd.feed("value: EN")
+    assert v == "value: "
+    assert sd.flush() == "EN"  # stream ended; jail released

@@ -1,0 +1,48 @@
+from dynamo_tpu.llm.tokens import (
+    TokenSequence,
+    chain_hash,
+    compute_block_hashes,
+    compute_seq_hashes,
+    hash_tokens,
+)
+
+
+def test_hash_stability():
+    # pinned values: the wire protocol must be stable across processes
+    assert hash_tokens([1, 2, 3]) == hash_tokens([1, 2, 3])
+    assert hash_tokens([1, 2, 3]) != hash_tokens([3, 2, 1])
+    assert chain_hash(None, 5) == chain_hash(None, 5)
+    assert chain_hash(None, 5) != chain_hash(1, 5)
+
+
+def test_sequence_chunking():
+    seq = TokenSequence.from_tokens(range(10), block_size=4)
+    assert len(seq.blocks) == 2
+    assert seq.partial == [8, 9]
+    assert seq.total_tokens == 10
+    assert seq.all_tokens() == list(range(10))
+    # chained: second block's parent is first block's seq hash
+    assert seq.blocks[1].parent_sequence_hash == seq.blocks[0].sequence_hash
+
+
+def test_same_content_different_position():
+    # identical block content at different positions: same block_hash,
+    # different sequence_hash
+    seq = TokenSequence.from_tokens([7, 7, 7, 7, 7, 7, 7, 7], block_size=4)
+    b0, b1 = seq.blocks
+    assert b0.block_hash == b1.block_hash
+    assert b0.sequence_hash != b1.sequence_hash
+
+
+def test_helpers_match_sequence():
+    toks = list(range(13))
+    seq = TokenSequence.from_tokens(toks, block_size=4)
+    assert compute_block_hashes(toks, 4) == seq.block_hashes()
+    assert compute_seq_hashes(toks, 4) == seq.sequence_hashes()
+
+
+def test_incremental_append_matches_bulk():
+    bulk = TokenSequence.from_tokens(range(8), block_size=4)
+    inc = TokenSequence(block_size=4)
+    sealed = [inc.append(t) for t in range(8)]
+    assert [b for b in sealed if b] == bulk.blocks
