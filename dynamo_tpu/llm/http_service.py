@@ -1,0 +1,280 @@
+"""OpenAI-compatible HTTP frontend (aiohttp).
+
+Routes: POST /v1/chat/completions, POST /v1/completions, GET /v1/models,
+GET /health, GET /metrics (Prometheus). SSE streaming with client-disconnect
+propagation into engine cancellation; a ModelManager maps model name → engines
+and supports live add/remove (used by etcd-style discovery later).
+
+Reference capability: lib/llm/src/http/service/{service_v2,openai,metrics,
+discovery}.rs — axum server, ModelManager, disconnect monitor, Prometheus.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from ..runtime.engine import AsyncEngine, Context, EngineError
+from ..utils.prometheus import Registry
+from .model_card import ModelDeploymentCard
+from .protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    ProtocolError,
+    SSE_DONE,
+    aggregate_chat_chunks,
+    aggregate_completion_chunks,
+    sse_encode,
+)
+
+
+@dataclass
+class ServedModel:
+    card: ModelDeploymentCard
+    chat_engine: Optional[AsyncEngine] = None
+    completion_engine: Optional[AsyncEngine] = None
+
+
+class ModelManager:
+    """Live registry of served models; safe to mutate while serving."""
+
+    def __init__(self):
+        self._models: Dict[str, ServedModel] = {}
+
+    def add(self, model: ServedModel) -> None:
+        self._models[model.card.name] = model
+
+    def remove(self, name: str) -> None:
+        self._models.pop(name, None)
+
+    def get(self, name: str) -> Optional[ServedModel]:
+        return self._models.get(name)
+
+    def list(self):
+        return list(self._models.values())
+
+
+class HttpService:
+    def __init__(self, manager: Optional[ModelManager] = None,
+                 host: str = "0.0.0.0", port: int = 8080):
+        self.manager = manager or ModelManager()
+        self.host = host
+        self.port = port
+        self.registry = Registry()
+        m = self.registry
+        self.m_requests = m.counter(
+            "dyn_http_requests_total", "HTTP requests",
+            ("model", "endpoint", "status"))
+        self.m_inflight = m.gauge(
+            "dyn_http_inflight_requests", "In-flight requests", ("model",))
+        self.m_duration = m.histogram(
+            "dyn_http_request_duration_seconds", "Request duration",
+            ("model", "endpoint"))
+        self.m_ttft = m.histogram(
+            "dyn_http_time_to_first_token_seconds", "Time to first streamed token",
+            ("model",))
+        self.m_tokens = m.counter(
+            "dyn_http_output_tokens_total", "Completion tokens produced", ("model",))
+        self._runner: Optional[web.AppRunner] = None
+        self.app = self._build_app()
+
+    # ------------------------------------------------------------------
+    def _build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", self._chat)
+        app.router.add_post("/v1/completions", self._completions)
+        app.router.add_get("/v1/models", self._models)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/metrics", self._metrics)
+        return app
+
+    async def start(self) -> int:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # resolve the actual port (port=0 supported for tests)
+        for s in site._server.sockets:  # type: ignore[union-attr]
+            self.port = s.getsockname()[1]
+            break
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def run_forever(self) -> None:
+        await self.start()
+        while True:
+            await asyncio.sleep(3600)
+
+    # ------------------------------------------------------------------
+    async def _health(self, _req: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "ok", "models": [m.card.name for m in self.manager.list()]}
+        )
+
+    async def _metrics(self, _req: web.Request) -> web.Response:
+        return web.Response(text=self.registry.render(),
+                            content_type="text/plain")
+
+    async def _models(self, _req: web.Request) -> web.Response:
+        now = int(time.time())
+        return web.json_response({
+            "object": "list",
+            "data": [
+                {"id": m.card.name, "object": "model", "created": now,
+                 "owned_by": "dynamo_tpu",
+                 "context_length": m.card.context_length}
+                for m in self.manager.list()
+            ],
+        })
+
+    # ------------------------------------------------------------------
+    async def _chat(self, req: web.Request) -> web.StreamResponse:
+        return await self._serve(req, "chat")
+
+    async def _completions(self, req: web.Request) -> web.StreamResponse:
+        return await self._serve(req, "completions")
+
+    async def _serve(self, req: web.Request, endpoint: str) -> web.StreamResponse:
+        started = time.monotonic()
+        model_name = "unknown"
+        try:
+            body = await req.json()
+        except Exception:
+            self.m_requests.inc(model_name, endpoint, "400")
+            return _err(400, "invalid JSON body")
+        if not isinstance(body, dict):
+            self.m_requests.inc(model_name, endpoint, "400")
+            return _err(400, "request body must be a JSON object")
+        try:
+            if endpoint == "chat":
+                oai_req = ChatCompletionRequest.from_dict(body)
+            else:
+                oai_req = CompletionRequest.from_dict(body)
+        except ProtocolError as e:
+            self.m_requests.inc("unknown", endpoint, "400")
+            return _err(400, str(e))
+        except Exception as e:
+            # any other parse failure is still the client's malformed input
+            self.m_requests.inc("unknown", endpoint, "400")
+            return _err(400, f"malformed request: {e}")
+        model_name = oai_req.model
+        served = self.manager.get(model_name)
+        engine = served and (served.chat_engine if endpoint == "chat"
+                             else served.completion_engine)
+        if engine is None:
+            # label with a constant to keep metric cardinality bounded
+            # (model names of 404s are client-controlled)
+            self.m_requests.inc("unknown", endpoint, "404")
+            return _err(404, f"model {model_name!r} not found")
+
+        ctx = Context()
+        self.m_inflight.inc(model_name)
+        status = "200"
+        try:
+            if oai_req.stream:
+                return await self._stream(req, engine, oai_req, ctx,
+                                          model_name, endpoint, started)
+            chunks = []
+            try:
+                async for ch in engine.generate(oai_req, ctx):
+                    if "event" in ch:
+                        continue  # annotations only meaningful when streaming
+                    chunks.append(ch)
+                    u = ch.get("usage")
+                    if u:
+                        self.m_tokens.inc(model_name,
+                                          amount=u["completion_tokens"])
+            except ProtocolError as e:
+                status = "400"
+                return _err(400, str(e))
+            except EngineError as e:
+                status = str(e.code)
+                return _err(e.code, str(e))
+            agg = (aggregate_chat_chunks(chunks) if endpoint == "chat"
+                   else aggregate_completion_chunks(chunks))
+            return web.json_response(agg)
+        finally:
+            self.m_inflight.dec(model_name)
+            self.m_requests.inc(model_name, endpoint, status)
+            self.m_duration.observe(model_name, endpoint,
+                                    value=time.monotonic() - started)
+
+    async def _stream(self, req: web.Request, engine: AsyncEngine, oai_req,
+                      ctx: Context, model: str, endpoint: str,
+                      started: float) -> web.StreamResponse:
+        agen = engine.generate(oai_req, ctx)
+        # Pull the first item BEFORE committing the 200/SSE response so that
+        # preprocessing failures (context overflow, bad template) still map to
+        # a proper 4xx status instead of an error inside a 200 stream.
+        try:
+            first_item = await agen.__anext__()
+        except StopAsyncIteration:
+            first_item = None
+        except ProtocolError as e:
+            return _err(400, str(e))
+        except EngineError as e:
+            return _err(e.code, str(e))
+
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"},
+        )
+        await resp.prepare(req)
+        first = True
+
+        async def chain():
+            if first_item is not None:
+                yield first_item
+            async for item in agen:
+                yield item
+
+        try:
+            async for ch in chain():
+                if "event" in ch:
+                    payload = (f"event: {ch['event']}\n"
+                               f"data: {json.dumps(ch['data'])}\n\n").encode()
+                    await resp.write(payload)
+                    continue
+                if first:
+                    self.m_ttft.observe(model, value=time.monotonic() - started)
+                    first = False
+                u = ch.get("usage")
+                if u:
+                    self.m_tokens.inc(model, amount=u["completion_tokens"])
+                await resp.write(sse_encode(json.dumps(ch)))
+            await resp.write(sse_encode(SSE_DONE))
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: propagate cancellation into the engine
+            ctx.stop_generating()
+            raise
+        except ProtocolError as e:
+            await resp.write(sse_encode(json.dumps({"error": {
+                "message": str(e), "type": "invalid_request_error"}})))
+            await resp.write(sse_encode(SSE_DONE))
+        except EngineError as e:
+            await resp.write(sse_encode(json.dumps({"error": {
+                "message": str(e), "type": "engine_error", "code": e.code}})))
+            await resp.write(sse_encode(SSE_DONE))
+        finally:
+            ctx.stop_generating()
+        await resp.write_eof()
+        return resp
+
+
+def _err(code: int, message: str) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message,
+                   "type": "invalid_request_error" if code == 400 else "not_found_error"
+                   if code == 404 else "internal_error",
+                   "code": code}},
+        status=code,
+    )

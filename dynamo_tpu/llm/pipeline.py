@@ -1,0 +1,132 @@
+"""Pipeline assembly: compose preprocessor + backend + core engine into
+OpenAI-level engines that consume request objects and stream chunk dicts.
+
+This is the local (in-process) analogue of the reference's pipeline graph
+ServiceFrontend → OpenAIPreprocessor → Backend → ServiceBackend(engine)
+(reference: launch/dynamo-run/src/input/http.rs:86, lib/runtime/src/pipeline.rs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Dict, Optional
+
+from ..runtime.engine import AsyncEngine, Context
+from .backend import Backend
+from .engines import EchoFullEngine
+from .model_card import ModelDeploymentCard
+from .preprocessor import Preprocessor
+from .protocols.common import BackendInput, EngineOutput, FinishReason
+from .protocols.openai import (
+    ChatCompletionRequest,
+    ChatDeltaGenerator,
+    CompletionDeltaGenerator,
+    CompletionRequest,
+    usage_dict,
+)
+from .tokenizer import load_tokenizer
+
+
+class OpenAIChatEngine(AsyncEngine[ChatCompletionRequest, Dict[str, Any]]):
+    """ChatCompletionRequest -> stream of chat.completion.chunk dicts."""
+
+    def __init__(self, card: ModelDeploymentCard,
+                 core_engine: AsyncEngine[BackendInput, EngineOutput]):
+        self.card = card
+        self.preprocessor = Preprocessor(card)
+        self.backend = Backend(core_engine, self.preprocessor.tokenizer)
+
+    async def generate(self, request: ChatCompletionRequest,
+                       context: Context) -> AsyncIterator[Dict[str, Any]]:
+        pre = self.preprocessor.preprocess_chat(request)
+        gen = ChatDeltaGenerator(request.model, request_id=f"chatcmpl-{context.id[:24]}")
+        prompt_tokens = len(pre.backend_input.token_ids)
+        completion_tokens = 0
+        if pre.annotations:
+            yield {"event": "annotations", "data": pre.annotations}
+        async for out in self.backend.generate(pre.backend_input, context):
+            completion_tokens += len(out.token_ids)
+            if out.text:
+                yield gen.text_chunk(out.text, out.index)
+            if out.finish_reason is not None:
+                yield gen.finish_chunk(
+                    out.finish_reason, out.index,
+                    usage=usage_dict(prompt_tokens, completion_tokens),
+                )
+                return
+
+
+class OpenAICompletionEngine(AsyncEngine[CompletionRequest, Dict[str, Any]]):
+    """CompletionRequest -> stream of text_completion chunk dicts."""
+
+    def __init__(self, card: ModelDeploymentCard,
+                 core_engine: AsyncEngine[BackendInput, EngineOutput]):
+        self.card = card
+        self.preprocessor = Preprocessor(card)
+        self.backend = Backend(core_engine, self.preprocessor.tokenizer)
+
+    async def generate(self, request: CompletionRequest,
+                       context: Context) -> AsyncIterator[Dict[str, Any]]:
+        pre = self.preprocessor.preprocess_completion(request)
+        gen = CompletionDeltaGenerator(request.model, request_id=f"cmpl-{context.id[:24]}")
+        prompt_tokens = len(pre.backend_input.token_ids)
+        completion_tokens = 0
+        if request.echo and pre.formatted_prompt:
+            yield gen.text_chunk(pre.formatted_prompt)
+        async for out in self.backend.generate(pre.backend_input, context):
+            completion_tokens += len(out.token_ids)
+            fin = out.finish_reason.to_openai() if out.finish_reason else None
+            if out.text or fin:
+                chunk = gen.text_chunk(out.text or "", out.index, fin)
+                if fin:
+                    chunk["usage"] = usage_dict(prompt_tokens, completion_tokens)
+                yield chunk
+            if fin:
+                return
+
+
+class FullEngineAdapter(AsyncEngine):
+    """Adapts a text-level full engine (streams plain text, e.g. EchoFullEngine)
+    to OpenAI chunk dicts for both chat and completions."""
+
+    def __init__(self, model: str, engine: AsyncEngine, kind: str = "chat"):
+        self.model = model
+        self.engine = engine
+        self.kind = kind
+
+    async def generate(self, request, context: Context):
+        if self.kind == "chat":
+            gen = ChatDeltaGenerator(self.model, request_id=f"chatcmpl-{context.id[:24]}")
+        else:
+            gen = CompletionDeltaGenerator(self.model, request_id=f"cmpl-{context.id[:24]}")
+        async for text in self.engine.generate(request, context):
+            yield gen.text_chunk(text)
+        yield gen.finish_chunk(FinishReason.STOP)
+
+
+def build_chat_engine(card: ModelDeploymentCard, kind: str,
+                      core_engine: Optional[AsyncEngine] = None) -> AsyncEngine:
+    """``kind``: 'echo_core' | 'echo_full' | 'core' (bring your own core engine)."""
+    from .engines import EchoCoreEngine
+
+    if kind == "echo_full":
+        return FullEngineAdapter(card.name, EchoFullEngine(), "chat")
+    if kind == "echo_core":
+        return OpenAIChatEngine(card, EchoCoreEngine())
+    if kind == "core":
+        assert core_engine is not None
+        return OpenAIChatEngine(card, core_engine)
+    raise ValueError(f"unknown engine kind {kind!r}")
+
+
+def build_completion_engine(card: ModelDeploymentCard, kind: str,
+                            core_engine: Optional[AsyncEngine] = None) -> AsyncEngine:
+    from .engines import EchoCoreEngine
+
+    if kind == "echo_full":
+        return FullEngineAdapter(card.name, EchoFullEngine(), "completion")
+    if kind == "echo_core":
+        return OpenAICompletionEngine(card, EchoCoreEngine())
+    if kind == "core":
+        assert core_engine is not None
+        return OpenAICompletionEngine(card, core_engine)
+    raise ValueError(f"unknown engine kind {kind!r}")

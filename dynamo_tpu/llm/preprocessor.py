@@ -96,12 +96,22 @@ class Preprocessor:
 
     def preprocess_completion(self, req: CompletionRequest) -> PreprocessedRequest:
         prompt: Optional[str]
-        if isinstance(req.prompt, str):
-            prompt = req.prompt
+        raw_prompt = req.prompt
+        if (isinstance(raw_prompt, list) and len(raw_prompt) == 1
+                and isinstance(raw_prompt[0], str)):
+            raw_prompt = raw_prompt[0]  # single-element batch == plain string
+        if isinstance(raw_prompt, list) and not raw_prompt:
+            raise ProtocolError("prompt must not be empty")
+        if isinstance(raw_prompt, list) and all(isinstance(x, str) for x in raw_prompt):
+            raise ProtocolError(
+                "multi-prompt batch completions are not supported yet; send one "
+                "request per prompt")
+        if isinstance(raw_prompt, str):
+            prompt = raw_prompt
             token_ids = self.tokenizer.encode(prompt)
-        elif isinstance(req.prompt, list) and all(isinstance(x, int) for x in req.prompt):
+        elif isinstance(raw_prompt, list) and all(isinstance(x, int) for x in raw_prompt):
             prompt = None
-            token_ids = list(req.prompt)
+            token_ids = list(raw_prompt)
             if any(t < 0 or t >= 1 << 32 for t in token_ids):
                 raise ProtocolError("token ids must be in [0, 2^32)")
         else:

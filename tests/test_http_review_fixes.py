@@ -1,0 +1,107 @@
+"""Regressions for the HTTP-layer review findings."""
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import Preprocessor
+from dynamo_tpu.llm.protocols.openai import CompletionRequest, ProtocolError
+from dynamo_tpu.utils.prometheus import Registry
+
+from tests.test_http_service import start_service
+
+
+async def test_non_dict_and_garbage_bodies_are_400():
+    svc, base = await start_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            for body in ("[1,2,3]", '{"model":"echo","messages":[{"role":"user","content":"x"}],"n":"two"}',
+                         '{"model":"echo","messages":[{"role":"user","content":"x"}],"ext":null}'):
+                async with s.post(f"{base}/v1/chat/completions", data=body,
+                                  headers={"Content-Type": "application/json"}) as r:
+                    assert r.status == 400, body
+    finally:
+        await svc.stop()
+
+
+async def test_streaming_preprocess_error_is_400():
+    svc, base = await start_service()
+    # shrink context so the prompt overflows
+    svc.manager.get("echo").card.context_length = 4
+    for m in svc.manager.list():
+        m.chat_engine.card.context_length = 4
+        m.chat_engine.preprocessor.card.context_length = 4
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "echo", "stream": True,
+                    "messages": [{"role": "user", "content": "way too long"}],
+                    "ext": {"use_raw_prompt": True}}
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 400  # not a 200 SSE stream with an error inside
+    finally:
+        await svc.stop()
+
+
+async def test_metrics_label_escaping_and_cardinality():
+    svc, base = await start_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            evil = 'x"} evil\nname'
+            async with s.post(f"{base}/v1/chat/completions", json={
+                    "model": evil,
+                    "messages": [{"role": "user", "content": "x"}]}) as r:
+                assert r.status == 404
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+        # 404s are recorded under a constant label, never the client string
+        assert "evil" not in text
+        assert 'model="unknown",endpoint="chat",status="404"' in text
+    finally:
+        await svc.stop()
+
+
+def test_prometheus_escape_rendering():
+    reg = Registry()
+    c = reg.counter("c_total", "help", ("l",))
+    c.inc('a"b\\c\nd')
+    out = reg.render()
+    assert 'l="a\\"b\\\\c\\nd"' in out
+
+
+async def test_output_tokens_metric_counts_tokens():
+    svc, base = await start_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json={
+                    "model": "echo",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "ext": {"use_raw_prompt": True}}) as r:
+                data = await r.json()
+                n = data["usage"]["completion_tokens"]
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+        assert f'dyn_http_output_tokens_total{{model="echo"}} {float(n)}' in text
+    finally:
+        await svc.stop()
+
+
+def test_completion_prompt_variants():
+    prep = Preprocessor(ModelDeploymentCard.synthetic("t"))
+    # single-element string batch accepted
+    pr = prep.preprocess_completion(
+        CompletionRequest.from_dict({"model": "m", "prompt": ["ab"]}))
+    assert pr.backend_input.token_ids == [97, 98]
+    with pytest.raises(ProtocolError):
+        prep.preprocess_completion(
+            CompletionRequest.from_dict({"model": "m", "prompt": ["a", "b"]}))
+    with pytest.raises(ProtocolError):
+        prep.preprocess_completion(
+            CompletionRequest.from_dict({"model": "m", "prompt": []}))
+
+
+def test_cli_unknown_out_modes():
+    from dynamo_tpu.cli.run import make_card, make_engines, parse_args
+
+    args = parse_args(["out=dyn://ns.comp.ep"])
+    with pytest.raises(SystemExit):
+        make_engines(args, make_card(args))

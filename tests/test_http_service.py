@@ -1,0 +1,137 @@
+"""HTTP frontend tests: real aiohttp server + real HTTP client, echo engines.
+
+Mirrors the reference's http-service integration tests (axum server + fake
+engines + scraping real Prometheus metrics)."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.llm.http_service import HttpService, ModelManager, ServedModel
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.pipeline import build_chat_engine, build_completion_engine
+from dynamo_tpu.llm.protocols.openai import sse_parse_lines
+
+
+async def start_service():
+    card = ModelDeploymentCard.synthetic("echo")
+    manager = ModelManager()
+    manager.add(ServedModel(
+        card,
+        build_chat_engine(card, "echo_core"),
+        build_completion_engine(card, "echo_core"),
+    ))
+    svc = HttpService(manager, host="127.0.0.1", port=0)
+    port = await svc.start()
+    return svc, f"http://127.0.0.1:{port}"
+
+
+async def test_models_and_health():
+    svc, base = await start_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/v1/models") as r:
+                assert r.status == 200
+                data = await r.json()
+                assert data["data"][0]["id"] == "echo"
+            async with s.get(f"{base}/health") as r:
+                assert (await r.json())["status"] == "ok"
+    finally:
+        await svc.stop()
+
+
+async def test_chat_non_streaming():
+    svc, base = await start_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "echo",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "ext": {"use_raw_prompt": True}}
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                data = await r.json()
+                assert data["object"] == "chat.completion"
+                assert data["choices"][0]["message"]["content"] == "hello"
+                assert data["usage"]["completion_tokens"] == 5
+    finally:
+        await svc.stop()
+
+
+async def test_chat_streaming_sse():
+    svc, base = await start_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "echo", "stream": True,
+                    "messages": [{"role": "user", "content": "hi!"}],
+                    "ext": {"use_raw_prompt": True}}
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                text = (await r.read()).decode()
+        payloads = sse_parse_lines(text.splitlines())
+        assert payloads[-1] == "[DONE]"
+        chunks = [json.loads(p) for p in payloads[:-1]]
+        content = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks)
+        assert content == "hi!"
+        assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+    finally:
+        await svc.stop()
+
+
+async def test_completions_endpoint():
+    svc, base = await start_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "echo", "prompt": "abc", "max_tokens": 2}
+            async with s.post(f"{base}/v1/completions", json=body) as r:
+                data = await r.json()
+                assert data["choices"][0]["text"] == "ab"
+    finally:
+        await svc.stop()
+
+
+async def test_errors_and_metrics():
+    svc, base = await start_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", data=b"{nope") as r:
+                assert r.status == 400
+            async with s.post(f"{base}/v1/chat/completions", json={
+                    "model": "missing",
+                    "messages": [{"role": "user", "content": "x"}]}) as r:
+                assert r.status == 404
+            async with s.post(f"{base}/v1/chat/completions", json={
+                    "model": "echo", "messages": []}) as r:
+                assert r.status == 400
+            # a good request, then scrape metrics
+            async with s.post(f"{base}/v1/chat/completions", json={
+                    "model": "echo",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "ext": {"use_raw_prompt": True}}) as r:
+                assert r.status == 200
+            async with s.get(f"{base}/metrics") as r:
+                metrics = await r.text()
+        assert 'dyn_http_requests_total{model="echo",endpoint="chat",status="200"} 1' in metrics
+        assert 'status="404"' in metrics
+        assert "dyn_http_request_duration_seconds_bucket" in metrics
+    finally:
+        await svc.stop()
+
+
+async def test_annotations_sse_event():
+    svc, base = await start_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "echo", "stream": True,
+                    "messages": [{"role": "user", "content": "zz"}],
+                    "ext": {"use_raw_prompt": True,
+                            "annotations": ["token_ids"]}}
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                text = (await r.read()).decode()
+        assert "event: annotations" in text
+        assert '"token_ids": [122, 122]' in text
+    finally:
+        await svc.stop()
