@@ -1,0 +1,88 @@
+"""Benchmark: decode throughput + TTFT of the in-tree JAX engine on the
+attached accelerator (TPU under the driver; CPU as fallback).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Primary metric: steady-state decode tokens/sec/chip on Llama-3.2-1B shapes
+(bf16, random-init weights — throughput is weight-value independent),
+continuous batch of 8, 128-token prompts. The reference publishes no absolute
+numbers (BASELINE.md); ``vs_baseline`` is measured against a nominal H100
+Dynamo+vLLM figure for a 1B-class model, stated in TARGET_TOK_S below.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+TARGET_TOK_S = 4000.0  # nominal Dynamo+vLLM H100 decode tok/s/GPU, 1B-class model
+
+
+def main() -> None:
+    import jax
+
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+    from dynamo_tpu.llm.protocols.common import BackendInput, StopConditions
+    from dynamo_tpu.models import llama
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+    if on_tpu:
+        model = llama.preset("llama-3.2-1b", max_position=2048)
+        max_batch, prompt_len, gen_tokens = 8, 128, 128
+        max_context = 1024
+    else:  # smoke path for dev machines
+        model = llama.preset("tiny-byte")
+        max_batch, prompt_len, gen_tokens = 4, 32, 32
+        max_context = 256
+
+    cfg = JaxEngineConfig(model=model, tp=1, page_size=64,
+                          max_batch=max_batch, max_context=max_context,
+                          prefill_chunk=min(512, max_context))
+    core = EngineCore(cfg)
+
+    def run_round(tag: str):
+        t0 = time.monotonic()
+        prompt = list(range(1, prompt_len + 1))
+        for i in range(max_batch):
+            core.submit(f"{tag}{i}", BackendInput(
+                token_ids=[p + i for p in prompt],
+                stop=StopConditions(max_tokens=gen_tokens, ignore_eos=True)))
+        done = 0
+        first_token_at = None
+        tokens = 0
+        while done < max_batch:
+            outs = core.step()
+            for so in outs:
+                tokens += 1
+                if first_token_at is None:
+                    first_token_at = time.monotonic() - t0
+                if so.finish is not None:
+                    done += 1
+        return tokens, time.monotonic() - t0, first_token_at
+
+    # warmup: compile all bucket programs
+    run_round("warm")
+    # timed: measure decode-dominated steady state
+    tokens, wall, ttft = run_round("bench")
+
+    tok_s = tokens / wall
+    result = {
+        "metric": "decode_tok_s_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / TARGET_TOK_S, 3),
+        "platform": platform,
+        "model": "llama-3.2-1b" if on_tpu else "tiny-byte",
+        "batch": max_batch,
+        "prompt_len": prompt_len,
+        "gen_tokens": gen_tokens,
+        "ttft_s": round(ttft, 4) if ttft else None,
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

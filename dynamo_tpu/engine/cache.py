@@ -1,0 +1,126 @@
+"""Host-side paged KV cache bookkeeping for the JAX engine.
+
+The device arrays (``k_pool``/``v_pool``: [L, N_pool_tokens, H_kv, D_h]) are a
+flat pool of fixed-size pages. This module owns the *maps*: free-page list,
+per-sequence page tables, token-slot index computation for scatter/gather, and
+sequence-hash bookkeeping that later feeds prefix reuse + KV events.
+
+Reference capability: the engine-side half of the KV block manager
+(lib/llm/src/kv/*, vllm patch block manager hooks) — reuse pool and event
+publishing hook in here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..llm.tokens import TokenSequence
+
+
+class OutOfPages(RuntimeError):
+    pass
+
+
+@dataclass
+class SeqCache:
+    """Per-sequence cache state: owned pages + token count."""
+
+    seq_id: str
+    pages: List[int] = field(default_factory=list)
+    num_tokens: int = 0
+    # chained-hash view of the tokens in cache (block size == page size)
+    hashes: Optional[TokenSequence] = None
+
+
+class PagePool:
+    """Free-list allocator over the flat device pool.
+
+    Page 0 is reserved as the scratch page: masked/inactive lanes write there
+    so every jit step has fully static shapes with no host branching.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is scratch)")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))  # stack; 0 reserved
+        self.seqs: Dict[str, SeqCache] = {}
+        # hook: called with (seq_id, sealed TokenBlock) when a page fills —
+        # feeds the KV event publisher for the router index
+        self.on_block_sealed: Optional[Callable] = None
+        self.on_blocks_freed: Optional[Callable] = None
+
+    # ------------------------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, num_tokens: int) -> int:
+        return (num_tokens + self.page_size - 1) // self.page_size
+
+    def can_admit(self, prompt_tokens: int, reserve_pages: int = 0) -> bool:
+        return self.free_pages - reserve_pages >= self.pages_needed(prompt_tokens)
+
+    # ------------------------------------------------------------------
+    def create(self, seq_id: str, block_hashing: bool = True) -> SeqCache:
+        if seq_id in self.seqs:
+            raise ValueError(f"sequence {seq_id} already exists")
+        sc = SeqCache(seq_id,
+                      hashes=TokenSequence(self.page_size) if block_hashing else None)
+        self.seqs[seq_id] = sc
+        return sc
+
+    def extend(self, seq_id: str, tokens: Sequence[int]) -> None:
+        """Account ``tokens`` appended to the sequence, allocating pages as
+        needed and sealing full-page blocks (hash chain -> events)."""
+        sc = self.seqs[seq_id]
+        new_total = sc.num_tokens + len(tokens)
+        need = self.pages_needed(new_total) - len(sc.pages)
+        if need > len(self._free):
+            raise OutOfPages(
+                f"need {need} pages, {len(self._free)} free")
+        for _ in range(need):
+            sc.pages.append(self._free.pop())
+        if sc.hashes is not None:
+            for t in tokens:
+                sealed = sc.hashes.append(int(t))
+                if sealed is not None and self.on_block_sealed:
+                    page = sc.pages[len(sc.hashes.blocks) - 1]
+                    self.on_block_sealed(sc.seq_id, sealed, page)
+        sc.num_tokens = new_total
+
+    def release(self, seq_id: str) -> None:
+        sc = self.seqs.pop(seq_id, None)
+        if sc is None:
+            return
+        if sc.hashes is not None and self.on_blocks_freed and sc.hashes.blocks:
+            self.on_blocks_freed(sc.seq_id, sc.hashes.blocks)
+        self._free.extend(reversed(sc.pages))
+
+    # ------------------------------------------------------------------
+    # index computation for the jitted forward
+    # ------------------------------------------------------------------
+    def write_slots(self, seq_id: str, start_token: int, count: int) -> np.ndarray:
+        """Pool token-slot index for tokens [start, start+count) of a seq."""
+        sc = self.seqs[seq_id]
+        t = np.arange(start_token, start_token + count)
+        pages = np.asarray(sc.pages, dtype=np.int32)
+        return pages[t // self.page_size] * self.page_size + t % self.page_size
+
+    def read_slots(self, seq_id: str, length: int, padded: int
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(slots, positions, valid) arrays of static length ``padded``
+        covering tokens [0, length); padding points at scratch page 0."""
+        slots = np.zeros(padded, dtype=np.int32)
+        pos = np.zeros(padded, dtype=np.int32)
+        valid = np.zeros(padded, dtype=bool)
+        n = min(length, padded)
+        if n:
+            slots[:n] = self.write_slots(seq_id, 0, n)
+            pos[:n] = np.arange(n)
+            valid[:n] = True
+        return slots, pos, valid

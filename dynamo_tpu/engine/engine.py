@@ -1,0 +1,575 @@
+"""The in-tree JAX engine: continuous batching over a paged KV pool.
+
+Architecture (TPU-first):
+- All device work happens in exactly two jitted programs per (bucket) shape:
+  ``prefill_mid`` (chunk forward, no LM head) and ``prefill_last``/``decode``
+  (forward + sample). Shapes are bucketed so XLA compiles a handful of
+  programs once and replays them forever; KV pools are donated so updates are
+  in-place in HBM.
+- A synchronous :class:`EngineCore` owns all mutable state (slots, page
+  tables, sampling vectors) and is driven from one engine thread — the same
+  single-owner actor discipline the reference uses for its schedulers.
+- :class:`JaxEngine` is the asyncio facade implementing the AsyncEngine
+  contract (BackendInput -> stream of EngineOutput).
+
+Reference capability: the role vLLM/TRT-LLM play behind the reference's
+adapters (continuous batching, paged KV, streaming detached tokens), per
+SURVEY §7 step 3.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import queue as thread_queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, AsyncIterator, Deque, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..llm.model_card import ModelDeploymentCard
+from ..llm.protocols.common import BackendInput, EngineOutput, FinishReason
+from ..models import llama
+from ..parallel.mesh import AXIS_TP, tp_mesh
+from ..runtime.engine import AsyncEngine, Context
+from .cache import OutOfPages, PagePool
+from .sampling import STATIC_K, SamplingState, sample
+
+log = logging.getLogger("dynamo_tpu.engine")
+
+
+def _buckets(lo: int, hi: int) -> List[int]:
+    out = []
+    b = lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return out
+
+
+@dataclass
+class JaxEngineConfig:
+    model: llama.LlamaConfig
+    tp: int = 1
+    page_size: int = 64
+    max_batch: int = 8
+    max_context: int = 2048
+    prefill_chunk: int = 512
+    num_pages: Optional[int] = None     # default: max_batch*max_context worth
+    params_path: Optional[str] = None   # safetensors dir; None => random init
+    seed: int = 0
+    preset: Optional[str] = None
+
+    @classmethod
+    def from_card(cls, card: ModelDeploymentCard, tensor_parallel: int = 1,
+                  **extra) -> "JaxEngineConfig":
+        if card.model_config:
+            mcfg = llama.LlamaConfig.from_hf_config(card.model_config)
+        elif extra.get("preset"):
+            mcfg = llama.preset(extra["preset"])
+        else:
+            mcfg = llama.preset("tiny-byte")
+        kw = dict(
+            model=mcfg,
+            tp=tensor_parallel,
+            page_size=card.kv_block_size,
+            params_path=card.path,
+        )
+        for k in ("max_batch", "max_context", "prefill_chunk", "num_pages",
+                  "seed", "preset"):
+            if k in extra:
+                kw[k] = extra[k]
+        cfg = cls(**kw)
+        cfg.max_context = min(cfg.max_context, card.context_length)
+        return cfg
+
+
+@dataclass
+class _Slot:
+    seq_id: str
+    request: BackendInput
+    prompt: List[int]
+    prefill_done: int = 0           # prompt tokens already in cache
+    generated: int = 0
+    last_token: int = 0
+    cum_logprob: float = 0.0
+    cancelled: bool = False
+
+
+@dataclass
+class StepOutput:
+    seq_id: str
+    token: int
+    logprob: float
+    finish: Optional[FinishReason] = None
+    prompt_tokens: int = 0
+
+
+class EngineCore:
+    """Synchronous continuous-batching core. Single-threaded by contract."""
+
+    def __init__(self, cfg: JaxEngineConfig,
+                 devices: Optional[List[jax.Device]] = None):
+        self.cfg = cfg
+        m = cfg.model
+        llama.validate_tp(m, cfg.tp)
+        self.mesh = tp_mesh(cfg.tp, devices)
+        self.page_size = cfg.page_size
+        self.max_pages_per_seq = cfg.max_context // cfg.page_size
+        num_pages = cfg.num_pages or (cfg.max_batch * self.max_pages_per_seq + 1)
+        self.pool = PagePool(num_pages, cfg.page_size)
+
+        # --- params ---------------------------------------------------
+        specs = llama.param_specs(m, cfg.tp)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        if cfg.params_path and _has_safetensors(cfg.params_path):
+            from .loader import load_llama_params
+            self.params = load_llama_params(cfg.params_path, m, shardings)
+        else:
+            params = llama.init_params(m, jax.random.PRNGKey(cfg.seed))
+            self.params = jax.tree.map(
+                lambda a, s: jax.device_put(a, s), params, shardings)
+
+        # --- KV pools -------------------------------------------------
+        kv_spec = llama.kv_cache_spec(m, cfg.tp)
+        self.kv_sharding = NamedSharding(self.mesh, kv_spec)
+        pool_tokens = num_pages * cfg.page_size
+        self.k_pool = jax.device_put(
+            jnp.zeros((m.num_layers, pool_tokens, m.num_kv_heads, m.head_dim),
+                      m.dtype), self.kv_sharding)
+        self.v_pool = jax.device_put(
+            jnp.zeros_like(self.k_pool), self.kv_sharding)
+
+        # --- slots / scheduler ---------------------------------------
+        self.slots: List[Optional[_Slot]] = [None] * cfg.max_batch
+        self.by_seq: Dict[str, _Slot] = {}
+        self.waiting: Deque[Tuple[str, BackendInput]] = collections.deque()
+        self.sampling = SamplingState.host_init(cfg.max_batch)
+        self.sampling.key = jax.device_put(self.sampling.key)
+
+        # --- compiled programs ---------------------------------------
+        self.s_buckets = _buckets(min(256, cfg.max_context), cfg.max_context)
+        self.c_buckets = _buckets(min(32, cfg.prefill_chunk), cfg.prefill_chunk)
+        self._decode_fns: Dict[int, Any] = {}
+        self._prefill_mid_fns: Dict[Tuple[int, int], Any] = {}
+        self._prefill_last_fns: Dict[Tuple[int, int], Any] = {}
+        self._decoded_last = False   # prefill/decode alternation flag
+
+    # ------------------------------------------------------------------
+    # compiled program builders
+    # ------------------------------------------------------------------
+    def _decode_fn(self, S: int):
+        if S not in self._decode_fns:
+            cfg = self.cfg
+
+            @partial(jax.jit, donate_argnums=(3, 4))
+            def step(params, tokens, positions, k_pool, v_pool, write_idx,
+                     read_idx, read_pos, read_valid, temp, top_p, top_k, key):
+                logits, k_pool, v_pool = llama.forward(
+                    params, cfg.model, tokens[:, None], positions[:, None],
+                    k_pool, v_pool, write_idx[:, None],
+                    read_idx, read_pos, read_valid)
+                tok, logp, new_key = sample(
+                    logits[:, 0], temp, top_p, top_k, key)
+                return tok, logp, new_key, k_pool, v_pool
+
+            self._decode_fns[S] = step
+        return self._decode_fns[S]
+
+    def _prefill_fns(self, C: int, S: int, last: bool):
+        cache = self._prefill_last_fns if last else self._prefill_mid_fns
+        if (C, S) not in cache:
+            cfg = self.cfg
+
+            if last:
+                @partial(jax.jit, donate_argnums=(3, 4), static_argnums=(13,))
+                def fn(params, tokens, positions, k_pool, v_pool, write_idx,
+                       read_idx, read_pos, read_valid, temp, top_p, top_k,
+                       key, last_i):
+                    logits, k_pool, v_pool = llama.forward(
+                        params, cfg.model, tokens, positions, k_pool, v_pool,
+                        write_idx, read_idx, read_pos, read_valid)
+                    tok, logp, new_key = sample(
+                        logits[:, last_i], temp, top_p, top_k, key)
+                    return tok, logp, new_key, k_pool, v_pool
+            else:
+                @partial(jax.jit, donate_argnums=(3, 4))
+                def fn(params, tokens, positions, k_pool, v_pool, write_idx,
+                       read_idx, read_pos, read_valid):
+                    # mid-prefill chunks skip the LM head entirely
+                    _, k_pool, v_pool = llama.forward(
+                        params, cfg.model, tokens, positions, k_pool, v_pool,
+                        write_idx, read_idx, read_pos, read_valid)
+                    return k_pool, v_pool
+            cache[(C, S)] = fn
+        return cache[(C, S)]
+
+    @staticmethod
+    def _bucket(n: int, buckets: List[int]) -> int:
+        for b in buckets:
+            if n <= b:
+                return b
+        return buckets[-1]
+
+    # ------------------------------------------------------------------
+    # public API (engine thread)
+    # ------------------------------------------------------------------
+    def submit(self, seq_id: str, request: BackendInput) -> None:
+        self.waiting.append((seq_id, request))
+
+    def cancel(self, seq_id: str) -> None:
+        slot = self.by_seq.get(seq_id)
+        if slot is not None:
+            slot.cancelled = True
+        else:
+            self.waiting = collections.deque(
+                (s, r) for s, r in self.waiting if s != seq_id)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.by_seq)
+
+    @property
+    def active(self) -> int:
+        return len(self.by_seq)
+
+    def utilization(self) -> Dict[str, float]:
+        total = self.pool.num_pages - 1
+        return {
+            "request_active_slots": float(self.active),
+            "request_total_slots": float(self.cfg.max_batch),
+            "kv_active_blocks": float(total - self.pool.free_pages),
+            "kv_total_blocks": float(total),
+            "num_requests_waiting": float(len(self.waiting)),
+        }
+
+    # ------------------------------------------------------------------
+    def step(self) -> List[StepOutput]:
+        """Run one engine iteration: at most ONE prefill chunk OR one decode
+        batch per call, alternating when both kinds of work exist so ongoing
+        decodes keep streaming while a long prompt prefills chunk by chunk."""
+        out: List[StepOutput] = []
+        out.extend(self._reap_cancelled())
+        midfill = [(i, s) for i, s in enumerate(self.slots)
+                   if s is not None and s.prefill_done < len(s.prompt)]
+        decodable = any(s is not None and s.prefill_done >= len(s.prompt)
+                        for s in self.slots)
+        want_prefill = bool(midfill) or (self.waiting and None in self.slots)
+        if want_prefill and (not decodable or not self._decoded_last):
+            if midfill:
+                i, slot = midfill[0]
+                self._prefill_chunk(i, slot, out)
+                self._decoded_last = True  # alternate back to decode
+                return out
+            if self._admit_and_prefill(out):
+                self._decoded_last = True
+                return out
+        if decodable:
+            out.extend(self._decode_step())
+            self._decoded_last = False
+        return out
+
+    # ------------------------------------------------------------------
+    def _reap_cancelled(self) -> List[StepOutput]:
+        outs = []
+        for i, slot in enumerate(self.slots):
+            if slot is not None and slot.cancelled:
+                outs.append(StepOutput(slot.seq_id, slot.last_token, 0.0,
+                                       FinishReason.CANCELLED))
+                self._free_slot(i)
+        return outs
+
+    def _free_slot(self, i: int) -> None:
+        slot = self.slots[i]
+        if slot is None:
+            return
+        self.pool.release(slot.seq_id)
+        self.by_seq.pop(slot.seq_id, None)
+        self.slots[i] = None
+
+    def _admit_and_prefill(self, out: List[StepOutput]) -> bool:
+        """Admit the head-of-line request and run ONE prefill chunk (possibly
+        finishing the prompt). Returns True if an XLA step ran."""
+        seq_id, req = self.waiting[0]
+        prompt = list(req.token_ids)
+        if len(prompt) >= self.cfg.max_context:
+            self.waiting.popleft()
+            out.append(StepOutput(seq_id, 0, 0.0, FinishReason.ERROR))
+            return False
+        if self.pool.pages_needed(len(prompt) + 1) > self.pool.num_pages - 1:
+            # can NEVER fit, even with an empty pool: reject, don't starve
+            self.waiting.popleft()
+            out.append(StepOutput(seq_id, 0, 0.0, FinishReason.ERROR))
+            return False
+        if not self.pool.can_admit(len(prompt) + 1):
+            return False  # no KV space yet; decode will free some eventually
+        self.waiting.popleft()
+        slot_idx = self.slots.index(None)
+        slot = _Slot(seq_id, req, prompt)
+        self.slots[slot_idx] = slot
+        self.by_seq[seq_id] = slot
+        self.pool.create(seq_id)
+        s = self.sampling
+        s.temperature[slot_idx] = float(req.sampling.temperature or 0.0)
+        s.top_p[slot_idx] = float(req.sampling.top_p
+                                  if req.sampling.top_p is not None else 1.0)
+        s.top_k[slot_idx] = int(min(req.sampling.top_k or 0, STATIC_K))
+        if req.sampling.seed is not None:
+            s.key = s.key.at[slot_idx].set(
+                jax.random.key(req.sampling.seed))
+        return self._prefill_chunk(slot_idx, slot, out)
+
+    def _prefill_chunk(self, slot_idx: int, slot: _Slot,
+                       out: List[StepOutput]) -> bool:
+        prompt = slot.prompt
+        start = slot.prefill_done
+        count = min(len(prompt) - start, self.cfg.prefill_chunk)
+        is_last = start + count == len(prompt)
+        C = self._bucket(count, self.c_buckets)
+        S = self._bucket(start + count, self.s_buckets)
+
+        try:
+            self.pool.extend(slot.seq_id, prompt[start:start + count])
+        except OutOfPages:
+            out.append(StepOutput(slot.seq_id, 0, 0.0, FinishReason.ERROR))
+            self._free_slot(slot_idx)
+            return False
+
+        tokens = np.zeros((1, C), np.int32)
+        tokens[0, :count] = prompt[start:start + count]
+        positions = np.zeros((1, C), np.int32)
+        positions[0, :count] = np.arange(start, start + count)
+        write_idx = np.zeros((1, C), np.int32)  # pad writes -> scratch page 0
+        write_idx[0, :count] = self.pool.write_slots(slot.seq_id, start, count)
+        r_slots, r_pos, r_valid = self.pool.read_slots(
+            slot.seq_id, start + count, S)
+        args = (self.params, tokens, positions, self.k_pool, self.v_pool,
+                write_idx, r_slots[None], r_pos[None], r_valid[None])
+        if is_last:
+            s = self.sampling
+            fn = self._prefill_fns(C, S, last=True)
+            tok, logp, new_key, self.k_pool, self.v_pool = fn(
+                *args, s.temperature[slot_idx:slot_idx + 1],
+                s.top_p[slot_idx:slot_idx + 1],
+                s.top_k[slot_idx:slot_idx + 1],
+                s.key[slot_idx:slot_idx + 1], count - 1)
+            s.key = s.key.at[slot_idx].set(new_key[0])
+            slot.prefill_done += count
+            t = int(tok[0])
+            try:
+                self._append_generated(slot, t)
+            except OutOfPages:
+                out.append(StepOutput(slot.seq_id, t, float(logp[0]),
+                                      FinishReason.ERROR))
+                self._free_slot(slot_idx)
+                return True
+            slot.cum_logprob += float(logp[0])
+            fin = self._finish_reason(slot, t)
+            out.append(StepOutput(slot.seq_id, t, slot.cum_logprob, fin,
+                                  prompt_tokens=len(prompt)))
+            if fin is not None:
+                self._free_slot(slot_idx)
+        else:
+            fn = self._prefill_fns(C, S, last=False)
+            self.k_pool, self.v_pool = fn(*args)
+            slot.prefill_done += count
+        return True
+
+    def _append_generated(self, slot: _Slot, token: int) -> None:
+        slot.generated += 1
+        slot.last_token = token
+        self.pool.extend(slot.seq_id, [token])
+
+    def _finish_reason(self, slot: _Slot, token: int) -> Optional[FinishReason]:
+        req = slot.request
+        if not req.stop.ignore_eos:
+            eos = set(req.eos_token_ids) | set(req.stop.stop_token_ids)
+            if token in eos and slot.generated >= (req.stop.min_tokens or 0):
+                return FinishReason.EOS
+        if req.stop.max_tokens and slot.generated >= req.stop.max_tokens:
+            return FinishReason.LENGTH
+        if len(slot.prompt) + slot.generated >= self.cfg.max_context:
+            return FinishReason.LENGTH
+        return None
+
+    # ------------------------------------------------------------------
+    def _decode_step(self) -> List[StepOutput]:
+        B = self.cfg.max_batch
+        # only fully-prefilled slots decode; mid-prefill slots keep their
+        # lanes masked (scratch writes) until their prompt is in cache
+        active = [(i, s) for i, s in enumerate(self.slots)
+                  if s is not None and s.prefill_done >= len(s.prompt)]
+        if not active:
+            return []
+        max_len = max(len(s.prompt) + s.generated for _, s in active)
+        S = self._bucket(max_len, self.s_buckets)
+
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        write_idx = np.zeros(B, np.int32)   # inactive lanes -> scratch page 0
+        read_idx = np.zeros((B, S), np.int32)
+        read_pos = np.zeros((B, S), np.int32)
+        read_valid = np.zeros((B, S), bool)
+
+        # The input token this step is slot.last_token at position n-1 (its KV
+        # was accounted by _append_generated but not yet written to the pool —
+        # the write happens inside this step's forward).
+        for i, slot in active:
+            n = len(slot.prompt) + slot.generated
+            tokens[i] = slot.last_token
+            positions[i] = n - 1
+            write_idx[i] = self.pool.write_slots(slot.seq_id, n - 1, 1)[0]
+            r_s, r_p, r_v = self.pool.read_slots(slot.seq_id, n, S)
+            read_idx[i], read_pos[i], read_valid[i] = r_s, r_p, r_v
+
+        s = self.sampling
+        fn = self._decode_fn(S)
+        tok, logp, new_key, self.k_pool, self.v_pool = fn(
+            self.params, tokens, positions, self.k_pool, self.v_pool,
+            write_idx, read_idx, read_pos, read_valid,
+            s.temperature, s.top_p, s.top_k, s.key)
+        s.key = new_key
+        tok_np = np.asarray(tok)
+        logp_np = np.asarray(logp)
+
+        outs: List[StepOutput] = []
+        for i, slot in active:
+            t = int(tok_np[i])
+            try:
+                self._append_generated(slot, t)
+            except OutOfPages:
+                # capacity failure is an ERROR, not a length finish — the
+                # client must be able to tell truncation from completion
+                outs.append(StepOutput(slot.seq_id, t, slot.cum_logprob,
+                                       FinishReason.ERROR))
+                self._free_slot(i)
+                continue
+            slot.cum_logprob += float(logp_np[i])
+            fin = self._finish_reason(slot, t)
+            outs.append(StepOutput(slot.seq_id, t, slot.cum_logprob, fin))
+            if fin is not None:
+                self._free_slot(i)
+        return outs
+
+
+def _has_safetensors(path: str) -> bool:
+    import glob
+    import os
+
+    return bool(glob.glob(os.path.join(path, "*.safetensors")))
+
+
+# ---------------------------------------------------------------------------
+# Async facade
+# ---------------------------------------------------------------------------
+
+class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
+    """AsyncEngine facade: one background engine thread runs EngineCore."""
+
+    def __init__(self, cfg: JaxEngineConfig,
+                 devices: Optional[List[jax.Device]] = None):
+        self.core = EngineCore(cfg, devices)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._inbox: thread_queue.Queue = thread_queue.Queue()
+        self._wake = threading.Event()
+        self._running = True
+        self._thread = threading.Thread(target=self._run, name="jax-engine",
+                                        daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    def _run(self) -> None:
+        while self._running:
+            moved = False
+            while True:
+                try:
+                    kind, seq_id, payload = self._inbox.get_nowait()
+                except thread_queue.Empty:
+                    break
+                moved = True
+                if kind == "submit":
+                    self.core.submit(seq_id, payload)
+                elif kind == "cancel":
+                    self.core.cancel(seq_id)
+            if not self.core.has_work:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            try:
+                outs = self.core.step()
+            except Exception:  # engine must never die silently
+                log.exception("engine step failed")
+                outs = [StepOutput(sid, 0, 0.0, FinishReason.ERROR)
+                        for sid in list(self.core.by_seq)]
+                for sid in list(self.core.by_seq):
+                    self.core.cancel(sid)
+                self.core._reap_cancelled()
+            for so in outs:
+                try:
+                    self._deliver(so)
+                except Exception:  # closed loop etc. must not kill the thread
+                    log.exception("failed to deliver step output")
+            if not outs and not self.core.by_seq:
+                # waiting requests that can't be admitted yet: don't busy-spin
+                self._wake.wait(timeout=0.02)
+                self._wake.clear()
+
+    def _deliver(self, so: StepOutput) -> None:
+        loop = self._loop
+        if loop is None:
+            return
+        q = self._queues.get(so.seq_id)
+        if q is not None:
+            loop.call_soon_threadsafe(q.put_nowait, so)
+
+    # ------------------------------------------------------------------
+    async def generate(self, request: BackendInput,
+                       context: Context) -> AsyncIterator[EngineOutput]:
+        self._loop = asyncio.get_running_loop()
+        seq_id = context.id
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[seq_id] = q
+        self._inbox.put(("submit", seq_id, request))
+        self._wake.set()
+
+        async def watch_cancel():
+            await context.stopped()
+            self._inbox.put(("cancel", seq_id, None))
+            self._wake.set()
+
+        cancel_task = asyncio.ensure_future(watch_cancel())
+        try:
+            while True:
+                so: StepOutput = await q.get()
+                if so.finish == FinishReason.ERROR:
+                    yield EngineOutput(token_ids=[], finish_reason=FinishReason.ERROR)
+                    return
+                yield EngineOutput(
+                    token_ids=[so.token],
+                    cum_log_prob=so.logprob,
+                    finish_reason=so.finish,
+                )
+                if so.finish is not None:
+                    return
+        finally:
+            cancel_task.cancel()
+            self._queues.pop(seq_id, None)
+            self._inbox.put(("cancel", seq_id, None))
+            self._wake.set()
+
+    # ------------------------------------------------------------------
+    def shutdown(self) -> None:
+        self._running = False
+        self._wake.set()
+        self._thread.join(timeout=5)

@@ -1,0 +1,121 @@
+"""Checkpoint loading: HF safetensors -> sharded stacked param pytree.
+
+Maps the HF LlamaForCausalLM parameter names onto our stacked-layer layout
+(llama.init_params structure) and device_puts each tensor directly into its
+NamedSharding — per-shard placement, no full-model host copy beyond the
+memory-mapped safetensors views.
+
+Reference capability: the model-weight fast path noted in SURVEY §5.4
+(safetensors -> sharded jax arrays is the only 'resume'-like path).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import LlamaConfig
+
+
+def _open_all(path: str) -> Dict[str, Any]:
+    """tensor name -> (file, slice accessor) across all shards."""
+    from safetensors import safe_open
+
+    tensors: Dict[str, Any] = {}
+    for fn in sorted(glob.glob(os.path.join(path, "*.safetensors"))):
+        f = safe_open(fn, framework="numpy")
+        for name in f.keys():
+            tensors[name] = f
+    return tensors
+
+
+def _get(tensors: Dict[str, Any], name: str) -> np.ndarray:
+    t = tensors[name].get_tensor(name)
+    if t.dtype == np.uint16:  # bf16 stored raw
+        t = t.view(jnp.bfloat16)
+    return t
+
+
+def load_llama_params(path: str, cfg: LlamaConfig,
+                      shardings: Dict[str, Any]) -> Dict[str, Any]:
+    tensors = _open_all(path)
+    L, D, Hq, Hkv, Dh = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
+                         cfg.num_kv_heads, cfg.head_dim)
+    pfx = "model." if any(k.startswith("model.") for k in tensors) else ""
+
+    def lay(i: int, name: str) -> np.ndarray:
+        return _get(tensors, f"{pfx}layers.{i}.{name}.weight")
+
+    def stack(name: str, transform) -> np.ndarray:
+        return np.stack([transform(lay(i, name)) for i in range(L)])
+
+    dt = cfg.dtype
+    # HF Linear stores [out, in]; our layout is [in, ...out...]
+    params: Dict[str, Any] = {
+        "embed": _get(tensors, f"{pfx}embed_tokens.weight").astype(dt),
+        "layers": {
+            "ln1": stack("input_layernorm",
+                         lambda w: w.astype(np.float32)).reshape(L, D),
+            "ln2": stack("post_attention_layernorm",
+                         lambda w: w.astype(np.float32)).reshape(L, D),
+            "wq": stack("self_attn.q_proj",
+                        lambda w: w.astype(dt).T.reshape(D, Hq, Dh)),
+            "wk": stack("self_attn.k_proj",
+                        lambda w: w.astype(dt).T.reshape(D, Hkv, Dh)),
+            "wv": stack("self_attn.v_proj",
+                        lambda w: w.astype(dt).T.reshape(D, Hkv, Dh)),
+            "wo": stack("self_attn.o_proj",
+                        lambda w: w.astype(dt).T.reshape(Hq, Dh, D)),
+            "wg": stack("mlp.gate_proj", lambda w: w.astype(dt).T),
+            "wu": stack("mlp.up_proj", lambda w: w.astype(dt).T),
+            "wd": stack("mlp.down_proj", lambda w: w.astype(dt).T),
+        },
+        "final_norm": _get(tensors, f"{pfx}norm.weight").astype(np.float32),
+    }
+    if not cfg.tie_embeddings:
+        head = ("lm_head.weight" if "lm_head.weight" in tensors
+                else f"{pfx}lm_head.weight")
+        params["lm_head"] = _get(tensors, head).astype(dt).T
+
+    return jax.tree.map(lambda a, s: jax.device_put(a, s), params, shardings)
+
+
+def save_llama_params(path: str, params: Dict[str, Any], cfg: LlamaConfig) -> None:
+    """Write params back out in HF layout (used by tests to round-trip)."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    # safetensors writes the raw buffer: every transposed view MUST be made
+    # contiguous first or the transpose is silently lost
+    C = np.ascontiguousarray
+    L, D, Hq, Hkv, Dh = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
+                         cfg.num_kv_heads, cfg.head_dim)
+    lp = params["layers"]
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    for i in range(L):
+        p = f"model.layers.{i}."
+        out[p + "input_layernorm.weight"] = np.asarray(lp["ln1"][i], np.float32)
+        out[p + "post_attention_layernorm.weight"] = np.asarray(lp["ln2"][i], np.float32)
+        out[p + "self_attn.q_proj.weight"] = C(np.asarray(
+            lp["wq"][i], np.float32).reshape(D, Hq * Dh).T)
+        out[p + "self_attn.k_proj.weight"] = C(np.asarray(
+            lp["wk"][i], np.float32).reshape(D, Hkv * Dh).T)
+        out[p + "self_attn.v_proj.weight"] = C(np.asarray(
+            lp["wv"][i], np.float32).reshape(D, Hkv * Dh).T)
+        out[p + "self_attn.o_proj.weight"] = C(np.asarray(
+            lp["wo"][i], np.float32).reshape(Hq * Dh, D).T)
+        out[p + "mlp.gate_proj.weight"] = C(np.asarray(lp["wg"][i], np.float32).T)
+        out[p + "mlp.up_proj.weight"] = C(np.asarray(lp["wu"][i], np.float32).T)
+        out[p + "mlp.down_proj.weight"] = C(np.asarray(lp["wd"][i], np.float32).T)
+    if "lm_head" in params:
+        out["lm_head.weight"] = C(np.asarray(params["lm_head"], np.float32).T)
+    save_file(out, os.path.join(path, "model.safetensors"))

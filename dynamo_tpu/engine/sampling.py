@@ -1,0 +1,74 @@
+"""Batched token sampling inside jit.
+
+One static-shaped sampler covers all slots: per-slot temperature/top-k/top-p
+vectors select behavior lane-wise (greedy lanes use argmax; sampling lanes use
+temperature + nucleus/top-k restricted to a static K window — restriction to
+the top-K=64 candidates is exact for top-k<=64 and a standard approximation
+for pure top-p, since mass beyond the top-64 logits is negligible for LLMs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+STATIC_K = 64
+
+
+@dataclass
+class SamplingState:
+    """Per-slot device vectors (length = max_batch)."""
+
+    temperature: jax.Array  # f32, 0 => greedy
+    top_p: jax.Array        # f32 in (0,1], 1 => off
+    top_k: jax.Array        # i32, 0 => off (capped at STATIC_K)
+    key: jax.Array          # [B] typed PRNG keys (new-style jax.random.key)
+
+    @classmethod
+    def host_init(cls, max_batch: int) -> "SamplingState":
+        return cls(
+            temperature=np.zeros(max_batch, np.float32),
+            top_p=np.ones(max_batch, np.float32),
+            top_k=np.zeros(max_batch, np.int32),
+            key=jax.random.split(jax.random.key(0), max_batch),
+        )
+
+
+def sample(logits: jax.Array, temperature: jax.Array, top_p: jax.Array,
+           top_k: jax.Array, key: jax.Array
+           ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """logits [B,V] f32 -> (tokens [B] i32, logprob [B] f32, new_keys [B]).
+
+    Greedy lanes (temperature==0) take argmax; others sample within the
+    top-STATIC_K window with temperature, then top-k/top-p masks.
+    """
+    B, V = logits.shape
+    greedy_tok = jnp.argmax(logits, axis=-1)
+
+    vals, idxs = jax.lax.top_k(logits, STATIC_K)  # [B,K]
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = vals / temp
+    probs = jax.nn.softmax(scaled, axis=-1)
+    # top-k mask (0 => off)
+    karr = jnp.where(top_k[:, None] > 0, top_k[:, None], STATIC_K)
+    kmask = jnp.arange(STATIC_K)[None, :] < karr
+    # top-p (nucleus) mask over the sorted window: keep the smallest prefix
+    # with cumulative mass >= top_p (always keep the first candidate)
+    cum = jnp.cumsum(probs, axis=-1)
+    pmask = (cum - probs) < top_p[:, None]
+    mask = kmask & pmask
+    masked = jnp.where(mask, scaled, -jnp.inf)
+
+    split = jax.vmap(lambda k: jax.random.split(k, 2))(key)  # [B,2] typed
+    new_keys, sub = split[:, 0], split[:, 1]
+    draw = jax.vmap(jax.random.categorical)(sub, masked)
+    sampled_tok = jnp.take_along_axis(idxs, draw[:, None], axis=-1)[:, 0]
+
+    token = jnp.where(temperature <= 0.0, greedy_tok, sampled_tok)
+    logp_all = jax.nn.log_softmax(logits, axis=-1)
+    logprob = jnp.take_along_axis(logp_all, token[:, None], axis=-1)[:, 0]
+    return token.astype(jnp.int32), logprob, new_keys

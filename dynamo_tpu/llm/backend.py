@@ -71,7 +71,9 @@ class Backend(AsyncEngine[BackendInput, EngineOutput]):
                     if jail:
                         text_parts.append(jail)
             text = "".join(text_parts)
-            if text or finish is not None:
+            # always yield (even with empty text) so downstream usage
+            # accounting sees every generated token id
+            if text or finish is not None or out.token_ids:
                 yield EngineOutput(
                     token_ids=out.token_ids,
                     text=text,

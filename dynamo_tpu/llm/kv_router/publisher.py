@@ -1,0 +1,120 @@
+"""Worker-side KV event publishing.
+
+``KvEventPublisher`` bridges the engine's page-pool hooks (block sealed /
+blocks freed) to the event plane without ever stalling the engine step loop:
+events go into an unbounded in-memory queue; a background task drains and
+publishes. The transport is pluggable (in-process bus for tests, the
+distributed runtime's event plane in deployment).
+
+Reference capability: lib/llm/src/kv_router/publisher.rs:32-60 (mpsc ->
+NATS), and the C-ABI publish path (lib/bindings/c) that engines call.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Awaitable, Callable, List, Optional
+
+from ..tokens import TokenBlock
+from .protocols import (
+    KvCacheEvent,
+    KvRemovedEvent,
+    KvStoredEvent,
+    RouterEvent,
+    StoredBlock,
+)
+
+PublishFn = Callable[[str, dict], Awaitable[None]]
+
+
+class KvEventPublisher:
+    """Thread-safe producer, asyncio consumer.
+
+    The engine thread calls ``block_stored``/``blocks_removed`` (cheap, no IO);
+    ``run`` drains and hands RouterEvents to the transport publish function.
+    """
+
+    def __init__(self, worker_id: int, publish: PublishFn,
+                 subject: str = "kv_events"):
+        self.worker_id = worker_id
+        self.subject = subject
+        self._publish = publish
+        self._event_id = 0
+        self._buf: List[KvCacheEvent] = []
+        self._lock = threading.Lock()
+        self._task: Optional[asyncio.Task] = None
+        self._wake: Optional[asyncio.Event] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self.published = 0
+
+    # -- engine-thread side (hooks for PagePool) ------------------------
+    def block_stored(self, seq_id: str, block: TokenBlock, page: int) -> None:
+        ev = KvCacheEvent(
+            event_id=self._next_id(),
+            stored=KvStoredEvent(
+                blocks=[StoredBlock(block_hash=block.sequence_hash,
+                                    tokens_hash=block.block_hash)],
+                parent_hash=block.parent_sequence_hash,
+            ))
+        self._push(ev)
+
+    def blocks_removed(self, seq_id: str, blocks: List[TokenBlock]) -> None:
+        ev = KvCacheEvent(
+            event_id=self._next_id(),
+            removed=KvRemovedEvent(
+                block_hashes=[b.sequence_hash for b in blocks]))
+        self._push(ev)
+
+    def _next_id(self) -> int:
+        with self._lock:
+            self._event_id += 1
+            return self._event_id
+
+    def _push(self, ev: KvCacheEvent) -> None:
+        with self._lock:
+            self._buf.append(ev)
+        wake, loop = self._wake, self._loop
+        if wake is not None and loop is not None:
+            try:
+                loop.call_soon_threadsafe(wake.set)
+            except RuntimeError:
+                pass  # loop closed; the 0.2s poll in _run still drains
+
+    # -- asyncio side ---------------------------------------------------
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._wake = asyncio.Event()
+        self._task = asyncio.create_task(self._run(), name="kv-event-pub")
+
+    async def stop(self) -> None:
+        if self._task:
+            await self.flush()
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def flush(self) -> None:
+        await self._drain()
+
+    async def _drain(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        for ev in batch:
+            await self._publish(
+                self.subject,
+                RouterEvent(self.worker_id, ev).to_dict())
+            self.published += 1
+
+    async def _run(self) -> None:
+        assert self._wake is not None
+        while True:
+            await self._drain()
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout=0.2)
+                self._wake.clear()
+            except asyncio.TimeoutError:
+                pass

@@ -1,0 +1,128 @@
+"""KvScheduler: pick the worker for a tokenized request.
+
+Default cost (reference formula, kv_router/scheduler.rs:92-205):
+
+    logit = 2.0 * overlap_blocks_norm - cache_usage - normalized_active_slots
+
+highest logit wins; ties break randomly; if every candidate is saturated the
+request waits for capacity. The selector is pluggable (CustomWorkerSelector
+override point, components/router/src/main.rs:36-95).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .indexer import OverlapScores
+from .protocols import ForwardPassMetrics, KVHitRateEvent
+
+
+@dataclass
+class WorkerSnapshot:
+    worker_id: int
+    metrics: ForwardPassMetrics
+
+
+@dataclass
+class ProcessedEndpoints:
+    """Aggregated view of live workers (from the metrics aggregator)."""
+
+    workers: Dict[int, ForwardPassMetrics] = field(default_factory=dict)
+
+    @property
+    def load_avg(self) -> float:
+        if not self.workers:
+            return 0.0
+        vals = [m.request_active_slots for m in self.workers.values()]
+        return sum(vals) / len(vals)
+
+    @property
+    def load_std(self) -> float:
+        if not self.workers:
+            return 0.0
+        avg = self.load_avg
+        vals = [m.request_active_slots for m in self.workers.values()]
+        return (sum((v - avg) ** 2 for v in vals) / len(vals)) ** 0.5
+
+
+WorkerSelector = Callable[
+    [Sequence[int], int, OverlapScores, ProcessedEndpoints],
+    Optional[int]]
+
+
+def default_selector(tokens: Sequence[int], block_size: int,
+                     overlaps: OverlapScores,
+                     endpoints: ProcessedEndpoints,
+                     rng: Optional[random.Random] = None) -> Optional[int]:
+    """The 2*overlap - usage - load cost; None => no capacity anywhere."""
+    rng = rng or random
+    isl_blocks = max(1, len(tokens) // block_size)
+    best: List[int] = []
+    best_logit = None
+    for wid, m in endpoints.workers.items():
+        if (m.request_total_slots
+                and m.request_active_slots >= m.request_total_slots
+                and m.num_requests_waiting > 0):
+            continue  # saturated
+        overlap = overlaps.scores.get(wid, 0)
+        logit = (2.0 * (overlap / isl_blocks)
+                 - m.cache_usage
+                 - (m.request_active_slots / m.request_total_slots
+                    if m.request_total_slots else 0.0))
+        if best_logit is None or logit > best_logit + 1e-9:
+            best, best_logit = [wid], logit
+        elif abs(logit - best_logit) <= 1e-9:
+            best.append(wid)
+    if not best:
+        return None
+    return rng.choice(best)
+
+
+class KvScheduler:
+    """Combines overlap scores + live endpoint metrics into a decision; emits
+    KVHitRateEvent telemetry for each routed request."""
+
+    def __init__(self, block_size: int,
+                 selector: Optional[WorkerSelector] = None,
+                 on_hit_rate: Optional[Callable[[KVHitRateEvent], None]] = None):
+        self.block_size = block_size
+        self.selector = selector
+        self.on_hit_rate = on_hit_rate
+        self.endpoints = ProcessedEndpoints()
+
+    def update_endpoints(self, workers: Dict[int, ForwardPassMetrics]) -> None:
+        self.endpoints = ProcessedEndpoints(dict(workers))
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.endpoints.workers.pop(worker_id, None)
+
+    def schedule(self, tokens: Sequence[int],
+                 overlaps: OverlapScores) -> Optional[int]:
+        if self.selector is not None:
+            wid = self.selector(tokens, self.block_size, overlaps, self.endpoints)
+        else:
+            wid = default_selector(tokens, self.block_size, overlaps,
+                                   self.endpoints)
+        if wid is not None and self.on_hit_rate:
+            self.on_hit_rate(KVHitRateEvent(
+                worker_id=wid,
+                isl_blocks=len(tokens) // self.block_size,
+                overlap_blocks=overlaps.scores.get(wid, 0)))
+        return wid
+
+    async def schedule_or_wait(self, tokens: Sequence[int],
+                               overlaps: OverlapScores,
+                               poll_s: float = 0.05,
+                               timeout_s: float = 30.0) -> int:
+        """Wait for capacity when all workers are saturated."""
+        deadline = asyncio.get_event_loop().time() + timeout_s
+        while True:
+            wid = self.schedule(tokens, overlaps)
+            if wid is not None:
+                return wid
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError("no worker capacity")
+            await asyncio.sleep(poll_s)

@@ -1,0 +1,68 @@
+"""JSONL record/replay of event streams.
+
+Capture any dict-event stream (KV events, router decisions) to a JSONL file
+with timestamps, and replay it later — deterministic router tests and offline
+analysis. Reference capability: lib/llm/src/recorder.rs:38-291 + KvRecorder.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+class Recorder:
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a")
+        self.count = 0
+
+    def record(self, event: Dict[str, Any]) -> None:
+        self._f.write(json.dumps({"ts": time.time(), "event": event}) + "\n")
+        self.count += 1
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "Recorder":
+        return self
+
+    def __exit__(self, *a) -> None:
+        self.close()
+
+
+def replay(path: str, speed: Optional[float] = None
+           ) -> Iterator[Dict[str, Any]]:
+    """Yield recorded events; ``speed`` (e.g. 1.0) reproduces original pacing,
+    None replays as fast as possible."""
+    prev_ts: Optional[float] = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if speed and prev_ts is not None:
+                delta = (rec["ts"] - prev_ts) / speed
+                if delta > 0:
+                    time.sleep(delta)
+            prev_ts = rec["ts"]
+            yield rec["event"]
+
+
+class KvRecorder(Recorder):
+    """Recorder wired as a KV event publish function."""
+
+    async def publish(self, subject: str, payload: Dict[str, Any]) -> None:
+        self.record({"subject": subject, "payload": payload})
+
+    def replay_into(self, apply: Callable[[Dict[str, Any]], None]) -> int:
+        n = 0
+        for ev in replay(self.path):
+            apply(ev["payload"])
+            n += 1
+        return n

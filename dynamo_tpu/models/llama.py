@@ -1,0 +1,299 @@
+"""Llama model family (Llama 2/3/3.x, DeepSeek-R1-Distill-Llama) — functional
+JAX implementation built for paged-KV serving.
+
+Design (TPU-first, not a torch translation):
+- Params are a plain pytree of stacked per-layer weights; sharding is declared
+  once as PartitionSpecs (tp over heads / ffn) and applied with NamedSharding —
+  XLA inserts all collectives.
+- The KV cache is a flat paged pool ([L, N_tokens_pool, H_kv, D_h]); sequences
+  own pages via integer page tables. Writes are scatters at token indices,
+  reads are gathers — both static-shaped so every step compiles once.
+- One forward function serves both prefill chunks (T>1) and decode (T=1):
+  write-then-gather with a causal+length mask. Static shapes everywhere
+  (bucketed T and S) per XLA's compile-once model.
+- bf16 weights/activations, fp32 norms/softmax/logits (MXU-friendly).
+
+Reference capability equivalent: the in-engine model executed by vLLM/TRT-LLM
+behind the reference's engine adapters (SURVEY §2.1, §7 step 3).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import AXIS_TP
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    intermediate_size: int = 14336
+    rope_theta: float = 500000.0
+    rope_scaling: Optional[Dict[str, Any]] = None
+    rms_eps: float = 1e-5
+    max_position: int = 8192
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def from_hf_config(cls, cfg: Dict[str, Any], dtype=jnp.bfloat16) -> "LlamaConfig":
+        """Map a HF ``config.json`` (LlamaForCausalLM family) onto ours."""
+        return cls(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=cfg["num_attention_heads"],
+            num_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+            head_dim=cfg.get("head_dim",
+                             cfg["hidden_size"] // cfg["num_attention_heads"]),
+            intermediate_size=cfg["intermediate_size"],
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rope_scaling=cfg.get("rope_scaling"),
+            rms_eps=cfg.get("rms_norm_eps", 1e-5),
+            max_position=cfg.get("max_position_embeddings", 8192),
+            tie_embeddings=cfg.get("tie_word_embeddings", False),
+            dtype=dtype,
+        )
+
+
+# test/bench presets (shapes only; weights are random or loaded)
+PRESETS: Dict[str, Dict[str, Any]] = {
+    # tiny model over the byte tokenizer vocab — the hermetic test model
+    "tiny-byte": dict(vocab_size=259, hidden_size=64, num_layers=2, num_heads=4,
+                      num_kv_heads=2, head_dim=16, intermediate_size=128,
+                      rope_theta=10000.0, max_position=1024),
+    "llama-3.2-1b": dict(vocab_size=128256, hidden_size=2048, num_layers=16,
+                         num_heads=32, num_kv_heads=8, head_dim=64,
+                         intermediate_size=8192, rope_theta=500000.0,
+                         max_position=131072, tie_embeddings=True),
+    "llama-3-8b": dict(vocab_size=128256, hidden_size=4096, num_layers=32,
+                       num_heads=32, num_kv_heads=8, head_dim=128,
+                       intermediate_size=14336, rope_theta=500000.0,
+                       max_position=8192),
+    "llama-3-70b": dict(vocab_size=128256, hidden_size=8192, num_layers=80,
+                        num_heads=64, num_kv_heads=8, head_dim=128,
+                        intermediate_size=28672, rope_theta=500000.0,
+                        max_position=8192),
+}
+
+
+def preset(name: str, **overrides) -> LlamaConfig:
+    d = dict(PRESETS[name])
+    d.update(overrides)
+    return LlamaConfig(**d)
+
+
+# ---------------------------------------------------------------------------
+# Params: init + shardings
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    """Random-init params (testing/benching without checkpoint files)."""
+    D, Hq, Hkv, Dh, F, L, V = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                               cfg.head_dim, cfg.intermediate_size,
+                               cfg.num_layers, cfg.vocab_size)
+    ks = jax.random.split(key, 10)
+    s = lambda *shape: 1.0 / math.sqrt(shape[0])
+
+    def norm(k, *shape):
+        return (jax.random.normal(k, shape, jnp.float32) * s(*shape)).astype(cfg.dtype)
+
+    params = {
+        "embed": norm(ks[0], V, D),
+        "layers": {
+            "ln1": jnp.ones((L, D), jnp.float32),
+            "ln2": jnp.ones((L, D), jnp.float32),
+            "wq": norm(ks[1], L, D, Hq * Dh).reshape(L, D, Hq, Dh),
+            "wk": norm(ks[2], L, D, Hkv * Dh).reshape(L, D, Hkv, Dh),
+            "wv": norm(ks[3], L, D, Hkv * Dh).reshape(L, D, Hkv, Dh),
+            "wo": norm(ks[4], L, Hq * Dh, D).reshape(L, Hq, Dh, D),
+            "wg": norm(ks[5], L, D, F),
+            "wu": norm(ks[6], L, D, F),
+            "wd": norm(ks[7], L, F, D),
+        },
+        "final_norm": jnp.ones((D,), jnp.float32),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(ks[8], D, V)
+    return params
+
+
+def param_specs(cfg: LlamaConfig, tp_size: int = 1) -> Dict[str, Any]:
+    """PartitionSpecs: tp shards attention heads and the ffn dimension.
+    KV projections replicate when GQA kv_heads aren't divisible by tp.
+    (vocab/embed replicated — vocab-sharding is a later optimization.)"""
+    tp = AXIS_TP
+    kv = tp if cfg.num_kv_heads % max(tp_size, 1) == 0 else None
+    specs = {
+        "embed": P(None, None),
+        "layers": {
+            "ln1": P(None, None),
+            "ln2": P(None, None),
+            "wq": P(None, None, tp, None),
+            "wk": P(None, None, kv, None),
+            "wv": P(None, None, kv, None),
+            "wo": P(None, tp, None, None),
+            "wg": P(None, None, tp),
+            "wu": P(None, None, tp),
+            "wd": P(None, tp, None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, None)
+    return specs
+
+
+def validate_tp(cfg: LlamaConfig, tp: int) -> None:
+    if cfg.num_heads % tp:
+        raise ValueError(f"num_heads {cfg.num_heads} not divisible by tp={tp}")
+    if cfg.intermediate_size % tp:
+        raise ValueError(f"ffn {cfg.intermediate_size} not divisible by tp={tp}")
+
+
+def kv_cache_spec(cfg: LlamaConfig, tp: int) -> P:
+    """KV pool sharding: shard kv heads over tp when divisible, else
+    replicate (GQA with kv_heads < tp)."""
+    if cfg.num_kv_heads % tp == 0:
+        return P(None, None, AXIS_TP, None)
+    return P(None, None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# Ops
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale * w).astype(x.dtype)
+
+
+def _rope_inv_freq(cfg: LlamaConfig) -> np.ndarray:
+    Dh = cfg.head_dim
+    inv = 1.0 / (cfg.rope_theta ** (np.arange(0, Dh, 2, dtype=np.float64) / Dh))
+    rs = cfg.rope_scaling or {}
+    if rs.get("rope_type") == "llama3" or rs.get("type") == "llama3":
+        # llama3 frequency-dependent NTK-style scaling
+        factor = rs.get("factor", 8.0)
+        lo = rs.get("low_freq_factor", 1.0)
+        hi = rs.get("high_freq_factor", 4.0)
+        orig = rs.get("original_max_position_embeddings", 8192)
+        wavelen = 2 * np.pi / inv
+        ratio = orig / wavelen
+        smooth = np.clip((ratio - lo) / (hi - lo), 0.0, 1.0)
+        scaled = np.where(ratio < lo, inv / factor,
+                          np.where(ratio > hi, inv,
+                                   (1 - smooth) * inv / factor + smooth * inv))
+        inv = scaled
+    return inv.astype(np.float32)
+
+
+def rope_tables(cfg: LlamaConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables for given integer positions [...]: -> [..., Dh/2]."""
+    inv = jnp.asarray(_rope_inv_freq(cfg))
+    ang = positions[..., None].astype(jnp.float32) * inv
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [..., H, Dh]; cos/sin: [..., Dh/2] (broadcast over H)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c = cos[..., None, :]
+    s = sin[..., None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+NEG_INF = -1e30
+
+
+def attend(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array) -> jax.Array:
+    """GQA attention. q: [B,T,Hq,Dh]; k,v: [B,S,Hkv,Dh]; mask: [B,T,S] bool
+    (True = attend). Returns [B,T,Hq,Dh]. fp32 softmax."""
+    B, T, Hq, Dh = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, T, Hkv, G, Dh)
+    scores = jnp.einsum("bthgd,bshd->bhgts", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(Dh)
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgts,bshd->bthgd", w.astype(v.dtype), v)
+    return out.reshape(B, T, Hq, Dh)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def forward(params: Dict[str, Any], cfg: LlamaConfig,
+            tokens: jax.Array,           # [B, T] int32 (decode: T=1)
+            positions: jax.Array,        # [B, T] int32 position of each token
+            k_pool: jax.Array,           # [L, N_pool, Hkv, Dh] paged KV pool
+            v_pool: jax.Array,
+            write_idx: jax.Array,        # [B, T] int32 pool token-slot per new token
+            read_idx: jax.Array,         # [B, S] int32 pool token-slots to attend over
+            read_pos: jax.Array,         # [B, S] int32 position of each read slot
+            read_valid: jax.Array,       # [B, S] bool slot holds a real token
+            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One forward pass over a token chunk against the paged KV pool.
+
+    The new chunk's K/V are scattered into the pool at ``write_idx`` first;
+    attention then gathers ``read_idx`` (which must cover the chunk itself)
+    and masks causally by position: token at position p attends to slots with
+    ``read_pos <= p``. Works for prefill chunks and single-token decode alike.
+
+    Returns (logits [B, T, vocab] fp32, k_pool, v_pool).
+    """
+    B, T = tokens.shape
+    lp = params["layers"]
+    x = params["embed"][tokens]  # [B,T,D] bf16
+    cos, sin = rope_tables(cfg, positions)
+    # causal/validity mask [B,T,S]
+    mask = read_valid[:, None, :] & (read_pos[:, None, :] <= positions[:, :, None])
+
+    for l in range(cfg.num_layers):
+        h = rms_norm(x, lp["ln1"][l], cfg.rms_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"][l])
+        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"][l])
+        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"][l])
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # scatter chunk KV into the pool (write-then-gather)
+        flat_w = write_idx.reshape(-1)
+        k_pool = k_pool.at[l, flat_w].set(k.reshape(B * T, *k.shape[2:]))
+        v_pool = v_pool.at[l, flat_w].set(v.reshape(B * T, *v.shape[2:]))
+        # gather this sequence's context
+        k_ctx = k_pool[l][read_idx]  # [B,S,Hkv,Dh]
+        v_ctx = v_pool[l][read_idx]
+        attn = attend(q, k_ctx, v_ctx, mask)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"][l])
+        h2 = rms_norm(x, lp["ln2"][l], cfg.rms_eps)
+        g = jnp.einsum("btd,df->btf", h2, lp["wg"][l])
+        u = jnp.einsum("btd,df->btf", h2, lp["wu"][l])
+        x = x + jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u, lp["wd"][l])
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", x, head.astype(x.dtype))
+    return logits.astype(jnp.float32), k_pool, v_pool

@@ -1,0 +1,84 @@
+"""Device mesh and sharding conventions.
+
+One place defines the axis names used everywhere:
+
+- ``dp``  — data parallel (independent decode batches / replicas in one proc)
+- ``tp``  — tensor parallel: attention heads / ffn dim over ICI
+- ``sp``  — sequence/context parallel for long prefill (ring attention axis)
+- ``ep``  — expert parallel (MoE layers)
+- ``pp``  — pipeline stages (inter-slice over DCN, optional)
+
+The serving engine usually runs a 1-D ``tp`` mesh per replica; the runtime
+scales replicas (the reference's data parallelism is worker replication, not
+an in-engine axis). ``dryrun`` builds the full 4-D mesh to validate shardings.
+
+TPU-native stance: shardings are declared with NamedSharding/PartitionSpec and
+XLA inserts the collectives (scaling-book recipe) — no hand-written NCCL-style
+calls anywhere.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DP = "dp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+AXIS_PP = "pp"
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.tp * self.sp * self.ep * self.pp
+
+
+def make_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if cfg.size > len(devices):
+        raise ValueError(f"mesh needs {cfg.size} devices, have {len(devices)}")
+    devs = np.array(devices[: cfg.size]).reshape(cfg.pp, cfg.dp, cfg.ep, cfg.sp, cfg.tp)
+    return Mesh(devs, (AXIS_PP, AXIS_DP, AXIS_EP, AXIS_SP, AXIS_TP))
+
+
+def tp_mesh(tp: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The common serving mesh: 1-D tensor parallel."""
+    devices = list(devices if devices is not None else jax.devices())
+    devs = np.array(devices[:tp]).reshape(tp)
+    return Mesh(devs, (AXIS_TP,))
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    # drop axis names the mesh doesn't have (lets one spec serve 1-D and 4-D)
+    names = set(mesh.axis_names)
+
+    def keep(s):
+        if s is None:
+            return None
+        if isinstance(s, (tuple, list)):
+            kept = tuple(x for x in s if x in names)
+            return kept if kept else None
+        return s if s in names else None
+
+    return NamedSharding(mesh, P(*(keep(s) for s in spec)))
+
+
+def shard_divisible(n: int, mesh: Mesh, axis: str) -> bool:
+    """Can dimension ``n`` be sharded over mesh axis ``axis``?"""
+    if axis not in mesh.axis_names:
+        return False
+    return n % mesh.shape[axis] == 0

@@ -8,7 +8,9 @@ import os
 import sys
 
 # Must be set before jax import: 8 virtual CPU devices for sharding tests.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# (The driver environment pre-sets JAX_PLATFORMS=axon — the real TPU — so this
+# must override, not setdefault: tests are CPU-only by design.)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -20,6 +22,12 @@ os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
 os.environ.setdefault("DYN_TOKEN_ECHO_DELAY_MS", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon TPU plugin overrides JAX_PLATFORMS env; the config flag wins.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for tests"
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

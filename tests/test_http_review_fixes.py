@@ -105,3 +105,21 @@ def test_cli_unknown_out_modes():
     args = parse_args(["out=dyn://ns.comp.ep"])
     with pytest.raises(SystemExit):
         make_engines(args, make_card(args))
+
+
+async def test_usage_counts_tokens_without_visible_text():
+    """Multibyte fragments produce empty-text outputs; usage must still count
+    every generated token (regression: undercounted completion_tokens)."""
+    svc, base = await start_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            # echo of 你 = 3 bytes; each step emits one byte token, the first
+            # two decode to no visible text
+            async with s.post(f"{base}/v1/completions", json={
+                    "model": "echo", "prompt": [228, 189, 160],
+                    "max_tokens": 3}) as r:
+                data = await r.json()
+        assert data["usage"]["completion_tokens"] == 3
+        assert data["choices"][0]["text"] == "你"
+    finally:
+        await svc.stop()

@@ -1,0 +1,203 @@
+"""KV routing subsystem: radix indexer, scheduler cost, publisher, recorder,
+and end-to-end engine->events->index->routing."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, KvIndexerSharded, RadixTree
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvRemovedEvent,
+    KvStoredEvent,
+    RouterEvent,
+    StoredBlock,
+)
+from dynamo_tpu.llm.kv_router.scheduler import (
+    KvScheduler,
+    ProcessedEndpoints,
+    default_selector,
+)
+from dynamo_tpu.llm.tokens import compute_seq_hashes
+
+
+def stored(worker, hashes, parent=None):
+    return RouterEvent(worker, KvCacheEvent(
+        event_id=1,
+        stored=KvStoredEvent(
+            blocks=[StoredBlock(block_hash=h, tokens_hash=h ^ 1) for h in hashes],
+            parent_hash=parent)))
+
+
+def removed(worker, hashes):
+    return RouterEvent(worker, KvCacheEvent(
+        event_id=2, removed=KvRemovedEvent(block_hashes=list(hashes))))
+
+
+def test_radix_prefix_matching():
+    t = RadixTree()
+    tokens = list(range(16))
+    h = compute_seq_hashes(tokens, 4)  # 4 blocks
+    t.apply_event(stored(1, h))
+    t.apply_event(stored(2, h[:2]))
+    scores = t.find_matches(h)
+    assert scores.scores == {1: 4, 2: 2}
+    # divergent suffix matches only shared prefix
+    other = compute_seq_hashes(list(range(8)) + [99] * 8, 4)
+    scores = t.find_matches(other)
+    assert scores.scores == {1: 2, 2: 2}
+
+
+def test_radix_remove_and_prune():
+    t = RadixTree()
+    h = compute_seq_hashes(list(range(12)), 4)
+    t.apply_event(stored(1, h))
+    assert t.num_blocks == 3
+    t.apply_event(removed(1, [h[2]]))
+    assert t.find_matches(h).scores == {1: 2}
+    t.remove_worker(1)
+    assert t.find_matches(h).scores == {}
+    assert t.num_blocks == 0  # fully pruned
+
+
+def test_radix_shared_blocks_two_workers():
+    t = RadixTree()
+    h = compute_seq_hashes(list(range(8)), 4)
+    t.apply_event(stored(1, h))
+    t.apply_event(stored(2, h))
+    t.apply_event(removed(1, [h[0], h[1]]))
+    assert t.find_matches(h).scores == {2: 2}
+    assert t.num_blocks == 2  # still held by worker 2
+
+
+def test_event_roundtrip_serialization():
+    ev = stored(7, [11, 22], parent=33)
+    d = ev.to_dict()
+    back = RouterEvent.from_dict(d)
+    assert back.worker_id == 7
+    assert back.event.stored.parent_hash == 33
+    assert [b.block_hash for b in back.event.stored.blocks] == [11, 22]
+
+
+def test_indexer_sharded():
+    idx = KvIndexerSharded(block_size=4, num_shards=3)
+    h = compute_seq_hashes(list(range(8)), 4)
+    for w in range(6):
+        idx.apply_sync(stored(w, h))
+    scores = idx.find_matches(h)
+    assert all(scores.scores[w] == 2 for w in range(6))
+
+
+def metrics(active=0, total=8, kv_active=0, kv_total=100, waiting=0):
+    return ForwardPassMetrics(
+        request_active_slots=active, request_total_slots=total,
+        kv_active_blocks=kv_active, kv_total_blocks=kv_total,
+        num_requests_waiting=waiting)
+
+
+def test_selector_prefers_overlap():
+    sched = KvScheduler(block_size=4)
+    sched.update_endpoints({1: metrics(), 2: metrics()})
+    tokens = list(range(16))
+    h = compute_seq_hashes(tokens, 4)
+    idx = KvIndexer(block_size=4)
+    idx.apply_sync(stored(2, h))
+    assert sched.schedule(tokens, idx.find_matches(h)) == 2
+
+
+def test_selector_penalizes_load():
+    sched = KvScheduler(block_size=4)
+    sched.update_endpoints({
+        1: metrics(active=7, kv_active=90),   # nearly full
+        2: metrics(active=0, kv_active=0),
+    })
+    assert sched.schedule(list(range(16)), _no_overlap()) == 2
+
+
+def _no_overlap():
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+
+    return OverlapScores()
+
+
+def test_selector_saturated_returns_none():
+    sched = KvScheduler(block_size=4)
+    sched.update_endpoints({1: metrics(active=8, total=8, waiting=2)})
+    assert sched.schedule(list(range(8)), _no_overlap()) is None
+
+
+def test_hit_rate_event_emitted():
+    events = []
+    sched = KvScheduler(block_size=4, on_hit_rate=events.append)
+    sched.update_endpoints({1: metrics()})
+    tokens = list(range(16))
+    h = compute_seq_hashes(tokens, 4)
+    idx = KvIndexer(block_size=4)
+    idx.apply_sync(stored(1, h[:2]))
+    sched.schedule(tokens, idx.find_matches(h))
+    assert events and events[0].worker_id == 1
+    assert events[0].isl_blocks == 4 and events[0].overlap_blocks == 2
+
+
+async def test_publisher_and_recorder(tmp_path):
+    """Engine pool hooks -> publisher -> transport; record + replay."""
+    from dynamo_tpu.engine.cache import PagePool
+    from dynamo_tpu.llm.recorder import KvRecorder
+    from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+
+    seen = []
+
+    async def transport(subject, payload):
+        seen.append((subject, payload))
+
+    pub = KvEventPublisher(worker_id=42, publish=transport)
+    pool = PagePool(num_pages=8, page_size=4)
+    pool.on_block_sealed = pub.block_stored
+    pool.on_blocks_freed = pub.blocks_removed
+
+    pool.create("s1")
+    pool.extend("s1", list(range(9)))   # seals 2 blocks
+    pool.release("s1")                  # frees -> removed event
+    await pub.start()
+    await pub.flush()
+    await pub.stop()
+    assert len(seen) == 3
+    evs = [RouterEvent.from_dict(p) for _, p in seen]
+    assert evs[0].worker_id == 42 and evs[0].event.stored is not None
+    assert evs[2].event.removed is not None
+    # chained: second stored block's parent is the first's hash
+    assert (evs[1].event.stored.parent_hash
+            == evs[0].event.stored.blocks[0].block_hash)
+
+    # feed into an indexer -> prefix match works end to end
+    idx = KvIndexer(block_size=4)
+    for ev in evs[:2]:
+        idx.apply_sync(ev)
+    scores = idx.find_matches_for_tokens(list(range(9)))
+    assert scores.scores == {42: 2}
+
+    # record + replay reproduces the same index
+    rec = KvRecorder(str(tmp_path / "events.jsonl"))
+    for _, p in seen:
+        await rec.publish("kv_events", p)
+    rec.flush()
+    idx2 = KvIndexer(block_size=4)
+    n = rec.replay_into(lambda p: idx2.apply_sync(RouterEvent.from_dict(p)))
+    assert n == 3
+    # after replaying the removal, worker 42 holds nothing
+    assert idx2.find_matches_for_tokens(list(range(9))).scores == {}
+    rec.close()
+
+
+def test_shared_prefix_refcounted():
+    """Two sequences on one worker store the same prefix; releasing one must
+    not revoke the worker's claim (regression: set instead of refcount)."""
+    t = RadixTree()
+    h = compute_seq_hashes(list(range(8)), 4)
+    t.apply_event(stored(1, h))   # seq A
+    t.apply_event(stored(1, h))   # seq B, same prefix
+    t.apply_event(removed(1, h))  # seq A released
+    assert t.find_matches(h).scores == {1: 2}  # B still holds it
+    t.apply_event(removed(1, h))  # seq B released
+    assert t.find_matches(h).scores == {}

@@ -1,0 +1,127 @@
+"""Model-level correctness: paged chunked prefill + decode must reproduce the
+full-sequence forward pass exactly (same pool, same masks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import llama
+
+CFG = llama.preset("tiny-byte")
+
+
+def full_logits(params, tokens):
+    """Whole sequence in one chunk against a fresh pool."""
+    T = len(tokens)
+    L, Hkv, Dh = CFG.num_layers, CFG.num_kv_heads, CFG.head_dim
+    pool_k = jnp.zeros((L, 64 + T, Hkv, Dh), CFG.dtype)
+    pool_v = jnp.zeros_like(pool_k)
+    tok = jnp.asarray(tokens, jnp.int32)[None]
+    pos = jnp.arange(T, dtype=jnp.int32)[None]
+    idx = (jnp.arange(T, dtype=jnp.int32) + 64)[None]
+    valid = jnp.ones((1, T), bool)
+    logits, _, _ = llama.forward(params, CFG, tok, pos, pool_k, pool_v,
+                                 idx, idx, pos, valid)
+    return np.asarray(logits[0])
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def test_chunked_prefill_matches_full(params):
+    tokens = list(range(1, 25))
+    ref = full_logits(params, tokens)
+
+    # same computation split into chunks of 8 against a paged pool
+    L, Hkv, Dh = CFG.num_layers, CFG.num_kv_heads, CFG.head_dim
+    pool_k = jnp.zeros((L, 256, Hkv, Dh), CFG.dtype)
+    pool_v = jnp.zeros_like(pool_k)
+    # pages out of order to exercise the indirection: tokens t -> slot map
+    pages = [3, 1, 2]  # page size 8, 24 tokens
+    slot_of = lambda t: pages[t // 8] * 8 + t % 8
+    all_slots = np.array([slot_of(t) for t in range(24)], np.int32)
+    last = None
+    for start in range(0, 24, 8):
+        tok = jnp.asarray(tokens[start:start + 8], jnp.int32)[None]
+        pos = jnp.arange(start, start + 8, dtype=jnp.int32)[None]
+        widx = jnp.asarray(all_slots[start:start + 8])[None]
+        S = start + 8
+        ridx = jnp.asarray(all_slots[:S])[None]
+        rpos = jnp.arange(S, dtype=jnp.int32)[None]
+        rvalid = jnp.ones((1, S), bool)
+        logits, pool_k, pool_v = llama.forward(
+            params, CFG, tok, pos, pool_k, pool_v, widx, ridx, rpos, rvalid)
+        last = np.asarray(logits[0])
+    np.testing.assert_allclose(last[-1], ref[-1], rtol=2e-2, atol=2e-2)
+
+
+def test_decode_matches_full(params):
+    tokens = list(range(40, 56))
+    ref = full_logits(params, tokens)
+
+    L, Hkv, Dh = CFG.num_layers, CFG.num_kv_heads, CFG.head_dim
+    pool_k = jnp.zeros((L, 128, Hkv, Dh), CFG.dtype)
+    pool_v = jnp.zeros_like(pool_k)
+    # prefill the first 8, then decode the rest one token at a time
+    slots = np.arange(16, dtype=np.int32)  # contiguous slots starting at 0
+    tok = jnp.asarray(tokens[:8], jnp.int32)[None]
+    pos = jnp.arange(8, dtype=jnp.int32)[None]
+    logits, pool_k, pool_v = llama.forward(
+        params, CFG, tok, pos, pool_k, pool_v,
+        jnp.asarray(slots[:8])[None], jnp.asarray(slots[:8])[None],
+        pos, jnp.ones((1, 8), bool))
+    for t in range(8, 16):
+        tokp = jnp.asarray([[tokens[t]]], jnp.int32)
+        posp = jnp.asarray([[t]], jnp.int32)
+        S = t + 1
+        logits, pool_k, pool_v = llama.forward(
+            params, CFG, tokp, posp, pool_k, pool_v,
+            jnp.asarray([[slots[t]]]), jnp.asarray(slots[:S])[None],
+            jnp.arange(S, dtype=jnp.int32)[None], jnp.ones((1, S), bool))
+    np.testing.assert_allclose(np.asarray(logits[0, 0]), ref[-1],
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_padding_invariance(params):
+    """Extra masked-out read slots must not change the result."""
+    tokens = list(range(10, 20))
+    T = len(tokens)
+    L, Hkv, Dh = CFG.num_layers, CFG.num_kv_heads, CFG.head_dim
+    pool_k = jnp.zeros((L, 128, Hkv, Dh), CFG.dtype)
+    pool_v = jnp.zeros_like(pool_k)
+    tok = jnp.asarray(tokens, jnp.int32)[None]
+    pos = jnp.arange(T, dtype=jnp.int32)[None]
+    idx = jnp.arange(T, dtype=jnp.int32)[None]
+    lo, _, _ = llama.forward(params, CFG, tok, pos, pool_k, pool_v,
+                             idx, idx, pos, jnp.ones((1, T), bool))
+    # padded read view: 64 slots, only first T valid
+    ridx = jnp.zeros((1, 64), jnp.int32).at[0, :T].set(jnp.arange(T))
+    rpos = jnp.zeros((1, 64), jnp.int32).at[0, :T].set(jnp.arange(T))
+    rvalid = jnp.zeros((1, 64), bool).at[0, :T].set(True)
+    lp, _, _ = llama.forward(params, CFG, tok, pos,
+                             jnp.zeros_like(pool_k), jnp.zeros_like(pool_v),
+                             idx, ridx, rpos, rvalid)
+    np.testing.assert_array_equal(np.asarray(lo), np.asarray(lp))
+
+
+def test_hf_config_mapping():
+    cfg = llama.LlamaConfig.from_hf_config({
+        "vocab_size": 128256, "hidden_size": 4096, "num_hidden_layers": 32,
+        "num_attention_heads": 32, "num_key_value_heads": 8,
+        "intermediate_size": 14336, "rope_theta": 500000.0,
+        "max_position_embeddings": 8192, "rms_norm_eps": 1e-5,
+    })
+    assert cfg.head_dim == 128 and cfg.num_kv_heads == 8
+
+
+def test_llama3_rope_scaling_applies():
+    base = llama.preset("tiny-byte")
+    scaled = llama.preset("tiny-byte", rope_scaling={
+        "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+        "high_freq_factor": 4.0, "original_max_position_embeddings": 64})
+    f_base = llama._rope_inv_freq(base)
+    f_scaled = llama._rope_inv_freq(scaled)
+    assert not np.allclose(f_base, f_scaled)
