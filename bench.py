@@ -40,7 +40,8 @@ def main() -> None:
 
     cfg = JaxEngineConfig(model=model, tp=1, page_size=64,
                           max_batch=max_batch, max_context=max_context,
-                          prefill_chunk=min(512, max_context))
+                          prefill_chunk=min(512, max_context),
+                          decode_steps=32 if on_tpu else 8)
     core = EngineCore(cfg)
 
     def run_round(tag: str):

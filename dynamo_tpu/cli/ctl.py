@@ -1,0 +1,79 @@
+"""llmctl equivalent: manage model -> endpoint registrations in the store.
+
+    python -m dynamo_tpu.cli.ctl --store 127.0.0.1:4222 http add chat \
+        my-model dynamo.backend.generate [--model-path ...]
+    python -m dynamo_tpu.cli.ctl http list
+    python -m dynamo_tpu.cli.ctl http remove chat my-model
+
+Reference capability: launch/llmctl (http add/list/remove model mappings).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+
+from ..llm.model_card import ModelDeploymentCard
+from ..llm.remote import list_models, model_key, register_model, unregister_model
+from ..runtime.store_client import StoreClient
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="dynamo-ctl")
+    p.add_argument("--store", default="127.0.0.1:4222")
+    sub = p.add_subparsers(dest="plane", required=True)
+    http = sub.add_parser("http")
+    hsub = http.add_subparsers(dest="action", required=True)
+
+    add = hsub.add_parser("add")
+    add.add_argument("model_type", choices=("chat", "completion", "both"))
+    add.add_argument("name")
+    add.add_argument("endpoint", help="ns.component.endpoint")
+    add.add_argument("--model-path", default=None)
+    add.add_argument("--kv-block-size", type=int, default=64)
+
+    rem = hsub.add_parser("remove")
+    rem.add_argument("model_type", choices=("chat", "completion", "both"))
+    rem.add_argument("name")
+
+    hsub.add_parser("list")
+    return p.parse_args(argv)
+
+
+async def run(args) -> int:
+    host, port = args.store.split(":")
+    store = await StoreClient(host, int(port)).connect()
+    try:
+        if args.action == "add":
+            if args.model_path:
+                card = ModelDeploymentCard.from_local_path(
+                    args.model_path, args.name)
+            else:
+                card = ModelDeploymentCard.synthetic(args.name)
+            card.kv_block_size = args.kv_block_size
+            types = (["chat", "completion"] if args.model_type == "both"
+                     else [args.model_type])
+            for t in types:
+                await register_model(store, card, args.endpoint, model_type=t)
+            print(f"added {args.name} -> {args.endpoint} ({','.join(types)})")
+        elif args.action == "remove":
+            types = (["chat", "completion"] if args.model_type == "both"
+                     else [args.model_type])
+            for t in types:
+                await unregister_model(store, args.name, model_type=t)
+            print(f"removed {args.name}")
+        elif args.action == "list":
+            for m in await list_models(store):
+                print(f"{m['type']:<11} {m['name']:<30} {m['endpoint']}")
+        return 0
+    finally:
+        await store.close()
+
+
+def main() -> None:
+    raise SystemExit(asyncio.run(run(parse_args())))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Standalone OpenAI HTTP frontend with live model discovery.
+
+    python -m dynamo_tpu.cli.http --store 127.0.0.1:4222 --port 8080 \
+        [--namespace dynamo] [--router-component router]
+
+Watches the store's ``models/`` prefix: every registered model becomes a
+served OpenAI model backed by a RemoteCoreEngine over the runtime data plane
+(KV-routed when a router component is live). Models appear/disappear live as
+workers register/die. Reference capability: components/http/src/main.rs +
+lib/llm/src/http/service/discovery.rs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+from typing import Dict, Optional
+
+from ..llm.http_service import HttpService, ModelManager, ServedModel
+from ..llm.model_card import ModelDeploymentCard
+from ..llm.pipeline import OpenAIChatEngine, OpenAICompletionEngine
+from ..llm.remote import MODEL_PREFIX, RemoteCoreEngine
+from ..runtime.component import Client, DistributedRuntime
+
+log = logging.getLogger("dynamo_tpu.http")
+
+
+class DiscoveryFrontend:
+    def __init__(self, drt: DistributedRuntime, manager: ModelManager,
+                 router_component: Optional[str] = None):
+        self.drt = drt
+        self.manager = manager
+        self.router_component = router_component
+        self._clients: Dict[str, Client] = {}       # endpoint path -> client
+        self._router_clients: Dict[str, Client] = {}
+        self._model_types: Dict[str, set] = {}
+
+    async def start(self) -> None:
+        await self.drt.store.watch_prefix(MODEL_PREFIX, self._on_change)
+        # initial snapshot
+        for key, value in await self.drt.store.get_prefix(MODEL_PREFIX):
+            await self._on_change(key, value, False)
+
+    async def _client_for(self, endpoint_path: str) -> Client:
+        if endpoint_path not in self._clients:
+            ns, comp, ep = endpoint_path.split(".")
+            cl = await self.drt.namespace(ns).component(comp) \
+                .endpoint(ep).client().start()
+            self._clients[endpoint_path] = cl
+        return self._clients[endpoint_path]
+
+    async def _router_for(self, ns: str) -> Optional[Client]:
+        if not self.router_component:
+            return None
+        if ns not in self._router_clients:
+            cl = await self.drt.namespace(ns) \
+                .component(self.router_component).endpoint("route") \
+                .client().start()
+            self._router_clients[ns] = cl
+        return self._router_clients[ns]
+
+    async def _on_change(self, key: str, value: Optional[bytes],
+                         deleted: bool) -> None:
+        try:
+            parts = key[len(MODEL_PREFIX):].split("/", 1)
+            if len(parts) != 2:
+                return
+            mtype, name = parts
+            if deleted:
+                types = self._model_types.get(name, set())
+                types.discard(mtype)
+                if not types:
+                    self.manager.remove(name)
+                    self._model_types.pop(name, None)
+                return
+            d = json.loads(value.decode())
+            card = ModelDeploymentCard.from_dict(d["card"])
+            worker = await self._client_for(d["endpoint"])
+            router = await self._router_for(d["endpoint"].split(".")[0])
+            core = RemoteCoreEngine(worker, router)
+            served = self.manager.get(name) or ServedModel(card)
+            if mtype == "chat":
+                served.chat_engine = OpenAIChatEngine(card, core)
+            else:
+                served.completion_engine = OpenAICompletionEngine(card, core)
+            served.card = card
+            self.manager.add(served)
+            self._model_types.setdefault(name, set()).add(mtype)
+            log.info("model %s (%s) -> %s", name, mtype, d["endpoint"])
+        except Exception:
+            log.exception("model discovery update failed for %s", key)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="dynamo-http")
+    p.add_argument("--store", default="127.0.0.1:4222")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--router-component", default=None,
+                   help="component name of a KV router to consult")
+    return p.parse_args(argv)
+
+
+async def run_http(args, *, ready_event=None,
+                   drt: Optional[DistributedRuntime] = None
+                   ) -> HttpService:
+    host, port = args.store.split(":")
+    own = drt is None
+    if own:
+        drt = await DistributedRuntime(store_host=host,
+                                       store_port=int(port)).connect()
+    manager = ModelManager()
+    frontend = DiscoveryFrontend(drt, manager, args.router_component)
+    await frontend.start()
+    svc = HttpService(manager, host=args.host, port=args.port)
+    actual = await svc.start()
+    print(f"dynamo_tpu http frontend on :{actual} (discovery mode)",
+          flush=True)
+    if ready_event is not None:
+        ready_event.set()
+    return svc
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+
+    async def amain():
+        args = parse_args()
+        await run_http(args)
+        while True:
+            await asyncio.sleep(3600)
+
+    try:
+        asyncio.run(amain())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

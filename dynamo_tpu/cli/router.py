@@ -1,0 +1,66 @@
+"""Standalone KV-aware router service.
+
+    python -m dynamo_tpu.cli.router --namespace dynamo --worker-component \
+        backend --store 127.0.0.1:4222
+
+Serves ``route`` on {namespace}/router: {token_ids} -> {worker_id}.
+Reference capability: components/router/src/main.rs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from ..llm.kv_router.router import KvRouterService
+from ..runtime.component import DistributedRuntime
+
+log = logging.getLogger("dynamo_tpu.router")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="dynamo-router")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="router")
+    p.add_argument("--worker-component", default="backend")
+    p.add_argument("--store", default="127.0.0.1:4222")
+    p.add_argument("--advertise-host", default=None)
+    p.add_argument("--block-size", type=int, default=64)
+    return p.parse_args(argv)
+
+
+async def run_router(args, *, ready_event=None,
+                     drt: DistributedRuntime | None = None) -> None:
+    host, port = args.store.split(":")
+    own = drt is None
+    if own:
+        drt = await DistributedRuntime(
+            store_host=host, store_port=int(port),
+            advertise_host=args.advertise_host).connect()
+    svc = await KvRouterService(drt, args.namespace, args.worker_component,
+                                block_size=args.block_size).start()
+    await svc.serve(drt.namespace(args.namespace).component(args.component))
+    print(f"kv router serving {args.namespace}.{args.component}.route "
+          f"(workers: {args.worker_component})", flush=True)
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await svc.stop()
+        if own:
+            await drt.close()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    try:
+        asyncio.run(run_router(parse_args()))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+"""Worker binary: serve an engine as a distributed endpoint.
+
+    python -m dynamo_tpu.cli.worker --engine jax|echo --namespace dynamo \
+        --component backend --store localhost:4222 [--model-path ...] \
+        [--register-model NAME]
+
+Serves ``generate`` (BackendInput -> EngineOutput stream), publishes KV cache
+events on the component event plane, and refreshes ForwardPassMetrics in the
+store under its lease (the aggregator scrapes the prefix). This is the
+equivalent of a reference engine worker process: serve_endpoint + KV event
+publisher + metrics publisher.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from ..llm.kv_router.protocols import KV_EVENT_SUBJECT, ForwardPassMetrics
+from ..llm.kv_router.publisher import KvEventPublisher
+from ..llm.model_card import ModelDeploymentCard
+from ..llm.remote import register_model, serve_core_engine
+from ..runtime.component import DistributedRuntime
+
+log = logging.getLogger("dynamo_tpu.worker")
+
+METRICS_PREFIX = "metrics/"
+
+
+def metrics_key(namespace: str, component: str, worker_id: int) -> str:
+    return f"{METRICS_PREFIX}{namespace}/{component}/{worker_id:x}"
+
+
+async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
+                     drt: Optional[DistributedRuntime] = None) -> None:
+    host, port = args.store.split(":")
+    own_drt = drt is None
+    if own_drt:
+        drt = await DistributedRuntime(
+            store_host=host, store_port=int(port),
+            advertise_host=args.advertise_host).connect()
+    ns = drt.namespace(args.namespace)
+    component = ns.component(args.component)
+
+    # --- engine -------------------------------------------------------
+    if args.model_path:
+        card = ModelDeploymentCard.from_local_path(args.model_path,
+                                                   args.model_name)
+    else:
+        card = ModelDeploymentCard.synthetic(args.model_name or "echo")
+    card.kv_block_size = args.kv_block_size
+
+    core = None
+    if args.engine == "jax":
+        from ..engine.engine import JaxEngine, JaxEngineConfig
+
+        extra = json.loads(args.extra_engine_args) if args.extra_engine_args else {}
+        cfg = JaxEngineConfig.from_card(card, tensor_parallel=args.tp, **extra)
+        engine = JaxEngine(cfg)
+        core = engine.core
+    else:
+        from ..llm.engines import EchoCoreEngine
+
+        engine = EchoCoreEngine()
+
+    # --- KV event publishing -----------------------------------------
+    async def publish(subject, payload):
+        await component.publish(subject, payload)
+
+    pub = KvEventPublisher(worker_id=drt.worker_id, publish=publish,
+                           subject=KV_EVENT_SUBJECT)
+    await pub.start()
+    if core is not None:
+        core.pool.on_block_sealed = pub.block_stored
+        core.pool.on_blocks_freed = pub.blocks_removed
+
+    # --- serve endpoint ----------------------------------------------
+    endpoint = component.endpoint("generate")
+    await serve_core_engine(endpoint, engine)
+    if args.register_model:
+        await register_model(drt.store, card, endpoint.path,
+                             model_type="chat", lease=drt.lease)
+        await register_model(drt.store, card, endpoint.path,
+                             model_type="completion", lease=drt.lease)
+
+    # --- metrics loop -------------------------------------------------
+    async def metrics_loop():
+        key = metrics_key(args.namespace, args.component, drt.worker_id)
+        while True:
+            if core is not None:
+                m = ForwardPassMetrics(**core.utilization())
+            else:
+                m = ForwardPassMetrics(request_total_slots=64)
+            await drt.store.put(key, json.dumps(m.to_dict()).encode(),
+                                lease=drt.lease)
+            await asyncio.sleep(args.metrics_interval)
+
+    mtask = asyncio.create_task(metrics_loop())
+    log.info("worker %x serving %s", drt.worker_id, endpoint.path)
+    print(f"worker {drt.worker_id:x} serving {endpoint.path}", flush=True)
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        mtask.cancel()
+        await pub.stop()
+        if own_drt:
+            await drt.close()
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="dynamo-worker")
+    p.add_argument("--engine", choices=("jax", "echo"), default="jax")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--store", default="127.0.0.1:4222")
+    p.add_argument("--advertise-host", default=None)
+    p.add_argument("--model-path", default=None)
+    p.add_argument("--model-name", default=None)
+    p.add_argument("--register-model", action="store_true")
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--kv-block-size", type=int, default=64)
+    p.add_argument("--metrics-interval", type=float, default=1.0)
+    p.add_argument("--extra-engine-args", default=None,
+                   help="inline JSON engine kwargs")
+    return p.parse_args(argv)
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    try:
+        asyncio.run(run_worker(parse_args()))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

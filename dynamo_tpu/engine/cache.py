@@ -74,24 +74,33 @@ class PagePool:
         self.seqs[seq_id] = sc
         return sc
 
-    def extend(self, seq_id: str, tokens: Sequence[int]) -> None:
-        """Account ``tokens`` appended to the sequence, allocating pages as
-        needed and sealing full-page blocks (hash chain -> events)."""
+    def ensure_pages(self, seq_id: str, total_tokens: int) -> None:
+        """Pre-allocate pages so the sequence can hold ``total_tokens`` (used
+        before a multi-step decode dispatch writes tokens speculatively)."""
         sc = self.seqs[seq_id]
-        new_total = sc.num_tokens + len(tokens)
-        need = self.pages_needed(new_total) - len(sc.pages)
+        need = self.pages_needed(total_tokens) - len(sc.pages)
         if need > len(self._free):
-            raise OutOfPages(
-                f"need {need} pages, {len(self._free)} free")
+            raise OutOfPages(f"need {need} pages, {len(self._free)} free")
         for _ in range(need):
             sc.pages.append(self._free.pop())
+
+    def account_tokens(self, seq_id: str, tokens: Sequence[int]) -> None:
+        """Record tokens as present (pages must already exist); seals
+        full-page blocks, firing the hash-chain event hook."""
+        sc = self.seqs[seq_id]
         if sc.hashes is not None:
             for t in tokens:
                 sealed = sc.hashes.append(int(t))
                 if sealed is not None and self.on_block_sealed:
                     page = sc.pages[len(sc.hashes.blocks) - 1]
                     self.on_block_sealed(sc.seq_id, sealed, page)
-        sc.num_tokens = new_total
+        sc.num_tokens += len(tokens)
+
+    def extend(self, seq_id: str, tokens: Sequence[int]) -> None:
+        """Allocate-and-account in one call (prefill path)."""
+        sc = self.seqs[seq_id]
+        self.ensure_pages(seq_id, sc.num_tokens + len(tokens))
+        self.account_tokens(seq_id, tokens)
 
     def release(self, seq_id: str) -> None:
         sc = self.seqs.pop(seq_id, None)
@@ -110,6 +119,15 @@ class PagePool:
         t = np.arange(start_token, start_token + count)
         pages = np.asarray(sc.pages, dtype=np.int32)
         return pages[t // self.page_size] * self.page_size + t % self.page_size
+
+    def page_table_row(self, seq_id: str, padded_pages: int) -> np.ndarray:
+        """This sequence's page ids padded (with scratch page 0) to a static
+        width — the device-side index base for multi-step decode."""
+        sc = self.seqs[seq_id]
+        row = np.zeros(padded_pages, dtype=np.int32)
+        n = min(len(sc.pages), padded_pages)
+        row[:n] = sc.pages[:n]
+        return row
 
     def read_slots(self, seq_id: str, length: int, padded: int
                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

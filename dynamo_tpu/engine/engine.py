@@ -64,6 +64,7 @@ class JaxEngineConfig:
     max_context: int = 2048
     prefill_chunk: int = 512
     num_pages: Optional[int] = None     # default: max_batch*max_context worth
+    decode_steps: int = 8               # decode iterations per XLA dispatch
     params_path: Optional[str] = None   # safetensors dir; None => random init
     seed: int = 0
     preset: Optional[str] = None
@@ -84,7 +85,7 @@ class JaxEngineConfig:
             params_path=card.path,
         )
         for k in ("max_batch", "max_context", "prefill_chunk", "num_pages",
-                  "seed", "preset"):
+                  "decode_steps", "seed", "preset"):
             if k in extra:
                 kw[k] = extra[k]
         cfg = cls(**kw)
@@ -123,7 +124,11 @@ class EngineCore:
         llama.validate_tp(m, cfg.tp)
         self.mesh = tp_mesh(cfg.tp, devices)
         self.page_size = cfg.page_size
-        self.max_pages_per_seq = cfg.max_context // cfg.page_size
+        # every sequence may overshoot up to decode_steps speculative tokens
+        self._spec_pad = -(-cfg.decode_steps // cfg.page_size) * cfg.page_size
+        # ceil: a seq at max_context with the speculative pad must always fit
+        self.max_pages_per_seq = -(-(cfg.max_context + self._spec_pad)
+                                   // cfg.page_size)
         num_pages = cfg.num_pages or (cfg.max_batch * self.max_pages_per_seq + 1)
         self.pool = PagePool(num_pages, cfg.page_size)
 
@@ -158,7 +163,12 @@ class EngineCore:
         self.sampling.key = jax.device_put(self.sampling.key)
 
         # --- compiled programs ---------------------------------------
-        self.s_buckets = _buckets(min(256, cfg.max_context), cfg.max_context)
+        # decode reads are indexed through page tables of width S/page_size:
+        # every S bucket MUST be a page multiple or the final partial page
+        # would clamp out of bounds and silently read/write the wrong page
+        pg = cfg.page_size
+        raw = _buckets(min(256, cfg.max_context), cfg.max_context + self._spec_pad)
+        self.s_buckets = sorted({-(-b // pg) * pg for b in raw})
         self.c_buckets = _buckets(min(32, cfg.prefill_chunk), cfg.prefill_chunk)
         self._decode_fns: Dict[int, Any] = {}
         self._prefill_mid_fns: Dict[Tuple[int, int], Any] = {}
@@ -169,19 +179,48 @@ class EngineCore:
     # compiled program builders
     # ------------------------------------------------------------------
     def _decode_fn(self, S: int):
+        """Multi-step decode: N autoregressive iterations inside one jitted
+        lax.scan — indices computed on device from page tables, sampled token
+        fed straight back in. One host round-trip per N tokens (the round-trip
+        is the latency floor on TPU; this amortizes it N-fold). Lanes that hit
+        a finish condition mid-scan overshoot harmlessly into their own
+        pre-allocated pages; the host trims afterwards."""
         if S not in self._decode_fns:
             cfg = self.cfg
+            page = self.page_size
+            N = cfg.decode_steps
 
-            @partial(jax.jit, donate_argnums=(3, 4))
-            def step(params, tokens, positions, k_pool, v_pool, write_idx,
-                     read_idx, read_pos, read_valid, temp, top_p, top_k, key):
-                logits, k_pool, v_pool = llama.forward(
-                    params, cfg.model, tokens[:, None], positions[:, None],
-                    k_pool, v_pool, write_idx[:, None],
-                    read_idx, read_pos, read_valid)
-                tok, logp, new_key = sample(
-                    logits[:, 0], temp, top_p, top_k, key)
-                return tok, logp, new_key, k_pool, v_pool
+            @partial(jax.jit, donate_argnums=(2, 3))
+            def step(params, tokens, k_pool, v_pool, page_tables, lengths,
+                     temp, top_p, top_k, key):
+                t_range = jnp.arange(S, dtype=jnp.int32)
+                read_slot = (jnp.take_along_axis(
+                    page_tables, (t_range // page)[None, :].repeat(
+                        page_tables.shape[0], 0), axis=1) * page
+                    + t_range[None, :] % page)                  # [B,S]
+                read_pos = jnp.broadcast_to(t_range[None, :],
+                                            read_slot.shape)
+
+                def one(carry, _):
+                    tokens, lengths, k_pool, v_pool, key = carry
+                    pos = lengths - 1
+                    w = (jnp.take_along_axis(
+                        page_tables, (pos // page)[:, None], axis=1)[:, 0]
+                        * page + pos % page)                    # [B]
+                    read_valid = t_range[None, :] < lengths[:, None]
+                    logits, k_pool, v_pool = llama.forward(
+                        params, cfg.model, tokens[:, None], pos[:, None],
+                        k_pool, v_pool, w[:, None],
+                        read_slot, read_pos, read_valid)
+                    tok, logp, new_key = sample(
+                        logits[:, 0], temp, top_p, top_k, key)
+                    return ((tok, lengths + 1, k_pool, v_pool, new_key),
+                            (tok, logp))
+
+                carry = (tokens, lengths, k_pool, v_pool, key)
+                (tok, lengths, k_pool, v_pool, key), (toks, logps) = \
+                    jax.lax.scan(one, carry, None, length=N)
+                return toks, logps, key, k_pool, v_pool
 
             self._decode_fns[S] = step
         return self._decode_fns[S]
@@ -252,6 +291,79 @@ class EngineCore:
             "kv_total_blocks": float(total),
             "num_requests_waiting": float(len(self.waiting)),
         }
+
+    # ------------------------------------------------------------------
+    # KV export/import (disaggregated prefill -> decode transfer)
+    # ------------------------------------------------------------------
+    def extract_kv(self, seq_id: str, layer: Optional[int] = None):
+        """Gather a sequence's KV out of the pool -> host numpy arrays.
+        With ``layer`` set, returns that layer only ([T,Hkv,Dh] k, v) for
+        layer-pipelined transfer; otherwise all layers ([L,T,Hkv,Dh])."""
+        sc = self.pool.seqs[seq_id]
+        slots = jnp.asarray(self.pool.write_slots(seq_id, 0, sc.num_tokens))
+        if layer is None:
+            k = np.asarray(self._kv_gather(self.k_pool, slots))
+            v = np.asarray(self._kv_gather(self.v_pool, slots))
+        else:
+            k = np.asarray(self._kv_gather_layer(self.k_pool, slots, layer))
+            v = np.asarray(self._kv_gather_layer(self.v_pool, slots, layer))
+        return k, v
+
+    def _kv_gather(self, pool, slots):
+        if not hasattr(self, "_gather_fn"):
+            self._gather_fn = jax.jit(lambda p, s: p[:, s])
+        return self._gather_fn(pool, slots)
+
+    def _kv_gather_layer(self, pool, slots, layer: int):
+        if not hasattr(self, "_gather_layer_fn"):
+            self._gather_layer_fn = jax.jit(
+                lambda p, s, l: p[l][s], static_argnums=2)
+        return self._gather_layer_fn(pool, slots, layer)
+
+    def inject_prefilled(self, seq_id: str, request: BackendInput,
+                         k: np.ndarray, v: np.ndarray,
+                         first_token: int,
+                         first_logprob: float = 0.0) -> StepOutput:
+        """Receive a remotely-prefilled sequence: write its prompt KV into
+        this pool and enter it straight into decode (prefill_done=len).
+        ``k``/``v``: [L, T, Hkv, Dh] for the prompt tokens."""
+        if None not in self.slots:
+            raise RuntimeError("no free slot for injected sequence")
+        prompt = list(request.token_ids)
+        T = k.shape[1]
+        if T != len(prompt):
+            raise ValueError(f"KV covers {T} tokens, prompt is {len(prompt)}")
+        self.pool.create(seq_id)
+        self.pool.extend(seq_id, prompt)
+        slots = jnp.asarray(self.pool.write_slots(seq_id, 0, T))
+        if not hasattr(self, "_scatter_fn"):
+            self._scatter_fn = jax.jit(
+                lambda p, s, vals: p.at[:, s].set(vals), donate_argnums=0)
+        self.k_pool = self._scatter_fn(self.k_pool, slots,
+                                       k.astype(self.cfg.model.dtype))
+        self.v_pool = self._scatter_fn(self.v_pool, slots,
+                                       v.astype(self.cfg.model.dtype))
+
+        slot_idx = self.slots.index(None)
+        slot = _Slot(seq_id, request, prompt, prefill_done=len(prompt))
+        self.slots[slot_idx] = slot
+        self.by_seq[seq_id] = slot
+        s = self.sampling
+        s.temperature[slot_idx] = float(request.sampling.temperature or 0.0)
+        s.top_p[slot_idx] = float(request.sampling.top_p
+                                  if request.sampling.top_p is not None else 1.0)
+        s.top_k[slot_idx] = int(min(request.sampling.top_k or 0, STATIC_K))
+        if request.sampling.seed is not None:
+            s.key = s.key.at[slot_idx].set(
+                jax.random.key(request.sampling.seed))
+        self._append_generated(slot, int(first_token))
+        slot.cum_logprob = float(first_logprob)
+        fin = self._finish_reason(slot, int(first_token))
+        so = StepOutput(seq_id, int(first_token), slot.cum_logprob, fin,
+                        prompt_tokens=len(prompt))
+        if fin is not None:
+            self._free_slot(slot_idx)
+        return so
 
     # ------------------------------------------------------------------
     def step(self) -> List[StepOutput]:
@@ -405,60 +517,72 @@ class EngineCore:
     # ------------------------------------------------------------------
     def _decode_step(self) -> List[StepOutput]:
         B = self.cfg.max_batch
+        N = self.cfg.decode_steps
+        outs: List[StepOutput] = []
         # only fully-prefilled slots decode; mid-prefill slots keep their
-        # lanes masked (scratch writes) until their prompt is in cache
-        active = [(i, s) for i, s in enumerate(self.slots)
-                  if s is not None and s.prefill_done >= len(s.prompt)]
+        # lanes masked (scratch page table) until their prompt is in cache
+        active = []
+        deferred = []
+        for i, slot in enumerate(self.slots):
+            if slot is None or slot.prefill_done < len(slot.prompt):
+                continue
+            n = len(slot.prompt) + slot.generated
+            try:
+                # reserve room for N speculative tokens up front
+                self.pool.ensure_pages(slot.seq_id, n + N)
+            except OutOfPages:
+                # pool pressure: defer this slot — batchmates finishing will
+                # free pages — rather than killing a healthy request
+                deferred.append((i, slot))
+                continue
+            active.append((i, slot))
         if not active:
-            return []
-        max_len = max(len(s.prompt) + s.generated for _, s in active)
+            if deferred:
+                # nothing can make progress: evict the largest consumer so
+                # the rest of the system unblocks (capacity error)
+                i, slot = max(deferred,
+                              key=lambda t: len(self.pool.seqs[t[1].seq_id].pages))
+                outs.append(StepOutput(slot.seq_id, slot.last_token,
+                                       slot.cum_logprob, FinishReason.ERROR))
+                self._free_slot(i)
+            return outs
+        max_len = max(len(s.prompt) + s.generated for _, s in active) + N
         S = self._bucket(max_len, self.s_buckets)
+        P = S // self.page_size
 
         tokens = np.zeros(B, np.int32)
-        positions = np.zeros(B, np.int32)
-        write_idx = np.zeros(B, np.int32)   # inactive lanes -> scratch page 0
-        read_idx = np.zeros((B, S), np.int32)
-        read_pos = np.zeros((B, S), np.int32)
-        read_valid = np.zeros((B, S), bool)
-
-        # The input token this step is slot.last_token at position n-1 (its KV
-        # was accounted by _append_generated but not yet written to the pool —
-        # the write happens inside this step's forward).
+        lengths = np.ones(B, np.int32)    # inactive lanes write into page 0
+        page_tables = np.zeros((B, P), np.int32)
         for i, slot in active:
             n = len(slot.prompt) + slot.generated
             tokens[i] = slot.last_token
-            positions[i] = n - 1
-            write_idx[i] = self.pool.write_slots(slot.seq_id, n - 1, 1)[0]
-            r_s, r_p, r_v = self.pool.read_slots(slot.seq_id, n, S)
-            read_idx[i], read_pos[i], read_valid[i] = r_s, r_p, r_v
+            lengths[i] = n
+            page_tables[i] = self.pool.page_table_row(slot.seq_id, P)
 
         s = self.sampling
         fn = self._decode_fn(S)
-        tok, logp, new_key, self.k_pool, self.v_pool = fn(
-            self.params, tokens, positions, self.k_pool, self.v_pool,
-            write_idx, read_idx, read_pos, read_valid,
-            s.temperature, s.top_p, s.top_k, s.key)
+        toks, logps, new_key, self.k_pool, self.v_pool = fn(
+            self.params, tokens, self.k_pool, self.v_pool,
+            page_tables, lengths, s.temperature, s.top_p, s.top_k, s.key)
         s.key = new_key
-        tok_np = np.asarray(tok)
-        logp_np = np.asarray(logp)
+        toks_np = np.asarray(toks)    # [N, B]
+        logps_np = np.asarray(logps)
 
-        outs: List[StepOutput] = []
         for i, slot in active:
-            t = int(tok_np[i])
-            try:
-                self._append_generated(slot, t)
-            except OutOfPages:
-                # capacity failure is an ERROR, not a length finish — the
-                # client must be able to tell truncation from completion
-                outs.append(StepOutput(slot.seq_id, t, slot.cum_logprob,
-                                       FinishReason.ERROR))
-                self._free_slot(i)
-                continue
-            slot.cum_logprob += float(logp_np[i])
-            fin = self._finish_reason(slot, t)
-            outs.append(StepOutput(slot.seq_id, t, slot.cum_logprob, fin))
-            if fin is not None:
-                self._free_slot(i)
+            for j in range(N):
+                t = int(toks_np[j, i])
+                self.pool.account_tokens(slot.seq_id, [t])
+                slot.generated += 1
+                slot.last_token = t
+                slot.cum_logprob += float(logps_np[j, i])
+                fin = self._finish_reason(slot, t)
+                outs.append(StepOutput(slot.seq_id, t, slot.cum_logprob, fin))
+                if fin is not None:
+                    # overshoot tokens beyond the finish are discarded; their
+                    # page-pool writes are inside this seq's own pages and are
+                    # released with the slot
+                    self._free_slot(i)
+                    break
         return outs
 
 
@@ -502,6 +626,13 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                     self.core.submit(seq_id, payload)
                 elif kind == "cancel":
                     self.core.cancel(seq_id)
+                elif kind == "inject":
+                    try:
+                        so = self.core.inject_prefilled(seq_id, *payload)
+                    except Exception:
+                        log.exception("KV injection failed")
+                        so = StepOutput(seq_id, 0, 0.0, FinishReason.ERROR)
+                    self._deliver(so)
             if not self.core.has_work:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -536,11 +667,27 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
     # ------------------------------------------------------------------
     async def generate(self, request: BackendInput,
                        context: Context) -> AsyncIterator[EngineOutput]:
+        async for out in self._generate(("submit", request), context):
+            yield out
+
+    async def generate_prefilled(self, request: BackendInput, context: Context,
+                                 k, v, first_token: int,
+                                 first_logprob: float = 0.0
+                                 ) -> AsyncIterator[EngineOutput]:
+        """Stream a request whose prompt KV (and first token) arrived from a
+        remote prefill worker — enters decode directly."""
+        payload = (request, k, v, first_token, first_logprob)
+        async for out in self._generate(("inject", payload), context):
+            yield out
+
+    async def _generate(self, work, context: Context
+                        ) -> AsyncIterator[EngineOutput]:
+        kind, payload = work
         self._loop = asyncio.get_running_loop()
         seq_id = context.id
         q: asyncio.Queue = asyncio.Queue()
         self._queues[seq_id] = q
-        self._inbox.put(("submit", seq_id, request))
+        self._inbox.put((kind, seq_id, payload))
         self._wake.set()
 
         async def watch_cancel():

@@ -1,0 +1,114 @@
+"""KvRouter: composes indexer + metrics aggregation + scheduler into a
+routing service over the distributed runtime.
+
+- subscribes the target component's ``kv_events`` subject -> KvIndexer
+- scrapes worker ForwardPassMetrics from the store prefix -> scheduler
+- tracks the worker endpoint's live instance set (drops dead workers from
+  the index)
+- serves ``route``: {token_ids} -> {worker_id, overlap_blocks}
+
+Reference capability: lib/llm/src/kv_router.rs (KvRouter), metrics_aggregator.rs,
+components/router binary.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, Optional
+
+from ...runtime.component import Client, Component, DistributedRuntime
+from .indexer import KvIndexer
+from .protocols import KV_EVENT_SUBJECT, ForwardPassMetrics, RouterEvent
+from .scheduler import KvScheduler
+
+log = logging.getLogger("dynamo_tpu.kv_router")
+
+
+class KvRouterService:
+    def __init__(self, drt: DistributedRuntime, namespace: str,
+                 worker_component: str, block_size: int = 64,
+                 scrape_interval: float = 0.5):
+        self.drt = drt
+        self.namespace = namespace
+        self.worker_component = worker_component
+        self.indexer = KvIndexer(block_size)
+        self.scheduler = KvScheduler(block_size,
+                                     on_hit_rate=self._emit_hit_rate)
+        self.scrape_interval = scrape_interval
+        self._scrape_task: Optional[asyncio.Task] = None
+        self.worker_client: Optional[Client] = None
+        self._hit_events = 0
+
+    def _emit_hit_rate(self, ev) -> None:
+        self._hit_events += 1
+        asyncio.ensure_future(
+            self.drt.namespace(self.namespace).publish(
+                "kv-hit-rate", ev.to_dict()))
+
+    # ------------------------------------------------------------------
+    async def start(self) -> "KvRouterService":
+        ns = self.drt.namespace(self.namespace)
+        component = ns.component(self.worker_component)
+
+        async def on_kv_event(payload: Dict) -> None:
+            self.indexer.apply_sync(RouterEvent.from_dict(payload))
+
+        await component.subscribe(KV_EVENT_SUBJECT, on_kv_event)
+
+        # live worker set: prune index + scheduler on death
+        self.worker_client = await component.endpoint("generate").client().start()
+
+        def on_change():
+            live = set(self.worker_client.instances)
+            for w in self.indexer.tree.workers() - live:
+                self.indexer.remove_worker(w)
+            for w in list(self.scheduler.endpoints.workers) :
+                if w not in live:
+                    self.scheduler.remove_worker(w)
+
+        self.worker_client.on_instances_changed = on_change
+        self._scrape_task = asyncio.create_task(self._scrape_loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._scrape_task:
+            self._scrape_task.cancel()
+
+    async def _scrape_loop(self) -> None:
+        from ...cli.worker import METRICS_PREFIX
+
+        prefix = f"{METRICS_PREFIX}{self.namespace}/{self.worker_component}/"
+        while True:
+            try:
+                items = await self.drt.store.get_prefix(prefix)
+                workers = {}
+                live = set(self.worker_client.instances) \
+                    if self.worker_client else None
+                for key, value in items:
+                    wid = int(key.rsplit("/", 1)[1], 16)
+                    if live is not None and wid not in live:
+                        continue
+                    workers[wid] = ForwardPassMetrics.from_dict(
+                        json.loads(value.decode()))
+                self.scheduler.update_endpoints(workers)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("metrics scrape failed")
+            await asyncio.sleep(self.scrape_interval)
+
+    # ------------------------------------------------------------------
+    async def route(self, token_ids) -> Dict:
+        overlaps = self.indexer.find_matches_for_tokens(token_ids)
+        wid = await self.scheduler.schedule_or_wait(token_ids, overlaps)
+        return {"worker_id": wid,
+                "overlap_blocks": overlaps.scores.get(wid, 0)}
+
+    async def serve(self, component: Component,
+                    endpoint_name: str = "route") -> None:
+        async def handler(request, ctx):
+            yield await self.route(request["token_ids"])
+
+        await component.endpoint(endpoint_name).serve(handler)

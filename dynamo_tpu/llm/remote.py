@@ -1,0 +1,99 @@
+"""Remote engine plumbing: serve a core engine over the runtime's data plane
+and call it from a frontend, with optional KV-aware routing.
+
+Wire shape on the ``generate`` endpoint: request = BackendInput.to_dict(),
+stream items = EngineOutput.to_dict(). The KV router service serves ``route``:
+{token_ids} -> {worker_id, overlap_blocks}.
+
+Reference capability: the dyn:// egress path (launch/dynamo-run in=http
+out=dyn://, lib/runtime egress/push.rs) and components/router.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, Optional
+
+from ..runtime.component import Client, Endpoint
+from ..runtime.engine import AsyncEngine, Context, EngineError
+from .protocols.common import BackendInput, EngineOutput
+from .model_card import ModelDeploymentCard
+
+log = logging.getLogger("dynamo_tpu.remote")
+
+MODEL_PREFIX = "models/"  # store keys: models/{chat|completion}/{name}
+
+
+def model_key(model_type: str, name: str) -> str:
+    return f"{MODEL_PREFIX}{model_type}/{name}"
+
+
+class RemoteCoreEngine(AsyncEngine[BackendInput, EngineOutput]):
+    """Frontend-side core engine that forwards BackendInput to a remote
+    worker endpoint; optionally consults a router endpoint first and pins the
+    request to the returned worker (KV-aware routing)."""
+
+    def __init__(self, worker_client: Client,
+                 router_client: Optional[Client] = None):
+        self.worker_client = worker_client
+        self.router_client = router_client
+
+    async def generate(self, request: BackendInput,
+                       context: Context) -> AsyncIterator[EngineOutput]:
+        mode = "random"
+        instance_id = None
+        if self.router_client is not None and self.router_client.instances:
+            try:
+                async for resp in self.router_client.generate(
+                        {"token_ids": request.token_ids}, context.child()):
+                    wid = resp.get("worker_id")
+                    if wid is not None and wid in self.worker_client.instances:
+                        mode, instance_id = "direct", wid
+                    break
+            except EngineError:
+                log.warning("router unavailable; falling back to random")
+        async for item in self.worker_client.generate(
+                request.to_dict(), context, mode=mode,
+                instance_id=instance_id):
+            yield EngineOutput.from_dict(item)
+
+
+async def serve_core_engine(endpoint: Endpoint, engine: AsyncEngine) -> None:
+    """Expose a local core engine (BackendInput->EngineOutput) on an
+    endpoint, handling dict (de)serialization."""
+
+    async def handler(request, ctx):
+        bi = BackendInput.from_dict(request)
+        async for out in engine.generate(bi, ctx):
+            yield out.to_dict()
+
+    await endpoint.serve(handler)
+
+
+async def register_model(store, card: ModelDeploymentCard,
+                         endpoint_path: str, model_type: str = "chat",
+                         lease: Optional[int] = None) -> None:
+    """llmctl add: advertise model -> endpoint mapping for frontends."""
+    import json
+
+    payload = json.dumps({"card": card.to_dict(),
+                          "endpoint": endpoint_path}).encode()
+    await store.put(model_key(model_type, card.name), payload, lease=lease)
+
+
+async def unregister_model(store, name: str, model_type: str = "chat") -> None:
+    await store.delete(model_key(model_type, name))
+
+
+async def list_models(store):
+    import json
+
+    out = []
+    for key, value in await store.get_prefix(MODEL_PREFIX):
+        d = json.loads(value.decode())
+        _, mtype, name = key.split("/", 2)
+        out.append({"name": name, "type": mtype,
+                    "endpoint": d["endpoint"],
+                    "card": d.get("card")})
+    return out

@@ -1,0 +1,409 @@
+"""Distributed runtime: Namespace -> Component -> Endpoint model.
+
+A worker process creates a :class:`DistributedRuntime` (store connection +
+lease), names a component, and serves endpoints. Serving an endpoint:
+
+1. starts (once per process) a TCP data-plane server speaking two-part frames,
+2. registers ``{namespace}/components/{component}/{endpoint}:{lease_id}`` in
+   dynstore bound to the process lease (death => key vanishes => clients
+   shrink their live set automatically — the failure-detection plane).
+
+Requests flow DIRECTLY client->worker over TCP (the reference splits NATS
+request / TCP response; with no broker in the middle we collapse both onto
+one connection, keeping the two-part codec, the error-before-stream prologue
+and Stop/Kill control messages of the reference's wire contract,
+lib/runtime/src/pipeline/network.rs:44-233).
+
+Reference capability: lib/runtime/src/component.rs, component/endpoint.rs,
+component/client.rs, distributed.rs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+import random
+import socket
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
+
+from .engine import AsyncEngine, Context, EngineError
+from .store_client import StoreClient
+from .wire import FrameReader, write_frame
+
+log = logging.getLogger("dynamo_tpu.runtime")
+
+Handler = Callable[[Any, Context], AsyncIterator[Any]]
+
+
+def endpoint_key(namespace: str, component: str, endpoint: str,
+                 lease: int) -> str:
+    return f"{namespace}/components/{component}/{endpoint}:{lease:x}"
+
+
+def endpoint_prefix(namespace: str, component: str, endpoint: str) -> str:
+    return f"{namespace}/components/{component}/{endpoint}:"
+
+
+@dataclass
+class EndpointInfo:
+    """What a worker publishes to the store for one endpoint instance."""
+
+    host: str
+    port: int
+    endpoint: str
+    lease: int
+    worker_id: int
+    transport: str = "tcp"
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "EndpointInfo":
+        return cls(**json.loads(b.decode()))
+
+
+class DistributedRuntime:
+    """Per-process handle: store connection, lease, data-plane server."""
+
+    def __init__(self, store_host: str = "127.0.0.1", store_port: int = 4222,
+                 advertise_host: Optional[str] = None):
+        self.store = StoreClient(store_host, store_port)
+        self.lease: Optional[int] = None
+        self.worker_id: int = 0
+        self._advertise_host = advertise_host
+        self._dp_server: Optional[asyncio.base_events.Server] = None
+        self.dp_host: Optional[str] = None
+        self.dp_port: Optional[int] = None
+        self._handlers: Dict[str, Handler] = {}
+        self._active: Dict[str, Context] = {}
+
+    async def connect(self) -> "DistributedRuntime":
+        await self.store.connect()
+        self.lease = await self.store.lease_grant(ttl=5.0)
+        self.worker_id = self.lease
+        return self
+
+    async def close(self) -> None:
+        if self.lease is not None:
+            try:
+                await self.store.lease_revoke(self.lease)
+            except Exception:
+                pass
+        if self._dp_server:
+            self._dp_server.close()
+        await self.store.close()
+
+    def namespace(self, name: str) -> "Namespace":
+        return Namespace(self, name)
+
+    # ------------------------------------------------------------------
+    # data plane (one TCP server per process, endpoints multiplexed by name)
+    # ------------------------------------------------------------------
+    async def _ensure_data_plane(self) -> None:
+        if self._dp_server is not None:
+            return
+        self._dp_server = await asyncio.start_server(
+            self._serve_conn, "0.0.0.0", 0)
+        self.dp_port = self._dp_server.sockets[0].getsockname()[1]
+        self.dp_host = self._advertise_host or _local_ip()
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        fr = FrameReader(reader)
+        try:
+            while True:
+                frame = await fr.read()
+                control, payload = frame
+                kind = control.get("kind")
+                if kind == "request":
+                    # one stream per connection at a time; pipelining uses
+                    # separate connections (pooled client-side)
+                    await self._run_request(control, payload, fr, writer)
+                else:
+                    break
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def _run_request(self, control: Dict[str, Any],
+                           payload: Optional[bytes], fr: FrameReader,
+                           writer: asyncio.StreamWriter) -> None:
+        ep = control.get("endpoint")
+        ctx_id = control.get("context_id") or None
+        handler = self._handlers.get(ep)
+        if handler is None:
+            await write_frame(writer, [{"kind": "error",
+                                        "message": f"no endpoint {ep!r}",
+                                        "code": 404}, None])
+            return
+        if control.get("ctype") == "bin":
+            request = payload  # raw bytes pass through untouched (KV plane)
+        else:
+            request = json.loads(payload.decode()) if payload else None
+        ctx = Context(ctx_id)
+        self._active[ctx.id] = ctx
+
+        async def watch_control():
+            """Stop/Kill control frames arriving mid-stream."""
+            try:
+                while True:
+                    frame = await fr.read()
+                    c, _ = frame
+                    if c.get("kind") == "stop":
+                        ctx.stop_generating()
+                    elif c.get("kind") == "kill":
+                        ctx.kill()
+            except (asyncio.IncompleteReadError, ConnectionResetError):
+                ctx.stop_generating()
+
+        watcher = asyncio.create_task(watch_control())
+        try:
+            stream = handler(request, ctx)
+            # prologue: the first item may raise before anything is sent —
+            # deliver it as a typed error instead of a broken stream
+            try:
+                first = await stream.__anext__()
+                have_first = True
+            except StopAsyncIteration:
+                have_first = False
+            except EngineError as e:
+                await write_frame(writer, [{"kind": "error", "message": str(e),
+                                            "code": e.code}, None])
+                return
+            except Exception as e:  # noqa: BLE001
+                await write_frame(writer, [{"kind": "error", "message": str(e),
+                                            "code": 500}, None])
+                return
+            await write_frame(writer, [{"kind": "prologue"}, None])
+
+            def enc(item):
+                if isinstance(item, (bytes, bytearray)):
+                    return {"kind": "data", "ctype": "bin"}, bytes(item)
+                return {"kind": "data"}, json.dumps(item).encode()
+
+            if have_first:
+                await write_frame(writer, list(enc(first)))
+                async for item in stream:
+                    await write_frame(writer, list(enc(item)))
+            await write_frame(writer, [{"kind": "sentinel"}, None])
+        except (ConnectionResetError, BrokenPipeError):
+            ctx.stop_generating()
+        except Exception as e:  # noqa: BLE001 - mid-stream failure
+            try:
+                await write_frame(writer, [{"kind": "error", "message": str(e),
+                                            "code": 500}, None])
+            except Exception:
+                pass
+        finally:
+            watcher.cancel()
+            self._active.pop(ctx.id, None)
+
+
+def _local_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+class Namespace:
+    def __init__(self, drt: DistributedRuntime, name: str):
+        self.drt = drt
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self, name)
+
+    # namespace-scoped event plane
+    async def publish(self, event: str, payload: Dict[str, Any]) -> None:
+        await self.drt.store.publish(f"{self.name}.{event}",
+                                     json.dumps(payload).encode())
+
+    async def subscribe(self, event: str,
+                        cb: Callable[[Dict[str, Any]], Awaitable[None]]) -> None:
+        async def _cb(subject: str, payload: bytes):
+            await cb(json.loads(payload.decode()))
+
+        await self.drt.store.subscribe(f"{self.name}.{event}", _cb)
+
+
+class Component:
+    def __init__(self, ns: Namespace, name: str):
+        self.namespace = ns
+        self.name = name
+        self.drt = ns.drt
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self, name)
+
+    async def publish(self, event: str, payload: Dict[str, Any]) -> None:
+        await self.drt.store.publish(
+            f"{self.namespace.name}.{self.name}.{event}",
+            json.dumps(payload).encode())
+
+    async def subscribe(self, event: str,
+                        cb: Callable[[Dict[str, Any]], Awaitable[None]]) -> None:
+        async def _cb(subject: str, payload: bytes):
+            await cb(json.loads(payload.decode()))
+
+        await self.drt.store.subscribe(
+            f"{self.namespace.name}.{self.name}.{event}", _cb)
+
+
+class Endpoint:
+    def __init__(self, component: Component, name: str):
+        self.component = component
+        self.name = name
+        self.drt = component.drt
+
+    @property
+    def path(self) -> str:
+        return (f"{self.component.namespace.name}."
+                f"{self.component.name}.{self.name}")
+
+    async def serve(self, handler: Handler) -> None:
+        """Register the handler on the data plane + advertise in the store."""
+        drt = self.drt
+        await drt._ensure_data_plane()
+        drt._handlers[self.name] = handler
+        info = EndpointInfo(
+            host=drt.dp_host, port=drt.dp_port, endpoint=self.name,
+            lease=drt.lease, worker_id=drt.worker_id)
+        key = endpoint_key(self.component.namespace.name,
+                           self.component.name, self.name, drt.lease)
+        await drt.store.put(key, info.to_bytes(), lease=drt.lease)
+
+    async def serve_engine(self, engine: AsyncEngine) -> None:
+        async def handler(request, ctx):
+            async for item in engine.generate(request, ctx):
+                yield item
+
+        await self.serve(handler)
+
+    def client(self) -> "Client":
+        return Client(self)
+
+
+class Client:
+    """Watches the endpoint prefix => live instance set; issues requests with
+    random / round_robin / direct routing. Connections are pooled per
+    instance. (Reference: component/client.rs:52-295 + egress/push.rs.)"""
+
+    def __init__(self, endpoint: Endpoint):
+        self.endpoint = endpoint
+        self.drt = endpoint.drt
+        self.instances: Dict[int, EndpointInfo] = {}
+        self._rr = itertools.count()
+        self._watching = False
+        self._pool: Dict[int, List[Any]] = {}
+        self.on_instances_changed: Optional[Callable[[], None]] = None
+
+    async def start(self) -> "Client":
+        prefix = endpoint_prefix(self.endpoint.component.namespace.name,
+                                 self.endpoint.component.name,
+                                 self.endpoint.name)
+
+        async def on_change(key: str, value: Optional[bytes], deleted: bool):
+            lease = int(key.rsplit(":", 1)[1], 16)
+            if deleted:
+                self.instances.pop(lease, None)
+                self._pool.pop(lease, None)
+            else:
+                self.instances[lease] = EndpointInfo.from_bytes(value)
+            if self.on_instances_changed:
+                self.on_instances_changed()
+
+        snapshot = await self.drt.store.watch_prefix(prefix, on_change)
+        for key, value in snapshot:
+            lease = int(key.rsplit(":", 1)[1], 16)
+            self.instances[lease] = EndpointInfo.from_bytes(value)
+        self._watching = True
+        return self
+
+    async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while len(self.instances) < n:
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(
+                    f"{self.endpoint.path}: {len(self.instances)}/{n} instances")
+            await asyncio.sleep(0.05)
+
+    def instance_ids(self) -> List[int]:
+        return sorted(self.instances)
+
+    def _pick(self, mode: str, instance_id: Optional[int]) -> EndpointInfo:
+        if not self.instances:
+            raise EngineError(f"no live instances of {self.endpoint.path}", 503)
+        if mode == "direct":
+            if instance_id not in self.instances:
+                raise EngineError(
+                    f"instance {instance_id} of {self.endpoint.path} is gone",
+                    503)
+            return self.instances[instance_id]
+        ids = sorted(self.instances)
+        if mode == "round_robin":
+            return self.instances[ids[next(self._rr) % len(ids)]]
+        return self.instances[random.choice(ids)]
+
+    async def generate(self, request: Any, context: Optional[Context] = None,
+                       mode: str = "random",
+                       instance_id: Optional[int] = None
+                       ) -> AsyncIterator[Any]:
+        """Issue a request; yields response items (the remote stream)."""
+        ctx = context or Context()
+        info = self._pick(mode, instance_id)
+        reader, writer = await asyncio.open_connection(info.host, info.port)
+        fr = FrameReader(reader)
+        stop_sent = False
+        try:
+            if isinstance(request, (bytes, bytearray)):
+                req_control = {"kind": "request", "endpoint": info.endpoint,
+                               "context_id": ctx.id, "ctype": "bin"}
+                req_payload = bytes(request)
+            else:
+                req_control = {"kind": "request", "endpoint": info.endpoint,
+                               "context_id": ctx.id}
+                req_payload = json.dumps(request).encode()
+            await write_frame(writer, [req_control, req_payload])
+
+            async def forward_stop():
+                await ctx.stopped()
+                try:
+                    await write_frame(writer, [{"kind": "stop"}, None])
+                except Exception:
+                    pass
+
+            stopper = asyncio.create_task(forward_stop())
+            try:
+                frame = await fr.read()
+                control, payload = frame
+                if control.get("kind") == "error":
+                    raise EngineError(control.get("message", "remote error"),
+                                      control.get("code", 500))
+                # else: prologue
+                while True:
+                    control, payload = await fr.read()
+                    kind = control.get("kind")
+                    if kind == "data":
+                        if control.get("ctype") == "bin":
+                            yield payload
+                        else:
+                            yield json.loads(payload.decode())
+                    elif kind == "sentinel":
+                        return
+                    elif kind == "error":
+                        raise EngineError(control.get("message", "remote"),
+                                          control.get("code", 500))
+            finally:
+                stopper.cancel()
+        finally:
+            writer.close()

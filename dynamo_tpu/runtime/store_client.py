@@ -1,0 +1,186 @@
+"""Async client for dynstore (KV/lease/watch + pub/sub + queues).
+
+One connection multiplexes everything: request/reply by id, plus pushed
+frames routed to watch/subscription/queue callbacks. The API mirrors what the
+runtime layers need (component registration, endpoint discovery, KV events,
+prefill queue) — the union of the reference's etcd + NATS client surfaces
+(lib/runtime/src/transports/{etcd,nats}.rs) behind one handle.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional, Tuple
+
+from .wire import FrameReader, write_frame
+
+log = logging.getLogger("dynamo_tpu.store.client")
+
+WatchCallback = Callable[[str, Optional[bytes], bool], Awaitable[None]]
+MsgCallback = Callable[[str, bytes], Awaitable[None]]
+
+
+class StoreError(RuntimeError):
+    pass
+
+
+class StoreClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 4222):
+        self.host, self.port = host, port
+        self._reader: Optional[FrameReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._ids = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._watch_cbs: Dict[int, WatchCallback] = {}
+        self._sub_cbs: Dict[int, MsgCallback] = {}
+        self._rx_task: Optional[asyncio.Task] = None
+        self._keepalive_tasks: List[asyncio.Task] = []
+        self._send_lock = asyncio.Lock()
+        self.closed = asyncio.Event()
+
+    # ------------------------------------------------------------------
+    async def connect(self) -> "StoreClient":
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        self._reader = FrameReader(reader)
+        self._writer = writer
+        self._rx_task = asyncio.create_task(self._rx_loop(), name="store-rx")
+        return self
+
+    async def close(self) -> None:
+        for t in self._keepalive_tasks:
+            t.cancel()
+        if self._rx_task:
+            self._rx_task.cancel()
+        if self._writer:
+            self._writer.close()
+        self.closed.set()
+
+    async def _rx_loop(self) -> None:
+        try:
+            while True:
+                msg = await self._reader.read()
+                if "push" in msg:
+                    await self._handle_push(msg)
+                else:
+                    fut = self._pending.pop(msg.get("id"), None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                asyncio.CancelledError):
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(StoreError("connection lost"))
+            self._pending.clear()
+            self.closed.set()
+
+    async def _handle_push(self, msg: Dict[str, Any]) -> None:
+        kind = msg["push"]
+        try:
+            if kind == "watch":
+                cb = self._watch_cbs.get(msg["watch_id"])
+                if cb:
+                    await cb(msg["key"], msg.get("value"), msg["deleted"])
+            elif kind == "msg":
+                cb = self._sub_cbs.get(msg["sub_id"])
+                if cb:
+                    await cb(msg["subject"], msg["payload"])
+        except Exception:
+            log.exception("push handler failed")
+
+    async def _call(self, op: str, **kw) -> Dict[str, Any]:
+        rid = next(self._ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        async with self._send_lock:
+            await write_frame(self._writer, {"op": op, "id": rid, **kw})
+        reply = await fut
+        if not reply.get("ok", False):
+            raise StoreError(reply.get("error", "store error"))
+        return reply
+
+    # -- KV -------------------------------------------------------------
+    async def put(self, key: str, value: bytes,
+                  lease: Optional[int] = None) -> None:
+        await self._call("put", key=key, value=value, lease=lease)
+
+    async def create(self, key: str, value: bytes,
+                     lease: Optional[int] = None,
+                     or_validate: bool = False) -> bool:
+        r = await self._call("create", key=key, value=value, lease=lease,
+                             or_validate=or_validate)
+        return r.get("created", True)
+
+    async def get(self, key: str) -> Optional[bytes]:
+        r = await self._call("get", key=key)
+        return r["value"] if r["found"] else None
+
+    async def get_prefix(self, prefix: str) -> List[Tuple[str, bytes]]:
+        r = await self._call("get_prefix", prefix=prefix)
+        return [(k, v) for k, v in r["items"]]
+
+    async def delete(self, key: str) -> bool:
+        r = await self._call("delete", key=key)
+        return r["deleted"]
+
+    # -- leases ----------------------------------------------------------
+    async def lease_grant(self, ttl: float = 5.0,
+                          auto_keepalive: bool = True) -> int:
+        r = await self._call("lease_grant", ttl=ttl)
+        lease = r["lease"]
+        if auto_keepalive:
+            self._keepalive_tasks.append(asyncio.create_task(
+                self._keepalive_loop(lease, ttl), name=f"lease-{lease}"))
+        return lease
+
+    async def _keepalive_loop(self, lease: int, ttl: float) -> None:
+        try:
+            while True:
+                await asyncio.sleep(ttl / 3)
+                await self._call("lease_keepalive", lease=lease)
+        except (asyncio.CancelledError, StoreError):
+            pass
+
+    async def lease_revoke(self, lease: int) -> None:
+        await self._call("lease_revoke", lease=lease)
+
+    # -- watches ---------------------------------------------------------
+    async def watch_prefix(self, prefix: str, callback: WatchCallback
+                           ) -> List[Tuple[str, bytes]]:
+        """Start watching; returns the current snapshot; callback fires on
+        every subsequent put/delete under the prefix."""
+        wid = next(self._ids)
+        self._watch_cbs[wid] = callback
+        r = await self._call("watch", watch_id=wid, prefix=prefix)
+        return [(k, v) for k, v in r["items"]]
+
+    # -- pub/sub ---------------------------------------------------------
+    async def subscribe(self, subject: str, callback: MsgCallback) -> int:
+        sid = next(self._ids)
+        self._sub_cbs[sid] = callback
+        await self._call("subscribe", sub_id=sid, subject=subject)
+        return sid
+
+    async def publish(self, subject: str, payload: bytes) -> int:
+        r = await self._call("publish", subject=subject, payload=payload)
+        return r["delivered"]
+
+    # -- queues -----------------------------------------------------------
+    async def q_push(self, queue: str, payload: bytes) -> int:
+        r = await self._call("q_push", queue=queue, payload=payload)
+        return r["msg_id"]
+
+    async def q_pull(self, queue: str) -> Tuple[int, bytes]:
+        """Blocks until a message is available; must q_ack when done."""
+        r = await self._call("q_pull", queue=queue)
+        return r["msg_id"], r["payload"]
+
+    async def q_ack(self, queue: str, msg_id: int) -> None:
+        await self._call("q_ack", queue=queue, msg_id=msg_id)
+
+    async def q_len(self, queue: str) -> int:
+        return (await self._call("q_len", queue=queue))["len"]
+
+    async def ping(self) -> bool:
+        return (await self._call("ping")).get("pong", False)

@@ -1,0 +1,351 @@
+"""dynstore — the coordination plane in one service.
+
+Provides, over one TCP protocol (wire.py frames):
+
+- **KV with leases + prefix watches** (the etcd role): put/get/get_prefix/
+  create/delete; leases with TTL + keepalive; keys bound to a lease vanish
+  when it expires; watchers get pushed put/delete events.
+- **Pub/sub** (the NATS core role): subject-based fanout.
+- **Work queues** (the JetStream role): push/pull-with-ack; unacked messages
+  return to the queue when their consumer's connection dies.
+
+Single asyncio process, all state in memory owned by one task group — the
+discovery/config/event/queue planes of SURVEY §1/L0 collapsed into one
+deployable binary. The same wire protocol is implemented natively (C++) as
+the production server; this Python server is the reference implementation
+and test fixture.
+
+Ops (client -> server): {op, id, ...} -> reply {id, ok, ...}; pushed
+server -> client frames carry {push: "watch"|"msg"|"queue", ...}.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
+
+from .wire import FrameReader, write_frame
+
+log = logging.getLogger("dynamo_tpu.store")
+
+DEFAULT_TTL = 5.0
+
+# sentinel: an op handler parked the request; the reply is pushed later
+DEFER = object()
+
+
+@dataclass
+class _KeyVal:
+    value: bytes
+    lease: Optional[int] = None
+
+
+@dataclass
+class _Lease:
+    id: int
+    ttl: float
+    expires: float
+    keys: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class _QueueMsg:
+    id: int
+    payload: bytes
+
+
+class _Conn:
+    _ids = itertools.count(1)
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.id = next(_Conn._ids)
+        self.writer = writer
+        self.watches: Dict[int, str] = {}          # watch_id -> prefix
+        self.subs: Dict[int, str] = {}             # sub_id -> subject
+        self.leases: Set[int] = set()
+        self.pulling: Dict[str, List[int]] = {}    # queue -> pending pull ids
+        self.unacked: Dict[Tuple[str, int], _QueueMsg] = {}
+        self._send_lock = asyncio.Lock()
+
+    async def push(self, obj: Any) -> None:
+        async with self._send_lock:
+            await write_frame(self.writer, obj)
+
+
+class StoreServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host, self.port = host, port
+        self._kv: Dict[str, _KeyVal] = {}
+        self._leases: Dict[int, _Lease] = {}
+        self._lease_ids = itertools.count(1)
+        self._watchers: Dict[int, Tuple[_Conn, int, str]] = {}  # gid -> (conn, wid, prefix)
+        self._watch_gids = itertools.count(1)
+        self._subs: Dict[str, Dict[int, Tuple[_Conn, int]]] = {}  # subject -> gid -> (conn, sid)
+        self._sub_gids = itertools.count(1)
+        self._queues: Dict[str, Deque[_QueueMsg]] = {}
+        self._queue_waiters: Dict[str, Deque[Tuple[_Conn, int]]] = {}
+        self._queue_msg_ids = itertools.count(1)
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._reaper: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------------
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(self._serve, self.host,
+                                                  self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._reaper = asyncio.create_task(self._reap_leases())
+        return self.port
+
+    async def stop(self) -> None:
+        if self._reaper:
+            self._reaper.cancel()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _reap_leases(self) -> None:
+        while True:
+            await asyncio.sleep(0.2)
+            now = time.monotonic()
+            for lid, lease in list(self._leases.items()):
+                if lease.expires < now:
+                    await self._expire_lease(lid)
+
+    async def _expire_lease(self, lid: int) -> None:
+        lease = self._leases.pop(lid, None)
+        if lease is None:
+            return
+        for key in list(lease.keys):
+            if key in self._kv and self._kv[key].lease == lid:
+                del self._kv[key]
+                await self._notify_watchers(key, None)
+
+    # ------------------------------------------------------------------
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        conn = _Conn(writer)
+        fr = FrameReader(reader)
+        try:
+            while True:
+                msg = await fr.read()
+                try:
+                    reply = await self._dispatch(conn, msg)
+                except Exception as e:  # noqa: BLE001 - op failure => error reply
+                    reply = {"id": msg.get("id"), "ok": False, "error": str(e)}
+                if reply is not None:
+                    await conn.push(reply)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            await self._cleanup(conn)
+            writer.close()
+
+    async def _cleanup(self, conn: _Conn) -> None:
+        for gid in [g for g, (c, _, _) in self._watchers.items() if c is conn]:
+            del self._watchers[gid]
+        for subject in list(self._subs):
+            self._subs[subject] = {g: v for g, v in self._subs[subject].items()
+                                   if v[0] is not conn}
+        # a dead consumer's unacked queue messages go back to the queue head
+        for (qname, _mid), m in list(conn.unacked.items()):
+            self._queues.setdefault(qname, collections.deque()).appendleft(m)
+            await self._kick_queue(qname)
+        conn.unacked.clear()
+        for qname, pulls in conn.pulling.items():
+            w = self._queue_waiters.get(qname)
+            if w:
+                self._queue_waiters[qname] = collections.deque(
+                    (c, rid) for c, rid in w if c is not conn)
+        # leases owned by this connection expire immediately (process death)
+        for lid in list(conn.leases):
+            await self._expire_lease(lid)
+
+    # ------------------------------------------------------------------
+    async def _dispatch(self, conn: _Conn, m: Dict[str, Any]) -> Optional[Dict]:
+        op = m["op"]
+        rid = m.get("id")
+        fn = getattr(self, f"_op_{op}", None)
+        if fn is None:
+            return {"id": rid, "ok": False, "error": f"unknown op {op!r}"}
+        out = await fn(conn, m)
+        if out is DEFER:
+            return None
+        if out is None:
+            out = {}
+        out.setdefault("id", rid)
+        out.setdefault("ok", True)
+        return out
+
+    # -- KV -------------------------------------------------------------
+    async def _op_put(self, conn, m):
+        key, value = m["key"], m["value"]
+        lease = m.get("lease")
+        if lease is not None and lease not in self._leases:
+            return {"ok": False, "error": "lease not found"}
+        self._kv[key] = _KeyVal(value, lease)
+        if lease is not None:
+            self._leases[lease].keys.add(key)
+        await self._notify_watchers(key, value)
+        return {}
+
+    async def _op_create(self, conn, m):
+        """Create-if-absent (atomic); optionally validate existing value."""
+        key = m["key"]
+        existing = self._kv.get(key)
+        if existing is not None:
+            if m.get("or_validate") and existing.value == m["value"]:
+                return {"created": False}
+            return {"ok": False, "error": "key exists"}
+        return await self._op_put(conn, m) or {"created": True}
+
+    async def _op_get(self, conn, m):
+        kv = self._kv.get(m["key"])
+        return {"value": kv.value if kv else None, "found": kv is not None}
+
+    async def _op_get_prefix(self, conn, m):
+        pfx = m["prefix"]
+        return {"items": [[k, v.value] for k, v in sorted(self._kv.items())
+                          if k.startswith(pfx)]}
+
+    async def _op_delete(self, conn, m):
+        key = m["key"]
+        kv = self._kv.pop(key, None)
+        if kv is not None:
+            if kv.lease in self._leases:
+                self._leases[kv.lease].keys.discard(key)
+            await self._notify_watchers(key, None)
+        return {"deleted": kv is not None}
+
+    async def _notify_watchers(self, key: str, value: Optional[bytes]) -> None:
+        for conn, wid, prefix in list(self._watchers.values()):
+            if key.startswith(prefix):
+                try:
+                    await conn.push({"push": "watch", "watch_id": wid,
+                                     "key": key, "value": value,
+                                     "deleted": value is None})
+                except Exception:
+                    pass
+
+    # -- leases ----------------------------------------------------------
+    async def _op_lease_grant(self, conn, m):
+        ttl = float(m.get("ttl", DEFAULT_TTL))
+        lid = next(self._lease_ids)
+        self._leases[lid] = _Lease(lid, ttl, time.monotonic() + ttl)
+        conn.leases.add(lid)
+        return {"lease": lid, "ttl": ttl}
+
+    async def _op_lease_keepalive(self, conn, m):
+        lease = self._leases.get(m["lease"])
+        if lease is None:
+            return {"ok": False, "error": "lease not found"}
+        lease.expires = time.monotonic() + lease.ttl
+        return {}
+
+    async def _op_lease_revoke(self, conn, m):
+        await self._expire_lease(m["lease"])
+        return {}
+
+    # -- watches ---------------------------------------------------------
+    async def _op_watch(self, conn, m):
+        """Register a prefix watch; current state is returned inline so the
+        caller starts from a consistent snapshot."""
+        wid = m["watch_id"]
+        prefix = m["prefix"]
+        gid = next(self._watch_gids)
+        self._watchers[gid] = (conn, wid, prefix)
+        conn.watches[wid] = prefix
+        items = [[k, v.value] for k, v in sorted(self._kv.items())
+                 if k.startswith(prefix)]
+        return {"items": items}
+
+    # -- pub/sub ---------------------------------------------------------
+    async def _op_subscribe(self, conn, m):
+        sid, subject = m["sub_id"], m["subject"]
+        gid = next(self._sub_gids)
+        self._subs.setdefault(subject, {})[gid] = (conn, sid)
+        conn.subs[sid] = subject
+        return {}
+
+    async def _op_publish(self, conn, m):
+        subject, payload = m["subject"], m["payload"]
+        n = 0
+        for c, sid in list(self._subs.get(subject, {}).values()):
+            try:
+                await c.push({"push": "msg", "sub_id": sid,
+                              "subject": subject, "payload": payload})
+                n += 1
+            except Exception:
+                pass
+        return {"delivered": n}
+
+    # -- work queues ------------------------------------------------------
+    async def _op_q_push(self, conn, m):
+        qname = m["queue"]
+        msg = _QueueMsg(next(self._queue_msg_ids), m["payload"])
+        self._queues.setdefault(qname, collections.deque()).append(msg)
+        await self._kick_queue(qname)
+        return {"msg_id": msg.id}
+
+    async def _op_q_pull(self, conn, m):
+        """Pull one message; blocks server-side by parking the request until
+        a message arrives. Message must be acked or it requeues on disconnect."""
+        qname = m["queue"]
+        q = self._queues.setdefault(qname, collections.deque())
+        if q:
+            msg = q.popleft()
+            conn.unacked[(qname, msg.id)] = msg
+            return {"msg_id": msg.id, "payload": msg.payload}
+        self._queue_waiters.setdefault(qname, collections.deque()).append(
+            (conn, m.get("id")))
+        conn.pulling.setdefault(qname, []).append(m.get("id"))
+        return DEFER  # reply pushed by _kick_queue when a message arrives
+
+    async def _op_q_ack(self, conn, m):
+        conn.unacked.pop((m["queue"], m["msg_id"]), None)
+        return {}
+
+    async def _op_q_len(self, conn, m):
+        q = self._queues.get(m["queue"])
+        return {"len": len(q) if q else 0}
+
+    async def _kick_queue(self, qname: str) -> None:
+        q = self._queues.get(qname)
+        waiters = self._queue_waiters.get(qname)
+        while q and waiters:
+            conn, rid = waiters.popleft()
+            if conn.writer.is_closing():
+                continue
+            msg = q.popleft()
+            conn.unacked[(qname, msg.id)] = msg
+            try:
+                await conn.push({"id": rid, "ok": True, "msg_id": msg.id,
+                                 "payload": msg.payload})
+            except Exception:
+                q.appendleft(msg)
+                conn.unacked.pop((qname, msg.id), None)
+
+    # -- misc -------------------------------------------------------------
+    async def _op_ping(self, conn, m):
+        return {"pong": True}
+
+
+async def main(host: str = "0.0.0.0", port: int = 4222) -> None:
+    srv = StoreServer(host, port)
+    p = await srv.start()
+    log.info("dynstore listening on %s:%s", host, p)
+    print(f"dynstore listening on {host}:{p}", flush=True)
+    while True:
+        await asyncio.sleep(3600)
+
+
+if __name__ == "__main__":
+    import sys
+
+    logging.basicConfig(level=logging.INFO)
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 4222
+    asyncio.run(main(port=port))

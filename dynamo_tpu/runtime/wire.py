@@ -1,0 +1,57 @@
+"""Framing + message codec shared by every dynamo_tpu TCP protocol.
+
+Frame = 4-byte big-endian length || msgpack payload. One codec for the store
+protocol, the request/data plane and the C++ implementations to come — a
+single place defines the bytes on the wire.
+
+The data plane additionally uses two-part messages: a small control header
+(dict) plus an optional raw binary payload, packed as one msgpack array
+[control, payload]. This mirrors the reference's TwoPartCodec
+(lib/runtime/src/pipeline/network/codec/two_part.rs) so large tensors ride
+untouched next to JSON-ish control data.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, Optional, Tuple
+
+import msgpack
+
+MAX_FRAME = 256 * 1024 * 1024  # 256 MB: KV block transfers ride this plane
+
+
+def pack(obj: Any) -> bytes:
+    body = msgpack.packb(obj, use_bin_type=True)
+    return struct.pack(">I", len(body)) + body
+
+
+def pack_two_part(control: dict, payload: Optional[bytes] = None) -> bytes:
+    return pack([control, payload])
+
+
+def unpack_two_part(obj: Any) -> Tuple[dict, Optional[bytes]]:
+    control, payload = obj
+    return control, payload
+
+
+class FrameReader:
+    """Incremental frame decoder over an asyncio StreamReader."""
+
+    def __init__(self, reader: asyncio.StreamReader):
+        self._r = reader
+
+    async def read(self) -> Any:
+        """Read one frame; raises asyncio.IncompleteReadError on EOF."""
+        hdr = await self._r.readexactly(4)
+        (n,) = struct.unpack(">I", hdr)
+        if n > MAX_FRAME:
+            raise ValueError(f"frame of {n} bytes exceeds MAX_FRAME")
+        body = await self._r.readexactly(n)
+        return msgpack.unpackb(body, raw=False)
+
+
+async def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
+    writer.write(pack(obj))
+    await writer.drain()

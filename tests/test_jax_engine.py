@@ -213,3 +213,32 @@ def test_cum_logprob_accumulates(core):
     got = drain(core, ["lp"])["lp"]
     # cumulative: non-increasing sum of per-token logprobs (logp <= 0)
     assert got[0].logprob >= got[1].logprob >= got[2].logprob
+
+
+def test_unaligned_max_context_correctness():
+    """max_context not divisible by page_size must not corrupt KV via
+    clamped page-table indexing (regression: floor-divided bucket widths)."""
+    cfg_a = make_cfg(max_batch=1, page_size=16, max_context=40,
+                     prefill_chunk=32)
+    cfg_b = make_cfg(max_batch=1, page_size=16, max_context=48,
+                     prefill_chunk=32)
+    prompt = list(range(1, 29))
+    ca, cb = EngineCore(cfg_a), EngineCore(cfg_b)
+    ca.submit("x", req(prompt, max_tokens=8))
+    cb.submit("x", req(prompt, max_tokens=8))
+    ta = [g.token for g in drain(ca, ["x"])["x"]]
+    tb = [g.token for g in drain(cb, ["x"])["x"]]
+    assert ta == tb[:len(ta)]
+
+
+def test_pool_pressure_defers_not_kills():
+    """With the pool exhausted by batchmates, a nearly-done request waits for
+    pages instead of dying with ERROR (regression: speculative reservation)."""
+    cfg = make_cfg(max_batch=2, page_size=8, max_context=64)
+    cfg.num_pages = 2 * ((64 + 8) // 8) + 1  # exactly 2 full seqs
+    core = EngineCore(cfg)
+    core.submit("a", req([1] * 30, max_tokens=20))
+    core.submit("b", req([2] * 30, max_tokens=20))
+    got = drain(core, ["a", "b"])
+    assert got["a"][-1].finish == FinishReason.LENGTH
+    assert got["b"][-1].finish == FinishReason.LENGTH

@@ -1,0 +1,331 @@
+"""Distributed runtime: dynstore (KV/lease/watch/pubsub/queue) and the
+component/endpoint/client model with the TCP data plane — all on localhost,
+mirroring the reference's subprocess-etcd/NATS test tier."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context, EngineError
+from dynamo_tpu.runtime.store_client import StoreClient, StoreError
+from dynamo_tpu.runtime.store_server import StoreServer
+
+
+async def start_store():
+    srv = StoreServer()
+    port = await srv.start()
+    return srv, port
+
+
+async def client(port):
+    return await StoreClient(port=port).connect()
+
+
+async def test_kv_basic():
+    srv, port = await start_store()
+    try:
+        c = await client(port)
+        await c.put("a/b", b"1")
+        assert await c.get("a/b") == b"1"
+        assert await c.get("missing") is None
+        await c.put("a/c", b"2")
+        assert await c.get_prefix("a/") == [("a/b", b"1"), ("a/c", b"2")]
+        assert await c.delete("a/b")
+        assert not await c.delete("a/b")
+        assert await c.create("a/d", b"3")
+        with pytest.raises(StoreError):
+            await c.create("a/d", b"4")
+        assert not await c.create("a/d", b"3", or_validate=True)
+        await c.close()
+    finally:
+        await srv.stop()
+
+
+async def test_lease_expiry_deletes_keys():
+    srv, port = await start_store()
+    try:
+        c1 = await client(port)
+        lease = await c1.lease_grant(ttl=0.5, auto_keepalive=False)
+        await c1.put("w/x", b"v", lease=lease)
+        c2 = await client(port)
+        assert await c2.get("w/x") == b"v"
+        await asyncio.sleep(1.0)  # lease expires without keepalive
+        assert await c2.get("w/x") is None
+        await c1.close()
+        await c2.close()
+    finally:
+        await srv.stop()
+
+
+async def test_connection_death_expires_lease():
+    srv, port = await start_store()
+    try:
+        c1 = await client(port)
+        lease = await c1.lease_grant(ttl=30.0)
+        await c1.put("d/k", b"v", lease=lease)
+        c2 = await client(port)
+        deleted = asyncio.Event()
+
+        async def cb(key, value, was_deleted):
+            if was_deleted:
+                deleted.set()
+
+        snap = await c2.watch_prefix("d/", cb)
+        assert snap == [("d/k", b"v")]
+        await c1.close()  # process death
+        await asyncio.wait_for(deleted.wait(), 2.0)
+        assert await c2.get("d/k") is None
+        await c2.close()
+    finally:
+        await srv.stop()
+
+
+async def test_watch_notifications():
+    srv, port = await start_store()
+    try:
+        c1 = await client(port)
+        c2 = await client(port)
+        events = []
+        got = asyncio.Event()
+
+        async def cb(key, value, deleted):
+            events.append((key, value, deleted))
+            got.set()
+
+        await c2.watch_prefix("ns/", cb)
+        await c1.put("ns/a", b"1")
+        await asyncio.wait_for(got.wait(), 2.0)
+        assert events[0] == ("ns/a", b"1", False)
+        await c1.close()
+        await c2.close()
+    finally:
+        await srv.stop()
+
+
+async def test_pubsub():
+    srv, port = await start_store()
+    try:
+        pub = await client(port)
+        sub = await client(port)
+        got = []
+        ev = asyncio.Event()
+
+        async def cb(subject, payload):
+            got.append((subject, payload))
+            ev.set()
+
+        await sub.subscribe("events.kv", cb)
+        n = await pub.publish("events.kv", b"hello")
+        assert n == 1
+        await asyncio.wait_for(ev.wait(), 2.0)
+        assert got == [("events.kv", b"hello")]
+        assert await pub.publish("nobody.home", b"x") == 0
+        await pub.close()
+        await sub.close()
+    finally:
+        await srv.stop()
+
+
+async def test_queue_push_pull_ack():
+    srv, port = await start_store()
+    try:
+        prod = await client(port)
+        cons = await client(port)
+        await prod.q_push("prefill", b"job1")
+        assert await prod.q_len("prefill") == 1
+        mid, payload = await cons.q_pull("prefill")
+        assert payload == b"job1"
+        await cons.q_ack("prefill", mid)
+        assert await prod.q_len("prefill") == 0
+        # blocking pull: starts before the push
+        pull_task = asyncio.create_task(cons.q_pull("prefill"))
+        await asyncio.sleep(0.05)
+        await prod.q_push("prefill", b"job2")
+        mid2, p2 = await asyncio.wait_for(pull_task, 2.0)
+        assert p2 == b"job2"
+        await cons.q_ack("prefill", mid2)
+        await prod.close()
+        await cons.close()
+    finally:
+        await srv.stop()
+
+
+async def test_queue_unacked_requeues_on_disconnect():
+    srv, port = await start_store()
+    try:
+        prod = await client(port)
+        cons1 = await client(port)
+        await prod.q_push("q", b"work")
+        mid, _ = await cons1.q_pull("q")
+        await cons1.close()  # dies without ack
+        await asyncio.sleep(0.2)
+        cons2 = await client(port)
+        mid2, payload = await asyncio.wait_for(cons2.q_pull("q"), 2.0)
+        assert payload == b"work"
+        await cons2.q_ack("q", mid2)
+        await prod.close()
+        await cons2.close()
+    finally:
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# component / endpoint / client
+# ---------------------------------------------------------------------------
+
+async def echo_handler(request, ctx: Context):
+    for tok in request["text"].split():
+        yield {"word": tok}
+
+
+async def test_endpoint_serve_and_client_roundtrip():
+    srv, port = await start_store()
+    try:
+        worker = await DistributedRuntime(store_port=port,
+                                          advertise_host="127.0.0.1").connect()
+        ep = worker.namespace("test").component("echo").endpoint("generate")
+        await ep.serve(echo_handler)
+
+        caller = await DistributedRuntime(store_port=port).connect()
+        cl = await caller.namespace("test").component("echo") \
+            .endpoint("generate").client().start()
+        await cl.wait_for_instances(1)
+        items = [x async for x in cl.generate({"text": "a b c"})]
+        assert items == [{"word": "a"}, {"word": "b"}, {"word": "c"}]
+        await caller.close()
+        await worker.close()
+    finally:
+        await srv.stop()
+
+
+async def test_routing_modes_and_failure_detection():
+    srv, port = await start_store()
+    try:
+        workers = []
+        for i in range(2):
+            w = await DistributedRuntime(store_port=port,
+                                         advertise_host="127.0.0.1").connect()
+
+            def make_handler(wid):
+                async def handler(request, ctx):
+                    yield {"served_by": wid}
+
+                return handler
+
+            await w.namespace("t").component("c").endpoint("g") \
+                .serve(make_handler(i))
+            workers.append(w)
+
+        caller = await DistributedRuntime(store_port=port).connect()
+        cl = await caller.namespace("t").component("c").endpoint("g") \
+            .client().start()
+        await cl.wait_for_instances(2)
+
+        # round robin alternates
+        served = []
+        for _ in range(4):
+            async for item in cl.generate({}, mode="round_robin"):
+                served.append(item["served_by"])
+        assert set(served) == {0, 1}
+
+        # direct hits the chosen instance
+        iid = cl.instance_ids()[0]
+        async for item in cl.generate({}, mode="direct", instance_id=iid):
+            direct_hit = item["served_by"]
+
+        # worker death => instance disappears from the live set
+        await workers[0].close()
+        for _ in range(40):
+            if len(cl.instances) == 1:
+                break
+            await asyncio.sleep(0.05)
+        assert len(cl.instances) == 1
+        async for item in cl.generate({}):
+            assert item["served_by"] == 1
+        await caller.close()
+        await workers[1].close()
+    finally:
+        await srv.stop()
+
+
+async def test_remote_error_prologue():
+    srv, port = await start_store()
+    try:
+        w = await DistributedRuntime(store_port=port,
+                                     advertise_host="127.0.0.1").connect()
+
+        async def failing(request, ctx):
+            raise EngineError("model exploded", 500)
+            yield  # pragma: no cover
+
+        await w.namespace("t").component("f").endpoint("g").serve(failing)
+        caller = await DistributedRuntime(store_port=port).connect()
+        cl = await caller.namespace("t").component("f").endpoint("g") \
+            .client().start()
+        await cl.wait_for_instances(1)
+        with pytest.raises(EngineError, match="model exploded"):
+            async for _ in cl.generate({}):
+                pass
+        await caller.close()
+        await w.close()
+    finally:
+        await srv.stop()
+
+
+async def test_stop_propagates_to_remote():
+    srv, port = await start_store()
+    try:
+        w = await DistributedRuntime(store_port=port,
+                                     advertise_host="127.0.0.1").connect()
+        server_stopped = asyncio.Event()
+
+        async def endless(request, ctx):
+            i = 0
+            while not ctx.is_stopped:
+                yield {"i": i}
+                i += 1
+                await asyncio.sleep(0.01)
+            server_stopped.set()
+
+        await w.namespace("t").component("e").endpoint("g").serve(endless)
+        caller = await DistributedRuntime(store_port=port).connect()
+        cl = await caller.namespace("t").component("e").endpoint("g") \
+            .client().start()
+        await cl.wait_for_instances(1)
+        ctx = Context()
+        n = 0
+        async for _ in cl.generate({}, context=ctx):
+            n += 1
+            if n == 3:
+                ctx.stop_generating()
+                break
+        await asyncio.wait_for(server_stopped.wait(), 2.0)
+        await caller.close()
+        await w.close()
+    finally:
+        await srv.stop()
+
+
+async def test_event_plane_namespace_scoped():
+    srv, port = await start_store()
+    try:
+        a = await DistributedRuntime(store_port=port).connect()
+        b = await DistributedRuntime(store_port=port).connect()
+        got = asyncio.Event()
+        events = []
+
+        async def cb(payload):
+            events.append(payload)
+            got.set()
+
+        await b.namespace("ns").component("comp").subscribe("kv_events", cb)
+        await a.namespace("ns").component("comp").publish(
+            "kv_events", {"worker_id": 7})
+        await asyncio.wait_for(got.wait(), 2.0)
+        assert events == [{"worker_id": 7}]
+        await a.close()
+        await b.close()
+    finally:
+        await srv.stop()

@@ -1,0 +1,160 @@
+"""End-to-end serving graph in one process (multi-task): dynstore + JAX/echo
+workers + KV router + discovery HTTP frontend — BASELINE config-3 shape."""
+
+import argparse
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.cli.http import DiscoveryFrontend, run_http
+from dynamo_tpu.cli.router import run_router
+from dynamo_tpu.cli.worker import run_worker
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.store_server import StoreServer
+
+
+def worker_args(port, component="backend", engine="echo", **kw):
+    d = dict(engine=engine, namespace="dyn", component=component,
+             store=f"127.0.0.1:{port}", advertise_host="127.0.0.1",
+             model_path=None, model_name="m1", register_model=True,
+             tp=1, kv_block_size=8, metrics_interval=0.2,
+             extra_engine_args=None)
+    d.update(kw)
+    return argparse.Namespace(**d)
+
+
+async def spawn(coro_fn, args, drt):
+    ready = asyncio.Event()
+    task = asyncio.create_task(coro_fn(args, ready_event=ready, drt=drt))
+    await asyncio.wait_for(ready.wait(), 30)
+    return task
+
+
+async def test_full_graph_echo_workers():
+    store = StoreServer()
+    port = await store.start()
+    tasks, drts = [], []
+    try:
+        # two echo workers
+        for i in range(2):
+            drt = await DistributedRuntime(
+                store_port=port, advertise_host="127.0.0.1").connect()
+            drts.append(drt)
+            tasks.append(await spawn(run_worker, worker_args(port), drt))
+        # router over them
+        rdrt = await DistributedRuntime(
+            store_port=port, advertise_host="127.0.0.1").connect()
+        drts.append(rdrt)
+        rargs = argparse.Namespace(namespace="dyn", component="router",
+                                   worker_component="backend",
+                                   store=f"127.0.0.1:{port}",
+                                   advertise_host="127.0.0.1", block_size=8)
+        tasks.append(await spawn(run_router, rargs, rdrt))
+        # discovery http frontend
+        hdrt = await DistributedRuntime(store_port=port).connect()
+        drts.append(hdrt)
+        hargs = argparse.Namespace(store=f"127.0.0.1:{port}",
+                                   host="127.0.0.1", port=0,
+                                   router_component="router")
+        svc = await run_http(hargs, drt=hdrt)
+        base = f"http://127.0.0.1:{svc.port}"
+
+        async with aiohttp.ClientSession() as s:
+            # model discovered from the store registration
+            for _ in range(50):
+                async with s.get(f"{base}/v1/models") as r:
+                    models = await r.json()
+                if models["data"]:
+                    break
+                await asyncio.sleep(0.1)
+            assert models["data"][0]["id"] == "m1"
+
+            # chat via remote echo worker (through router + data plane)
+            body = {"model": "m1",
+                    "messages": [{"role": "user", "content": "remote hello"}],
+                    "ext": {"use_raw_prompt": True}}
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                data = await r.json()
+            assert data["choices"][0]["message"]["content"] == "remote hello"
+
+            # streaming path: reconstruct content from per-token deltas
+            body["stream"] = True
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                text = (await r.read()).decode()
+            from dynamo_tpu.llm.protocols.openai import sse_parse_lines
+
+            payloads = sse_parse_lines(text.splitlines())
+            assert payloads[-1] == "[DONE]"
+            content = "".join(
+                json.loads(p)["choices"][0]["delta"].get("content", "")
+                for p in payloads[:-1])
+            assert content == "remote hello"
+
+        await svc.stop()
+    finally:
+        for t in tasks:
+            t.cancel()
+        for d in drts:
+            await d.close()
+        await store.stop()
+
+
+async def test_full_graph_jax_worker_kv_routing():
+    """JAX worker publishes KV events; the router index fills; routing pins
+    repeat prefixes to the same worker."""
+    store = StoreServer()
+    port = await store.start()
+    tasks, drts = [], []
+    try:
+        for i in range(2):
+            drt = await DistributedRuntime(
+                store_port=port, advertise_host="127.0.0.1").connect()
+            drts.append(drt)
+            tasks.append(await spawn(run_worker, worker_args(
+                port, engine="jax",
+                extra_engine_args=json.dumps({
+                    "max_batch": 2, "max_context": 64, "prefill_chunk": 32,
+                    "decode_steps": 4})), drt))
+        rdrt = await DistributedRuntime(
+            store_port=port, advertise_host="127.0.0.1").connect()
+        drts.append(rdrt)
+        rargs = argparse.Namespace(namespace="dyn", component="router",
+                                   worker_component="backend",
+                                   store=f"127.0.0.1:{port}",
+                                   advertise_host="127.0.0.1", block_size=8)
+        tasks.append(await spawn(run_router, rargs, rdrt))
+        hdrt = await DistributedRuntime(store_port=port).connect()
+        drts.append(hdrt)
+        hargs = argparse.Namespace(store=f"127.0.0.1:{port}",
+                                   host="127.0.0.1", port=0,
+                                   router_component="router")
+        svc = await run_http(hargs, drt=hdrt)
+        base = f"http://127.0.0.1:{svc.port}"
+
+        async with aiohttp.ClientSession() as s:
+            for _ in range(50):
+                async with s.get(f"{base}/v1/models") as r:
+                    if (await r.json())["data"]:
+                        break
+                await asyncio.sleep(0.1)
+            body = {"model": "m1", "prompt": list(range(1, 25)),
+                    "max_tokens": 4}
+            async with s.post(f"{base}/v1/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                d1 = await r.json()
+            assert d1["usage"]["completion_tokens"] == 4
+            # same prefix again: must succeed and reuse the graph end-to-end
+            async with s.post(f"{base}/v1/completions", json=body) as r:
+                assert r.status == 200
+                d2 = await r.json()
+            assert d2["choices"][0]["text"] == d1["choices"][0]["text"]
+        await svc.stop()
+    finally:
+        for t in tasks:
+            t.cancel()
+        for d in drts:
+            await d.close()
+        await store.stop()
