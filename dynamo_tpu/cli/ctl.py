@@ -4,8 +4,12 @@
         my-model dynamo.backend.generate [--model-path ...]
     python -m dynamo_tpu.cli.ctl http list
     python -m dynamo_tpu.cli.ctl http remove chat my-model
+    python -m dynamo_tpu.cli.ctl disagg set --namespace dynamo \
+        --max-local-prefill-length 1000 --max-prefill-queue-size 2
 
-Reference capability: launch/llmctl (http add/list/remove model mappings).
+Reference capability: launch/llmctl (http add/list/remove model mappings)
+plus live disagg-threshold reconfiguration (the reference's etcd-watched
+DisaggregatedRouter config, lib/llm/src/disagg_router.rs:38-143).
 """
 
 from __future__ import annotations
@@ -38,6 +42,17 @@ def parse_args(argv=None):
     rem.add_argument("name")
 
     hsub.add_parser("list")
+
+    disagg = sub.add_parser("disagg")
+    dsub = disagg.add_subparsers(dest="action", required=True)
+    dset = dsub.add_parser("set")
+    dset.add_argument("--namespace", default="dynamo")
+    dset.add_argument("--model", default="default")
+    dset.add_argument("--max-local-prefill-length", type=int, default=1000)
+    dset.add_argument("--max-prefill-queue-size", type=int, default=2)
+    dget = dsub.add_parser("get")
+    dget.add_argument("--namespace", default="dynamo")
+    dget.add_argument("--model", default="default")
     return p.parse_args(argv)
 
 
@@ -45,6 +60,23 @@ async def run(args) -> int:
     host, port = args.store.split(":")
     store = await StoreClient(host, int(port)).connect()
     try:
+        if args.plane == "disagg":
+            from ..llm.disagg import (DisaggConfig, disagg_config_key,
+                                      set_disagg_config)
+
+            if args.action == "set":
+                cfg = DisaggConfig(
+                    max_local_prefill_length=args.max_local_prefill_length,
+                    max_prefill_queue_size=args.max_prefill_queue_size)
+                await set_disagg_config(store, args.namespace, cfg,
+                                        model=args.model)
+                print(f"disagg config for {args.namespace}/{args.model}: "
+                      f"{cfg.to_dict()}")
+            else:
+                raw = await store.get(
+                    disagg_config_key(args.namespace, args.model))
+                print(raw.decode() if raw else "(not set)")
+            return 0
         if args.action == "add":
             if args.model_path:
                 card = ModelDeploymentCard.from_local_path(

@@ -1,0 +1,131 @@
+"""Prefill worker: pulls the shared prefill queue, computes prompt KV on its
+own TPU slice, ships it to the owning decode worker.
+
+    python -m dynamo_tpu.cli.prefill_worker --namespace dynamo \
+        --decode-component backend --store localhost:4222 [--model-path ...]
+
+Like the reference's PrefillWorker (examples/llm/components/
+prefill_worker.py:46-158), prefill workers need **no registration**: they are
+queue consumers, so scaling up/down is just starting/stopping processes —
+unacked jobs are redelivered if one dies mid-prefill.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from ..llm.disagg import PrefillQueue
+from ..llm.kv_transfer import KV_RECEIVE_ENDPOINT, push_kv, push_kv_error
+
+MAX_ATTEMPTS = 3
+from ..llm.model_card import ModelDeploymentCard
+from ..llm.protocols.common import BackendInput
+from ..runtime.component import DistributedRuntime
+from ..runtime.engine import Context
+
+log = logging.getLogger("dynamo_tpu.prefill_worker")
+
+
+async def run_prefill_worker(args, *,
+                             ready_event: Optional[asyncio.Event] = None,
+                             drt: Optional[DistributedRuntime] = None,
+                             max_jobs: Optional[int] = None) -> None:
+    host, port = args.store.split(":")
+    own_drt = drt is None
+    if own_drt:
+        drt = await DistributedRuntime(
+            store_host=host, store_port=int(port),
+            advertise_host=args.advertise_host).connect()
+    ns = drt.namespace(args.namespace)
+
+    from ..engine.engine import JaxEngine, JaxEngineConfig
+
+    if args.model_path:
+        card = ModelDeploymentCard.from_local_path(args.model_path,
+                                                   args.model_name)
+    else:
+        card = ModelDeploymentCard.synthetic(args.model_name or "prefill")
+    card.kv_block_size = args.kv_block_size
+    extra = json.loads(args.extra_engine_args) if args.extra_engine_args else {}
+    cfg = JaxEngineConfig.from_card(card, tensor_parallel=args.tp, **extra)
+    # off-loop: engine bring-up must not starve the lease keepalive
+    engine = await asyncio.get_running_loop().run_in_executor(
+        None, lambda: JaxEngine(cfg))
+
+    queue = PrefillQueue(drt.store, args.namespace)
+    kv_client = await ns.component(args.decode_component) \
+        .endpoint(KV_RECEIVE_ENDPOINT).client().start()
+
+    log.info("prefill worker up, pulling %s", queue.queue)
+    print(f"prefill worker pulling {queue.queue}", flush=True)
+    if ready_event is not None:
+        ready_event.set()
+    done = 0
+    try:
+        while max_jobs is None or done < max_jobs:
+            msg_id, job = await queue.dequeue()
+            try:
+                bi = BackendInput.from_dict(job.request)
+                ctx = Context(job.request_id)
+                k, v, tok, logp = await engine.prefill_extract(bi, ctx)
+                await push_kv(kv_client, job.decode_worker_id,
+                              job.request_id, tok, logp, k, v)
+                await queue.ack(msg_id)
+                log.info("prefilled %s (%d tokens) -> worker %x",
+                         job.request_id, len(bi.token_ids),
+                         job.decode_worker_id)
+            except Exception as e:
+                # the store only redelivers unacked jobs when THIS connection
+                # dies — so ack and explicitly re-enqueue with an attempt
+                # count, dead-lettering back to the decode worker when the
+                # job looks poisoned (it falls back / errors the request)
+                log.exception("prefill job %s failed (attempt %d)",
+                              job.request_id, job.attempts + 1)
+                job.attempts += 1
+                await queue.ack(msg_id)
+                if job.attempts < MAX_ATTEMPTS:
+                    await queue.enqueue(job)
+                else:
+                    try:
+                        await push_kv_error(kv_client, job.decode_worker_id,
+                                            job.request_id, str(e))
+                    except Exception:
+                        log.exception("could not dead-letter %s",
+                                      job.request_id)
+                await asyncio.sleep(0.2)
+            done += 1
+    finally:
+        engine.shutdown()
+        if own_drt:
+            await drt.close()
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="dynamo-prefill-worker")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--decode-component", default="backend")
+    p.add_argument("--store", default="127.0.0.1:4222")
+    p.add_argument("--advertise-host", default=None)
+    p.add_argument("--model-path", default=None)
+    p.add_argument("--model-name", default=None)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--kv-block-size", type=int, default=64)
+    p.add_argument("--extra-engine-args", default=None,
+                   help="inline JSON engine kwargs")
+    return p.parse_args(argv)
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    try:
+        asyncio.run(run_prefill_worker(parse_args()))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

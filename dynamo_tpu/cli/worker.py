@@ -19,9 +19,14 @@ import json
 import logging
 from typing import Optional
 
+from ..llm.disagg import (DisaggConfig, DisaggRouter, PrefillQueue,
+                          RemotePrefillRequest)
 from ..llm.kv_router.protocols import KV_EVENT_SUBJECT, ForwardPassMetrics
 from ..llm.kv_router.publisher import KvEventPublisher
+from ..llm.kv_transfer import (KV_RECEIVE_ENDPOINT, KvReceiver,
+                               RemotePrefillError)
 from ..llm.model_card import ModelDeploymentCard
+from ..llm.protocols.common import BackendInput
 from ..llm.remote import register_model, serve_core_engine
 from ..runtime.component import DistributedRuntime
 
@@ -59,7 +64,10 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
 
         extra = json.loads(args.extra_engine_args) if args.extra_engine_args else {}
         cfg = JaxEngineConfig.from_card(card, tensor_parallel=args.tp, **extra)
-        engine = JaxEngine(cfg)
+        # engine bring-up (jax init, weight load, device_put) can exceed the
+        # lease TTL — run it off-loop so lease keepalives keep flowing
+        engine = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: JaxEngine(cfg))
         core = engine.core
     else:
         from ..llm.engines import EchoCoreEngine
@@ -79,7 +87,76 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
 
     # --- serve endpoint ----------------------------------------------
     endpoint = component.endpoint("generate")
-    await serve_core_engine(endpoint, engine)
+    if getattr(args, "enable_disagg", False) and core is not None:
+        # decode worker with conditional remote prefill (SURVEY §3.2):
+        # long cold prompts go to the shared queue; KV comes back on the
+        # kv_receive endpoint and the request enters decode directly
+        queue = PrefillQueue(drt.store, args.namespace)
+        drouter = await DisaggRouter(
+            args.namespace,
+            config=DisaggConfig(
+                max_local_prefill_length=getattr(
+                    args, "max_local_prefill_length", 1000),
+                max_prefill_queue_size=getattr(
+                    args, "max_prefill_queue_size", 2)),
+        ).start(drt.store)
+        receiver = KvReceiver()
+        await component.endpoint(KV_RECEIVE_ENDPOINT).serve(receiver.handler)
+
+        remote_timeout = getattr(args, "remote_prefill_timeout", 120.0)
+
+        async def await_remote_kv(ctx, fut):
+            """Wait for the KV push, racing client-stop and a timeout.
+            Returns the KV tuple, or None => fall back to local prefill."""
+            stop = asyncio.ensure_future(ctx.stopped())
+            try:
+                done, _ = await asyncio.wait(
+                    {fut, stop}, timeout=remote_timeout,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if fut in done:
+                    return fut.result()  # may raise RemotePrefillError
+                if stop in done:
+                    raise asyncio.CancelledError
+                log.warning("remote prefill for %s timed out after %.0fs; "
+                            "prefilling locally", ctx.id, remote_timeout)
+                return None
+            finally:
+                stop.cancel()
+                receiver.abandon(ctx.id)
+
+        async def generate_handler(request, ctx):
+            bi = BackendInput.from_dict(request)
+            prefix_hit = 0  # local prefix-cache hits count against remoting
+            remote = False
+            if drouter.length_exceeds_local(len(bi.token_ids), prefix_hit):
+                # only candidates pay the queue-depth RPC
+                qsize = await queue.size()
+                remote = drouter.should_prefill_remote(
+                    len(bi.token_ids), prefix_hit, qsize)
+            if remote:
+                # register interest BEFORE enqueueing: a fast prefill worker
+                # may push the KV back before we'd otherwise start listening
+                fut = receiver.expect(ctx.id)
+                await queue.enqueue(RemotePrefillRequest(
+                    ctx.id, drt.worker_id, request))
+                try:
+                    kv = await await_remote_kv(ctx, fut)
+                except RemotePrefillError as e:
+                    log.warning("remote prefill for %s dead-lettered (%s); "
+                                "prefilling locally", ctx.id, e)
+                    kv = None
+                if kv is not None:
+                    k, v, tok, logp = kv
+                    async for out in engine.generate_prefilled(
+                            bi, ctx, k, v, tok, logp):
+                        yield out.to_dict()
+                    return
+            async for out in engine.generate(bi, ctx):
+                yield out.to_dict()
+
+        await endpoint.serve(generate_handler)
+    else:
+        await serve_core_engine(endpoint, engine)
     if args.register_model:
         await register_model(drt.store, card, endpoint.path,
                              model_type="chat", lease=drt.lease)
@@ -126,6 +203,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--kv-block-size", type=int, default=64)
     p.add_argument("--metrics-interval", type=float, default=1.0)
+    p.add_argument("--enable-disagg", action="store_true",
+                   help="decode role: remote-prefill long cold prompts")
+    p.add_argument("--max-local-prefill-length", type=int, default=1000)
+    p.add_argument("--max-prefill-queue-size", type=int, default=2)
+    p.add_argument("--remote-prefill-timeout", type=float, default=120.0)
     p.add_argument("--extra-engine-args", default=None,
                    help="inline JSON engine kwargs")
     return p.parse_args(argv)

@@ -295,12 +295,15 @@ class EngineCore:
     # ------------------------------------------------------------------
     # KV export/import (disaggregated prefill -> decode transfer)
     # ------------------------------------------------------------------
-    def extract_kv(self, seq_id: str, layer: Optional[int] = None):
+    def extract_kv(self, seq_id: str, layer: Optional[int] = None,
+                   count: Optional[int] = None):
         """Gather a sequence's KV out of the pool -> host numpy arrays.
         With ``layer`` set, returns that layer only ([T,Hkv,Dh] k, v) for
-        layer-pipelined transfer; otherwise all layers ([L,T,Hkv,Dh])."""
+        layer-pipelined transfer; otherwise all layers ([L,T,Hkv,Dh]).
+        ``count`` limits extraction to the first N tokens (e.g. the prompt)."""
         sc = self.pool.seqs[seq_id]
-        slots = jnp.asarray(self.pool.write_slots(seq_id, 0, sc.num_tokens))
+        n = sc.num_tokens if count is None else min(count, sc.num_tokens)
+        slots = jnp.asarray(self.pool.write_slots(seq_id, 0, n))
         if layer is None:
             k = np.asarray(self._kv_gather(self.k_pool, slots))
             v = np.asarray(self._kv_gather(self.v_pool, slots))
@@ -319,6 +322,42 @@ class EngineCore:
             self._gather_layer_fn = jax.jit(
                 lambda p, s, l: p[l][s], static_argnums=2)
         return self._gather_layer_fn(pool, slots, layer)
+
+    def prefill_extract(self, seq_id: str, request: BackendInput
+                        ) -> Tuple[np.ndarray, np.ndarray, int, float]:
+        """Prefill-worker path: run the full (chunked) prefill for a request,
+        sample its first token, gather the prompt KV to host, release the
+        slot. Returns (k [L,T,Hkv,Dh], v, first_token, first_logprob).
+        The caller owns queue/transfer; this runs on the engine thread."""
+        from dataclasses import replace
+
+        prompt = list(request.token_ids)
+        if len(prompt) + 1 >= self.cfg.max_context:
+            raise ValueError(f"prompt of {len(prompt)} exceeds max_context")
+        if None not in self.slots:
+            raise RuntimeError("no free slot for prefill job")
+        # the first sampled token must never finish the slot (we need the KV
+        # before release) — neutralize stop conditions for the prefill pass
+        req = replace(request, stop=replace(
+            request.stop, max_tokens=None, stop_token_ids=[],
+            min_tokens=None, ignore_eos=True))
+        slot_idx = self.slots.index(None)
+        slot = _Slot(seq_id, req, prompt)
+        self.slots[slot_idx] = slot
+        self.by_seq[seq_id] = slot
+        self.pool.create(seq_id)
+        self._load_sampling(slot_idx, req)
+        out: List[StepOutput] = []
+        try:
+            while slot.prefill_done < len(prompt):
+                self._prefill_chunk(slot_idx, slot, out)
+                if out and out[-1].finish == FinishReason.ERROR:
+                    raise OutOfPages("prefill ran out of KV pages")
+            so = out[-1]
+            k, v = self.extract_kv(seq_id, count=len(prompt))
+        finally:
+            self._free_slot(slot_idx)
+        return k, v, so.token, so.logprob
 
     def inject_prefilled(self, seq_id: str, request: BackendInput,
                          k: np.ndarray, v: np.ndarray,
@@ -348,14 +387,7 @@ class EngineCore:
         slot = _Slot(seq_id, request, prompt, prefill_done=len(prompt))
         self.slots[slot_idx] = slot
         self.by_seq[seq_id] = slot
-        s = self.sampling
-        s.temperature[slot_idx] = float(request.sampling.temperature or 0.0)
-        s.top_p[slot_idx] = float(request.sampling.top_p
-                                  if request.sampling.top_p is not None else 1.0)
-        s.top_k[slot_idx] = int(min(request.sampling.top_k or 0, STATIC_K))
-        if request.sampling.seed is not None:
-            s.key = s.key.at[slot_idx].set(
-                jax.random.key(request.sampling.seed))
+        self._load_sampling(slot_idx, request)
         self._append_generated(slot, int(first_token))
         slot.cum_logprob = float(first_logprob)
         fin = self._finish_reason(slot, int(first_token))
@@ -431,6 +463,10 @@ class EngineCore:
         self.slots[slot_idx] = slot
         self.by_seq[seq_id] = slot
         self.pool.create(seq_id)
+        self._load_sampling(slot_idx, req)
+        return self._prefill_chunk(slot_idx, slot, out)
+
+    def _load_sampling(self, slot_idx: int, req: BackendInput) -> None:
         s = self.sampling
         s.temperature[slot_idx] = float(req.sampling.temperature or 0.0)
         s.top_p[slot_idx] = float(req.sampling.top_p
@@ -439,7 +475,6 @@ class EngineCore:
         if req.sampling.seed is not None:
             s.key = s.key.at[slot_idx].set(
                 jax.random.key(req.sampling.seed))
-        return self._prefill_chunk(slot_idx, slot, out)
 
     def _prefill_chunk(self, slot_idx: int, slot: _Slot,
                        out: List[StepOutput]) -> bool:
@@ -586,6 +621,16 @@ class EngineCore:
         return outs
 
 
+def _set_result(fut, res) -> None:
+    if not fut.done():
+        fut.set_result(res)
+
+
+def _set_exception(fut, exc) -> None:
+    if not fut.done():
+        fut.set_exception(exc)
+
+
 def _has_safetensors(path: str) -> bool:
     import glob
     import os
@@ -633,6 +678,14 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                         log.exception("KV injection failed")
                         so = StepOutput(seq_id, 0, 0.0, FinishReason.ERROR)
                     self._deliver(so)
+                elif kind == "prefill_extract":
+                    request, loop, fut = payload
+                    try:
+                        res = self.core.prefill_extract(seq_id, request)
+                        loop.call_soon_threadsafe(_set_result, fut, res)
+                    except Exception as e:
+                        log.exception("prefill_extract failed")
+                        loop.call_soon_threadsafe(_set_exception, fut, e)
             if not self.core.has_work:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -669,6 +722,17 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                        context: Context) -> AsyncIterator[EngineOutput]:
         async for out in self._generate(("submit", request), context):
             yield out
+
+    async def prefill_extract(self, request: BackendInput, context: Context
+                              ) -> Tuple[np.ndarray, np.ndarray, int, float]:
+        """Prefill-worker entry: compute prompt KV + first token on the
+        engine thread, await the result. Returns (k, v, token, logprob)."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._inbox.put(("prefill_extract", context.id,
+                         (request, loop, fut)))
+        self._wake.set()
+        return await fut
 
     async def generate_prefilled(self, request: BackendInput, context: Context,
                                  k, v, first_token: int,

@@ -1,0 +1,153 @@
+"""Disaggregated prefill/decode serving: queue, decision router, protocol.
+
+The decode worker receives every request. For long, cold prompts it enqueues a
+:class:`RemotePrefillRequest` on the shared prefill queue (dynstore work queue
+— the JetStream role) instead of prefilling locally; a prefill worker pulls
+the queue, computes the prompt KV on its own TPU slice and pushes the blocks
+straight to the decode worker's ``kv_receive`` endpoint over the data plane
+(the NIXL-RDMA role, host-staged over DCN on TPU). The decode worker then
+enters the sequence directly into its decode batch.
+
+The local-vs-remote decision and its live-reloadable threshold mirror the
+reference's DisaggregatedRouter (lib/llm/src/disagg_router.rs:146-262:
+``prefill_length - prefix_hit_length > max_local_prefill_length`` and queue
+depth below ``max_prefill_queue_size``; etcd-watched config at
+lib/llm/src/disagg_router.rs:38-143). The queue protocol mirrors
+examples/llm/utils/nats_queue.py:27-150; the request shape mirrors the vLLM
+patch's RemotePrefillRequest (patch:3716-3789).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+log = logging.getLogger("dynamo_tpu.disagg")
+
+DISAGG_CONFIG_PREFIX = "disagg/"  # store key: disagg/{namespace}/{model}
+
+
+def disagg_config_key(namespace: str, model: str = "default") -> str:
+    return f"{DISAGG_CONFIG_PREFIX}{namespace}/{model}"
+
+
+def prefill_queue_name(namespace: str) -> str:
+    return f"{namespace}.prefill"
+
+
+@dataclass
+class RemotePrefillRequest:
+    """One unit of prefill work handed from a decode worker to the queue.
+
+    ``decode_worker_id`` lets the prefill worker route the computed KV back
+    with direct addressing; ``request`` is the full BackendInput dict so the
+    prefill engine can honour sampling for the first generated token.
+    """
+
+    request_id: str
+    decode_worker_id: int
+    request: Dict[str, Any]
+    prefix_hit_tokens: int = 0
+    attempts: int = 0
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "RemotePrefillRequest":
+        return cls(**json.loads(b.decode()))
+
+
+class PrefillQueue:
+    """Shared work queue of RemotePrefillRequests over the dynstore queue
+    plane. Unacked messages are redelivered when a prefill worker dies
+    mid-job (at-least-once, like the durable JetStream pull consumer)."""
+
+    def __init__(self, store, namespace: str):
+        self.store = store
+        self.queue = prefill_queue_name(namespace)
+
+    async def enqueue(self, req: RemotePrefillRequest) -> int:
+        return await self.store.q_push(self.queue, req.to_bytes())
+
+    async def dequeue(self) -> tuple:
+        """Blocks until work is available. Returns (msg_id, request);
+        the caller MUST ack(msg_id) after the KV has been delivered."""
+        msg_id, payload = await self.store.q_pull(self.queue)
+        return msg_id, RemotePrefillRequest.from_bytes(payload)
+
+    async def ack(self, msg_id: int) -> None:
+        await self.store.q_ack(self.queue, msg_id)
+
+    async def size(self) -> int:
+        return await self.store.q_len(self.queue)
+
+
+@dataclass
+class DisaggConfig:
+    max_local_prefill_length: int = 1000
+    max_prefill_queue_size: int = 2
+
+    def to_dict(self) -> Dict[str, int]:
+        return dict(self.__dict__)
+
+
+class DisaggRouter:
+    """The local-vs-remote prefill decision, with the threshold live-reloaded
+    from the store (set via ``dynamo-ctl disagg set``)."""
+
+    def __init__(self, namespace: str, model: str = "default",
+                 config: Optional[DisaggConfig] = None):
+        self.namespace = namespace
+        self.model = model
+        self.config = config or DisaggConfig()
+
+    def length_exceeds_local(self, prefill_length: int,
+                             prefix_hit_length: int) -> bool:
+        """Cheap first-stage check (no queue RPC needed)."""
+        return (prefill_length - prefix_hit_length
+                > self.config.max_local_prefill_length)
+
+    def should_prefill_remote(self, prefill_length: int,
+                              prefix_hit_length: int,
+                              queue_size: int) -> bool:
+        return (self.length_exceeds_local(prefill_length, prefix_hit_length)
+                and queue_size < self.config.max_prefill_queue_size)
+
+    # ------------------------------------------------------------------
+    async def start(self, store) -> "DisaggRouter":
+        """Load current config and watch the key for live updates."""
+        key = disagg_config_key(self.namespace, self.model)
+
+        async def on_change(k: str, value: Optional[bytes], deleted: bool):
+            # prefix watch: ignore sibling models whose name extends ours
+            if k == key and not deleted and value:
+                self._apply(value)
+
+        snapshot = await store.watch_prefix(key, on_change)
+        for k, value in snapshot:
+            if k == key:
+                self._apply(value)
+        return self
+
+    def _apply(self, value: bytes) -> None:
+        try:
+            d = json.loads(value.decode())
+            self.config = DisaggConfig(
+                max_local_prefill_length=int(
+                    d.get("max_local_prefill_length",
+                          self.config.max_local_prefill_length)),
+                max_prefill_queue_size=int(
+                    d.get("max_prefill_queue_size",
+                          self.config.max_prefill_queue_size)))
+            log.info("disagg config updated: %s", self.config)
+        except (ValueError, json.JSONDecodeError):
+            log.warning("ignoring malformed disagg config: %r", value)
+
+
+async def set_disagg_config(store, namespace: str, config: DisaggConfig,
+                            model: str = "default") -> None:
+    await store.put(disagg_config_key(namespace, model),
+                    json.dumps(config.to_dict()).encode())

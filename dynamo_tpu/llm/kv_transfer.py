@@ -1,0 +1,139 @@
+"""Bulk KV-block movement between workers over the data plane.
+
+Prefill→decode transfer rides the same TCP two-part codec as requests, but as
+a *streaming request*: one JSON meta header (request id, first token, tensor
+geometry) followed by 2·L binary parts — layer k then layer v, in layer order
+— so the receiver can scatter layer l into its device pool while layer l+1 is
+still in flight (the layer-pipelined CopyStream idea,
+lib/llm/src/kv/layer.rs:619-1132). On TPU this is the host-staged DCN path
+replacing the reference's NIXL RDMA plane (docs/disagg_serving.md:58-91);
+intra-slice movement stays inside XLA as collectives.
+
+Sender: :func:`push_kv` (prefill worker). Receiver: :class:`KvReceiver`
+(decode worker) — serves the ``kv_receive`` endpoint and hands the assembled
+arrays to whoever is awaiting that request id.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, Dict, Optional, Tuple
+
+import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
+import numpy as np
+
+from ..runtime.component import Client, StreamingRequest
+from ..runtime.engine import Context
+
+log = logging.getLogger("dynamo_tpu.kv_transfer")
+
+KV_RECEIVE_ENDPOINT = "kv_receive"
+
+
+def _meta(request_id: str, first_token: int, first_logprob: float,
+          k: np.ndarray) -> dict:
+    L, T, H, D = k.shape
+    return {
+        "request_id": request_id,
+        "first_token": int(first_token),
+        "first_logprob": float(first_logprob),
+        "layers": int(L), "tokens": int(T),
+        "kv_heads": int(H), "head_dim": int(D),
+        "dtype": str(k.dtype),
+    }
+
+
+async def push_kv(client: Client, decode_worker_id: int, request_id: str,
+                  first_token: int, first_logprob: float,
+                  k: np.ndarray, v: np.ndarray,
+                  context: Optional[Context] = None) -> dict:
+    """Stream a sequence's prompt KV ([L,T,Hkv,Dh] each) to the decode
+    worker that owns ``request_id``. Returns the receiver's ack."""
+    meta = _meta(request_id, first_token, first_logprob, k)
+
+    async def parts() -> AsyncIterator[bytes]:
+        for layer in range(k.shape[0]):
+            yield k[layer].tobytes()
+            yield v[layer].tobytes()
+
+    ack = None
+    async for resp in client.generate(meta, context, mode="direct",
+                                      instance_id=decode_worker_id,
+                                      parts=parts()):
+        ack = resp
+    return ack or {}
+
+
+class RemotePrefillError(RuntimeError):
+    pass
+
+
+async def push_kv_error(client: Client, decode_worker_id: int,
+                        request_id: str, message: str) -> None:
+    """Tell the decode worker its remote prefill failed permanently so the
+    parked request errors out instead of waiting forever."""
+    meta = {"request_id": request_id, "error": message}
+
+    async def no_parts() -> AsyncIterator[bytes]:
+        return
+        yield  # pragma: no cover
+
+    async for _ in client.generate(meta, mode="direct",
+                                   instance_id=decode_worker_id,
+                                   parts=no_parts()):
+        pass
+
+
+class KvReceiver:
+    """Decode-worker side: collects streamed KV for requests this worker
+    parked while their prefill ran remotely."""
+
+    def __init__(self) -> None:
+        self._pending: Dict[str, asyncio.Future] = {}
+
+    def expect(self, request_id: str) -> asyncio.Future:
+        """Register interest; the future resolves to
+        (k, v, first_token, first_logprob) when the KV arrives."""
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[request_id] = fut
+        return fut
+
+    def abandon(self, request_id: str) -> None:
+        fut = self._pending.pop(request_id, None)
+        if fut is not None and not fut.done():
+            fut.cancel()
+
+    async def handler(self, request: StreamingRequest, ctx: Context):
+        meta = request.meta
+        rid = meta["request_id"]
+        if meta.get("error"):
+            async for _ in request.parts:
+                pass
+            fut = self._pending.pop(rid, None)
+            if fut is not None and not fut.done():
+                fut.set_exception(RemotePrefillError(meta["error"]))
+            yield {"ok": True}
+            return
+        L, T = meta["layers"], meta["tokens"]
+        H, D = meta["kv_heads"], meta["head_dim"]
+        dtype = np.dtype(meta["dtype"])
+        k = np.empty((L, T, H, D), dtype)
+        v = np.empty((L, T, H, D), dtype)
+        i = 0
+        async for part in request.parts:
+            layer, is_v = divmod(i, 2)
+            if layer >= L:
+                raise ValueError(f"kv stream for {rid}: too many parts")
+            arr = np.frombuffer(part, dtype).reshape(T, H, D)
+            (v if is_v else k)[layer] = arr
+            i += 1
+        if i != 2 * L:
+            raise ValueError(f"kv stream for {rid}: got {i}/{2 * L} parts")
+        fut = self._pending.pop(rid, None)
+        if fut is None or fut.done():
+            log.warning("unexpected KV for request %s (client gone?)", rid)
+            yield {"ok": False, "error": "no pending request"}
+            return
+        fut.set_result((k, v, meta["first_token"], meta["first_logprob"]))
+        yield {"ok": True, "tokens": T}

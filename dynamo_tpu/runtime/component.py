@@ -38,6 +38,17 @@ log = logging.getLogger("dynamo_tpu.runtime")
 Handler = Callable[[Any, Context], AsyncIterator[Any]]
 
 
+@dataclass
+class StreamingRequest:
+    """A client-streamed request: a JSON meta header plus a sequence of raw
+    binary parts (the KV-block upload shape). Handlers registered on an
+    endpoint receive this when the caller used ``parts=``; they MUST drain
+    ``parts`` before yielding responses."""
+
+    meta: Any
+    parts: AsyncIterator[bytes]
+
+
 def endpoint_key(namespace: str, component: str, endpoint: str,
                  lease: int) -> str:
     return f"{namespace}/components/{component}/{endpoint}:{lease:x}"
@@ -161,7 +172,29 @@ class DistributedRuntime:
             except (asyncio.IncompleteReadError, ConnectionResetError):
                 ctx.stop_generating()
 
-        watcher = asyncio.create_task(watch_control())
+        watcher = None
+        if control.get("streaming"):
+            # the connection keeps carrying request parts; stop/kill frames
+            # interleave on the same stream until the "end" marker, after
+            # which the normal control watcher takes over the socket
+            async def parts_gen():
+                nonlocal watcher
+                while True:
+                    c, p = await fr.read()
+                    kind = c.get("kind")
+                    if kind == "part":
+                        yield p
+                    elif kind == "end":
+                        watcher = asyncio.create_task(watch_control())
+                        return
+                    elif kind == "stop":
+                        ctx.stop_generating()
+                    elif kind == "kill":
+                        ctx.kill()
+
+            request = StreamingRequest(meta=request, parts=parts_gen())
+        else:
+            watcher = asyncio.create_task(watch_control())
         try:
             stream = handler(request, ctx)
             # prologue: the first item may raise before anything is sent —
@@ -200,7 +233,8 @@ class DistributedRuntime:
             except Exception:
                 pass
         finally:
-            watcher.cancel()
+            if watcher is not None:
+                watcher.cancel()
             self._active.pop(ctx.id, None)
 
 
@@ -356,9 +390,12 @@ class Client:
 
     async def generate(self, request: Any, context: Optional[Context] = None,
                        mode: str = "random",
-                       instance_id: Optional[int] = None
+                       instance_id: Optional[int] = None,
+                       parts: Optional[AsyncIterator[bytes]] = None
                        ) -> AsyncIterator[Any]:
-        """Issue a request; yields response items (the remote stream)."""
+        """Issue a request; yields response items (the remote stream).
+        With ``parts`` set, streams the binary chunks after the request header
+        (server handler receives a :class:`StreamingRequest`)."""
         ctx = context or Context()
         info = self._pick(mode, instance_id)
         reader, writer = await asyncio.open_connection(info.host, info.port)
@@ -373,7 +410,15 @@ class Client:
                 req_control = {"kind": "request", "endpoint": info.endpoint,
                                "context_id": ctx.id}
                 req_payload = json.dumps(request).encode()
+            if parts is not None:
+                req_control["streaming"] = True
             await write_frame(writer, [req_control, req_payload])
+            if parts is not None:
+                async for chunk in parts:
+                    await write_frame(
+                        writer, [{"kind": "part", "ctype": "bin"},
+                                 bytes(chunk)])
+                await write_frame(writer, [{"kind": "end"}, None])
 
             async def forward_stop():
                 await ctx.stopped()

@@ -36,6 +36,8 @@ class StoreClient:
         self._watch_cbs: Dict[int, WatchCallback] = {}
         self._sub_cbs: Dict[int, MsgCallback] = {}
         self._rx_task: Optional[asyncio.Task] = None
+        self._push_q: "asyncio.Queue[Dict[str, Any]]" = asyncio.Queue()
+        self._push_task: Optional[asyncio.Task] = None
         self._keepalive_tasks: List[asyncio.Task] = []
         self._send_lock = asyncio.Lock()
         self.closed = asyncio.Event()
@@ -46,6 +48,8 @@ class StoreClient:
         self._reader = FrameReader(reader)
         self._writer = writer
         self._rx_task = asyncio.create_task(self._rx_loop(), name="store-rx")
+        self._push_task = asyncio.create_task(self._push_loop(),
+                                              name="store-push")
         return self
 
     async def close(self) -> None:
@@ -53,6 +57,8 @@ class StoreClient:
             t.cancel()
         if self._rx_task:
             self._rx_task.cancel()
+        if self._push_task:
+            self._push_task.cancel()
         if self._writer:
             self._writer.close()
         self.closed.set()
@@ -62,7 +68,10 @@ class StoreClient:
             while True:
                 msg = await self._reader.read()
                 if "push" in msg:
-                    await self._handle_push(msg)
+                    # NEVER await user callbacks here: a callback that issues
+                    # a store call would deadlock the rx loop (the reply is
+                    # read by this very loop). FIFO queue keeps event order.
+                    self._push_q.put_nowait(msg)
                 else:
                     fut = self._pending.pop(msg.get("id"), None)
                     if fut is not None and not fut.done():
@@ -74,6 +83,13 @@ class StoreClient:
                     fut.set_exception(StoreError("connection lost"))
             self._pending.clear()
             self.closed.set()
+
+    async def _push_loop(self) -> None:
+        try:
+            while True:
+                await self._handle_push(await self._push_q.get())
+        except asyncio.CancelledError:
+            pass
 
     async def _handle_push(self, msg: Dict[str, Any]) -> None:
         kind = msg["push"]

@@ -344,8 +344,11 @@ async def main(host: str = "0.0.0.0", port: int = 4222) -> None:
 
 
 if __name__ == "__main__":
-    import sys
+    import argparse
 
     logging.basicConfig(level=logging.INFO)
-    port = int(sys.argv[1]) if len(sys.argv) > 1 else 4222
-    asyncio.run(main(port=port))
+    ap = argparse.ArgumentParser(prog="dynstore")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=4222)
+    a = ap.parse_args()
+    asyncio.run(main(host=a.host, port=a.port))
