@@ -20,12 +20,12 @@ from typing import Optional
 
 from ..llm.disagg import PrefillQueue
 from ..llm.kv_transfer import KV_RECEIVE_ENDPOINT, push_kv, push_kv_error
-
-MAX_ATTEMPTS = 3
 from ..llm.model_card import ModelDeploymentCard
 from ..llm.protocols.common import BackendInput
 from ..runtime.component import DistributedRuntime
 from ..runtime.engine import Context
+
+MAX_ATTEMPTS = 3
 
 log = logging.getLogger("dynamo_tpu.prefill_worker")
 
@@ -68,6 +68,11 @@ async def run_prefill_worker(args, *,
     try:
         while max_jobs is None or done < max_jobs:
             msg_id, job = await queue.dequeue()
+            if await queue.consume_cancelled(job.request_id):
+                await queue.ack(msg_id)
+                log.info("dropping cancelled prefill job %s", job.request_id)
+                done += 1
+                continue
             try:
                 bi = BackendInput.from_dict(job.request)
                 ctx = Context(job.request_id)

@@ -205,8 +205,21 @@ async def run_batch(args, card, chat_engine, _c, path: str) -> Dict[str, Any]:
     return stats
 
 
+def _honor_jax_platforms_env() -> None:
+    """Some PJRT plugins (axon) override the JAX_PLATFORMS env var at import;
+    re-assert the operator's choice via the config flag, which wins."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat and plat != "axon":
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
 async def amain(argv: Optional[List[str]] = None) -> None:
     args = parse_args(argv)
+    _honor_jax_platforms_env()
     card = make_card(args)
     chat_engine, completion_engine = make_engines(args, card)
     mode = args.input

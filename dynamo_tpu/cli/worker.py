@@ -116,9 +116,13 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                 if fut in done:
                     return fut.result()  # may raise RemotePrefillError
                 if stop in done:
+                    await queue.cancel(ctx.id)
                     raise asyncio.CancelledError
                 log.warning("remote prefill for %s timed out after %.0fs; "
                             "prefilling locally", ctx.id, remote_timeout)
+                # tombstone the queued job so a prefill worker doesn't burn
+                # a full prompt prefill on KV nobody will accept
+                await queue.cancel(ctx.id)
                 return None
             finally:
                 stop.cancel()

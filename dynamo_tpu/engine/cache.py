@@ -1,7 +1,8 @@
 """Host-side paged KV cache bookkeeping for the JAX engine.
 
-The device arrays (``k_pool``/``v_pool``: [L, N_pool_tokens, H_kv, D_h]) are a
-flat pool of fixed-size pages. This module owns the *maps*: free-page list,
+The device arrays (``k_pool``/``v_pool``: [L, n_pages, H_kv, page, D_h]) are a
+page-major pool of fixed-size pages; a flat token slot
+``page_id * page_size + offset`` addresses one token's KV. This module owns the *maps*: free-page list,
 per-sequence page tables, token-slot index computation for scatter/gather, and
 sequence-hash bookkeeping that later feeds prefix reuse + KV events.
 

@@ -84,6 +84,23 @@ class PrefillQueue:
     async def size(self) -> int:
         return await self.store.q_len(self.queue)
 
+    # ------------------------------------------------------------------
+    # cancellation: the submitter gave up (timeout / client gone). A
+    # tombstone key lets prefill workers drop the job at dequeue instead of
+    # computing KV nobody will accept.
+    def _cancel_key(self, request_id: str) -> str:
+        return f"{self.queue}/cancelled/{request_id}"
+
+    async def cancel(self, request_id: str) -> None:
+        await self.store.put(self._cancel_key(request_id), b"1")
+
+    async def consume_cancelled(self, request_id: str) -> bool:
+        """Check-and-clear the tombstone. True => drop the job unprocessed."""
+        if await self.store.get(self._cancel_key(request_id)) is not None:
+            await self.store.delete(self._cancel_key(request_id))
+            return True
+        return False
+
 
 @dataclass
 class DisaggConfig:

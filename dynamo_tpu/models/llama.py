@@ -171,11 +171,11 @@ def validate_tp(cfg: LlamaConfig, tp: int) -> None:
 
 
 def kv_cache_spec(cfg: LlamaConfig, tp: int) -> P:
-    """KV pool sharding: shard kv heads over tp when divisible, else
-    replicate (GQA with kv_heads < tp)."""
+    """KV pool sharding ([L, n_pages, Hkv, page, Dh]): shard kv heads over tp
+    when divisible, else replicate (GQA with kv_heads < tp)."""
     if cfg.num_kv_heads % tp == 0:
-        return P(None, None, AXIS_TP, None)
-    return P(None, None, None, None)
+        return P(None, None, AXIS_TP, None, None)
+    return P(None, None, None, None, None)
 
 
 # ---------------------------------------------------------------------------
@@ -249,28 +249,37 @@ def attend(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array) -> jax.Arr
 def forward(params: Dict[str, Any], cfg: LlamaConfig,
             tokens: jax.Array,           # [B, T] int32 (decode: T=1)
             positions: jax.Array,        # [B, T] int32 position of each token
-            k_pool: jax.Array,           # [L, N_pool, Hkv, Dh] paged KV pool
+            k_pool: jax.Array,           # [L, n_pages, Hkv, page, Dh] KV pool
             v_pool: jax.Array,
             write_idx: jax.Array,        # [B, T] int32 pool token-slot per new token
             read_idx: jax.Array,         # [B, S] int32 pool token-slots to attend over
             read_pos: jax.Array,         # [B, S] int32 position of each read slot
             read_valid: jax.Array,       # [B, S] bool slot holds a real token
+            attn_impl: str = "xla",      # "xla" dense | "flash" Pallas kernel
             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One forward pass over a token chunk against the paged KV pool.
 
-    The new chunk's K/V are scattered into the pool at ``write_idx`` first;
-    attention then gathers ``read_idx`` (which must cover the chunk itself)
-    and masks causally by position: token at position p attends to slots with
-    ``read_pos <= p``. Works for prefill chunks and single-token decode alike.
+    The pool is page-major ([L, n_pages, Hkv, page, Dh]); token-slot indices
+    (page_id * page_size + offset) address it. The new chunk's K/V are
+    scattered into the pool at ``write_idx`` first; attention then gathers
+    ``read_idx`` (which must cover the chunk itself) and masks causally by
+    position: token at position p attends to slots with ``read_pos <= p``.
+    Works for prefill chunks and single-token decode alike.
 
     Returns (logits [B, T, vocab] fp32, k_pool, v_pool).
     """
     B, T = tokens.shape
+    page = k_pool.shape[3]
     lp = params["layers"]
     x = params["embed"][tokens]  # [B,T,D] bf16
     cos, sin = rope_tables(cfg, positions)
-    # causal/validity mask [B,T,S]
-    mask = read_valid[:, None, :] & (read_pos[:, None, :] <= positions[:, :, None])
+    flat_w = write_idx.reshape(-1)
+    wp, wo = flat_w // page, flat_w % page
+    rp, ro = read_idx // page, read_idx % page
+    if attn_impl != "flash":
+        # causal/validity mask [B,T,S]
+        mask = (read_valid[:, None, :]
+                & (read_pos[:, None, :] <= positions[:, :, None]))
 
     for l in range(cfg.num_layers):
         h = rms_norm(x, lp["ln1"][l], cfg.rms_eps)
@@ -280,13 +289,81 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         # scatter chunk KV into the pool (write-then-gather)
-        flat_w = write_idx.reshape(-1)
-        k_pool = k_pool.at[l, flat_w].set(k.reshape(B * T, *k.shape[2:]))
-        v_pool = v_pool.at[l, flat_w].set(v.reshape(B * T, *v.shape[2:]))
+        k_pool = k_pool.at[l, wp, :, wo].set(k.reshape(B * T, *k.shape[2:]))
+        v_pool = v_pool.at[l, wp, :, wo].set(v.reshape(B * T, *v.shape[2:]))
         # gather this sequence's context
-        k_ctx = k_pool[l][read_idx]  # [B,S,Hkv,Dh]
-        v_ctx = v_pool[l][read_idx]
-        attn = attend(q, k_ctx, v_ctx, mask)
+        k_ctx = k_pool[l, rp, :, ro]  # [B,S,Hkv,Dh]
+        v_ctx = v_pool[l, rp, :, ro]
+        if attn_impl == "flash":
+            from ..ops.attention import flash_attention
+            attn = flash_attention(q, k_ctx, v_ctx, positions, read_pos,
+                                   read_valid)
+        else:
+            attn = attend(q, k_ctx, v_ctx, mask)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"][l])
+        h2 = rms_norm(x, lp["ln2"][l], cfg.rms_eps)
+        g = jnp.einsum("btd,df->btf", h2, lp["wg"][l])
+        u = jnp.einsum("btd,df->btf", h2, lp["wu"][l])
+        x = x + jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u, lp["wd"][l])
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", x, head.astype(x.dtype))
+    return logits.astype(jnp.float32), k_pool, v_pool
+
+
+def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
+                   tokens: jax.Array,        # [B] int32 — last sampled token
+                   k_pool: jax.Array,        # [L, n_pages, Hkv, page, Dh]
+                   v_pool: jax.Array,
+                   page_tables: jax.Array,   # [B, P] int32 (pad rows: page 0)
+                   lengths: jax.Array,       # [B] tokens incl. current one
+                   attn_impl: str = "xla",   # "xla" gather | "pallas" paged
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Single-token decode step addressed purely by page tables.
+
+    The current token sits at position ``lengths - 1``; its KV is written
+    through the page table, then attention covers tokens [0, length). With
+    ``attn_impl="pallas"`` the paged-attention kernel reads pages straight
+    from the HBM pool (no contiguous-context gather at all).
+
+    Returns (logits [B, 1, vocab] fp32, k_pool, v_pool).
+    """
+    B = tokens.shape[0]
+    page = k_pool.shape[3]
+    lp = params["layers"]
+    pos = lengths - 1                                  # [B]
+    x = params["embed"][tokens][:, None]               # [B,1,D]
+    cos, sin = rope_tables(cfg, pos[:, None])
+    w_page = jnp.take_along_axis(page_tables, (pos // page)[:, None],
+                                 axis=1)[:, 0]
+    w_off = pos % page
+    if attn_impl != "pallas":
+        S = page_tables.shape[1] * page
+        t = jnp.arange(S, dtype=jnp.int32)
+        rp = jnp.take_along_axis(
+            page_tables, jnp.broadcast_to((t // page)[None], (B, S)), axis=1)
+        ro = jnp.broadcast_to((t % page)[None], (B, S))
+        # causal == validity here: the query is the last token
+        mask = (t[None] < lengths[:, None])[:, None, :]  # [B,1,S]
+
+    for l in range(cfg.num_layers):
+        h = rms_norm(x, lp["ln1"][l], cfg.rms_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"][l])
+        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"][l])
+        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"][l])
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_pool = k_pool.at[l, w_page, :, w_off].set(k[:, 0])
+        v_pool = v_pool.at[l, w_page, :, w_off].set(v[:, 0])
+        if attn_impl == "pallas":
+            from ..ops.attention import paged_attention
+            attn = paged_attention(q[:, 0], k_pool[l], v_pool[l],
+                                   page_tables, lengths)[:, None]
+        else:
+            k_ctx = k_pool[l, rp, :, ro]               # [B,S,Hkv,Dh]
+            v_ctx = v_pool[l, rp, :, ro]
+            attn = attend(q, k_ctx, v_ctx, mask)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"][l])
         h2 = rms_norm(x, lp["ln2"][l], cfg.rms_eps)
         g = jnp.einsum("btd,df->btf", h2, lp["wg"][l])

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for the serving hot path."""
+
+from .attention import flash_attention, paged_attention  # noqa: F401

@@ -1,0 +1,116 @@
+"""Pallas attention kernels vs. the dense XLA reference.
+
+Runs in interpreter mode on CPU — the same kernel code the TPU compiles.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models.llama import attend
+from dynamo_tpu.ops.attention import flash_attention, paged_attention
+
+
+def _dense_ref(q, k, v, q_pos, k_pos, k_valid):
+    mask = k_valid[:, None, :] & (k_pos[:, None, :] <= q_pos[:, :, None])
+    return attend(q, k, v, mask)
+
+
+@pytest.mark.parametrize("B,T,S,Hq,Hkv,Dh", [
+    (1, 32, 128, 4, 2, 16),
+    (2, 64, 128, 8, 8, 32),   # MHA (G=1)
+    (1, 16, 64, 4, 1, 16),    # extreme GQA
+])
+def test_flash_matches_dense(B, T, S, Hq, Hkv, Dh):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, T, Hq, Dh), jnp.float32).astype(jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, Hkv, Dh), jnp.float32).astype(jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, Hkv, Dh), jnp.float32).astype(jnp.bfloat16)
+    # queries are a chunk at positions [ctx, ctx+T); context covers [0, n)
+    ctx = S // 2 - T // 2
+    n = ctx + T
+    q_pos = jnp.broadcast_to(jnp.arange(ctx, ctx + T, dtype=jnp.int32), (B, T))
+    k_pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    k_valid = k_pos < n
+
+    got = flash_attention(q, k, v, q_pos, k_pos, k_valid, interpret=True)
+    want = _dense_ref(q, k, v, q_pos, k_pos, k_valid)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=3e-2, rtol=3e-2)
+
+
+def test_flash_fully_padded_rows_are_finite():
+    B, T, S, Hq, Hkv, Dh = 1, 32, 64, 4, 2, 16
+    q = jnp.ones((B, T, Hq, Dh), jnp.bfloat16)
+    k = jnp.ones((B, S, Hkv, Dh), jnp.bfloat16)
+    v = jnp.ones((B, S, Hkv, Dh), jnp.bfloat16)
+    q_pos = jnp.zeros((B, T), jnp.int32)
+    k_pos = jnp.arange(S, dtype=jnp.int32)[None]
+    k_valid = jnp.zeros((B, S), bool)  # nothing valid at all
+    out = flash_attention(q, k, v, q_pos, k_pos, k_valid, interpret=True)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+@pytest.mark.parametrize("B,Hq,Hkv,Dh,page,P", [
+    (2, 4, 2, 16, 16, 4),
+    (3, 8, 8, 32, 8, 3),
+])
+def test_paged_matches_dense(B, Hq, Hkv, Dh, page, P):
+    n_pages = B * P + 1
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, Hq, Dh), jnp.float32).astype(jnp.bfloat16)
+    k_pages = jax.random.normal(
+        ks[1], (n_pages, Hkv, page, Dh), jnp.float32).astype(jnp.bfloat16)
+    v_pages = jax.random.normal(
+        ks[2], (n_pages, Hkv, page, Dh), jnp.float32).astype(jnp.bfloat16)
+    # sequence b owns pages [1 + b*P, 1 + (b+1)*P), variable lengths
+    page_tables = (jnp.arange(P, dtype=jnp.int32)[None]
+                   + jnp.arange(B, dtype=jnp.int32)[:, None] * P + 1)
+    lengths = jnp.asarray(
+        [min(page * P, 3 + b * (page + 1)) for b in range(B)], jnp.int32)
+
+    got = paged_attention(q, k_pages, v_pages, page_tables, lengths,
+                          interpret=True)
+
+    # dense reference: gather each sequence's context and mask by length
+    S = P * page
+    for b in range(B):
+        ctx_k = (k_pages[page_tables[b]].transpose(0, 2, 1, 3)
+                 .reshape(S, Hkv, Dh))
+        ctx_v = (v_pages[page_tables[b]].transpose(0, 2, 1, 3)
+                 .reshape(S, Hkv, Dh))
+        qb = q[b][None, None]                       # [1, 1, Hq, Dh]
+        k_pos = jnp.arange(S, dtype=jnp.int32)[None]
+        valid = k_pos < lengths[b]
+        q_pos = jnp.full((1, 1), lengths[b] - 1, jnp.int32)
+        want = _dense_ref(qb, ctx_k[None], ctx_v[None], q_pos, k_pos, valid)
+        np.testing.assert_allclose(
+            np.asarray(got[b], np.float32),
+            np.asarray(want[0, 0], np.float32), atol=3e-2, rtol=3e-2)
+
+
+def test_paged_inside_scan_with_donated_pool():
+    """The decode loop shape: kernel invoked inside lax.scan, pool donated."""
+    B, Hq, Hkv, Dh, page, P = 2, 4, 2, 16, 8, 2
+    n_pages = 8
+    q = jnp.ones((B, Hq, Dh), jnp.bfloat16)
+    k_pages = jnp.ones((n_pages, Hkv, page, Dh), jnp.bfloat16)
+    v_pages = jnp.ones((n_pages, Hkv, page, Dh), jnp.bfloat16)
+    pt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    lengths = jnp.asarray([5, 9], jnp.int32)
+
+    @jax.jit
+    def run(q, k_pages, v_pages, pt, lengths):
+        def body(carry, _):
+            out = paged_attention(q, k_pages, v_pages, pt, carry,
+                                  interpret=True)
+            return carry + 1, out
+        return jax.lax.scan(body, lengths, None, length=3)
+
+    _, outs = run(q, k_pages, v_pages, pt, lengths)
+    assert outs.shape == (3, B, Hq, Dh)
+    assert np.isfinite(np.asarray(outs, np.float32)).all()

@@ -191,21 +191,25 @@ def test_decode_interleaves_with_long_prefill(core):
     while not outs:
         outs = core.step()
     core.submit("long", req(list(range(100)), max_tokens=2))  # 4 chunks of 32
-    saw_decode_between_chunks = False
     long_first_token_seen = False
     decode_tokens_before_long_done = 0
+    finished = set()
     for _ in range(300):
         outs = core.step()
         for so in outs:
-            if so.seq_id == "dec":
+            if so.seq_id == "dec" and not long_first_token_seen:
                 decode_tokens_before_long_done += 1
             if so.seq_id == "long":
                 long_first_token_seen = True
+            if so.finish is not None:
+                finished.add(so.seq_id)
         if long_first_token_seen:
             break
     # the decode stream must have advanced while "long" was prefilling
     assert decode_tokens_before_long_done > 0
-    drain(core, ["dec", "long"])
+    remaining = [s for s in ("dec", "long") if s not in finished]
+    if remaining:
+        drain(core, remaining)
 
 
 def test_cum_logprob_accumulates(core):
