@@ -77,6 +77,13 @@ async def run_prefill_worker(args, *,
                 bi = BackendInput.from_dict(job.request)
                 ctx = Context(job.request_id)
                 k, v, tok, logp = await engine.prefill_extract(bi, ctx)
+                if await queue.consume_cancelled(job.request_id):
+                    # submitter gave up mid-compute: skip the (large) push
+                    await queue.ack(msg_id)
+                    log.info("dropping cancelled prefill job %s post-compute",
+                             job.request_id)
+                    done += 1
+                    continue
                 await push_kv(kv_client, job.decode_worker_id,
                               job.request_id, tok, logp, k, v)
                 await queue.ack(msg_id)

@@ -44,7 +44,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--concurrency", type=int, default=8,
                    help="batch mode concurrency")
     p.add_argument("--extra-engine-args", default=None,
-                   help="JSON file with extra engine kwargs")
+                   help="extra engine kwargs: a JSON file path, or inline "
+                        "JSON if the value starts with '{'")
     args = p.parse_args(argv)
     args.input, args.output = "text", "echo_core"
     for tok in args.positional:
@@ -81,8 +82,11 @@ def make_engines(args, card: ModelDeploymentCard):
 
         extra: Dict[str, Any] = {}
         if args.extra_engine_args:
-            with open(args.extra_engine_args) as f:
-                extra = json.load(f)
+            if args.extra_engine_args.lstrip().startswith("{"):
+                extra = json.loads(args.extra_engine_args)
+            else:
+                with open(args.extra_engine_args) as f:
+                    extra = json.load(f)
         cfg = JaxEngineConfig.from_card(
             card, tensor_parallel=args.tensor_parallel_size, **extra)
         core = JaxEngine(cfg)

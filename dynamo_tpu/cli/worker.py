@@ -83,7 +83,7 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
     await pub.start()
     if core is not None:
         core.pool.on_block_sealed = pub.block_stored
-        core.pool.on_blocks_freed = pub.blocks_removed
+        core.pool.on_blocks_removed = pub.blocks_removed
 
     # --- serve endpoint ----------------------------------------------
     endpoint = component.endpoint("generate")
@@ -130,7 +130,11 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
 
         async def generate_handler(request, ctx):
             bi = BackendInput.from_dict(request)
-            prefix_hit = 0  # local prefix-cache hits count against remoting
+            # local prefix-cache hits count against remoting: a prompt we
+            # mostly have cached prefills locally regardless of length
+            host = core.tiered
+            prefix_hit = core.pool.probe_prefix(
+                bi.token_ids, (lambda h: h in host) if host else None)
             remote = False
             if drouter.length_exceeds_local(len(bi.token_ids), prefix_hit):
                 # only candidates pay the queue-depth RPC

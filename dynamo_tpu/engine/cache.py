@@ -2,13 +2,21 @@
 
 The device arrays (``k_pool``/``v_pool``: [L, n_pages, H_kv, page, D_h]) are a
 page-major pool of fixed-size pages; a flat token slot
-``page_id * page_size + offset`` addresses one token's KV. This module owns the *maps*: free-page list,
-per-sequence page tables, token-slot index computation for scatter/gather, and
-sequence-hash bookkeeping that later feeds prefix reuse + KV events.
+``page_id * page_size + offset`` addresses one token's KV. This module owns
+the *maps*: per-sequence page tables, token-slot index computation for
+scatter/gather, the sequence-hash chain, and — through
+:class:`~dynamo_tpu.llm.kvbm.pool.DeviceBlockPool` — block states
+(free/leased/reusable) enabling prefix reuse and tiered offload.
+
+KV events: ``on_block_sealed`` fires when a page fills (router "stored"
+event); ``on_blocks_removed`` fires when a sealed block is *evicted* from
+the device pool (router "removed" event) — NOT on sequence release, because
+released blocks stay matchable until evicted. ``on_block_evicted`` runs
+first so the engine can offload the page to the host tier.
 
 Reference capability: the engine-side half of the KV block manager
-(lib/llm/src/kv/*, vllm patch block manager hooks) — reuse pool and event
-publishing hook in here.
+(lib/llm/src/kv/manager.rs:22-138 prepare_prefill_sequence, vllm patch block
+manager hooks, event_manager.py stored/removed semantics).
 """
 
 from __future__ import annotations
@@ -18,7 +26,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..llm.tokens import TokenSequence
+from ..llm.kvbm.pool import DeviceBlockPool, OutOfBlocks
+from ..llm.tokens import TokenSequence, chain_hash, hash_tokens
 
 
 class OutOfPages(RuntimeError):
@@ -37,28 +46,39 @@ class SeqCache:
 
 
 class PagePool:
-    """Free-list allocator over the flat device pool.
+    """Sequence bookkeeping over a :class:`DeviceBlockPool`.
 
     Page 0 is reserved as the scratch page: masked/inactive lanes write there
     so every jit step has fully static shapes with no host branching.
     """
 
     def __init__(self, num_pages: int, page_size: int):
-        if num_pages < 2:
-            raise ValueError("need at least 2 pages (page 0 is scratch)")
         self.num_pages = num_pages
         self.page_size = page_size
-        self._free: List[int] = list(range(num_pages - 1, 0, -1))  # stack; 0 reserved
+        self.blocks = DeviceBlockPool(num_pages)
+        self.blocks.on_evict = self._evicted
         self.seqs: Dict[str, SeqCache] = {}
-        # hook: called with (seq_id, sealed TokenBlock) when a page fills —
-        # feeds the KV event publisher for the router index
+        # hook: (seq_id, sealed TokenBlock, page) when a page fills — feeds
+        # the KV event publisher ("stored") for the router index
         self.on_block_sealed: Optional[Callable] = None
-        self.on_blocks_freed: Optional[Callable] = None
+        # hook: (seq_hashes: List[int]) when sealed blocks leave the device
+        # pool — the router "removed" event
+        self.on_blocks_removed: Optional[Callable] = None
+        # hook: (seq_hash, page) BEFORE an evicted page is recycled — the
+        # engine offloads the page to the host tier here
+        self.on_block_evicted: Optional[Callable] = None
+
+    def _evicted(self, seq_hash: int, page: int) -> None:
+        if self.on_block_evicted:
+            self.on_block_evicted(seq_hash, page)
+        if self.on_blocks_removed:
+            self.on_blocks_removed([seq_hash])
 
     # ------------------------------------------------------------------
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        """Pages a new allocation could obtain (free + evictable)."""
+        return self.blocks.allocatable
 
     def pages_needed(self, num_tokens: int) -> int:
         return (num_tokens + self.page_size - 1) // self.page_size
@@ -80,36 +100,124 @@ class PagePool:
         before a multi-step decode dispatch writes tokens speculatively)."""
         sc = self.seqs[seq_id]
         need = self.pages_needed(total_tokens) - len(sc.pages)
-        if need > len(self._free):
-            raise OutOfPages(f"need {need} pages, {len(self._free)} free")
+        if need > self.blocks.allocatable:
+            raise OutOfPages(
+                f"need {need} pages, {self.blocks.allocatable} allocatable")
         for _ in range(need):
-            sc.pages.append(self._free.pop())
+            sc.pages.append(self.blocks.lease_new())
 
     def account_tokens(self, seq_id: str, tokens: Sequence[int]) -> None:
         """Record tokens as present (pages must already exist); seals
-        full-page blocks, firing the hash-chain event hook."""
+        full-page blocks, registering them for reuse and firing the
+        stored-event hook."""
         sc = self.seqs[seq_id]
         if sc.hashes is not None:
             for t in tokens:
                 sealed = sc.hashes.append(int(t))
-                if sealed is not None and self.on_block_sealed:
+                if sealed is not None:
                     page = sc.pages[len(sc.hashes.blocks) - 1]
-                    self.on_block_sealed(sc.seq_id, sealed, page)
+                    registered = self.blocks.seal(page, sealed.sequence_hash)
+                    # stored events only for newly-registered blocks, so the
+                    # router's per-worker refcount balances the single
+                    # removed event fired at eviction
+                    if registered and self.on_block_sealed:
+                        self.on_block_sealed(sc.seq_id, sealed, page)
         sc.num_tokens += len(tokens)
 
     def extend(self, seq_id: str, tokens: Sequence[int]) -> None:
         """Allocate-and-account in one call (prefill path)."""
         sc = self.seqs[seq_id]
-        self.ensure_pages(seq_id, sc.num_tokens + len(tokens))
+        try:
+            self.ensure_pages(seq_id, sc.num_tokens + len(tokens))
+        except OutOfBlocks as e:
+            raise OutOfPages(str(e)) from e
         self.account_tokens(seq_id, tokens)
 
     def release(self, seq_id: str) -> None:
+        """Drop the sequence. Sealed pages park as reusable (still matchable
+        by their sequence hash); partial pages return to the free list."""
         sc = self.seqs.pop(seq_id, None)
         if sc is None:
             return
-        if sc.hashes is not None and self.on_blocks_freed and sc.hashes.blocks:
-            self.on_blocks_freed(sc.seq_id, sc.hashes.blocks)
-        self._free.extend(reversed(sc.pages))
+        for page in sc.pages:
+            self.blocks.release(page)
+
+    # ------------------------------------------------------------------
+    # prefix reuse
+    # ------------------------------------------------------------------
+    def match_prefix(self, seq_id: str,
+                     prompt: Sequence[int], max_tokens: int,
+                     host_lookup: Optional[Callable[[int], bool]] = None
+                     ) -> Tuple[int, List[Tuple[int, int]]]:
+        """Walk the prompt's chained block hashes, claiming matching device
+        blocks for a freshly-created sequence. When a device miss occurs and
+        ``host_lookup(seq_hash)`` returns True, a fresh page is leased for an
+        upload instead (caller copies the data in).
+
+        Returns (tokens_satisfied, uploads) where uploads is
+        [(seq_hash, page)] the caller must fill from the host tier.
+        """
+        sc = self.seqs[seq_id]
+        assert sc.num_tokens == 0, "match_prefix on a non-empty sequence"
+        page_sz = self.page_size
+        parent: Optional[int] = None
+        matched = 0
+        uploads: List[Tuple[int, int]] = []
+        limit = min(max_tokens, len(prompt))
+        for start in range(0, limit - page_sz + 1, page_sz):
+            blk = prompt[start:start + page_sz]
+            sh = chain_hash(parent, hash_tokens(blk))
+            page = self.blocks.match(sh)
+            fire_stored = False
+            if page is None and host_lookup is not None and host_lookup(sh):
+                try:
+                    page = self.blocks.lease_new()
+                except OutOfBlocks:
+                    break
+                # host->device restore re-registers a block that fired a
+                # removed event at eviction: publish stored again
+                fire_stored = self.blocks.seal(page, sh)
+                uploads.append((sh, page))
+            if page is None:
+                break
+            self._adopt_block(sc, blk, page, fire_stored)
+            parent = sh
+            matched += page_sz
+        return matched, uploads
+
+    def probe_prefix(self, prompt: Sequence[int],
+                     host_lookup: Optional[Callable[[int], bool]] = None
+                     ) -> int:
+        """Non-claiming prefix probe: how many leading prompt tokens could be
+        served from cache right now (device blocks + host tier). Feeds the
+        disagg router's prefix_hit input without touching block states."""
+        page_sz = self.page_size
+        parent: Optional[int] = None
+        n = 0
+        for start in range(0, len(prompt) - page_sz + 1, page_sz):
+            sh = chain_hash(parent,
+                            hash_tokens(prompt[start:start + page_sz]))
+            if not (self.blocks.contains(sh)
+                    or (host_lookup is not None and host_lookup(sh))):
+                break
+            parent = sh
+            n += page_sz
+        return n
+
+    def _adopt_block(self, sc: SeqCache, tokens: Sequence[int],
+                     page: int, fire_stored: bool = False) -> None:
+        """Attach an already-sealed device block to a fresh sequence.
+        ``fire_stored`` is True only for host-tier restores (the block
+        re-entered the device pool); plain device matches are already in
+        the router index and must not re-fire."""
+        sc.pages.append(page)
+        sealed = None
+        if sc.hashes is not None:
+            for t in tokens:
+                sealed = sc.hashes.append(int(t))
+        sc.num_tokens += len(tokens)
+        if fire_stored and sealed is not None and self.on_block_sealed:
+            self.on_block_sealed(sc.seq_id, sealed, page)
 
     # ------------------------------------------------------------------
     # index computation for the jitted forward

@@ -71,6 +71,11 @@ class JaxEngineConfig:
     # attention backend: "auto" => Pallas kernels on TPU, XLA dense elsewhere.
     # Explicit values: "pallas" | "xla".
     attn_impl: str = "auto"
+    # KV block manager (SURVEY §2.4): prefix reuse + tiered offload
+    enable_prefix_reuse: bool = True
+    host_cache_blocks: int = 0          # host-DRAM KV tier capacity (0 = off)
+    disk_cache_blocks: int = 0          # mmap spill tier capacity (0 = off)
+    disk_cache_path: Optional[str] = None
 
     @classmethod
     def from_card(cls, card: ModelDeploymentCard, tensor_parallel: int = 1,
@@ -88,7 +93,9 @@ class JaxEngineConfig:
             params_path=card.path,
         )
         for k in ("max_batch", "max_context", "prefill_chunk", "num_pages",
-                  "decode_steps", "seed", "preset", "attn_impl"):
+                  "decode_steps", "seed", "preset", "attn_impl",
+                  "enable_prefix_reuse", "host_cache_blocks",
+                  "disk_cache_blocks", "disk_cache_path"):
             if k in extra:
                 kw[k] = extra[k]
         cfg = cls(**kw)
@@ -173,6 +180,33 @@ class EngineCore:
                        cfg.page_size, m.head_dim), m.dtype), self.kv_sharding)
         self.v_pool = jax.device_put(
             jnp.zeros_like(self.k_pool), self.kv_sharding)
+
+        # --- KV block manager: tiered offload + prefix reuse ----------
+        from ..llm.kvbm.transfer import CopyStream
+        self.copy_stream = CopyStream()
+        self.tiered = None
+        if cfg.host_cache_blocks > 0:
+            from ..llm.kvbm.tiers import (DiskKvTier, HostKvTier,
+                                          TieredKvCache)
+            blk_shape = (m.num_layers, m.num_kv_heads, cfg.page_size,
+                         m.head_dim)
+            # ml_dtypes gives numpy a real bfloat16, so the host tier stores
+            # KV at device precision
+            np_dtype = np.asarray(jnp.zeros((), m.dtype)).dtype
+            host = HostKvTier(cfg.host_cache_blocks, blk_shape, np_dtype)
+            disk = None
+            if cfg.disk_cache_blocks > 0:
+                path = cfg.disk_cache_path or "/tmp/dynamo_tpu_kv_spill"
+                disk = DiskKvTier(cfg.disk_cache_blocks, blk_shape,
+                                  np_dtype, path)
+            self.tiered = TieredKvCache(host, disk)
+        self._evict_buf: List[Tuple[int, int]] = []
+        self.pool.on_block_evicted = self._offload_evicted
+
+        # prefix-cache accounting (feeds ForwardPassMetrics + disagg router)
+        self.last_prefix_hit = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_query_tokens = 0
 
         # --- slots / scheduler ---------------------------------------
         self.slots: List[Optional[_Slot]] = [None] * cfg.max_batch
@@ -291,12 +325,15 @@ class EngineCore:
 
     def utilization(self) -> Dict[str, float]:
         total = self.pool.num_pages - 1
+        hit_rate = (self.prefix_hit_tokens / self.prefix_query_tokens
+                    if self.prefix_query_tokens else 0.0)
         return {
             "request_active_slots": float(self.active),
             "request_total_slots": float(self.cfg.max_batch),
             "kv_active_blocks": float(total - self.pool.free_pages),
             "kv_total_blocks": float(total),
             "num_requests_waiting": float(len(self.waiting)),
+            "gpu_prefix_cache_hit_rate": hit_rate,
         }
 
     # ------------------------------------------------------------------
@@ -387,6 +424,7 @@ class EngineCore:
             raise ValueError(f"KV covers {T} tokens, prompt is {len(prompt)}")
         self.pool.create(seq_id)
         self.pool.extend(seq_id, prompt)
+        self._flush_evictions()
         slots = jnp.asarray(self.pool.write_slots(seq_id, 0, T))
         if not hasattr(self, "_scatter_fn"):
             pg = self.page_size
@@ -458,6 +496,53 @@ class EngineCore:
         self.by_seq.pop(slot.seq_id, None)
         self.slots[i] = None
 
+    def _offload_evicted(self, seq_hash: int, page: int) -> None:
+        """Eviction hook: queue the page for host-tier offload. The data
+        stays valid until the page's new owner WRITES (the next device
+        dispatch), so :meth:`_flush_evictions` batches the copies out right
+        before any dispatch that could overwrite pool pages."""
+        if self.tiered is None:
+            return
+        self._evict_buf.append((seq_hash, page))
+
+    def _flush_evictions(self) -> None:
+        if not self._evict_buf:
+            return
+        buf, self._evict_buf = self._evict_buf, []
+        pages = [p for _, p in buf]
+        k, v = self.copy_stream.d2h_pages(self.k_pool, self.v_pool, pages,
+                                          pipeline=len(pages) > 4)
+        for i, (seq_hash, _) in enumerate(buf):
+            self.tiered.offload(seq_hash, k[i], v[i])
+
+    def _restore_prefix(self, seq_id: str, prompt: List[int]) -> int:
+        """Prefix reuse at admission: claim matching device blocks and
+        upload matching host-tier blocks; returns tokens satisfied from
+        cache (always < len(prompt) so the last token still computes
+        logits)."""
+        host_lookup = None
+        fetched: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        if self.tiered is not None:
+            def host_lookup(h):
+                # fetch (and copy) eagerly: leasing the upload page can evict
+                # a device block whose offload lands in — and LRU-drops from —
+                # the very host tier we matched against
+                kv = self.tiered.lookup(h)
+                if kv is None:
+                    return False
+                fetched[h] = (kv[0].copy(), kv[1].copy())
+                return True
+        matched, uploads = self.pool.match_prefix(
+            seq_id, prompt, len(prompt) - 1, host_lookup)
+        if uploads:
+            self._flush_evictions()
+            pages = [p for _, p in uploads]
+            ks = np.stack([fetched[h][0] for h, _ in uploads])
+            vs = np.stack([fetched[h][1] for h, _ in uploads])
+            self.k_pool, self.v_pool = self.copy_stream.h2d_pages(
+                self.k_pool, self.v_pool, pages, ks, vs)
+        return matched
+
     def _admit_and_prefill(self, out: List[StepOutput]) -> bool:
         """Admit the head-of-line request and run ONE prefill chunk (possibly
         finishing the prompt). Returns True if an XLA step ran."""
@@ -480,6 +565,13 @@ class EngineCore:
         self.slots[slot_idx] = slot
         self.by_seq[seq_id] = slot
         self.pool.create(seq_id)
+        matched = 0
+        if self.cfg.enable_prefix_reuse:
+            matched = self._restore_prefix(seq_id, prompt)
+            slot.prefill_done = matched
+        self.last_prefix_hit = matched
+        self.prefix_hit_tokens += matched
+        self.prefix_query_tokens += len(prompt)
         self._load_sampling(slot_idx, req)
         return self._prefill_chunk(slot_idx, slot, out)
 
@@ -509,6 +601,7 @@ class EngineCore:
             self._free_slot(slot_idx)
             return False
 
+        self._flush_evictions()   # extend() may have evicted pages
         tokens = np.zeros((1, C), np.int32)
         tokens[0, :count] = prompt[start:start + count]
         positions = np.zeros((1, C), np.int32)
@@ -598,6 +691,7 @@ class EngineCore:
                                        slot.cum_logprob, FinishReason.ERROR))
                 self._free_slot(i)
             return outs
+        self._flush_evictions()   # ensure_pages() may have evicted pages
         max_len = max(len(s.prompt) + s.generated for _, s in active) + N
         S = self._bucket(max_len, self.s_buckets)
         P = S // self.page_size

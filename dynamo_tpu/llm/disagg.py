@@ -91,8 +91,11 @@ class PrefillQueue:
     def _cancel_key(self, request_id: str) -> str:
         return f"{self.queue}/cancelled/{request_id}"
 
-    async def cancel(self, request_id: str) -> None:
-        await self.store.put(self._cancel_key(request_id), b"1")
+    async def cancel(self, request_id: str, ttl: float = 600.0) -> None:
+        # TTL-leased so tombstones for jobs already dequeued (and thus never
+        # consumed at dequeue) don't accumulate in the store forever
+        lease = await self.store.lease_grant(ttl=ttl, auto_keepalive=False)
+        await self.store.put(self._cancel_key(request_id), b"1", lease=lease)
 
     async def consume_cancelled(self, request_id: str) -> bool:
         """Check-and-clear the tombstone. True => drop the job unprocessed."""

@@ -59,11 +59,13 @@ class KvEventPublisher:
             ))
         self._push(ev)
 
-    def blocks_removed(self, seq_id: str, blocks: List[TokenBlock]) -> None:
+    def blocks_removed(self, seq_hashes: List[int]) -> None:
+        """Fired when sealed blocks are EVICTED from the device pool (with
+        block reuse, sequence release keeps blocks matchable — only eviction
+        removes them from this worker's prefix cache)."""
         ev = KvCacheEvent(
             event_id=self._next_id(),
-            removed=KvRemovedEvent(
-                block_hashes=[b.sequence_hash for b in blocks]))
+            removed=KvRemovedEvent(block_hashes=list(seq_hashes)))
         self._push(ev)
 
     def _next_id(self) -> int:

@@ -1,0 +1,1 @@
+"""KV block manager: device reuse pool, tiered host/disk cache, transfers."""

@@ -1,0 +1,139 @@
+"""Host-DRAM and disk KV cache tiers.
+
+TPU VMs carry large host DRAM; offloaded KV pages park there (and optionally
+spill to an mmap'd file) keyed by chained sequence hash, so a later request
+with the same prefix re-uploads instead of recomputing. Capacity is
+fixed-slot: each tier is one preallocated array of block slots + an LRU map,
+so steady-state serving does zero host allocation.
+
+Reference capability: the multi-tier KV manager design HBM->CPU->SSD
+(docs/kv_cache_manager.md:5-15,39-71, lib/llm/src/kv/storage.rs pinned/system
+tiers) — host-staged rather than GPUDirect, which is the TPU reality.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class _SlotCache:
+    """Fixed-capacity LRU of KV blocks in one preallocated array pair."""
+
+    def __init__(self, num_blocks: int, block_shape: Tuple[int, ...],
+                 dtype, k_store: np.ndarray, v_store: np.ndarray):
+        self.num_blocks = num_blocks
+        self.block_shape = block_shape
+        self.dtype = dtype
+        self._k = k_store
+        self._v = v_store
+        self._slot_of: "collections.OrderedDict[int, int]" = \
+            collections.OrderedDict()          # seq_hash -> slot, LRU order
+        self._free = list(range(num_blocks - 1, -1, -1))
+
+    def __len__(self) -> int:
+        return len(self._slot_of)
+
+    def __contains__(self, seq_hash: int) -> bool:
+        return seq_hash in self._slot_of
+
+    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray
+            ) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
+        """Insert a block. Returns the evicted (hash, k, v) if the cache was
+        full (caller may cascade it to the next tier), else None."""
+        evicted = None
+        if seq_hash in self._slot_of:
+            self._slot_of.move_to_end(seq_hash)
+            slot = self._slot_of[seq_hash]
+        elif self._free:
+            slot = self._free.pop()
+            self._slot_of[seq_hash] = slot
+        else:
+            old_hash, slot = self._slot_of.popitem(last=False)  # LRU out
+            evicted = (old_hash, self._k[slot].copy(), self._v[slot].copy())
+            self._slot_of[seq_hash] = slot
+        self._k[slot] = k
+        self._v[slot] = v
+        return evicted
+
+    def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        slot = self._slot_of.get(seq_hash)
+        if slot is None:
+            return None
+        self._slot_of.move_to_end(seq_hash)
+        return self._k[slot], self._v[slot]
+
+    def pop(self, seq_hash: int) -> None:
+        slot = self._slot_of.pop(seq_hash, None)
+        if slot is not None:
+            self._free.append(slot)
+
+
+class HostKvTier(_SlotCache):
+    """Host-DRAM tier: [n_blocks, L, Hkv, page, Dh] preallocated numpy."""
+
+    def __init__(self, num_blocks: int, block_shape: Tuple[int, ...], dtype):
+        shape = (num_blocks, *block_shape)
+        super().__init__(num_blocks, block_shape, dtype,
+                         np.zeros(shape, dtype), np.zeros(shape, dtype))
+
+
+class DiskKvTier(_SlotCache):
+    """mmap-backed spill tier (the reference's SSD tier)."""
+
+    def __init__(self, num_blocks: int, block_shape: Tuple[int, ...], dtype,
+                 path: str):
+        shape = (num_blocks, *block_shape)
+        k = np.memmap(path + ".k", dtype=dtype, mode="w+", shape=shape)
+        v = np.memmap(path + ".v", dtype=dtype, mode="w+", shape=shape)
+        super().__init__(num_blocks, block_shape, dtype, k, v)
+
+
+class TieredKvCache:
+    """Host tier with optional disk spill, one lookup/offload surface.
+
+    ``offload`` inserts at the host tier and cascades host-LRU evictions to
+    disk; ``lookup`` checks host then disk (promoting disk hits back to
+    host). All arrays are [L, Hkv, page, Dh] per block.
+    """
+
+    def __init__(self, host: HostKvTier, disk: Optional[DiskKvTier] = None):
+        self.host = host
+        self.disk = disk
+        self.hits = 0
+        self.misses = 0
+
+    def __contains__(self, seq_hash: int) -> bool:
+        return seq_hash in self.host or (
+            self.disk is not None and seq_hash in self.disk)
+
+    def offload(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+        spilled = self.host.put(seq_hash, k, v)
+        if spilled is not None and self.disk is not None:
+            self.disk.put(*spilled)
+
+    def lookup(self, seq_hash: int
+               ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        got = self.host.get(seq_hash)
+        if got is None and self.disk is not None:
+            got = self.disk.get(seq_hash)
+            if got is not None:       # promote to host (may spill another)
+                k, v = got[0].copy(), got[1].copy()
+                self.disk.pop(seq_hash)
+                self.offload(seq_hash, k, v)
+                got = (k, v)
+        if got is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return got
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "host_blocks": len(self.host),
+            "disk_blocks": len(self.disk) if self.disk is not None else 0,
+            "hits": self.hits,
+            "misses": self.misses,
+        }

@@ -1,0 +1,70 @@
+"""Layer-pipelined page copies between the device KV pool and host memory.
+
+D2H: one async gather per layer is dispatched up front; the host then
+converts layer by layer while the device keeps executing the remaining
+gathers — transfer of layer l overlaps compute of layer l+1, the same
+pipelining the reference gets from its per-layer CUDA copy kernel on a
+dedicated stream. H2D: per-layer donated scatters queue on the device and
+return immediately.
+
+Reference capability: block_copy.cu + CopyStream layer triggering
+(lib/llm/src/kernels/block_copy.cu:25-80, lib/llm/src/kv/layer.rs:619-1132),
+re-expressed as jitted XLA gathers/scatters because on TPU the runtime's
+async dispatch queue *is* the copy stream.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class CopyStream:
+    """Jitted page gather/scatter helpers over pools shaped
+    [L, n_pages, Hkv, page, Dh]."""
+
+    def __init__(self):
+        self._gather_layer = jax.jit(lambda pool, l, pages: pool[l][pages])
+        self._scatter_layer = jax.jit(
+            lambda pool, l, pages, vals: pool.at[l, pages].set(vals),
+            donate_argnums=0)
+        self._gather_all = jax.jit(
+            lambda pool, pages: jnp.transpose(pool[:, pages], (1, 0, 2, 3, 4)))
+
+    # ------------------------------------------------------------------
+    def d2h_pages(self, k_pool, v_pool, pages: Sequence[int],
+                  pipeline: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+        """Copy pages out to host. Returns (k, v) [n, L, Hkv, page, Dh].
+
+        ``pipeline=True`` dispatches one gather per layer so host conversion
+        of layer l overlaps device execution of layer l+1 — worth it for
+        bulk multi-page transfers (disagg); small transfers use one
+        dispatch per pool."""
+        idx = jnp.asarray(list(pages), jnp.int32)
+        if not pipeline:
+            return (np.asarray(self._gather_all(k_pool, idx)),
+                    np.asarray(self._gather_all(v_pool, idx)))
+        L = k_pool.shape[0]
+        # dispatch every layer's gather before converting any (async queue)
+        k_parts = [self._gather_layer(k_pool, l, idx) for l in range(L)]
+        v_parts = [self._gather_layer(v_pool, l, idx) for l in range(L)]
+        k = np.stack([np.asarray(p) for p in k_parts], axis=1)
+        v = np.stack([np.asarray(p) for p in v_parts], axis=1)
+        return k, v
+
+    def h2d_pages(self, k_pool, v_pool, pages: Sequence[int],
+                  k: np.ndarray, v: np.ndarray):
+        """Upload [n, L, Hkv, page, Dh] host blocks into device pages,
+        queueing one donated scatter per layer. Returns the new pools."""
+        idx = jnp.asarray(list(pages), jnp.int32)
+        L = k_pool.shape[0]
+        dt = k_pool.dtype
+        for l in range(L):
+            k_pool = self._scatter_layer(k_pool, l, idx,
+                                         jnp.asarray(k[:, l], dt))
+            v_pool = self._scatter_layer(v_pool, l, idx,
+                                         jnp.asarray(v[:, l], dt))
+        return k_pool, v_pool

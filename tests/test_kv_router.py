@@ -154,18 +154,19 @@ async def test_publisher_and_recorder(tmp_path):
     pub = KvEventPublisher(worker_id=42, publish=transport)
     pool = PagePool(num_pages=8, page_size=4)
     pool.on_block_sealed = pub.block_stored
-    pool.on_blocks_freed = pub.blocks_removed
+    pool.on_blocks_removed = pub.blocks_removed
 
     pool.create("s1")
     pool.extend("s1", list(range(9)))   # seals 2 blocks
-    pool.release("s1")                  # frees -> removed event
+    pool.release("s1")                  # blocks park as reusable: NO event
+    pool.blocks.flush_reusable()        # eviction -> removed events
     await pub.start()
     await pub.flush()
     await pub.stop()
-    assert len(seen) == 3
+    assert len(seen) == 4
     evs = [RouterEvent.from_dict(p) for _, p in seen]
     assert evs[0].worker_id == 42 and evs[0].event.stored is not None
-    assert evs[2].event.removed is not None
+    assert evs[2].event.removed is not None and evs[3].event.removed is not None
     # chained: second stored block's parent is the first's hash
     assert (evs[1].event.stored.parent_hash
             == evs[0].event.stored.blocks[0].block_hash)
@@ -184,7 +185,7 @@ async def test_publisher_and_recorder(tmp_path):
     rec.flush()
     idx2 = KvIndexer(block_size=4)
     n = rec.replay_into(lambda p: idx2.apply_sync(RouterEvent.from_dict(p)))
-    assert n == 3
+    assert n == 4
     # after replaying the removal, worker 42 holds nothing
     assert idx2.find_matches_for_tokens(list(range(9))).scores == {}
     rec.close()

@@ -1,0 +1,201 @@
+"""KV block manager: device reuse pool, tiered host/disk cache, and
+engine-level prefix reuse + offload round trips."""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+from dynamo_tpu.llm.kvbm.pool import DeviceBlockPool, OutOfBlocks
+from dynamo_tpu.llm.kvbm.tiers import DiskKvTier, HostKvTier, TieredKvCache
+from dynamo_tpu.llm.protocols.common import BackendInput, StopConditions
+from dynamo_tpu.models import llama
+
+
+# ---------------------------------------------------------------------------
+# DeviceBlockPool state machine
+# ---------------------------------------------------------------------------
+
+def test_pool_lease_seal_match_release():
+    p = DeviceBlockPool(num_pages=4)
+    a = p.lease_new()
+    p.seal(a, seq_hash=111)
+    assert p.match(999) is None
+    p.release(a)                       # -> reusable, still matchable
+    assert p.reusable_count == 1
+    got = p.match(111)
+    assert got == a                    # same physical page claimed back
+    p.release(got)
+
+
+def test_pool_shared_block_refcount():
+    p = DeviceBlockPool(num_pages=4)
+    a = p.lease_new()
+    p.seal(a, 42)
+    b = p.match(42)                    # second sequence shares the live block
+    assert b == a
+    p.release(a)
+    assert p.match(42) == a            # still live (refs: B)
+    p.release(a)
+    p.release(a)                       # last ref -> reusable
+    assert p.reusable_count == 1
+
+
+def test_pool_eviction_lru_and_hook():
+    p = DeviceBlockPool(num_pages=4)   # 3 usable pages
+    evicted = []
+    p.on_evict = lambda h, pg: evicted.append(h)
+    pages = [p.lease_new() for _ in range(3)]
+    for i, pg in enumerate(pages):
+        p.seal(pg, 100 + i)
+        p.release(pg)                  # all reusable now
+    p.match(100)                       # touch 100 -> most recently used
+    p.release(p.match(100) or pages[0])
+    # pressure: new lease must evict the LRU reusable (101, not 100)
+    p.lease_new()
+    assert evicted == [101]
+
+
+def test_pool_unsealed_release_goes_free():
+    p = DeviceBlockPool(num_pages=3)
+    a = p.lease_new()
+    p.release(a)                       # never sealed -> free, not reusable
+    assert p.reusable_count == 0 and p.free_count == 2
+
+
+def test_pool_out_of_blocks():
+    p = DeviceBlockPool(num_pages=2)
+    p.lease_new()
+    with pytest.raises(OutOfBlocks):
+        p.lease_new()
+
+
+# ---------------------------------------------------------------------------
+# Tiers
+# ---------------------------------------------------------------------------
+
+def _blk(seed):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((2, 2, 4, 8)).astype(np.float32),
+            rng.standard_normal((2, 2, 4, 8)).astype(np.float32))
+
+
+def test_host_tier_put_get_lru_evict():
+    host = HostKvTier(2, (2, 2, 4, 8), np.float32)
+    k1, v1 = _blk(1)
+    k2, v2 = _blk(2)
+    k3, v3 = _blk(3)
+    assert host.put(10, k1, v1) is None
+    assert host.put(20, k2, v2) is None
+    host.get(10)                        # 10 becomes MRU
+    spilled = host.put(30, k3, v3)      # evicts LRU = 20
+    assert spilled is not None and spilled[0] == 20
+    np.testing.assert_array_equal(spilled[1], k2)
+    assert host.get(20) is None
+    np.testing.assert_array_equal(host.get(10)[0], k1)
+
+
+def test_tiered_cascade_to_disk_and_promote(tmp_path):
+    host = HostKvTier(1, (2, 2, 4, 8), np.float32)
+    disk = DiskKvTier(2, (2, 2, 4, 8), np.float32,
+                      str(tmp_path / "spill"))
+    cache = TieredKvCache(host, disk)
+    k1, v1 = _blk(1)
+    k2, v2 = _blk(2)
+    cache.offload(10, k1, v1)
+    cache.offload(20, k2, v2)           # 10 cascades to disk
+    assert 10 in cache and 20 in cache
+    got = cache.lookup(10)              # disk hit, promoted back to host
+    np.testing.assert_array_equal(got[0], k1)
+    assert 10 in cache.host
+    assert cache.stats()["hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Engine-level prefix reuse + offload
+# ---------------------------------------------------------------------------
+
+def _cfg(**kw):
+    d = dict(model=llama.preset("tiny-byte"), tp=1, page_size=8, max_batch=2,
+             max_context=128, prefill_chunk=32)
+    d.update(kw)
+    return JaxEngineConfig(**d)
+
+
+def _run(core, seq_id, tokens, max_tokens=4):
+    core.submit(seq_id, BackendInput(
+        token_ids=list(tokens),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True)))
+    got = []
+    for _ in range(200):
+        for so in core.step():
+            if so.seq_id == seq_id:
+                got.append(so)
+                if so.finish is not None:
+                    return got
+    raise AssertionError("did not finish")
+
+
+def test_engine_prefix_reuse_same_tokens():
+    core = EngineCore(_cfg())
+    prompt = list(range(1, 41))        # 40 tokens = 5 full pages of 8
+    first = [g.token for g in _run(core, "a", prompt)]
+    # second identical request: the prefix must be served from cache
+    baseline_free = core.pool.free_pages
+    second = [g.token for g in _run(core, "b", prompt)]
+    assert second == first             # identical results through reuse
+    sc_tokens_prefilled = core.last_prefix_hit
+    assert sc_tokens_prefilled >= 32   # >= 4 of 5 blocks from cache
+    assert core.pool.free_pages == baseline_free
+
+
+def test_engine_prefix_reuse_divergent_suffix():
+    core = EngineCore(_cfg())
+    a = list(range(1, 33))             # 4 pages
+    b = list(range(1, 25)) + [99, 98, 97, 96, 95, 94, 93, 92]
+    _run(core, "a", a)
+    _run(core, "b", b)                 # shares 3 full pages with a
+    assert 16 <= core.last_prefix_hit <= 24
+    # b's results must match b computed cold
+    cold = EngineCore(_cfg(enable_prefix_reuse=False))
+    want = [g.token for g in _run(cold, "b2", b)]
+    core2 = EngineCore(_cfg())
+    _run(core2, "a", a)
+    got = [g.token for g in _run(core2, "b", b)]
+    assert got == want
+
+
+def test_engine_host_offload_round_trip():
+    """Evicted pages offload to the host tier and restore on re-admission."""
+    # tiny pool: 2 sequences of 4 pages can't both stay resident
+    core = EngineCore(_cfg(num_pages=9, host_cache_blocks=16))
+    p1 = list(range(1, 33))
+    p2 = list(range(100, 132))
+    first = [g.token for g in _run(core, "a", p1)]
+    _run(core, "b", p2)                # pressure: evicts a's blocks -> host
+    assert core.tiered.stats()["host_blocks"] > 0
+    again = [g.token for g in _run(core, "a2", p1)]
+    assert again == first              # host-tier restore is exact
+    assert core.tiered.stats()["hits"] > 0
+
+
+def test_engine_reuse_respects_batching_invariance():
+    """Reused-prefix requests in a batch don't perturb batchmates."""
+    core = EngineCore(_cfg(max_batch=4))
+    base = list(range(1, 33))
+    solo = [g.token for g in _run(core, "s", base)]
+    core.submit("x", BackendInput(token_ids=base,
+                                  stop=StopConditions(max_tokens=4,
+                                                      ignore_eos=True)))
+    core.submit("y", BackendInput(token_ids=list(range(50, 80)),
+                                  stop=StopConditions(max_tokens=4,
+                                                      ignore_eos=True)))
+    got = {"x": [], "y": []}
+    done = set()
+    for _ in range(300):
+        for so in core.step():
+            got[so.seq_id].append(so.token)
+            if so.finish is not None:
+                done.add(so.seq_id)
+        if done == {"x", "y"}:
+            break
+    assert got["x"] == solo
