@@ -255,7 +255,8 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
             read_idx: jax.Array,         # [B, S] int32 pool token-slots to attend over
             read_pos: jax.Array,         # [B, S] int32 position of each read slot
             read_valid: jax.Array,       # [B, S] bool slot holds a real token
-            attn_impl: str = "xla",      # "xla" dense | "flash" Pallas kernel
+            attn_impl: str = "xla",      # "xla" | "flash" Pallas | "ring" sp
+            mesh=None,                   # required for attn_impl="ring"
             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One forward pass over a token chunk against the paged KV pool.
 
@@ -276,7 +277,15 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
     flat_w = write_idx.reshape(-1)
     wp, wo = flat_w // page, flat_w % page
     rp, ro = read_idx // page, read_idx % page
-    if attn_impl != "flash":
+    if attn_impl == "ring":
+        from ..parallel.mesh import AXIS_TP as _TP
+        from ..parallel.ring_attention import ring_attention
+        head_axis = _TP if (
+            mesh is not None and _TP in mesh.axis_names
+            and mesh.shape[_TP] > 1
+            and cfg.num_heads % mesh.shape[_TP] == 0
+            and cfg.num_kv_heads % mesh.shape[_TP] == 0) else None
+    elif attn_impl != "flash":
         # causal/validity mask [B,T,S]
         mask = (read_valid[:, None, :]
                 & (read_pos[:, None, :] <= positions[:, :, None]))
@@ -298,6 +307,10 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
             from ..ops.attention import flash_attention
             attn = flash_attention(q, k_ctx, v_ctx, positions, read_pos,
                                    read_valid)
+        elif attn_impl == "ring":
+            attn = ring_attention(q, k_ctx, v_ctx, positions, read_pos,
+                                  read_valid, mesh=mesh,
+                                  head_axis=head_axis)
         else:
             attn = attend(q, k_ctx, v_ctx, mask)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"][l])

@@ -1,0 +1,121 @@
+"""Ring attention: sequence-parallel attention over a mesh axis.
+
+Long-context prefill splits both the query chunk and the KV context across
+the ``sp`` mesh axis; each device computes blockwise attention between its
+local queries and the KV shard it currently holds, then rotates the KV shard
+to its ring neighbor with ``lax.ppermute``, carrying flash-style online
+softmax statistics (m, l, acc) across the sp steps. After sp rotations every
+query has seen every context position, with peak memory O(S/sp) per device
+and the rotation riding ICI neighbor links.
+
+Positions and validity travel with the KV shard, so causal masking is
+position-exact regardless of which device currently holds which shard — the
+same explicit (k_pos <= q_pos) & valid contract as the Pallas flash kernel,
+which makes the two composable (the per-shard inner update can later be
+swapped for the kernel).
+
+Reference capability: the reference has NO sequence/context parallelism
+(SURVEY §5.7 — verified absent); this is the TPU-native long-context answer
+the survey assigns to the in-tree engine, not a port.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import AXIS_SP
+
+NEG_INF = -1e30
+
+
+def _online_update(qg, k, v, qpos, kpos, kval, scale, m, l, acc):
+    """One flash-style partial-attention update.
+
+    qg: [B, Hkv, G, Tl, Dh] ; k, v: [B, Sl, Hkv, Dh]
+    qpos: [B, Tl] ; kpos, kval: [B, Sl]
+    m, l: [B, Hkv, G, Tl, 1] ; acc: [B, Hkv, G, Tl, Dh]
+    """
+    s = jnp.einsum("bhgtd,bshd->bhgts", qg, k,
+                   preferred_element_type=jnp.float32) * scale
+    mask = (kval[:, None, None, None, :]
+            & (kpos[:, None, None, None, :]
+               <= qpos[:, None, None, :, None]))
+    s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    # explicit mask on p: with the finite NEG_INF sentinel, a fully-masked
+    # row would otherwise contribute exp(0) = 1 per position
+    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m - m_new)
+    l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc * alpha + jnp.einsum("bhgts,bshd->bhgtd",
+                                   p.astype(v.dtype), v)
+    return m_new, l, acc
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   q_pos: jax.Array, k_pos: jax.Array, k_valid: jax.Array,
+                   mesh: Mesh, axis: str = AXIS_SP,
+                   head_axis: Optional[str] = None) -> jax.Array:
+    """Sequence-parallel attention with explicit positions.
+
+    q: [B, T, Hq, Dh] ; k, v: [B, S, Hkv, Dh] ; q_pos: [B, T] int32 ;
+    k_pos: [B, S] int32 ; k_valid: [B, S] bool. T and S must divide by the
+    ``axis`` size. Returns [B, T, Hq, Dh] in q.dtype.
+
+    Call under jit with global arrays; shard_map internally splits T and S
+    over ``axis`` and rotates KV shards around the ring. With ``head_axis``
+    (tp) set, heads additionally stay sharded — both Hq and Hkv must divide
+    by that axis so GQA groups stay aligned per shard.
+    """
+    sp = mesh.shape[axis]
+    scale = 1.0 / (math.sqrt(q.shape[-1]))
+    if head_axis is not None:
+        hp = mesh.shape[head_axis]
+        if q.shape[2] % hp or k.shape[2] % hp:
+            raise ValueError(
+                f"head_axis={head_axis} ({hp}) must divide Hq={q.shape[2]} "
+                f"and Hkv={k.shape[2]}")
+
+    def local(q, k, v, qpos, kpos, kval):
+        # shapes here are PER-SHARD: T/sp, and heads/tp when head-sharded
+        B, Tl, Hq_l, Dh = q.shape
+        Hkv_l = k.shape[2]
+        G = Hq_l // Hkv_l
+        qg = q.reshape(B, Tl, Hkv_l, G, Dh).transpose(0, 2, 3, 1, 4)
+        m = jnp.full((B, Hkv_l, G, Tl, 1), NEG_INF, jnp.float32)
+        l = jnp.zeros((B, Hkv_l, G, Tl, 1), jnp.float32)
+        acc = jnp.zeros((B, Hkv_l, G, Tl, Dh), jnp.float32)
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+        # python loop: sp is static and small; lets XLA overlap the
+        # ppermute of step i+1's shard with step i's compute
+        carry = (k, v, kpos, kval, m, l, acc)
+        for i in range(sp):
+            k, v, kpos, kval, m, l, acc = carry
+            m, l, acc = _online_update(qg, k, v, qpos, kpos, kval,
+                                       scale, m, l, acc)
+            if sp > 1 and i < sp - 1:
+                k, v, kpos, kval = (
+                    jax.lax.ppermute(x, axis, perm)
+                    for x in (k, v, kpos, kval))
+            carry = (k, v, kpos, kval, m, l, acc)
+        out = acc / jnp.where(l == 0.0, 1.0, l)
+        return out.transpose(0, 3, 1, 2, 4).reshape(B, Tl, Hq_l, Dh) \
+                  .astype(q.dtype)
+
+    if sp == 1 and head_axis is None:
+        return local(q, k, v, q_pos, k_pos, k_valid)
+
+    seq = P(None, axis)
+    seq4 = P(None, axis, head_axis, None)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(seq4, seq4, seq4, seq, seq, seq),
+        out_specs=seq4,
+    )(q, k, v, q_pos, k_pos, k_valid)
