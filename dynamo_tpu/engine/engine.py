@@ -213,7 +213,12 @@ class EngineCore:
         self.by_seq: Dict[str, _Slot] = {}
         self.waiting: Deque[Tuple[str, BackendInput]] = collections.deque()
         self.sampling = SamplingState.host_init(cfg.max_batch)
-        self.sampling.key = jax.device_put(self.sampling.key)
+        # commit to a canonical replicated sharding: program cache keys
+        # include argument shardings, so an uncommitted key would recompile
+        # every bucket once more after the first on-device key update
+        self._rep_sharding = NamedSharding(self.mesh, P())
+        self.sampling.key = jax.device_put(self.sampling.key,
+                                           self._rep_sharding)
 
         # --- compiled programs ---------------------------------------
         # decode reads are indexed through page tables of width S/page_size:
@@ -241,8 +246,14 @@ class EngineCore:
             cfg = self.cfg
             N = cfg.decode_steps
             impl = self.attn_impl
+            rep, kv = self._rep_sharding, self.kv_sharding
 
-            @partial(jax.jit, donate_argnums=(2, 3))
+            # out_shardings pinned so the pools keep the canonical kv
+            # sharding across programs: without this, XLA may emit an
+            # equivalent-but-differently-spec'd sharding and every *other*
+            # bucket program compiles a second variant against it
+            @partial(jax.jit, donate_argnums=(2, 3),
+                     out_shardings=(rep, rep, rep, kv, kv))
             def step(params, tokens, k_pool, v_pool, page_tables, lengths,
                      temp, top_p, top_k, key):
                 def one(carry, _):
@@ -268,9 +279,11 @@ class EngineCore:
         if (C, S) not in cache:
             cfg = self.cfg
             impl = "flash" if self.attn_impl == "pallas" else "xla"
+            rep, kv = self._rep_sharding, self.kv_sharding
 
             if last:
-                @partial(jax.jit, donate_argnums=(3, 4), static_argnums=(13,))
+                @partial(jax.jit, donate_argnums=(3, 4), static_argnums=(13,),
+                         out_shardings=(rep, rep, rep, kv, kv))
                 def fn(params, tokens, positions, k_pool, v_pool, write_idx,
                        read_idx, read_pos, read_valid, temp, top_p, top_k,
                        key, last_i):
@@ -282,7 +295,8 @@ class EngineCore:
                         logits[:, last_i], temp, top_p, top_k, key)
                     return tok, logp, new_key, k_pool, v_pool
             else:
-                @partial(jax.jit, donate_argnums=(3, 4))
+                @partial(jax.jit, donate_argnums=(3, 4),
+                         out_shardings=(kv, kv))
                 def fn(params, tokens, positions, k_pool, v_pool, write_idx,
                        read_idx, read_pos, read_valid):
                     # mid-prefill chunks skip the LM head entirely
@@ -464,7 +478,14 @@ class EngineCore:
         chunk, admit as many waiting requests as fit (one chunk each), then
         run one decode batch. Long prompts still interleave with decode chunk
         by chunk, but decode dispatches always run at full occupancy — the
-        difference between ~1x and ~5x throughput when a batch arrives."""
+        difference between ~1x and ~5x throughput when a batch arrives.
+
+        TTFT: if the prefill/admission phase produced outputs (first tokens
+        of freshly-prefilled prompts), return them immediately instead of
+        holding them through a decode_steps-long dispatch — the caller
+        flushes them to clients and decode runs on the next iteration. Worst
+        case this costs one host round-trip per admission burst; it saves a
+        full multi-step decode dispatch of first-token latency."""
         out: List[StepOutput] = []
         out.extend(self._reap_cancelled())
         for i, slot in [(i, s) for i, s in enumerate(self.slots)
@@ -473,6 +494,8 @@ class EngineCore:
         while self.waiting and None in self.slots:
             if not self._admit_and_prefill(out):
                 break
+        if out:
+            return out
         if any(s is not None and s.prefill_done >= len(s.prompt)
                for s in self.slots):
             out.extend(self._decode_step())

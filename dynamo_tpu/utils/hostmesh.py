@@ -1,0 +1,64 @@
+"""Forcing the host-CPU platform with N virtual devices.
+
+Single home for the axon-plugin workaround used by tests/conftest.py,
+__graft_entry__.py and bench.py: the axon TPU PJRT plugin overrides the
+``JAX_PLATFORMS`` env var at import time (the ``jax_platforms`` config flag
+wins over it), and its backend init can hang or fail when the TPU tunnel is
+down — so anything that wants the CPU platform must force it *before* any
+backend touch and never let the plugin initialize.
+
+XLA parses ``--xla_force_host_platform_device_count`` once per process, at
+first backend creation: growing the device count after a backend exists is
+impossible in-process (``jax_num_cpu_devices`` likewise refuses post-init).
+:func:`force_cpu` therefore reports whether the live process satisfies the
+request so callers can re-exec in a fresh interpreter when it does not.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "xla_force_host_platform_device_count"
+
+
+def set_host_device_count_env(n: int) -> None:
+    """Ensure ``XLA_FLAGS`` requests >= n virtual host devices. Env-only —
+    safe to call before jax is imported (e.g. from conftest)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"--{_FLAG}=(\d+)", flags)
+    if m:
+        if int(m.group(1)) < n:
+            flags = re.sub(rf"--{_FLAG}=\d+", f"--{_FLAG}={n}", flags)
+    else:
+        flags = (flags + f" --{_FLAG}={n}").strip()
+    os.environ["XLA_FLAGS"] = flags
+
+
+def force_cpu(n_devices: int = 1) -> bool:
+    """Force the cpu platform with >= n_devices virtual devices.
+
+    Returns True when this process now sees enough CPU devices; False when a
+    backend was already initialized with fewer devices (the flag is parsed
+    once per process — the caller must re-exec in a fresh interpreter).
+    """
+    import jax
+
+    try:
+        from jax._src import xla_bridge
+
+        live = bool(xla_bridge._backends)  # noqa: SLF001 — no public probe
+    except Exception:
+        live = False
+    if live:
+        # A backend is already initialized: the flag was parsed, the count
+        # cannot change, and force-switching platforms would break the
+        # caller's live arrays. Mutate nothing — report whether the current
+        # state already satisfies the request (caller re-execs otherwise).
+        return (jax.default_backend() == "cpu"
+                and jax.device_count() >= n_devices)
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    set_host_device_count_env(n_devices)
+    jax.config.update("jax_platforms", "cpu")
+    return len(jax.devices()) >= n_devices

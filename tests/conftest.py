@@ -7,15 +7,6 @@ offline. The real-TPU path is exercised by bench.py / __graft_entry__.py.
 import os
 import sys
 
-# Must be set before jax import: 8 virtual CPU devices for sharding tests.
-# (The driver environment pre-sets JAX_PLATFORMS=axon — the real TPU — so this
-# must override, not setdefault: tests are CPU-only by design.)
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 os.environ.setdefault("HF_HUB_OFFLINE", "1")
 os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
 # Echo engines: no artificial delay in tests.
@@ -23,11 +14,12 @@ os.environ.setdefault("DYN_TOKEN_ECHO_DELAY_MS", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The axon TPU plugin overrides JAX_PLATFORMS env; the config flag wins.
-import jax  # noqa: E402
+# 8 virtual CPU devices for sharding tests, forced before any backend init.
+# (The driver environment pre-sets JAX_PLATFORMS=axon — the real TPU — so this
+# must override, not setdefault: tests are CPU-only by design.)
+from dynamo_tpu.utils.hostmesh import force_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for tests"
+assert force_cpu(8), "expected 8 virtual CPU devices for tests"
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
