@@ -133,15 +133,22 @@ def _run_model(model_cfg, batches, prompt_len, gen_tokens, max_context,
         if time.monotonic() > deadline:
             sweep.append({"batch": b, "skipped": "time budget"})
             continue
-        core = None  # drop the previous core BEFORE building the next one:
-        # params + KV pools of two cores resident at once would OOM the 8B
-        # sweep on exactly the chips its HBM gate admits
-        core = make_core(b)
-        if n_params is None:
-            n_params = sum(int(a.size) for a in jax.tree.leaves(core.params))
-        round_(f"warm{b}_", b, salt=2 * b)           # compile + warm caches
-        tokens, wall, ttfts, t_first, post_tokens = round_(
-            f"bench{b}_", b, salt=2 * b + 1)
+        try:
+            core = None  # drop the previous core BEFORE building the next
+            # one: params + KV pools of two cores resident at once would OOM
+            # the 8B sweep on exactly the chips its HBM gate admits
+            core = make_core(b)
+            if n_params is None:
+                n_params = sum(int(a.size)
+                               for a in jax.tree.leaves(core.params))
+            round_(f"warm{b}_", b, salt=2 * b)       # compile + warm caches
+            tokens, wall, ttfts, t_first, post_tokens = round_(
+                f"bench{b}_", b, salt=2 * b + 1)
+        except Exception as e:
+            # one batch failing (e.g. OOM at the largest size) must not
+            # discard the batches already measured for this model
+            sweep.append({"batch": b, "error": f"{type(e).__name__}: {e}"})
+            continue
         # steady-state decode rate: tokens from dispatches strictly after the
         # one that produced the last first-token, over the time after it —
         # both the prefill and that mixed first dispatch are excluded

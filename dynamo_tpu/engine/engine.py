@@ -488,13 +488,15 @@ class EngineCore:
         full multi-step decode dispatch of first-token latency."""
         out: List[StepOutput] = []
         out.extend(self._reap_cancelled())
+        n_reaped = len(out)
         for i, slot in [(i, s) for i, s in enumerate(self.slots)
                         if s is not None and s.prefill_done < len(s.prompt)]:
             self._prefill_chunk(i, slot, out)
         while self.waiting and None in self.slots:
             if not self._admit_and_prefill(out):
                 break
-        if out:
+        if len(out) > n_reaped:
+            # fresh first tokens (not just cancel reaps): flush them now
             return out
         if any(s is not None and s.prefill_done >= len(s.prompt)
                for s in self.slots):

@@ -11,9 +11,14 @@ Provides, over one TCP protocol (wire.py frames):
 
 Single asyncio process, all state in memory owned by one task group — the
 discovery/config/event/queue planes of SURVEY §1/L0 collapsed into one
-deployable binary. The same wire protocol is implemented natively (C++) as
-the production server; this Python server is the reference implementation
-and test fixture.
+deployable binary.
+
+Two implementations share this wire protocol:
+- this Python server (the reference implementation and test fixture), and
+- the production C++ server (native/dynstore.cpp, epoll event loop), spawned
+  by :class:`NativeStoreServer`.
+Set ``DYNAMO_TPU_STORE=native`` to make ``StoreServer`` resolve to the
+native implementation everywhere (tests included).
 
 Ops (client -> server): {op, id, ...} -> reply {id, ok, ...}; pushed
 server -> client frames carry {push: "watch"|"msg"|"queue", ...}.
@@ -332,6 +337,83 @@ class StoreServer:
     # -- misc -------------------------------------------------------------
     async def _op_ping(self, conn, m):
         return {"pong": True}
+
+
+# ----------------------------------------------------------------------
+# native (C++) implementation: same protocol, spawned as a subprocess
+# ----------------------------------------------------------------------
+
+def native_build_dir() -> str:
+    import os
+
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native")
+
+
+def build_native(target: str = "") -> str:
+    """Build the native binaries with make (no-op when up to date). Returns
+    the build directory. A missing toolchain is only an error when the
+    requested artifacts are not already present (deployment images may ship
+    prebuilt binaries without a compiler)."""
+    import os
+    import shutil
+    import subprocess
+
+    ndir = native_build_dir()
+    wanted = ([target] if target
+              else ["build/dynstore", "build/libdynamo_kv.so"])
+    prebuilt = all(os.path.exists(os.path.join(ndir, t)) for t in wanted)
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        if prebuilt:
+            return os.path.join(ndir, "build")
+        raise RuntimeError("native store requested but make/g++ not found "
+                           "and no prebuilt binaries present")
+    cmd = ["make", "-C", ndir] + ([target] if target else [])
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    if r.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{r.stdout}\n{r.stderr}")
+    return os.path.join(ndir, "build")
+
+
+class NativeStoreServer:
+    """Spawns the C++ dynstore (native/dynstore.cpp) — same ``start()/stop()/
+    port`` surface as the asyncio server so it drops into every fixture."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host, self.port = host, port
+        self._proc: Optional[asyncio.subprocess.Process] = None
+
+    async def start(self) -> int:
+        # build off-loop: the first build is a multi-second g++ run and must
+        # not stall live coroutines (lease keepalives use sub-second TTLs)
+        bdir = await asyncio.to_thread(build_native, "build/dynstore")
+        binary = f"{bdir}/dynstore"
+        self._proc = await asyncio.create_subprocess_exec(
+            binary, "--host", self.host, "--port", str(self.port),
+            stdout=asyncio.subprocess.PIPE)
+        line = await asyncio.wait_for(self._proc.stdout.readline(), 10.0)
+        text = line.decode().strip()  # "dynstore listening on H:P"
+        if "listening on" not in text:
+            raise RuntimeError(f"native dynstore failed to start: {text!r}")
+        self.port = int(text.rsplit(":", 1)[1])
+        return self.port
+
+    async def stop(self) -> None:
+        if self._proc and self._proc.returncode is None:
+            self._proc.terminate()
+            try:
+                await asyncio.wait_for(self._proc.wait(), 5.0)
+            except asyncio.TimeoutError:
+                self._proc.kill()
+                await self._proc.wait()
+
+
+PyStoreServer = StoreServer
+
+import os as _os  # noqa: E402
+
+if _os.environ.get("DYNAMO_TPU_STORE") == "native":
+    StoreServer = NativeStoreServer  # type: ignore[misc]
 
 
 async def main(host: str = "0.0.0.0", port: int = 4222) -> None:

@@ -1,0 +1,211 @@
+"""Native (C++) dynstore + C-ABI KV publisher.
+
+Two proof obligations (VERDICT round 1, item 3):
+1. the C++ store passes the existing distributed-runtime tests UNMODIFIED via
+   the ``DYNAMO_TPU_STORE=native`` env switch;
+2. the C ABI publisher (reference lib/bindings/c equivalent) feeds events a
+   Python subscriber/indexer consumes unchanged.
+"""
+
+import asyncio
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("make") is None,
+    reason="native toolchain not available")
+
+
+def _build():
+    from dynamo_tpu.runtime.store_server import build_native
+
+    return build_native()
+
+
+async def _native_store():
+    from dynamo_tpu.runtime.store_server import NativeStoreServer
+
+    srv = NativeStoreServer()
+    port = await srv.start()
+    return srv, port
+
+
+# ----------------------------------------------------------------------
+# 1. the full existing store/runtime test module against the C++ server
+# ----------------------------------------------------------------------
+
+def test_runtime_suite_passes_against_native_store():
+    """tests/test_runtime_distributed.py, unmodified, env-switched native."""
+    _build()
+    env = {**os.environ, "DYNAMO_TPU_STORE": "native"}
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-x", "-q",
+         "tests/test_runtime_distributed.py"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+
+# ----------------------------------------------------------------------
+# 2. direct smoke of the native server (cheap, no subprocess-pytest)
+# ----------------------------------------------------------------------
+
+async def test_native_kv_watch_pubsub_queue():
+    from dynamo_tpu.runtime.store_client import StoreClient
+
+    _build()
+    srv, port = await _native_store()
+    try:
+        c1 = await StoreClient(port=port).connect()
+        c2 = await StoreClient(port=port).connect()
+
+        # KV + prefix + create semantics
+        await c1.put("a/b", b"1")
+        assert await c2.get("a/b") == b"1"
+        await c1.put("a/c", b"2")
+        assert await c2.get_prefix("a/") == [("a/b", b"1"), ("a/c", b"2")]
+        assert await c1.create("a/d", b"3")
+        assert not await c1.create("a/d", b"3", or_validate=True)
+
+        # watch: snapshot + live events
+        events = []
+        got = asyncio.Event()
+
+        async def on_watch(key, value, deleted):
+            events.append((key, value, deleted))
+            got.set()
+
+        snap = await c2.watch_prefix("a/", on_watch)
+        assert ("a/b", b"1") in snap
+        await c1.put("a/e", b"4")
+        await asyncio.wait_for(got.wait(), 2.0)
+        assert events[0] == ("a/e", b"4", False)
+
+        # pub/sub fanout
+        msgs = []
+        mgot = asyncio.Event()
+
+        async def on_msg(subject, payload):
+            msgs.append((subject, payload))
+            mgot.set()
+
+        await c2.subscribe("ns.ev", on_msg)
+        assert await c1.publish("ns.ev", b"hello") == 1
+        await asyncio.wait_for(mgot.wait(), 2.0)
+        assert msgs == [("ns.ev", b"hello")]
+
+        # queue: push/pull/ack + blocking pull
+        await c1.q_push("q1", b"m1")
+        mid, payload = await c2.q_pull("q1")
+        assert payload == b"m1"
+        await c2.q_ack("q1", mid)
+        assert await c1.q_len("q1") == 0
+
+        pull = asyncio.create_task(c2.q_pull("q1"))
+        await asyncio.sleep(0.1)
+        assert not pull.done()  # parked server-side
+        await c1.q_push("q1", b"m2")
+        mid2, payload2 = await asyncio.wait_for(pull, 2.0)
+        assert payload2 == b"m2"
+        await c2.q_ack("q1", mid2)
+
+        await c1.close()
+        await c2.close()
+    finally:
+        await srv.stop()
+
+
+async def test_native_lease_expiry_and_disconnect():
+    from dynamo_tpu.runtime.store_client import StoreClient
+
+    _build()
+    srv, port = await _native_store()
+    try:
+        # TTL expiry deletes lease-bound keys
+        c1 = await StoreClient(port=port).connect()
+        lease = await c1.lease_grant(ttl=0.5, auto_keepalive=False)
+        await c1.put("w/x", b"v", lease=lease)
+        c2 = await StoreClient(port=port).connect()
+        assert await c2.get("w/x") == b"v"
+        await asyncio.sleep(1.0)
+        assert await c2.get("w/x") is None
+
+        # connection death expires its leases immediately (process death)
+        c3 = await StoreClient(port=port).connect()
+        lease3 = await c3.lease_grant(ttl=30.0, auto_keepalive=False)
+        await c3.put("w/y", b"v3", lease=lease3)
+        assert await c2.get("w/y") == b"v3"
+        await c3.close()
+        await asyncio.sleep(0.5)
+        assert await c2.get("w/y") is None
+
+        # unacked queue message requeues when its consumer dies
+        c4 = await StoreClient(port=port).connect()
+        await c2.q_push("qq", b"work")
+        mid, _ = await c4.q_pull("qq")  # pulled but never acked
+        await c4.close()
+        await asyncio.sleep(0.3)
+        mid2, payload = await asyncio.wait_for(c2.q_pull("qq"), 2.0)
+        assert payload == b"work"
+        await c2.q_ack("qq", mid2)
+
+        await c1.close()
+        await c2.close()
+    finally:
+        await srv.stop()
+
+
+# ----------------------------------------------------------------------
+# 3. C ABI publisher -> Python subscriber/indexer
+# ----------------------------------------------------------------------
+
+async def test_c_abi_publisher_feeds_python_indexer():
+    from dynamo_tpu.llm.kv_router.native import NativeKvPublisher
+    from dynamo_tpu.llm.kv_router.protocols import RouterEvent
+    from dynamo_tpu.runtime.store_client import StoreClient
+
+    _build()
+    srv, port = await _native_store()
+    pub = None
+    try:
+        c = await StoreClient(port=port).connect()
+        received = []
+        done = asyncio.Event()
+
+        async def on_msg(subject, payload):
+            received.append(json.loads(payload.decode()))
+            if len(received) >= 2:
+                done.set()
+
+        await c.subscribe("testns.worker.kv_events", on_msg)
+
+        loop = asyncio.get_running_loop()
+        pub = await loop.run_in_executor(
+            None, lambda: NativeKvPublisher(
+                "127.0.0.1", port, "testns", "worker", worker_id=7))
+        pub.publish_stored([(0xDEAD_BEEF_0000_0001, 0xABC0_0000_0000_0002)],
+                           parent_hash=None)
+        pub.publish_removed([0xDEAD_BEEF_0000_0001])
+        await asyncio.wait_for(done.wait(), 5.0)
+
+        ev0 = RouterEvent.from_dict(received[0])
+        assert ev0.worker_id == 7
+        assert ev0.event.stored is not None
+        assert ev0.event.stored.blocks[0].block_hash == 0xDEAD_BEEF_0000_0001
+        assert ev0.event.stored.blocks[0].tokens_hash == 0xABC0_0000_0000_0002
+        assert ev0.event.stored.parent_hash is None
+
+        ev1 = RouterEvent.from_dict(received[1])
+        assert ev1.event.removed is not None
+        assert ev1.event.removed.block_hashes == [0xDEAD_BEEF_0000_0001]
+
+        await c.close()
+    finally:
+        if pub is not None:
+            pub.shutdown()
+        await srv.stop()
