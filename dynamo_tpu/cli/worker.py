@@ -131,7 +131,13 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
         async def generate_handler(request, ctx):
             bi = BackendInput.from_dict(request)
             # local prefix-cache hits count against remoting: a prompt we
-            # mostly have cached prefills locally regardless of length
+            # mostly have cached prefills locally regardless of length.
+            # CROSS-THREAD CONTRACT: this runs on the asyncio thread while
+            # the engine thread mutates the block pool. probe_prefix and
+            # TieredKvCache.__contains__ are strictly READ-ONLY (no LRU
+            # reorder), which is what makes the unlocked probe safe under
+            # the GIL — do not swap in tiered.lookup() (it mutates LRU
+            # order) without adding a lock.
             host = core.tiered
             prefix_hit = core.pool.probe_prefix(
                 bi.token_ids, (lambda h: h in host) if host else None)

@@ -1,7 +1,7 @@
 """Host-side paged KV cache bookkeeping for the JAX engine.
 
-The device arrays (``k_pool``/``v_pool``: [L, n_pages, H_kv, page, D_h]) are a
-page-major pool of fixed-size pages; a flat token slot
+The device arrays (``k_pool``/``v_pool``: [L, H_kv, n_pages, page, D_h]) are a
+head-major pool of fixed-size pages; a flat token slot
 ``page_id * page_size + offset`` addresses one token's KV. This module owns
 the *maps*: per-sequence page tables, token-slot index computation for
 scatter/gather, the sequence-hash chain, and — through
@@ -67,12 +67,29 @@ class PagePool:
         # hook: (seq_hash, page) BEFORE an evicted page is recycled — the
         # engine offloads the page to the host tier here
         self.on_block_evicted: Optional[Callable] = None
+        self._removed_buf: List[int] = []
 
     def _evicted(self, seq_hash: int, page: int) -> None:
         if self.on_block_evicted:
             self.on_block_evicted(seq_hash, page)
-        if self.on_blocks_removed:
-            self.on_blocks_removed([seq_hash])
+        # buffer removals so a batched eviction (multi-page ensure_pages /
+        # extend) publishes ONE removed event, as the reference's event
+        # manager batches them, instead of N single-hash events
+        self._removed_buf.append(seq_hash)
+
+    def flush_reusable(self) -> int:
+        """Evict every reusable (parked) block back to the free list and
+        publish their removed events as one batch."""
+        n = self.blocks.flush_reusable()
+        self._flush_removed()
+        return n
+
+    def _flush_removed(self) -> None:
+        if self._removed_buf and self.on_blocks_removed:
+            buf, self._removed_buf = self._removed_buf, []
+            self.on_blocks_removed(buf)
+        else:
+            self._removed_buf.clear()
 
     # ------------------------------------------------------------------
     @property
@@ -105,6 +122,7 @@ class PagePool:
                 f"need {need} pages, {self.blocks.allocatable} allocatable")
         for _ in range(need):
             sc.pages.append(self.blocks.lease_new())
+        self._flush_removed()
 
     def account_tokens(self, seq_id: str, tokens: Sequence[int]) -> None:
         """Record tokens as present (pages must already exist); seals
@@ -183,6 +201,7 @@ class PagePool:
             self._adopt_block(sc, blk, page, fire_stored)
             parent = sh
             matched += page_sz
+        self._flush_removed()
         return matched, uploads
 
     def probe_prefix(self, prompt: Sequence[int],

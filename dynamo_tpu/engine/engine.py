@@ -113,6 +113,9 @@ class _Slot:
     last_token: int = 0
     cum_logprob: float = 0.0
     cancelled: bool = False
+    # physical tokens written after every ENQUEUED decode dispatch executes
+    # (runs ahead of `generated`, which advances when results are fetched)
+    sched_len: int = 0
 
 
 @dataclass
@@ -122,6 +125,7 @@ class StepOutput:
     logprob: float
     finish: Optional[FinishReason] = None
     prompt_tokens: int = 0
+    error: Optional[str] = None     # cause when finish == ERROR
 
 
 class EngineCore:
@@ -134,8 +138,9 @@ class EngineCore:
         llama.validate_tp(m, cfg.tp)
         self.mesh = tp_mesh(cfg.tp, devices)
         self.page_size = cfg.page_size
-        # every sequence may overshoot up to decode_steps speculative tokens
-        self._spec_pad = -(-cfg.decode_steps // cfg.page_size) * cfg.page_size
+        # every sequence may overshoot up to 2*decode_steps speculative
+        # tokens: one dispatch in flight plus one chained behind it
+        self._spec_pad = -(-2 * cfg.decode_steps // cfg.page_size) * cfg.page_size
         # ceil: a seq at max_context with the speculative pad must always fit
         self.max_pages_per_seq = -(-(cfg.max_context + self._spec_pad)
                                    // cfg.page_size)
@@ -172,11 +177,12 @@ class EngineCore:
                              "run per-shard; tp>1 uses the XLA path)")
         self.attn_impl = impl
 
-        # --- KV pools (page-major: [L, n_pages, Hkv, page, Dh]) -------
+        # --- KV pools (head-major: [L, Hkv, n_pages, page, Dh] so that
+        # pool[l] is directly the TPU paged-attention kernel layout) ----
         kv_spec = llama.kv_cache_spec(m, cfg.tp)
         self.kv_sharding = NamedSharding(self.mesh, kv_spec)
         self.k_pool = jax.device_put(
-            jnp.zeros((m.num_layers, num_pages, m.num_kv_heads,
+            jnp.zeros((m.num_layers, m.num_kv_heads, num_pages,
                        cfg.page_size, m.head_dim), m.dtype), self.kv_sharding)
         self.v_pool = jax.device_put(
             jnp.zeros_like(self.k_pool), self.kv_sharding)
@@ -196,7 +202,12 @@ class EngineCore:
             host = HostKvTier(cfg.host_cache_blocks, blk_shape, np_dtype)
             disk = None
             if cfg.disk_cache_blocks > 0:
-                path = cfg.disk_cache_path or "/tmp/dynamo_tpu_kv_spill"
+                import os
+                # default path is per-process: two engines on one host
+                # (e.g. prefill + decode workers) must not memmap the same
+                # spill files in w+ mode and corrupt each other's blocks
+                path = (cfg.disk_cache_path
+                        or f"/tmp/dynamo_tpu_kv_spill.{os.getpid()}")
                 disk = DiskKvTier(cfg.disk_cache_blocks, blk_shape,
                                   np_dtype, path)
             self.tiered = TieredKvCache(host, disk)
@@ -228,9 +239,18 @@ class EngineCore:
         raw = _buckets(min(256, cfg.max_context), cfg.max_context + self._spec_pad)
         self.s_buckets = sorted({-(-b // pg) * pg for b in raw})
         self.c_buckets = _buckets(min(32, cfg.prefill_chunk), cfg.prefill_chunk)
+        # prefill runs up to 8 sequences per dispatch (batched lanes)
+        self.b_buckets = _buckets(1, min(8, cfg.max_batch))
         self._decode_fns: Dict[int, Any] = {}
-        self._prefill_mid_fns: Dict[Tuple[int, int], Any] = {}
-        self._prefill_last_fns: Dict[Tuple[int, int], Any] = {}
+        self._prefill_batch_fns: Dict[Tuple[int, int, int], Any] = {}
+
+        # --- in-flight decode dispatches (device-chained) -------------
+        # Each record is a dispatch whose results have not been fetched yet.
+        # Chaining feeds the previous dispatch's on-device token/key arrays
+        # straight into the next one, so the host fetch (one full tunnel
+        # round-trip) overlaps device execution instead of gating it.
+        self._inflight: Deque[Dict[str, Any]] = collections.deque()
+        self._deferred_release: List[str] = []
 
     # ------------------------------------------------------------------
     # compiled program builders
@@ -238,10 +258,14 @@ class EngineCore:
     def _decode_fn(self, S: int):
         """Multi-step decode: N autoregressive iterations inside one jitted
         lax.scan — indices computed on device from page tables, sampled token
-        fed straight back in. One host round-trip per N tokens (the round-trip
-        is the latency floor on TPU; this amortizes it N-fold). Lanes that hit
-        a finish condition mid-scan overshoot harmlessly into their own
-        pre-allocated pages; the host trims afterwards."""
+        fed straight back in. Lanes that hit a finish condition mid-scan
+        overshoot harmlessly into their own pre-allocated pages; the host
+        trims afterwards.
+
+        Returns (packed [N, B, 2] f32 (token, logprob) — ONE host fetch per
+        dispatch — plus the final token [B] i32, key, pools, all of which
+        stay on device so the next dispatch can chain off them without a
+        host round-trip)."""
         if S not in self._decode_fns:
             cfg = self.cfg
             N = cfg.decode_steps
@@ -269,44 +293,42 @@ class EngineCore:
                 carry = (tokens, lengths, k_pool, v_pool, key)
                 (tok, lengths, k_pool, v_pool, key), (toks, logps) = \
                     jax.lax.scan(one, carry, None, length=N)
-                return toks, logps, key, k_pool, v_pool
+                # token ids < 2^24 are exact in f32, so one packed array
+                # (one host fetch) carries both streams losslessly
+                packed = jnp.stack([toks.astype(jnp.float32), logps], -1)
+                return packed, tok, key, k_pool, v_pool
 
             self._decode_fns[S] = step
         return self._decode_fns[S]
 
-    def _prefill_fns(self, C: int, S: int, last: bool):
-        cache = self._prefill_last_fns if last else self._prefill_mid_fns
-        if (C, S) not in cache:
+    def _prefill_fn(self, Bp: int, C: int, S: int):
+        """Batched prefill: Bp sequence chunks advance in ONE dispatch (the
+        whole admission wave prefills together instead of one dispatch — and
+        one host round-trip — per sequence). Every lane computes the LM head
+        only at its own last chunk position (``logits_idx``) and samples; the
+        host keeps results only for lanes whose prompt completed. Padded
+        lanes write to scratch page 0 with nothing valid to read."""
+        if (Bp, C, S) not in self._prefill_batch_fns:
             cfg = self.cfg
             impl = "flash" if self.attn_impl == "pallas" else "xla"
             rep, kv = self._rep_sharding, self.kv_sharding
 
-            if last:
-                @partial(jax.jit, donate_argnums=(3, 4), static_argnums=(13,),
-                         out_shardings=(rep, rep, rep, kv, kv))
-                def fn(params, tokens, positions, k_pool, v_pool, write_idx,
-                       read_idx, read_pos, read_valid, temp, top_p, top_k,
-                       key, last_i):
-                    logits, k_pool, v_pool = llama.forward(
-                        params, cfg.model, tokens, positions, k_pool, v_pool,
-                        write_idx, read_idx, read_pos, read_valid,
-                        attn_impl=impl)
-                    tok, logp, new_key = sample(
-                        logits[:, last_i], temp, top_p, top_k, key)
-                    return tok, logp, new_key, k_pool, v_pool
-            else:
-                @partial(jax.jit, donate_argnums=(3, 4),
-                         out_shardings=(kv, kv))
-                def fn(params, tokens, positions, k_pool, v_pool, write_idx,
-                       read_idx, read_pos, read_valid):
-                    # mid-prefill chunks skip the LM head entirely
-                    _, k_pool, v_pool = llama.forward(
-                        params, cfg.model, tokens, positions, k_pool, v_pool,
-                        write_idx, read_idx, read_pos, read_valid,
-                        attn_impl=impl)
-                    return k_pool, v_pool
-            cache[(C, S)] = fn
-        return cache[(C, S)]
+            @partial(jax.jit, donate_argnums=(3, 4),
+                     out_shardings=(rep, rep, rep, kv, kv))
+            def fn(params, tokens, positions, k_pool, v_pool, write_idx,
+                   read_idx, read_pos, read_valid, last_i, temp, top_p,
+                   top_k, keys):
+                logits, k_pool, v_pool = llama.forward(
+                    params, cfg.model, tokens, positions, k_pool, v_pool,
+                    write_idx, read_idx, read_pos, read_valid,
+                    attn_impl=impl, logits_idx=last_i)
+                tok, logp, new_keys = sample(
+                    logits[:, 0], temp, top_p, top_k, keys)
+                packed = jnp.stack([tok.astype(jnp.float32), logp], -1)
+                return packed, tok, new_keys, k_pool, v_pool
+
+            self._prefill_batch_fns[(Bp, C, S)] = fn
+        return self._prefill_batch_fns[(Bp, C, S)]
 
     @staticmethod
     def _bucket(n: int, buckets: List[int]) -> int:
@@ -331,7 +353,7 @@ class EngineCore:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting or self.by_seq)
+        return bool(self.waiting or self.by_seq or self._inflight)
 
     @property
     def active(self) -> int:
@@ -371,20 +393,21 @@ class EngineCore:
         return k, v
 
     def _kv_gather(self, pool, slots):
-        # pool [L, n_pages, Hkv, page, Dh], flat slots [n] -> [L, n, Hkv, Dh].
-        # (advanced indices around the Hkv slice land in front: [n, L, ...])
+        # pool [L, Hkv, n_pages, page, Dh], flat slots [n] -> [L, n, Hkv, Dh]
+        # (adjacent advanced indices stay in place: [L, Hkv, n, Dh])
         if not hasattr(self, "_gather_fn"):
             pg = self.page_size
             self._gather_fn = jax.jit(
-                lambda p, s: jnp.transpose(p[:, s // pg, :, s % pg],
-                                           (1, 0, 2, 3)))
+                lambda p, s: jnp.transpose(p[:, :, s // pg, s % pg],
+                                           (0, 2, 1, 3)))
         return self._gather_fn(pool, slots)
 
     def _kv_gather_layer(self, pool, slots, layer: int):
         if not hasattr(self, "_gather_layer_fn"):
             pg = self.page_size
             self._gather_layer_fn = jax.jit(
-                lambda p, s, l: p[l][s // pg, :, s % pg], static_argnums=2)
+                lambda p, s, l: jnp.transpose(p[l][:, s // pg, s % pg],
+                                              (1, 0, 2)), static_argnums=2)
         return self._gather_layer_fn(pool, slots, layer)
 
     def prefill_extract(self, seq_id: str, request: BackendInput
@@ -414,7 +437,7 @@ class EngineCore:
         out: List[StepOutput] = []
         try:
             while slot.prefill_done < len(prompt):
-                self._prefill_chunk(slot_idx, slot, out)
+                self._prefill_dispatch([(slot_idx, slot)], out)
                 if out and out[-1].finish == FinishReason.ERROR:
                     raise OutOfPages("prefill ran out of KV pages")
             so = out[-1]
@@ -442,10 +465,10 @@ class EngineCore:
         slots = jnp.asarray(self.pool.write_slots(seq_id, 0, T))
         if not hasattr(self, "_scatter_fn"):
             pg = self.page_size
-            # advanced indices around the Hkv slice put [T] in front
+            # vals [L, T, Hkv, Dh] -> pool indexed shape [L, Hkv, T, Dh]
             self._scatter_fn = jax.jit(
-                lambda p, s, vals: p.at[:, s // pg, :, s % pg].set(
-                    jnp.transpose(vals, (1, 0, 2, 3))), donate_argnums=0)
+                lambda p, s, vals: p.at[:, :, s // pg, s % pg].set(
+                    jnp.transpose(vals, (0, 2, 1, 3))), donate_argnums=0)
         self.k_pool = self._scatter_fn(self.k_pool, slots,
                                        k.astype(self.cfg.model.dtype))
         self.v_pool = self._scatter_fn(self.v_pool, slots,
@@ -474,33 +497,51 @@ class EngineCore:
 
     # ------------------------------------------------------------------
     def step(self) -> List[StepOutput]:
-        """Run one engine iteration: advance EVERY mid-prefill sequence by one
-        chunk, admit as many waiting requests as fit (one chunk each), then
-        run one decode batch. Long prompts still interleave with decode chunk
-        by chunk, but decode dispatches always run at full occupancy — the
-        difference between ~1x and ~5x throughput when a batch arrives.
+        """Run one engine iteration.
 
-        TTFT: if the prefill/admission phase produced outputs (first tokens
-        of freshly-prefilled prompts), return them immediately instead of
-        holding them through a decode_steps-long dispatch — the caller
-        flushes them to clients and decode runs on the next iteration. Worst
-        case this costs one host round-trip per admission burst; it saves a
-        full multi-step decode dispatch of first-token latency."""
+        Steady-state decode is PIPELINED: a dispatch's sampled tokens are
+        fetched one iteration later, while the next dispatch (chained off
+        the previous one's on-device token/key arrays) already executes.
+        The host fetch round-trip therefore overlaps device compute instead
+        of serializing with it. Membership changes (admission, prefill,
+        cancel, finish) are sync points: the in-flight window drains first.
+
+        Prefill advances every mid-prefill sequence and admits as many
+        waiting requests as fit, batched into ONE dispatch (up to 8 lanes);
+        fresh first tokens are flushed to callers immediately rather than
+        held through a decode dispatch (TTFT)."""
         out: List[StepOutput] = []
         out.extend(self._reap_cancelled())
         n_reaped = len(out)
-        for i, slot in [(i, s) for i, s in enumerate(self.slots)
-                        if s is not None and s.prefill_done < len(s.prompt)]:
-            self._prefill_chunk(i, slot, out)
-        while self.waiting and None in self.slots:
-            if not self._admit_and_prefill(out):
-                break
-        if len(out) > n_reaped:
-            # fresh first tokens (not just cancel reaps): flush them now
+
+        prefill_work = any(s is not None and s.prefill_done < len(s.prompt)
+                           for s in self.slots)
+        admit_possible = bool(self.waiting) and None in self.slots
+        sync_needed = prefill_work or admit_possible or n_reaped > 0
+
+        if self._inflight:
+            if not sync_needed and self._can_chain():
+                self._dispatch_decode()
+            out.extend(self._process_oldest_inflight())
+            while not self.by_seq and self._inflight:
+                # every live sequence finished: drain the stale window so
+                # its pages release instead of idling in limbo
+                out.extend(self._process_oldest_inflight())
+            if not self._inflight:
+                self._apply_deferred_release()
             return out
+
+        self._apply_deferred_release()
+        if prefill_work or admit_possible:
+            self._prefill_round(out)
+            # if no prefill progress was possible (e.g. pool full), fall
+            # through to decode so the engine never stalls
         if any(s is not None and s.prefill_done >= len(s.prompt)
                for s in self.slots):
-            out.extend(self._decode_step())
+            # non-blocking enqueue — even right after a prefill round, so
+            # decode keeps advancing between chunks of a long prompt; the
+            # results are fetched on a later iteration
+            self._dispatch_decode(out)
         return out
 
     # ------------------------------------------------------------------
@@ -517,9 +558,21 @@ class EngineCore:
         slot = self.slots[i]
         if slot is None:
             return
-        self.pool.release(slot.seq_id)
+        if self._inflight:
+            # an enqueued decode dispatch may still write into this
+            # sequence's pages; hold the release until the window drains so
+            # the pages cannot be reallocated under the in-flight program
+            self._deferred_release.append(slot.seq_id)
+        else:
+            self.pool.release(slot.seq_id)
         self.by_seq.pop(slot.seq_id, None)
         self.slots[i] = None
+
+    def _apply_deferred_release(self) -> None:
+        if self._deferred_release and not self._inflight:
+            for seq_id in self._deferred_release:
+                self.pool.release(seq_id)
+            self._deferred_release.clear()
 
     def _offload_evicted(self, seq_hash: int, page: int) -> None:
         """Eviction hook: queue the page for host-tier offload. The data
@@ -568,22 +621,29 @@ class EngineCore:
                 self.k_pool, self.v_pool, pages, ks, vs)
         return matched
 
-    def _admit_and_prefill(self, out: List[StepOutput]) -> bool:
-        """Admit the head-of-line request and run ONE prefill chunk (possibly
-        finishing the prompt). Returns True if an XLA step ran."""
+    def _admit_one(self, out: List[StepOutput]):
+        """Admit the head-of-line request into a free slot (no prefill yet).
+        Returns (slot_idx, slot), "rejected" (popped with an error emitted),
+        or "blocked" (no KV capacity right now)."""
         seq_id, req = self.waiting[0]
         prompt = list(req.token_ids)
         if len(prompt) >= self.cfg.max_context:
             self.waiting.popleft()
-            out.append(StepOutput(seq_id, 0, 0.0, FinishReason.ERROR))
-            return False
+            out.append(StepOutput(
+                seq_id, 0, 0.0, FinishReason.ERROR,
+                error=f"prompt of {len(prompt)} tokens exceeds max_context "
+                      f"{self.cfg.max_context}"))
+            return "rejected"
         if self.pool.pages_needed(len(prompt) + 1) > self.pool.num_pages - 1:
             # can NEVER fit, even with an empty pool: reject, don't starve
             self.waiting.popleft()
-            out.append(StepOutput(seq_id, 0, 0.0, FinishReason.ERROR))
-            return False
+            out.append(StepOutput(
+                seq_id, 0, 0.0, FinishReason.ERROR,
+                error=f"prompt of {len(prompt)} tokens cannot fit in the KV "
+                      f"pool ({self.pool.num_pages - 1} pages)"))
+            return "rejected"
         if not self.pool.can_admit(len(prompt) + 1):
-            return False  # no KV space yet; decode will free some eventually
+            return "blocked"  # decode will free KV space eventually
         self.waiting.popleft()
         slot_idx = self.slots.index(None)
         slot = _Slot(seq_id, req, prompt)
@@ -598,7 +658,29 @@ class EngineCore:
         self.prefix_hit_tokens += matched
         self.prefix_query_tokens += len(prompt)
         self._load_sampling(slot_idx, req)
-        return self._prefill_chunk(slot_idx, slot, out)
+        return slot_idx, slot
+
+    def _prefill_round(self, out: List[StepOutput]) -> bool:
+        """Advance every mid-prefill slot by one chunk and admit as many
+        waiting requests as fit, all in ONE batched dispatch (up to the
+        prefill lane budget). Returns True if a dispatch ran."""
+        max_lanes = self.b_buckets[-1]
+        chunks = [(i, s) for i, s in enumerate(self.slots)
+                  if s is not None and s.prefill_done < len(s.prompt)]
+        while (self.waiting and None in self.slots
+               and len(chunks) < max_lanes):
+            admitted = self._admit_one(out)
+            if admitted == "blocked":
+                break
+            if admitted == "rejected":
+                continue
+            # fully satisfied by prefix reuse still needs its last token
+            # computed, so every admission lands in the chunk list
+            chunks.append(admitted)
+        chunks = chunks[:max_lanes]
+        if not chunks:
+            return False
+        return self._prefill_dispatch(chunks, out)
 
     def _load_sampling(self, slot_idx: int, req: BackendInput) -> None:
         s = self.sampling
@@ -610,61 +692,96 @@ class EngineCore:
             s.key = s.key.at[slot_idx].set(
                 jax.random.key(req.sampling.seed))
 
-    def _prefill_chunk(self, slot_idx: int, slot: _Slot,
-                       out: List[StepOutput]) -> bool:
-        prompt = slot.prompt
-        start = slot.prefill_done
-        count = min(len(prompt) - start, self.cfg.prefill_chunk)
-        is_last = start + count == len(prompt)
-        C = self._bucket(count, self.c_buckets)
-        S = self._bucket(start + count, self.s_buckets)
-
-        try:
-            self.pool.extend(slot.seq_id, prompt[start:start + count])
-        except OutOfPages:
-            out.append(StepOutput(slot.seq_id, 0, 0.0, FinishReason.ERROR))
-            self._free_slot(slot_idx)
+    def _prefill_dispatch(self, chunks: List[Tuple[int, _Slot]],
+                          out: List[StepOutput]) -> bool:
+        """Advance each (slot_idx, slot) by one prompt chunk in a single
+        batched dispatch; fetch all lanes' sampled tokens with ONE host
+        round-trip and keep results only for lanes whose prompt completed.
+        Returns True if a dispatch ran."""
+        cfg = self.cfg
+        work = []  # (slot_idx, slot, start, count, is_last)
+        for i, slot in chunks:
+            prompt = slot.prompt
+            start = slot.prefill_done
+            count = min(len(prompt) - start, cfg.prefill_chunk)
+            try:
+                self.pool.extend(slot.seq_id, prompt[start:start + count])
+            except OutOfPages:
+                out.append(StepOutput(slot.seq_id, 0, 0.0,
+                                      FinishReason.ERROR,
+                                      error="out of KV pages during prefill"))
+                self._free_slot(i)
+                continue
+            work.append((i, slot, start, count,
+                         start + count == len(prompt)))
+        if not work:
             return False
-
         self._flush_evictions()   # extend() may have evicted pages
-        tokens = np.zeros((1, C), np.int32)
-        tokens[0, :count] = prompt[start:start + count]
-        positions = np.zeros((1, C), np.int32)
-        positions[0, :count] = np.arange(start, start + count)
-        write_idx = np.zeros((1, C), np.int32)  # pad writes -> scratch page 0
-        write_idx[0, :count] = self.pool.write_slots(slot.seq_id, start, count)
-        r_slots, r_pos, r_valid = self.pool.read_slots(
-            slot.seq_id, start + count, S)
-        args = (self.params, tokens, positions, self.k_pool, self.v_pool,
-                write_idx, r_slots[None], r_pos[None], r_valid[None])
-        if is_last:
-            s = self.sampling
-            fn = self._prefill_fns(C, S, last=True)
-            tok, logp, new_key, self.k_pool, self.v_pool = fn(
-                *args, s.temperature[slot_idx:slot_idx + 1],
-                s.top_p[slot_idx:slot_idx + 1],
-                s.top_k[slot_idx:slot_idx + 1],
-                s.key[slot_idx:slot_idx + 1], count - 1)
-            s.key = s.key.at[slot_idx].set(new_key[0])
-            slot.prefill_done += count
-            t = int(tok[0])
+
+        Bp = self._bucket(len(work), self.b_buckets)
+        C = self._bucket(max(w[3] for w in work), self.c_buckets)
+        S = self._bucket(max(w[2] + w[3] for w in work), self.s_buckets)
+        s = self.sampling
+        tokens = np.zeros((Bp, C), np.int32)
+        positions = np.zeros((Bp, C), np.int32)
+        write_idx = np.zeros((Bp, C), np.int32)   # pad -> scratch page 0
+        read_idx = np.zeros((Bp, S), np.int32)
+        read_pos = np.zeros((Bp, S), np.int32)
+        read_valid = np.zeros((Bp, S), bool)
+        last_i = np.zeros(Bp, np.int32)
+        temp = np.zeros(Bp, np.float32)
+        top_p = np.ones(Bp, np.float32)
+        top_k = np.zeros(Bp, np.int32)
+        idxs = np.zeros(Bp, np.int32)
+        for lane, (i, slot, start, count, _) in enumerate(work):
+            tokens[lane, :count] = slot.prompt[start:start + count]
+            positions[lane, :count] = np.arange(start, start + count)
+            write_idx[lane, :count] = self.pool.write_slots(
+                slot.seq_id, start, count)
+            r_s, r_p, r_v = self.pool.read_slots(slot.seq_id,
+                                                 start + count, S)
+            read_idx[lane], read_pos[lane], read_valid[lane] = r_s, r_p, r_v
+            last_i[lane] = count - 1
+            temp[lane] = s.temperature[i]
+            top_p[lane] = s.top_p[i]
+            top_k[lane] = s.top_k[i]
+            idxs[lane] = i
+        keys = s.key[jnp.asarray(idxs)]
+
+        fn = self._prefill_fn(Bp, C, S)
+        packed, _tok, new_keys, self.k_pool, self.v_pool = fn(
+            self.params, tokens, positions, self.k_pool, self.v_pool,
+            write_idx, read_idx, read_pos, read_valid, last_i,
+            temp, top_p, top_k, keys)
+
+        # persist advanced PRNG keys only for lanes that really sampled
+        last_lanes = [lane for lane, w in enumerate(work) if w[4]]
+        if last_lanes:
+            la = jnp.asarray([int(idxs[l]) for l in last_lanes])
+            s.key = s.key.at[la].set(new_keys[jnp.asarray(last_lanes)])
+
+        packed_np = np.asarray(packed)            # ONE host fetch
+        for lane, (i, slot, start, count, is_last) in enumerate(work):
+            slot.prefill_done = start + count
+            if not is_last:
+                continue
+            t = int(packed_np[lane, 0])
+            lp = float(packed_np[lane, 1])
             try:
                 self._append_generated(slot, t)
             except OutOfPages:
-                out.append(StepOutput(slot.seq_id, t, float(logp[0]),
-                                      FinishReason.ERROR))
-                self._free_slot(slot_idx)
-                return True
-            slot.cum_logprob += float(logp[0])
+                out.append(StepOutput(slot.seq_id, t, lp,
+                                      FinishReason.ERROR,
+                                      error="out of KV pages appending the "
+                                            "first generated token"))
+                self._free_slot(i)
+                continue
+            slot.cum_logprob += lp
             fin = self._finish_reason(slot, t)
             out.append(StepOutput(slot.seq_id, t, slot.cum_logprob, fin,
-                                  prompt_tokens=len(prompt)))
+                                  prompt_tokens=len(slot.prompt)))
             if fin is not None:
-                self._free_slot(slot_idx)
-        else:
-            fn = self._prefill_fns(C, S, last=False)
-            self.k_pool, self.v_pool = fn(*args)
-            slot.prefill_done += count
+                self._free_slot(i)
         return True
 
     def _append_generated(self, slot: _Slot, token: int) -> None:
@@ -685,73 +802,124 @@ class EngineCore:
         return None
 
     # ------------------------------------------------------------------
-    def _decode_step(self) -> List[StepOutput]:
-        B = self.cfg.max_batch
+    def _decode_eligible(self):
+        """(slot_idx, slot, phys_len) for every decode-ready slot whose next
+        dispatch's pages could be reserved; deferred = ready but no pages."""
         N = self.cfg.decode_steps
-        outs: List[StepOutput] = []
-        # only fully-prefilled slots decode; mid-prefill slots keep their
-        # lanes masked (scratch page table) until their prompt is in cache
-        active = []
-        deferred = []
+        active, deferred = [], []
         for i, slot in enumerate(self.slots):
             if slot is None or slot.prefill_done < len(slot.prompt):
                 continue
-            n = len(slot.prompt) + slot.generated
+            phys = slot.sched_len or (len(slot.prompt) + slot.generated)
             try:
                 # reserve room for N speculative tokens up front
-                self.pool.ensure_pages(slot.seq_id, n + N)
+                self.pool.ensure_pages(slot.seq_id, phys + N)
             except OutOfPages:
                 # pool pressure: defer this slot — batchmates finishing will
                 # free pages — rather than killing a healthy request
                 deferred.append((i, slot))
                 continue
-            active.append((i, slot))
+            active.append((i, slot, phys))
+        return active, deferred
+
+    def _can_chain(self) -> bool:
+        """True if the next decode dispatch can be enqueued straight off the
+        in-flight one's on-device outputs: same membership, pages available
+        for every lane, and only one dispatch currently outstanding."""
+        if len(self._inflight) != 1:
+            return False
+        rec = self._inflight[-1]
+        # the chained dispatch feeds the previous dispatch's on-device
+        # final_tok to EVERY lane, so the decode-ready set must be EXACTLY
+        # the lanes that were active in that dispatch: a newly injected or
+        # newly eligible slot (inject_prefilled, deferred slot unblocking)
+        # has a real last_token the device array does not contain
+        ready_now = {i for i, s in enumerate(self.slots)
+                     if s is not None and s.prefill_done >= len(s.prompt)}
+        rec_lanes = {i for i, _, _ in rec["active"]}
+        if ready_now != rec_lanes:
+            return False
+        for i, slot, _ in rec["active"]:
+            if self.slots[i] is not slot:
+                return False   # membership changed (cancel) -> sync
+        N = self.cfg.decode_steps
+        for i, slot, _ in rec["active"]:
+            try:
+                self.pool.ensure_pages(slot.seq_id, slot.sched_len + N)
+            except OutOfPages:
+                return False
+        return True
+
+    def _dispatch_decode(self, out: Optional[List[StepOutput]] = None) -> None:
+        """Enqueue one multi-step decode dispatch WITHOUT fetching results.
+        If a dispatch is already in flight, chain off its on-device token
+        and key arrays (no host data dependency)."""
+        B = self.cfg.max_batch
+        N = self.cfg.decode_steps
+        chain = bool(self._inflight)
+        active, deferred = self._decode_eligible()
         if not active:
-            if deferred:
+            if deferred and not chain and out is not None:
                 # nothing can make progress: evict the largest consumer so
                 # the rest of the system unblocks (capacity error)
-                i, slot = max(deferred,
-                              key=lambda t: len(self.pool.seqs[t[1].seq_id].pages))
-                outs.append(StepOutput(slot.seq_id, slot.last_token,
-                                       slot.cum_logprob, FinishReason.ERROR))
+                i, slot = max(
+                    deferred,
+                    key=lambda t: len(self.pool.seqs[t[1].seq_id].pages))
+                out.append(StepOutput(
+                    slot.seq_id, slot.last_token, slot.cum_logprob,
+                    FinishReason.ERROR,
+                    error="evicted under KV pool pressure (no capacity to "
+                          "continue decoding)"))
                 self._free_slot(i)
-            return outs
+            return
         self._flush_evictions()   # ensure_pages() may have evicted pages
-        max_len = max(len(s.prompt) + s.generated for _, s in active) + N
-        S = self._bucket(max_len, self.s_buckets)
+        S = self._bucket(max(phys for _, _, phys in active) + N,
+                         self.s_buckets)
         P = S // self.page_size
 
-        tokens = np.zeros(B, np.int32)
         lengths = np.ones(B, np.int32)    # inactive lanes write into page 0
         page_tables = np.zeros((B, P), np.int32)
-        for i, slot in active:
-            n = len(slot.prompt) + slot.generated
-            tokens[i] = slot.last_token
-            lengths[i] = n
+        for i, slot, phys in active:
+            lengths[i] = phys
             page_tables[i] = self.pool.page_table_row(slot.seq_id, P)
+            slot.sched_len = phys + N
+        if chain:
+            tokens = self._inflight[-1]["final_tok"]   # device [B], unfetched
+        else:
+            tokens = np.zeros(B, np.int32)
+            for i, slot, _ in active:
+                tokens[i] = slot.last_token
 
         s = self.sampling
         fn = self._decode_fn(S)
-        toks, logps, new_key, self.k_pool, self.v_pool = fn(
+        packed, final_tok, new_key, self.k_pool, self.v_pool = fn(
             self.params, tokens, self.k_pool, self.v_pool,
             page_tables, lengths, s.temperature, s.top_p, s.top_k, s.key)
         s.key = new_key
-        toks_np = np.asarray(toks)    # [N, B]
-        logps_np = np.asarray(logps)
+        self._inflight.append({"packed": packed, "final_tok": final_tok,
+                               "active": active})
 
-        for i, slot in active:
+    def _process_oldest_inflight(self) -> List[StepOutput]:
+        """Fetch (blocking) and account the oldest in-flight dispatch."""
+        rec = self._inflight.popleft()
+        packed_np = np.asarray(rec["packed"])     # [N, B, 2] — ONE fetch
+        N = packed_np.shape[0]
+        outs: List[StepOutput] = []
+        for i, slot, _ in rec["active"]:
+            if self.slots[i] is not slot:
+                continue   # freed since dispatch (finish/cancel): discard
             for j in range(N):
-                t = int(toks_np[j, i])
+                t = int(packed_np[j, i, 0])
                 self.pool.account_tokens(slot.seq_id, [t])
                 slot.generated += 1
                 slot.last_token = t
-                slot.cum_logprob += float(logps_np[j, i])
+                slot.cum_logprob += float(packed_np[j, i, 1])
                 fin = self._finish_reason(slot, t)
                 outs.append(StepOutput(slot.seq_id, t, slot.cum_logprob, fin))
                 if fin is not None:
                     # overshoot tokens beyond the finish are discarded; their
-                    # page-pool writes are inside this seq's own pages and are
-                    # released with the slot
+                    # page-pool writes are inside this seq's own pages, which
+                    # stay held until the in-flight window drains
                     self._free_slot(i)
                     break
         return outs
@@ -810,9 +978,10 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                 elif kind == "inject":
                     try:
                         so = self.core.inject_prefilled(seq_id, *payload)
-                    except Exception:
+                    except Exception as e:  # noqa: BLE001
                         log.exception("KV injection failed")
-                        so = StepOutput(seq_id, 0, 0.0, FinishReason.ERROR)
+                        so = StepOutput(seq_id, 0, 0.0, FinishReason.ERROR,
+                                        error=f"KV injection failed: {e}")
                     self._deliver(so)
                 elif kind == "prefill_extract":
                     request, loop, fut = payload
@@ -828,9 +997,10 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                 continue
             try:
                 outs = self.core.step()
-            except Exception:  # engine must never die silently
+            except Exception as e:  # engine must never die silently
                 log.exception("engine step failed")
-                outs = [StepOutput(sid, 0, 0.0, FinishReason.ERROR)
+                outs = [StepOutput(sid, 0, 0.0, FinishReason.ERROR,
+                                   error=f"engine step failed: {e}")
                         for sid in list(self.core.by_seq)]
                 for sid in list(self.core.by_seq):
                     self.core.cancel(sid)
@@ -900,7 +1070,9 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
             while True:
                 so: StepOutput = await q.get()
                 if so.finish == FinishReason.ERROR:
-                    yield EngineOutput(token_ids=[], finish_reason=FinishReason.ERROR)
+                    yield EngineOutput(token_ids=[],
+                                       finish_reason=FinishReason.ERROR,
+                                       error=so.error or "engine error")
                     return
                 yield EngineOutput(
                     token_ids=[so.token],

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import AsyncIterator
 
-from ..runtime.engine import AsyncEngine, Context
+from ..runtime.engine import AsyncEngine, Context, EngineError
 from .protocols.common import BackendInput, EngineOutput, FinishReason
 from .tokenizer import DecodeStream, StopSequenceDecoder, Tokenizer
 
@@ -41,6 +41,11 @@ class Backend(AsyncEngine[BackendInput, EngineOutput]):
         min_tokens = request.stop.min_tokens or 0
 
         async for out in self.engine.generate(request, context):
+            if out.finish_reason is FinishReason.ERROR:
+                # surface the cause as a typed error: over the wire it
+                # becomes an error frame, at the HTTP edge an SSE error
+                # event — never a silently terminated stream
+                raise EngineError(out.error or "engine error", 500)
             text_parts = []
             finish = out.finish_reason
             for tid in out.token_ids:

@@ -24,15 +24,19 @@ import numpy as np
 
 class CopyStream:
     """Jitted page gather/scatter helpers over pools shaped
-    [L, n_pages, Hkv, page, Dh]."""
+    [L, Hkv, n_pages, page, Dh] (host blocks stay [L, Hkv, page, Dh])."""
 
     def __init__(self):
-        self._gather_layer = jax.jit(lambda pool, l, pages: pool[l][pages])
+        self._gather_layer = jax.jit(
+            lambda pool, l, pages: jnp.swapaxes(pool[l][:, pages], 0, 1))
+        # [l, :, pages] batches the scalar l with pages -> indexed shape
+        # [n, Hkv, page, Dh], matching the host block layout directly
         self._scatter_layer = jax.jit(
-            lambda pool, l, pages, vals: pool.at[l, pages].set(vals),
+            lambda pool, l, pages, vals: pool.at[l, :, pages].set(vals),
             donate_argnums=0)
         self._gather_all = jax.jit(
-            lambda pool, pages: jnp.transpose(pool[:, pages], (1, 0, 2, 3, 4)))
+            lambda pool, pages: jnp.transpose(pool[:, :, pages],
+                                              (2, 0, 1, 3, 4)))
 
     # ------------------------------------------------------------------
     def d2h_pages(self, k_pool, v_pool, pages: Sequence[int],

@@ -99,6 +99,9 @@ class EngineOutput:
     cum_log_prob: Optional[float] = None
     logprobs: Optional[List[Dict[str, float]]] = None
     finish_reason: Optional[FinishReason] = None
+    # human-readable cause when finish_reason == ERROR — surfaced all the
+    # way to the SSE client instead of a silently terminated stream
+    error: Optional[str] = None
     # engine-side bookkeeping surfaced for routing/metrics
     kv_prefix_hit_tokens: Optional[int] = None
     index: int = 0  # choice index for n>1
@@ -122,6 +125,7 @@ class EngineOutput:
             cum_log_prob=d.get("cum_log_prob"),
             logprobs=d.get("logprobs"),
             finish_reason=FinishReason(fr) if fr else None,
+            error=d.get("error"),
             kv_prefix_hit_tokens=d.get("kv_prefix_hit_tokens"),
             index=d.get("index", 0),
         )

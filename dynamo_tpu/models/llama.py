@@ -171,10 +171,10 @@ def validate_tp(cfg: LlamaConfig, tp: int) -> None:
 
 
 def kv_cache_spec(cfg: LlamaConfig, tp: int) -> P:
-    """KV pool sharding ([L, n_pages, Hkv, page, Dh]): shard kv heads over tp
+    """KV pool sharding ([L, Hkv, n_pages, page, Dh]): shard kv heads over tp
     when divisible, else replicate (GQA with kv_heads < tp)."""
     if cfg.num_kv_heads % tp == 0:
-        return P(None, None, AXIS_TP, None, None)
+        return P(None, AXIS_TP, None, None, None)
     return P(None, None, None, None, None)
 
 
@@ -249,7 +249,7 @@ def attend(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array) -> jax.Arr
 def forward(params: Dict[str, Any], cfg: LlamaConfig,
             tokens: jax.Array,           # [B, T] int32 (decode: T=1)
             positions: jax.Array,        # [B, T] int32 position of each token
-            k_pool: jax.Array,           # [L, n_pages, Hkv, page, Dh] KV pool
+            k_pool: jax.Array,           # [L, Hkv, n_pages, page, Dh] KV pool
             v_pool: jax.Array,
             write_idx: jax.Array,        # [B, T] int32 pool token-slot per new token
             read_idx: jax.Array,         # [B, S] int32 pool token-slots to attend over
@@ -257,17 +257,23 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
             read_valid: jax.Array,       # [B, S] bool slot holds a real token
             attn_impl: str = "xla",      # "xla" | "flash" Pallas | "ring" sp
             mesh=None,                   # required for attn_impl="ring"
+            logits_idx: Optional[jax.Array] = None,  # [B] per-lane position
             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One forward pass over a token chunk against the paged KV pool.
 
-    The pool is page-major ([L, n_pages, Hkv, page, Dh]); token-slot indices
-    (page_id * page_size + offset) address it. The new chunk's K/V are
-    scattered into the pool at ``write_idx`` first; attention then gathers
-    ``read_idx`` (which must cover the chunk itself) and masks causally by
-    position: token at position p attends to slots with ``read_pos <= p``.
-    Works for prefill chunks and single-token decode alike.
+    The pool is head-major ([L, Hkv, n_pages, page, Dh] — so ``pool[l]`` is
+    directly the layout TPU paged-attention kernels consume); token-slot
+    indices (page_id * page_size + offset) address it. The new chunk's K/V
+    are scattered into the pool at ``write_idx`` first; attention then
+    gathers ``read_idx`` (which must cover the chunk itself) and masks
+    causally by position: token at position p attends to slots with
+    ``read_pos <= p``. Works for prefill chunks and single-token decode
+    alike.
 
-    Returns (logits [B, T, vocab] fp32, k_pool, v_pool).
+    Returns (logits [B, T, vocab] fp32, k_pool, v_pool). With ``logits_idx``
+    ([B] int32), the LM head runs only on each lane's hidden state at that
+    chunk position and logits are [B, 1, vocab] — the prefill fast path,
+    which never materializes the [B, T, vocab] tensor.
     """
     B, T = tokens.shape
     page = k_pool.shape[3]
@@ -297,12 +303,14 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
         v = jnp.einsum("btd,dhk->bthk", h, lp["wv"][l])
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # scatter chunk KV into the pool (write-then-gather)
-        k_pool = k_pool.at[l, wp, :, wo].set(k.reshape(B * T, *k.shape[2:]))
-        v_pool = v_pool.at[l, wp, :, wo].set(v.reshape(B * T, *v.shape[2:]))
-        # gather this sequence's context
-        k_ctx = k_pool[l, rp, :, ro]  # [B,S,Hkv,Dh]
-        v_ctx = v_pool[l, rp, :, ro]
+        # scatter chunk KV into the pool (write-then-gather). The scalar
+        # layer index is itself an "advanced" index, so the batched dims of
+        # [l, :, wp, wo] land in FRONT of the Hkv slice: shape [n, Hkv, Dh]
+        k_pool = k_pool.at[l, :, wp, wo].set(k.reshape(B * T, *k.shape[2:]))
+        v_pool = v_pool.at[l, :, wp, wo].set(v.reshape(B * T, *v.shape[2:]))
+        # gather this sequence's context (same rule): [B, S, Hkv, Dh]
+        k_ctx = k_pool[l, :, rp, ro]
+        v_ctx = v_pool[l, :, rp, ro]
         if attn_impl == "flash":
             from ..ops.attention import flash_attention
             attn = flash_attention(q, k_ctx, v_ctx, positions, read_pos,
@@ -319,6 +327,9 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
         u = jnp.einsum("btd,df->btf", h2, lp["wu"][l])
         x = x + jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u, lp["wd"][l])
 
+    if logits_idx is not None:
+        x = jnp.take_along_axis(
+            x, logits_idx[:, None, None].astype(jnp.int32), axis=1)  # [B,1,D]
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("btd,dv->btv", x, head.astype(x.dtype))
@@ -327,7 +338,7 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
 
 def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
                    tokens: jax.Array,        # [B] int32 — last sampled token
-                   k_pool: jax.Array,        # [L, n_pages, Hkv, page, Dh]
+                   k_pool: jax.Array,        # [L, Hkv, n_pages, page, Dh]
                    v_pool: jax.Array,
                    page_tables: jax.Array,   # [B, P] int32 (pad rows: page 0)
                    lengths: jax.Array,       # [B] tokens incl. current one
@@ -367,15 +378,17 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
         v = jnp.einsum("btd,dhk->bthk", h, lp["wv"][l])
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_pool = k_pool.at[l, w_page, :, w_off].set(k[:, 0])
-        v_pool = v_pool.at[l, w_page, :, w_off].set(v[:, 0])
+        # [l, :, w_page, w_off] batches over the scalar l too, so the
+        # indexed shape is [B, Hkv, Dh] — exactly k[:, 0]
+        k_pool = k_pool.at[l, :, w_page, w_off].set(k[:, 0])
+        v_pool = v_pool.at[l, :, w_page, w_off].set(v[:, 0])
         if attn_impl == "pallas":
             from ..ops.attention import paged_attention
             attn = paged_attention(q[:, 0], k_pool[l], v_pool[l],
                                    page_tables, lengths)[:, None]
         else:
-            k_ctx = k_pool[l, rp, :, ro]               # [B,S,Hkv,Dh]
-            v_ctx = v_pool[l, rp, :, ro]
+            k_ctx = k_pool[l, :, rp, ro]               # [B,S,Hkv,Dh]
+            v_ctx = v_pool[l, :, rp, ro]
             attn = attend(q, k_ctx, v_ctx, mask)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"][l])
         h2 = rms_norm(x, lp["ln2"][l], cfg.rms_eps)

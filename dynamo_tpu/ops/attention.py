@@ -40,7 +40,12 @@ def _interpret_default() -> bool:
 
 
 def _pick_block(n: int, cap: int = 128) -> int:
-    """Largest power-of-two block <= cap that divides n."""
+    """Largest power-of-two block <= cap that divides n.
+
+    The engine only calls flash_attention with power-of-two bucketed T/S,
+    so this returns >= 8 on every real path; a degenerate block of 1 can
+    only happen for odd ad-hoc shapes (tests), where interpret mode does
+    not care about TPU tiling."""
     b = cap
     while b > 1 and n % b:
         b //= 2
@@ -149,7 +154,186 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 # ---------------------------------------------------------------------------
 # Paged attention (decode directly over the HBM page pool)
+#
+# TPU path: multi-page double-buffered DMA kernel. The KV pool stays in HBM
+# (memory_space=ANY); each grid step (b, h, j) copies the next block of
+# ``pages_per_block`` pages for sequence b / kv-head h into a VMEM double
+# buffer with explicit async DMAs while the previous block computes, and
+# accumulates online softmax in VMEM scratch. Work is skipped (copies AND
+# compute) for page blocks beyond a sequence's length, so cost scales with
+# actual context, not the padded table width. This is the same design as
+# jax.experimental.pallas.ops.tpu.paged_attention, which we cannot use
+# directly: for GQA group sizes not divisible by 8 (Llama 8B/1B are 32q/8kv
+# = 4) its m/l pallas outputs lower to illegal (…,1) blocks in this JAX
+# version. Keeping m/l in scratch sidesteps that and drops two HBM outputs.
 # ---------------------------------------------------------------------------
+
+
+def _paged_dma_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
+                      k_buf, v_buf, sem, m_scr, l_scr, acc_scr, state,
+                      *, scale: float, page: int, ppb: int, hkv: int,
+                      fold: int, dh: int):
+    """Pools arrive pre-folded to [Hkv, n_pages, page//fold, fold*Dh] so DMA
+    rows are 128-lane aligned even for Dh=64; a folded row holds ``fold``
+    consecutive tokens, handled as ``fold`` score slices."""
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    j = pl.program_id(2)
+    L2 = ppb * page           # tokens per compute block
+    rows = L2 // fold         # folded rows per compute block
+
+    def nblocks(bb):
+        return (len_ref[bb] + L2 - 1) // L2
+
+    def copy_descs(bb, hh, jj, slot):
+        descs = []
+        for i in range(ppb):
+            pidx = pt_ref[bb, jj * ppb + i]
+            descs.append(pltpu.make_async_copy(
+                k_hbm.at[hh, pidx], k_buf.at[slot, i], sem.at[slot, 0]))
+            descs.append(pltpu.make_async_copy(
+                v_hbm.at[hh, pidx], v_buf.at[slot, i], sem.at[slot, 1]))
+        return descs
+
+    def start(bb, hh, jj, slot):
+        for d in copy_descs(bb, hh, jj, slot):
+            d.start()
+
+    nb = nblocks(b)
+    active = j < nb
+
+    # first grid step: prime the pipeline with our own block
+    first = (b == 0) & (h == 0) & (j == 0)
+
+    @pl.when(first)
+    def _():
+        state[0] = 0
+        start(b, h, j, 0)
+
+    @pl.when(active)
+    def _():
+        slot = state[0]
+
+        @pl.when(j == 0)
+        def _():
+            m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        # prefetch the next ACTIVE step's block into the other buffer.
+        # flat order: j within (b,h), then h, then b; j beyond a sequence's
+        # nblocks is dead (never copied, never computed).
+        nj, nh, nb_ = j + 1, h, b
+        wrap_h = nj >= nb
+        nj = jnp.where(wrap_h, 0, nj)
+        nh = jnp.where(wrap_h, h + 1, nh)
+        wrap_b = nh >= hkv
+        nh = jnp.where(wrap_b, 0, nh)
+        nb_ = jnp.where(wrap_b, b + 1, nb_)
+        has_next = nb_ < pl.num_programs(0)
+
+        @pl.when(has_next)
+        def _():
+            start(nb_, nh, nj, slot ^ 1)
+
+        # wait for our block's DMAs
+        for d in copy_descs(b, h, j, slot):
+            d.wait()
+
+        q = q_ref[0, 0]                                     # [G, Dh]
+        kf = k_buf[slot].reshape(rows, fold * dh)
+        vf = v_buf[slot].reshape(rows, fold * dh)
+        base = jax.lax.broadcasted_iota(jnp.int32, (1, rows), 1) * fold \
+            + j * L2
+        length = len_ref[b]
+
+        # one score slice per fold position: folded row r, slice f is token
+        # r*fold + f of this block
+        s_parts, mask_parts = [], []
+        for f in range(fold):
+            kslice = kf[:, f * dh:(f + 1) * dh]             # [rows, Dh]
+            s = jax.lax.dot_general(
+                q, kslice, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [G, rows]
+            mask = (base + f) < length
+            s_parts.append(jnp.where(mask, s, NEG_INF))
+            mask_parts.append(mask)
+
+        m_prev = m_scr[:]
+        m_cur = s_parts[0].max(axis=-1, keepdims=True)
+        for s in s_parts[1:]:
+            m_cur = jnp.maximum(m_cur, s.max(axis=-1, keepdims=True))
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:]
+        acc = acc_scr[:] * alpha
+        for f in range(fold):
+            p = jnp.where(mask_parts[f], jnp.exp(s_parts[f] - m_new), 0.0)
+            l_new = l_new + jnp.sum(p, axis=-1, keepdims=True)
+            vslice = vf[:, f * dh:(f + 1) * dh]
+            acc = acc + jax.lax.dot_general(
+                p.astype(vf.dtype), vslice, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)         # [G, Dh]
+        l_scr[:] = l_new
+        acc_scr[:] = acc
+        m_scr[:] = m_new
+        state[0] = slot ^ 1
+
+        @pl.when(j == nb - 1)
+        def _():
+            l = l_scr[:]
+            o_ref[0, 0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)
+                           ).astype(o_ref.dtype)
+
+
+def _paged_attention_tpu(q4, k_pages, v_pages, page_tables, lengths,
+                         *, pages_per_block: int = 8) -> jax.Array:
+    """q4: [B, Hkv, G, Dh]; pools [Hkv, n_pages, page, Dh]. Returns q4-shaped."""
+    B, Hkv, G, Dh = q4.shape
+    _, n_pages, page, _ = k_pages.shape
+    P = page_tables.shape[1]
+    ppb = min(pages_per_block, P)
+    if P % ppb:
+        page_tables = jnp.pad(page_tables, ((0, 0), (0, ppb - P % ppb)))
+        P = page_tables.shape[1]
+    NB = P // ppb
+    scale = 1.0 / math.sqrt(Dh)
+
+    # fold tokens so DMA rows are 128-lane aligned (free bitcast view)
+    fold = max(1, 128 // Dh)
+    if page % fold:
+        raise ValueError(f"page size {page} not divisible by fold {fold}")
+    kf = k_pages.reshape(Hkv, n_pages, page // fold, fold * Dh)
+    vf = v_pages.reshape(Hkv, n_pages, page // fold, fold * Dh)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, NB),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, Dh), lambda b, h, j, pt, ln: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, Dh),
+                               lambda b, h, j, pt, ln: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, ppb, page // fold, fold * Dh), k_pages.dtype),
+            pltpu.VMEM((2, ppb, page // fold, fold * Dh), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),                 # [slot, k/v]
+            pltpu.VMEM((G, 1), jnp.float32),                 # m
+            pltpu.VMEM((G, 1), jnp.float32),                 # l
+            pltpu.VMEM((G, Dh), jnp.float32),                # acc
+            pltpu.SMEM((1,), jnp.int32),                     # buffer slot
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_dma_kernel, scale=scale, page=page,
+                          ppb=ppb, hkv=Hkv, fold=fold, dh=Dh),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, Dh), q4.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+    )(page_tables, lengths, q4, kf, vf)
 
 def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, scale: float, page: int):
@@ -168,8 +352,8 @@ def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(p < npages)
     def _():
         q = q_ref[0]                                       # [Hkv, G, Dh]
-        k = k_ref[0]                                       # [Hkv, page, Dh]
-        v = v_ref[0]
+        k = k_ref[:, 0]                                    # [Hkv, page, Dh]
+        v = v_ref[:, 0]
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale    # [Hkv, G, page]
@@ -199,17 +383,26 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     """Decode attention straight over the paged KV pool.
 
     q: [B, Hq, Dh] (one new token per sequence, already rope'd)
-    k_pages, v_pages: [n_pages, Hkv, page, Dh] — the layer's HBM pool
+    k_pages, v_pages: [Hkv, n_pages, page, Dh] — the layer's HBM pool
     page_tables: [B, P] int32 page ids (rows padded with page 0)
     lengths: [B] int32 — tokens to attend per sequence (including current)
     Returns [B, Hq, Dh]. Sequences attend to tokens [0, length).
+
+    On a real TPU this runs the multi-page double-buffered DMA kernel
+    above; off-TPU (and under ``interpret=True``) the simple one-page-per-
+    step kernel below runs in interpreter mode so the CPU test suite
+    exercises the same contract.
     """
     B, Hq, Dh = q.shape
-    n_pages, Hkv, page, _ = k_pages.shape
+    Hkv, n_pages, page, _ = k_pages.shape
     G = Hq // Hkv
     P = page_tables.shape[1]
     if interpret is None:
         interpret = _interpret_default()
+    if not interpret:
+        q4 = q.reshape(B, Hkv, G, Dh)
+        out = _paged_attention_tpu(q4, k_pages, v_pages, page_tables, lengths)
+        return out.reshape(B, Hq, Dh)
     scale = 1.0 / math.sqrt(Dh)
 
     q4 = q.reshape(B, Hkv, G, Dh)
@@ -218,10 +411,10 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         grid=(B, P),
         in_specs=[
             pl.BlockSpec((1, Hkv, G, Dh), lambda b, p, pt, ln: (b, 0, 0, 0)),
-            pl.BlockSpec((1, Hkv, page, Dh),
-                         lambda b, p, pt, ln: (pt[b, p], 0, 0, 0)),
-            pl.BlockSpec((1, Hkv, page, Dh),
-                         lambda b, p, pt, ln: (pt[b, p], 0, 0, 0)),
+            pl.BlockSpec((Hkv, 1, page, Dh),
+                         lambda b, p, pt, ln: (0, pt[b, p], 0, 0)),
+            pl.BlockSpec((Hkv, 1, page, Dh),
+                         lambda b, p, pt, ln: (0, pt[b, p], 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, Hkv, G, Dh),
                                lambda b, p, pt, ln: (b, 0, 0, 0)),

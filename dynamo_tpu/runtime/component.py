@@ -27,7 +27,7 @@ import logging
 import random
 import socket
 from dataclasses import dataclass
-from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from .engine import AsyncEngine, Context, EngineError
 from .store_client import StoreClient
@@ -125,15 +125,20 @@ class DistributedRuntime:
     async def _serve_conn(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
         fr = FrameReader(reader)
+        pending = None
         try:
             while True:
-                frame = await fr.read()
+                frame = pending if pending is not None else await fr.read()
+                pending = None
                 control, payload = frame
                 kind = control.get("kind")
                 if kind == "request":
-                    # one stream per connection at a time; pipelining uses
-                    # separate connections (pooled client-side)
-                    await self._run_request(control, payload, fr, writer)
+                    # one stream at a time per connection; clients pool and
+                    # reuse connections for SEQUENTIAL requests. The control
+                    # watcher may race ahead and consume the next request
+                    # frame — _run_request hands it back as ``pending``.
+                    pending = await self._run_request(control, payload, fr,
+                                                      writer)
                 else:
                     break
         except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -143,7 +148,9 @@ class DistributedRuntime:
 
     async def _run_request(self, control: Dict[str, Any],
                            payload: Optional[bytes], fr: FrameReader,
-                           writer: asyncio.StreamWriter) -> None:
+                           writer: asyncio.StreamWriter):
+        """Serve one request stream. Returns a leftover frame if the control
+        watcher consumed the NEXT pipelined request off the socket."""
         ep = control.get("endpoint")
         ctx_id = control.get("context_id") or None
         handler = self._handlers.get(ep)
@@ -151,16 +158,19 @@ class DistributedRuntime:
             await write_frame(writer, [{"kind": "error",
                                         "message": f"no endpoint {ep!r}",
                                         "code": 404}, None])
-            return
+            return None
         if control.get("ctype") == "bin":
             request = payload  # raw bytes pass through untouched (KV plane)
         else:
             request = json.loads(payload.decode()) if payload else None
         ctx = Context(ctx_id)
         self._active[ctx.id] = ctx
+        leftover: List[Any] = []
 
         async def watch_control():
-            """Stop/Kill control frames arriving mid-stream."""
+            """Stop/Kill control frames arriving mid-stream. A non-control
+            frame is the next pipelined request on a reused connection:
+            stash it for _serve_conn and stop reading."""
             try:
                 while True:
                     frame = await fr.read()
@@ -169,6 +179,9 @@ class DistributedRuntime:
                         ctx.stop_generating()
                     elif c.get("kind") == "kill":
                         ctx.kill()
+                    else:
+                        leftover.append(frame)
+                        return
             except (asyncio.IncompleteReadError, ConnectionResetError):
                 ctx.stop_generating()
 
@@ -236,6 +249,7 @@ class DistributedRuntime:
             if watcher is not None:
                 watcher.cancel()
             self._active.pop(ctx.id, None)
+        return leftover[0] if leftover else None
 
 
 def _local_ip() -> str:
@@ -329,8 +343,12 @@ class Endpoint:
 
 class Client:
     """Watches the endpoint prefix => live instance set; issues requests with
-    random / round_robin / direct routing. Connections are pooled per
-    instance. (Reference: component/client.rs:52-295 + egress/push.rs.)"""
+    random / round_robin / direct routing. Data-plane connections are pooled
+    per instance and reused for sequential requests (the server keeps the
+    connection open across streams), saving a TCP handshake per request on
+    the hot path. (Reference: component/client.rs:52-295 + egress/push.rs.)"""
+
+    MAX_POOLED_PER_INSTANCE = 8
 
     def __init__(self, endpoint: Endpoint):
         self.endpoint = endpoint
@@ -338,8 +356,29 @@ class Client:
         self.instances: Dict[int, EndpointInfo] = {}
         self._rr = itertools.count()
         self._watching = False
-        self._pool: Dict[int, List[Any]] = {}
+        # (host, port) -> idle (reader, FrameReader, writer) connections
+        self._pool: Dict[Tuple[str, int], List[Any]] = {}
         self.on_instances_changed: Optional[Callable[[], None]] = None
+
+    def _pool_get(self, key):
+        conns = self._pool.get(key)
+        while conns:
+            item = conns.pop()
+            if not item[2].is_closing():
+                return item
+        return None
+
+    def _pool_put(self, key, item) -> None:
+        if item[2].is_closing():
+            return
+        conns = self._pool.setdefault(key, [])
+        conns.append(item)
+        while len(conns) > self.MAX_POOLED_PER_INSTANCE:
+            conns.pop(0)[2].close()
+
+    def _pool_drop(self, key) -> None:
+        for item in self._pool.pop(key, []):
+            item[2].close()
 
     async def start(self) -> "Client":
         prefix = endpoint_prefix(self.endpoint.component.namespace.name,
@@ -349,8 +388,9 @@ class Client:
         async def on_change(key: str, value: Optional[bytes], deleted: bool):
             lease = int(key.rsplit(":", 1)[1], 16)
             if deleted:
-                self.instances.pop(lease, None)
-                self._pool.pop(lease, None)
+                info = self.instances.pop(lease, None)
+                if info is not None:
+                    self._pool_drop((info.host, info.port))
             else:
                 self.instances[lease] = EndpointInfo.from_bytes(value)
             if self.on_instances_changed:
@@ -398,28 +438,56 @@ class Client:
         (server handler receives a :class:`StreamingRequest`)."""
         ctx = context or Context()
         info = self._pick(mode, instance_id)
-        reader, writer = await asyncio.open_connection(info.host, info.port)
-        fr = FrameReader(reader)
-        stop_sent = False
-        try:
-            if isinstance(request, (bytes, bytearray)):
-                req_control = {"kind": "request", "endpoint": info.endpoint,
-                               "context_id": ctx.id, "ctype": "bin"}
-                req_payload = bytes(request)
-            else:
-                req_control = {"kind": "request", "endpoint": info.endpoint,
-                               "context_id": ctx.id}
-                req_payload = json.dumps(request).encode()
-            if parts is not None:
-                req_control["streaming"] = True
-            await write_frame(writer, [req_control, req_payload])
-            if parts is not None:
-                async for chunk in parts:
-                    await write_frame(
-                        writer, [{"kind": "part", "ctype": "bin"},
-                                 bytes(chunk)])
-                await write_frame(writer, [{"kind": "end"}, None])
+        key = (info.host, info.port)
 
+        if isinstance(request, (bytes, bytearray)):
+            req_control = {"kind": "request", "endpoint": info.endpoint,
+                           "context_id": ctx.id, "ctype": "bin"}
+            req_payload = bytes(request)
+        else:
+            req_control = {"kind": "request", "endpoint": info.endpoint,
+                           "context_id": ctx.id}
+            req_payload = json.dumps(request).encode()
+        if parts is not None:
+            req_control["streaming"] = True
+
+        # part-streaming requests can't replay their body on a stale pooled
+        # connection, so they always open fresh
+        pooled = None if parts is not None else self._pool_get(key)
+        if pooled is not None:
+            reader, fr, writer = pooled
+        else:
+            reader, writer = await asyncio.open_connection(info.host,
+                                                           info.port)
+            fr = FrameReader(reader)
+
+        # first exchange: on a pooled connection the server may have closed
+        # under us — reopen fresh and resend (nothing was processed yet)
+        attempts = 2 if pooled is not None else 1
+        for attempt in range(attempts):
+            try:
+                await write_frame(writer, [req_control, req_payload])
+                if parts is not None:
+                    async for chunk in parts:
+                        await write_frame(
+                            writer, [{"kind": "part", "ctype": "bin"},
+                                     bytes(chunk)])
+                    await write_frame(writer, [{"kind": "end"}, None])
+                first = await fr.read()
+                break
+            except (ConnectionResetError, BrokenPipeError,
+                    asyncio.IncompleteReadError) as e:
+                writer.close()
+                if attempt == attempts - 1:
+                    raise EngineError(
+                        f"connection to {info.host}:{info.port} failed: {e}",
+                        503) from e
+                reader, writer = await asyncio.open_connection(info.host,
+                                                               info.port)
+                fr = FrameReader(reader)
+
+        clean = False
+        try:
             async def forward_stop():
                 await ctx.stopped()
                 try:
@@ -429,8 +497,7 @@ class Client:
 
             stopper = asyncio.create_task(forward_stop())
             try:
-                frame = await fr.read()
-                control, payload = frame
+                control, payload = first
                 if control.get("kind") == "error":
                     raise EngineError(control.get("message", "remote error"),
                                       control.get("code", 500))
@@ -444,6 +511,7 @@ class Client:
                         else:
                             yield json.loads(payload.decode())
                     elif kind == "sentinel":
+                        clean = True
                         return
                     elif kind == "error":
                         raise EngineError(control.get("message", "remote"),
@@ -451,4 +519,9 @@ class Client:
             finally:
                 stopper.cancel()
         finally:
-            writer.close()
+            if clean:
+                # full exchange completed: the connection sits at a frame
+                # boundary and is safe to reuse for the next request
+                self._pool_put(key, (reader, fr, writer))
+            else:
+                writer.close()

@@ -37,18 +37,29 @@ def unpack_two_part(obj: Any) -> Tuple[dict, Optional[bytes]]:
 
 
 class FrameReader:
-    """Incremental frame decoder over an asyncio StreamReader."""
+    """Incremental frame decoder over an asyncio StreamReader.
+
+    ``read()`` is CANCELLATION-SAFE at the frame level: a reader task
+    cancelled between the length header and the body (e.g. the data plane's
+    control watcher being torn down mid-frame) leaves the parsed length in
+    ``_pending_len``, and the next ``read()`` resumes with the body instead
+    of desynchronizing the stream. (StreamReader.readexactly itself only
+    consumes bytes once all n are buffered, so cancelling it is safe.)"""
 
     def __init__(self, reader: asyncio.StreamReader):
         self._r = reader
+        self._pending_len: Optional[int] = None
 
     async def read(self) -> Any:
         """Read one frame; raises asyncio.IncompleteReadError on EOF."""
-        hdr = await self._r.readexactly(4)
-        (n,) = struct.unpack(">I", hdr)
-        if n > MAX_FRAME:
-            raise ValueError(f"frame of {n} bytes exceeds MAX_FRAME")
-        body = await self._r.readexactly(n)
+        if self._pending_len is None:
+            hdr = await self._r.readexactly(4)
+            (n,) = struct.unpack(">I", hdr)
+            if n > MAX_FRAME:
+                raise ValueError(f"frame of {n} bytes exceeds MAX_FRAME")
+            self._pending_len = n
+        body = await self._r.readexactly(self._pending_len)
+        self._pending_len = None
         return msgpack.unpackb(body, raw=False)
 
 

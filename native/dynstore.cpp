@@ -150,9 +150,15 @@ class Server {
         reap_leases();
         next_reap = now_s() + kReapInterval;
       }
-      // deferred closes (drop while iterating epoll events is unsafe)
-      for (int fd : dead_) finish_drop(fd);
-      dead_.clear();
+      // deferred closes (drop while iterating epoll events is unsafe).
+      // finish_drop can cascade: lease expiry -> watcher notify -> failed
+      // send -> drop_conn pushes MORE fds onto dead_ — so drain by swapping
+      // batches instead of iterating a vector that may reallocate under us
+      while (!dead_.empty()) {
+        std::vector<int> batch;
+        batch.swap(dead_);
+        for (int fd : batch) finish_drop(fd);
+      }
     }
   }
 

@@ -64,9 +64,9 @@ def test_paged_matches_dense(B, Hq, Hkv, Dh, page, P):
     ks = jax.random.split(jax.random.PRNGKey(1), 3)
     q = jax.random.normal(ks[0], (B, Hq, Dh), jnp.float32).astype(jnp.bfloat16)
     k_pages = jax.random.normal(
-        ks[1], (n_pages, Hkv, page, Dh), jnp.float32).astype(jnp.bfloat16)
+        ks[1], (Hkv, n_pages, page, Dh), jnp.float32).astype(jnp.bfloat16)
     v_pages = jax.random.normal(
-        ks[2], (n_pages, Hkv, page, Dh), jnp.float32).astype(jnp.bfloat16)
+        ks[2], (Hkv, n_pages, page, Dh), jnp.float32).astype(jnp.bfloat16)
     # sequence b owns pages [1 + b*P, 1 + (b+1)*P), variable lengths
     page_tables = (jnp.arange(P, dtype=jnp.int32)[None]
                    + jnp.arange(B, dtype=jnp.int32)[:, None] * P + 1)
@@ -79,9 +79,9 @@ def test_paged_matches_dense(B, Hq, Hkv, Dh, page, P):
     # dense reference: gather each sequence's context and mask by length
     S = P * page
     for b in range(B):
-        ctx_k = (k_pages[page_tables[b]].transpose(0, 2, 1, 3)
+        ctx_k = (k_pages[:, page_tables[b]].transpose(1, 2, 0, 3)
                  .reshape(S, Hkv, Dh))
-        ctx_v = (v_pages[page_tables[b]].transpose(0, 2, 1, 3)
+        ctx_v = (v_pages[:, page_tables[b]].transpose(1, 2, 0, 3)
                  .reshape(S, Hkv, Dh))
         qb = q[b][None, None]                       # [1, 1, Hq, Dh]
         k_pos = jnp.arange(S, dtype=jnp.int32)[None]
@@ -98,8 +98,8 @@ def test_paged_inside_scan_with_donated_pool():
     B, Hq, Hkv, Dh, page, P = 2, 4, 2, 16, 8, 2
     n_pages = 8
     q = jnp.ones((B, Hq, Dh), jnp.bfloat16)
-    k_pages = jnp.ones((n_pages, Hkv, page, Dh), jnp.bfloat16)
-    v_pages = jnp.ones((n_pages, Hkv, page, Dh), jnp.bfloat16)
+    k_pages = jnp.ones((Hkv, n_pages, page, Dh), jnp.bfloat16)
+    v_pages = jnp.ones((Hkv, n_pages, page, Dh), jnp.bfloat16)
     pt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
     lengths = jnp.asarray([5, 9], jnp.int32)
 

@@ -159,14 +159,16 @@ async def test_publisher_and_recorder(tmp_path):
     pool.create("s1")
     pool.extend("s1", list(range(9)))   # seals 2 blocks
     pool.release("s1")                  # blocks park as reusable: NO event
-    pool.blocks.flush_reusable()        # eviction -> removed events
+    pool.flush_reusable()               # eviction -> removed events
     await pub.start()
     await pub.flush()
     await pub.stop()
-    assert len(seen) == 4
+    # 2 stored + ONE batched removed event covering both evicted blocks
+    assert len(seen) == 3
     evs = [RouterEvent.from_dict(p) for _, p in seen]
     assert evs[0].worker_id == 42 and evs[0].event.stored is not None
-    assert evs[2].event.removed is not None and evs[3].event.removed is not None
+    assert evs[2].event.removed is not None
+    assert len(evs[2].event.removed.block_hashes) == 2
     # chained: second stored block's parent is the first's hash
     assert (evs[1].event.stored.parent_hash
             == evs[0].event.stored.blocks[0].block_hash)
@@ -185,7 +187,7 @@ async def test_publisher_and_recorder(tmp_path):
     rec.flush()
     idx2 = KvIndexer(block_size=4)
     n = rec.replay_into(lambda p: idx2.apply_sync(RouterEvent.from_dict(p)))
-    assert n == 4
+    assert n == 3
     # after replaying the removal, worker 42 holds nothing
     assert idx2.find_matches_for_tokens(list(range(9))).scores == {}
     rec.close()

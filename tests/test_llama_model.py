@@ -1,6 +1,6 @@
 """Model-level correctness: paged chunked prefill + decode must reproduce the
 full-sequence forward pass exactly (same pool, same masks). Pools are
-page-major [L, n_pages, Hkv, page, Dh] with page size 8 here."""
+head-major [L, Hkv, n_pages, page, Dh] with page size 8 here."""
 
 import jax
 import jax.numpy as jnp
@@ -16,7 +16,7 @@ def full_logits(params, tokens):
     """Whole sequence in one chunk against a fresh pool."""
     T = len(tokens)
     L, Hkv, Dh = CFG.num_layers, CFG.num_kv_heads, CFG.head_dim
-    pool_k = jnp.zeros((L, (64 + T + 7) // 8 + 1, Hkv, 8, Dh), CFG.dtype)
+    pool_k = jnp.zeros((L, Hkv, (64 + T + 7) // 8 + 1, 8, Dh), CFG.dtype)
     pool_v = jnp.zeros_like(pool_k)
     tok = jnp.asarray(tokens, jnp.int32)[None]
     pos = jnp.arange(T, dtype=jnp.int32)[None]
@@ -38,7 +38,7 @@ def test_chunked_prefill_matches_full(params):
 
     # same computation split into chunks of 8 against a paged pool
     L, Hkv, Dh = CFG.num_layers, CFG.num_kv_heads, CFG.head_dim
-    pool_k = jnp.zeros((L, 32, Hkv, 8, Dh), CFG.dtype)
+    pool_k = jnp.zeros((L, Hkv, 32, 8, Dh), CFG.dtype)
     pool_v = jnp.zeros_like(pool_k)
     # pages out of order to exercise the indirection: tokens t -> slot map
     pages = [3, 1, 2]  # page size 8, 24 tokens
@@ -64,7 +64,7 @@ def test_decode_matches_full(params):
     ref = full_logits(params, tokens)
 
     L, Hkv, Dh = CFG.num_layers, CFG.num_kv_heads, CFG.head_dim
-    pool_k = jnp.zeros((L, 16, Hkv, 8, Dh), CFG.dtype)
+    pool_k = jnp.zeros((L, Hkv, 16, 8, Dh), CFG.dtype)
     pool_v = jnp.zeros_like(pool_k)
     # prefill the first 8, then decode the rest one token at a time
     slots = np.arange(16, dtype=np.int32)  # contiguous slots starting at 0
@@ -91,7 +91,7 @@ def test_padding_invariance(params):
     tokens = list(range(10, 20))
     T = len(tokens)
     L, Hkv, Dh = CFG.num_layers, CFG.num_kv_heads, CFG.head_dim
-    pool_k = jnp.zeros((L, 16, Hkv, 8, Dh), CFG.dtype)
+    pool_k = jnp.zeros((L, Hkv, 16, 8, Dh), CFG.dtype)
     pool_v = jnp.zeros_like(pool_k)
     tok = jnp.asarray(tokens, jnp.int32)[None]
     pos = jnp.arange(T, dtype=jnp.int32)[None]
