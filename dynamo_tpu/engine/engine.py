@@ -37,7 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..llm.model_card import ModelDeploymentCard
 from ..llm.protocols.common import BackendInput, EngineOutput, FinishReason
 from ..models import llama
-from ..parallel.mesh import AXIS_TP, tp_mesh
+from ..parallel.mesh import AXIS_TP, sp_tp_mesh, tp_mesh
 from ..runtime.engine import AsyncEngine, Context
 from .cache import OutOfPages, PagePool
 from .sampling import STATIC_K, SamplingState, sample
@@ -59,6 +59,7 @@ def _buckets(lo: int, hi: int) -> List[int]:
 class JaxEngineConfig:
     model: llama.LlamaConfig
     tp: int = 1
+    sp: int = 1                         # sequence-parallel (ring) axis size
     page_size: int = 64
     max_batch: int = 8
     max_context: int = 2048
@@ -69,7 +70,8 @@ class JaxEngineConfig:
     seed: int = 0
     preset: Optional[str] = None
     # attention backend: "auto" => Pallas kernels on TPU, XLA dense elsewhere.
-    # Explicit values: "pallas" | "xla".
+    # Explicit values: "pallas" | "xla" | "ring" (sequence-parallel prefill
+    # over the sp mesh axis; decode stays pallas/xla).
     attn_impl: str = "auto"
     # KV block manager (SURVEY §2.4): prefix reuse + tiered offload
     enable_prefix_reuse: bool = True
@@ -92,8 +94,8 @@ class JaxEngineConfig:
             page_size=card.kv_block_size,
             params_path=card.path,
         )
-        for k in ("max_batch", "max_context", "prefill_chunk", "num_pages",
-                  "decode_steps", "seed", "preset", "attn_impl",
+        for k in ("sp", "max_batch", "max_context", "prefill_chunk",
+                  "num_pages", "decode_steps", "seed", "preset", "attn_impl",
                   "enable_prefix_reuse", "host_cache_blocks",
                   "disk_cache_blocks", "disk_cache_path"):
             if k in extra:
@@ -136,7 +138,8 @@ class EngineCore:
         self.cfg = cfg
         m = cfg.model
         llama.validate_tp(m, cfg.tp)
-        self.mesh = tp_mesh(cfg.tp, devices)
+        self.mesh = (sp_tp_mesh(cfg.sp, cfg.tp, devices) if cfg.sp > 1
+                     else tp_mesh(cfg.tp, devices))
         self.page_size = cfg.page_size
         # every sequence may overshoot up to 2*decode_steps speculative
         # tokens: one dispatch in flight plus one chained behind it
@@ -166,16 +169,30 @@ class EngineCore:
             import os
             impl = os.environ.get("DYNAMO_TPU_ATTN", "auto")
         if impl == "auto":
-            # Pallas kernels on TPU; they run per-shard, so tp>1 needs the
-            # shard_map wrap (ring-attention work) — fall back to XLA there.
+            # Pallas kernels on TPU (shard_map-wrapped per tp shard); XLA
+            # dense elsewhere or when the model's GQA grouping can't split
             impl = ("pallas" if jax.default_backend() == "tpu"
-                    and cfg.tp == 1 else "xla")
-        if impl not in ("pallas", "xla"):
-            raise ValueError(f"attn_impl must be auto|pallas|xla, got {impl!r}")
-        if impl == "pallas" and cfg.tp > 1:
-            raise ValueError("attn_impl='pallas' requires tp=1 (the kernels "
-                             "run per-shard; tp>1 uses the XLA path)")
+                    and llama.pallas_tp_ok(m, cfg.tp) else "xla")
+        if impl not in ("pallas", "xla", "ring"):
+            raise ValueError(
+                f"attn_impl must be auto|pallas|xla|ring, got {impl!r}")
+        if impl == "pallas" and not llama.pallas_tp_ok(m, cfg.tp):
+            raise ValueError(
+                f"attn_impl='pallas' needs an integral per-shard GQA group: "
+                f"Hq={m.num_heads}/tp={cfg.tp} per shard must divide by the "
+                f"per-shard kv heads")
+        if impl == "ring" and cfg.sp < 2:
+            raise ValueError("attn_impl='ring' needs sp >= 2")
         self.attn_impl = impl
+        # decode is single-token — the ring (prefill) axis does not apply;
+        # decode attention runs pallas on TPU, dense XLA elsewhere
+        if impl == "ring":
+            self.decode_attn_impl = ("pallas"
+                                     if jax.default_backend() == "tpu"
+                                     and llama.pallas_tp_ok(m, cfg.tp)
+                                     else "xla")
+        else:
+            self.decode_attn_impl = impl
 
         # --- KV pools (head-major: [L, Hkv, n_pages, page, Dh] so that
         # pool[l] is directly the TPU paged-attention kernel layout) ----
@@ -269,7 +286,8 @@ class EngineCore:
         if S not in self._decode_fns:
             cfg = self.cfg
             N = cfg.decode_steps
-            impl = self.attn_impl
+            impl = self.decode_attn_impl
+            mesh = self.mesh
             rep, kv = self._rep_sharding, self.kv_sharding
 
             # out_shardings pinned so the pools keep the canonical kv
@@ -284,7 +302,7 @@ class EngineCore:
                     tokens, lengths, k_pool, v_pool, key = carry
                     logits, k_pool, v_pool = llama.forward_decode(
                         params, cfg.model, tokens, k_pool, v_pool,
-                        page_tables, lengths, attn_impl=impl)
+                        page_tables, lengths, attn_impl=impl, mesh=mesh)
                     tok, logp, new_key = sample(
                         logits[:, 0], temp, top_p, top_k, key)
                     return ((tok, lengths + 1, k_pool, v_pool, new_key),
@@ -310,7 +328,9 @@ class EngineCore:
         lanes write to scratch page 0 with nothing valid to read."""
         if (Bp, C, S) not in self._prefill_batch_fns:
             cfg = self.cfg
-            impl = "flash" if self.attn_impl == "pallas" else "xla"
+            impl = {"pallas": "flash", "ring": "ring"}.get(
+                self.attn_impl, "xla")
+            mesh = self.mesh
             rep, kv = self._rep_sharding, self.kv_sharding
 
             @partial(jax.jit, donate_argnums=(3, 4),
@@ -321,7 +341,7 @@ class EngineCore:
                 logits, k_pool, v_pool = llama.forward(
                     params, cfg.model, tokens, positions, k_pool, v_pool,
                     write_idx, read_idx, read_pos, read_valid,
-                    attn_impl=impl, logits_idx=last_i)
+                    attn_impl=impl, mesh=mesh, logits_idx=last_i)
                 tok, logp, new_keys = sample(
                     logits[:, 0], temp, top_p, top_k, keys)
                 packed = jnp.stack([tok.astype(jnp.float32), logp], -1)

@@ -291,7 +291,22 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
             and mesh.shape[_TP] > 1
             and cfg.num_heads % mesh.shape[_TP] == 0
             and cfg.num_kv_heads % mesh.shape[_TP] == 0) else None
-    elif attn_impl != "flash":
+    elif attn_impl == "flash":
+        tp_sz = _tp_size(mesh)
+        if tp_sz > 1:
+            # per-shard flash kernel: heads sharded over tp, kv heads when
+            # divisible (replicated otherwise); sequence dims replicated
+            from ..ops.attention import flash_attention as _flash
+            kv_spec = (P(None, None, AXIS_TP, None)
+                       if cfg.num_kv_heads % tp_sz == 0
+                       else P(None, None, None, None))
+            sharded_flash = jax.shard_map(
+                _flash, mesh=mesh,
+                in_specs=(P(None, None, AXIS_TP, None), kv_spec, kv_spec,
+                          P(None, None), P(None, None), P(None, None)),
+                out_specs=P(None, None, AXIS_TP, None),
+                check_vma=False)   # pallas_call can't declare vma
+    else:
         # causal/validity mask [B,T,S]
         mask = (read_valid[:, None, :]
                 & (read_pos[:, None, :] <= positions[:, :, None]))
@@ -313,8 +328,12 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
         v_ctx = v_pool[l, :, rp, ro]
         if attn_impl == "flash":
             from ..ops.attention import flash_attention
-            attn = flash_attention(q, k_ctx, v_ctx, positions, read_pos,
-                                   read_valid)
+            if tp_sz > 1:
+                attn = sharded_flash(q, k_ctx, v_ctx, positions, read_pos,
+                                     read_valid)
+            else:
+                attn = flash_attention(q, k_ctx, v_ctx, positions, read_pos,
+                                       read_valid)
         elif attn_impl == "ring":
             attn = ring_attention(q, k_ctx, v_ctx, positions, read_pos,
                                   read_valid, mesh=mesh,
@@ -336,6 +355,26 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
     return logits.astype(jnp.float32), k_pool, v_pool
 
 
+def pallas_tp_ok(cfg: LlamaConfig, tp: int) -> bool:
+    """Can the Pallas kernels run per-shard at this tp? Each shard needs an
+    integral GQA group: Hq/tp divisible by the per-shard kv head count."""
+    if tp <= 1:
+        return True
+    if cfg.num_heads % tp:
+        return False
+    hq_shard = cfg.num_heads // tp
+    hkv_shard = (cfg.num_kv_heads // tp if cfg.num_kv_heads % tp == 0
+                 else cfg.num_kv_heads)     # kv replicated when not divisible
+    return hq_shard % hkv_shard == 0
+
+
+def _tp_size(mesh) -> int:
+    from ..parallel.mesh import AXIS_TP as _TP
+    if mesh is None or _TP not in mesh.axis_names:
+        return 1
+    return mesh.shape[_TP]
+
+
 def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
                    tokens: jax.Array,        # [B] int32 — last sampled token
                    k_pool: jax.Array,        # [L, Hkv, n_pages, page, Dh]
@@ -343,6 +382,7 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
                    page_tables: jax.Array,   # [B, P] int32 (pad rows: page 0)
                    lengths: jax.Array,       # [B] tokens incl. current one
                    attn_impl: str = "xla",   # "xla" gather | "pallas" paged
+                   mesh=None,                # for pallas at tp>1 (shard_map)
                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Single-token decode step addressed purely by page tables.
 
@@ -362,6 +402,21 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
     w_page = jnp.take_along_axis(page_tables, (pos // page)[:, None],
                                  axis=1)[:, 0]
     w_off = pos % page
+    tp_sz = _tp_size(mesh) if attn_impl == "pallas" else 1
+    if tp_sz > 1:
+        # run the paged kernel per tp shard: q sharded over heads, pools
+        # over kv heads when divisible (replicated otherwise). Axes the
+        # specs don't mention (sp/dp/...) stay replicated.
+        from ..ops.attention import paged_attention as _paged
+        kv_spec = (P(AXIS_TP, None, None, None)
+                   if cfg.num_kv_heads % tp_sz == 0
+                   else P(None, None, None, None))
+        sharded_paged = jax.shard_map(
+            _paged, mesh=mesh,
+            in_specs=(P(None, AXIS_TP, None), kv_spec, kv_spec,
+                      P(None, None), P(None)),
+            out_specs=P(None, AXIS_TP, None),
+            check_vma=False)       # pallas_call can't declare vma
     if attn_impl != "pallas":
         S = page_tables.shape[1] * page
         t = jnp.arange(S, dtype=jnp.int32)
@@ -384,8 +439,12 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
         v_pool = v_pool.at[l, :, w_page, w_off].set(v[:, 0])
         if attn_impl == "pallas":
             from ..ops.attention import paged_attention
-            attn = paged_attention(q[:, 0], k_pool[l], v_pool[l],
-                                   page_tables, lengths)[:, None]
+            if tp_sz > 1:
+                attn = sharded_paged(q[:, 0], k_pool[l], v_pool[l],
+                                     page_tables, lengths)[:, None]
+            else:
+                attn = paged_attention(q[:, 0], k_pool[l], v_pool[l],
+                                       page_tables, lengths)[:, None]
         else:
             k_ctx = k_pool[l, :, rp, ro]               # [B,S,Hkv,Dh]
             v_ctx = v_pool[l, :, rp, ro]

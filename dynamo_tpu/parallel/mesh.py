@@ -62,6 +62,16 @@ def tp_mesh(tp: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(devs, (AXIS_TP,))
 
 
+def sp_tp_mesh(sp: int, tp: int,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Long-context serving mesh: ring-attention sequence axis x tensor
+    parallel. sp is the OUTER axis so each ring hop crosses between tp
+    groups (neighboring ICI links), while tp collectives stay innermost."""
+    devices = list(devices if devices is not None else jax.devices())
+    devs = np.array(devices[: sp * tp]).reshape(sp, tp)
+    return Mesh(devs, (AXIS_SP, AXIS_TP))
+
+
 def sharding(mesh: Mesh, *spec) -> NamedSharding:
     # drop axis names the mesh doesn't have (lets one spec serve 1-D and 4-D)
     names = set(mesh.axis_names)

@@ -163,6 +163,16 @@ class DistributedRuntime:
             request = payload  # raw bytes pass through untouched (KV plane)
         else:
             request = json.loads(payload.decode()) if payload else None
+        if ctx_id is not None and ctx_id in self._active:
+            # duplicate-context guard: a client's stale-connection retry
+            # re-sent a request whose original is still executing (the
+            # connection died mid-request) — fail cleanly instead of
+            # double-executing a non-idempotent handler
+            await write_frame(writer, [{
+                "kind": "error", "code": 409,
+                "message": f"context {ctx_id} is already executing "
+                           f"(duplicate delivery)"}, None])
+            return None
         ctx = Context(ctx_id)
         self._active[ctx.id] = ctx
         leftover: List[Any] = []
@@ -461,41 +471,54 @@ class Client:
                                                            info.port)
             fr = FrameReader(reader)
 
-        # first exchange: on a pooled connection the server may have closed
-        # under us — reopen fresh and resend (nothing was processed yet)
-        attempts = 2 if pooled is not None else 1
-        for attempt in range(attempts):
+        # a stop/kill issued while we wait for the first frame (mid-prefill)
+        # must reach the server immediately: the stopper lives for the whole
+        # exchange and always writes to the CURRENT connection
+        live = {"writer": writer}
+
+        async def forward_stop():
+            await ctx.stopped()
             try:
-                await write_frame(writer, [req_control, req_payload])
-                if parts is not None:
-                    async for chunk in parts:
-                        await write_frame(
-                            writer, [{"kind": "part", "ctype": "bin"},
-                                     bytes(chunk)])
-                    await write_frame(writer, [{"kind": "end"}, None])
-                first = await fr.read()
-                break
-            except (ConnectionResetError, BrokenPipeError,
-                    asyncio.IncompleteReadError) as e:
-                writer.close()
-                if attempt == attempts - 1:
-                    raise EngineError(
-                        f"connection to {info.host}:{info.port} failed: {e}",
-                        503) from e
-                reader, writer = await asyncio.open_connection(info.host,
-                                                               info.port)
-                fr = FrameReader(reader)
+                await write_frame(live["writer"], [{"kind": "stop"}, None])
+            except Exception:
+                pass
+
+        stopper = asyncio.create_task(forward_stop())
+
+        # first exchange: on a pooled connection the server may have closed
+        # it while idle — reopen fresh and resend. (If the server instead
+        # died MID-request, the resend could double-execute; the server's
+        # duplicate-context guard turns that rare race into a clean error.)
+        attempts = 2 if pooled is not None else 1
+        try:
+            for attempt in range(attempts):
+                try:
+                    await write_frame(writer, [req_control, req_payload])
+                    if parts is not None:
+                        async for chunk in parts:
+                            await write_frame(
+                                writer, [{"kind": "part", "ctype": "bin"},
+                                         bytes(chunk)])
+                        await write_frame(writer, [{"kind": "end"}, None])
+                    first = await fr.read()
+                    break
+                except (ConnectionResetError, BrokenPipeError,
+                        asyncio.IncompleteReadError) as e:
+                    writer.close()
+                    if attempt == attempts - 1:
+                        raise EngineError(
+                            f"connection to {info.host}:{info.port} failed: "
+                            f"{e}", 503) from e
+                    reader, writer = await asyncio.open_connection(
+                        info.host, info.port)
+                    fr = FrameReader(reader)
+                    live["writer"] = writer
+        except BaseException:
+            stopper.cancel()
+            raise
 
         clean = False
         try:
-            async def forward_stop():
-                await ctx.stopped()
-                try:
-                    await write_frame(writer, [{"kind": "stop"}, None])
-                except Exception:
-                    pass
-
-            stopper = asyncio.create_task(forward_stop())
             try:
                 control, payload = first
                 if control.get("kind") == "error":
