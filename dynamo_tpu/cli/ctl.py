@@ -15,6 +15,8 @@ DisaggregatedRouter config, lib/llm/src/disagg_router.rs:38-143).
 from __future__ import annotations
 
 import argparse
+
+from ..utils.dynconfig import EnvDefaultsParser
 import asyncio
 import json
 
@@ -24,7 +26,7 @@ from ..runtime.store_client import StoreClient
 
 
 def parse_args(argv=None):
-    p = argparse.ArgumentParser(prog="dynamo-ctl")
+    p = EnvDefaultsParser(prog="dynamo-ctl")
     p.add_argument("--store", default="127.0.0.1:4222")
     sub = p.add_subparsers(dest="plane", required=True)
     http = sub.add_parser("http")

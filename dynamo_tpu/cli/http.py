@@ -13,6 +13,8 @@ lib/llm/src/http/service/discovery.rs.
 from __future__ import annotations
 
 import argparse
+
+from ..utils.dynconfig import EnvDefaultsParser
 import asyncio
 import json
 import logging
@@ -94,7 +96,7 @@ class DiscoveryFrontend:
 
 
 def parse_args(argv=None):
-    p = argparse.ArgumentParser(prog="dynamo-http")
+    p = EnvDefaultsParser(prog="dynamo-http")
     p.add_argument("--store", default="127.0.0.1:4222")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8080)
@@ -124,7 +126,8 @@ async def run_http(args, *, ready_event=None,
 
 
 def main() -> None:
-    logging.basicConfig(level=logging.INFO)
+    from ..utils.logging_ext import init_logging
+    init_logging()
 
     async def amain():
         args = parse_args()

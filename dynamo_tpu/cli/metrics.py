@@ -1,0 +1,82 @@
+"""Cluster metrics aggregator binary.
+
+    python -m dynamo_tpu.cli.metrics --namespace dynamo \
+        --component backend [--component prefill] --store localhost:4222 \
+        --port 9091 [--scrape-interval 1.0]
+
+Subscribes the namespace kv-hit-rate events, scrapes every worker's
+ForwardPassMetrics from the store, and serves the cluster Prometheus gauges
+(llm_kv_blocks_*, llm_requests_*_slots, llm_load_avg/std,
+llm_kv_hit_rate_percent) on ``/metrics``.
+
+Reference capability: the standalone metrics binary
+(components/metrics/src/main.rs:115-241).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ..utils.dynconfig import EnvDefaultsParser
+import asyncio
+import logging
+
+from aiohttp import web
+
+from ..llm.metrics_aggregator import ClusterMetricsAggregator
+from ..runtime.component import DistributedRuntime
+
+log = logging.getLogger("dynamo_tpu.cli.metrics")
+
+
+def build_app(agg: ClusterMetricsAggregator) -> web.Application:
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(text=agg.render(),
+                            content_type="text/plain", charset="utf-8")
+
+    app = web.Application()
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+async def run_metrics(args) -> None:
+    host, port = args.store.split(":")
+    drt = await DistributedRuntime(store_host=host,
+                                   store_port=int(port)).connect()
+    agg = await ClusterMetricsAggregator(
+        drt, args.namespace, args.component,
+        scrape_interval=args.scrape_interval).start()
+    runner = web.AppRunner(build_app(agg))
+    await runner.setup()
+    site = web.TCPSite(runner, "0.0.0.0", args.port)
+    await site.start()
+    log.info("metrics aggregator on :%d (ns=%s components=%s)",
+             args.port, args.namespace, args.component)
+    print(f"metrics aggregator on :{args.port}", flush=True)
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await agg.stop()
+        await runner.cleanup()
+        await drt.close()
+
+
+def main(argv=None) -> None:
+    ap = EnvDefaultsParser("dynamo-metrics")
+    ap.add_argument("--store", default="127.0.0.1:4222")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", action="append", default=None,
+                    help="worker component to scrape (repeatable)")
+    ap.add_argument("--port", type=int, default=9091)
+    ap.add_argument("--scrape-interval", type=float, default=1.0)
+    args = ap.parse_args(argv)
+    if not args.component:
+        args.component = ["backend"]
+    from ..utils.logging_ext import init_logging
+    init_logging()
+    asyncio.run(run_metrics(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,8 @@ unacked jobs are redelivered if one dies mid-prefill.
 from __future__ import annotations
 
 import argparse
+
+from ..utils.dynconfig import EnvDefaultsParser
 import asyncio
 import json
 import logging
@@ -117,7 +119,7 @@ async def run_prefill_worker(args, *,
 
 
 def parse_args(argv=None) -> argparse.Namespace:
-    p = argparse.ArgumentParser(prog="dynamo-prefill-worker")
+    p = EnvDefaultsParser(prog="dynamo-prefill-worker")
     p.add_argument("--namespace", default="dynamo")
     p.add_argument("--decode-component", default="backend")
     p.add_argument("--store", default="127.0.0.1:4222")
@@ -132,7 +134,8 @@ def parse_args(argv=None) -> argparse.Namespace:
 
 
 def main() -> None:
-    logging.basicConfig(level=logging.INFO)
+    from ..utils.logging_ext import init_logging
+    init_logging()
     try:
         asyncio.run(run_prefill_worker(parse_args()))
     except KeyboardInterrupt:

@@ -10,6 +10,8 @@ Reference capability: components/router/src/main.rs.
 from __future__ import annotations
 
 import argparse
+
+from ..utils.dynconfig import EnvDefaultsParser
 import asyncio
 import logging
 
@@ -20,7 +22,7 @@ log = logging.getLogger("dynamo_tpu.router")
 
 
 def parse_args(argv=None):
-    p = argparse.ArgumentParser(prog="dynamo-router")
+    p = EnvDefaultsParser(prog="dynamo-router")
     p.add_argument("--namespace", default="dynamo")
     p.add_argument("--component", default="router")
     p.add_argument("--worker-component", default="backend")
@@ -55,7 +57,8 @@ async def run_router(args, *, ready_event=None,
 
 
 def main() -> None:
-    logging.basicConfig(level=logging.INFO)
+    from ..utils.logging_ext import init_logging
+    init_logging()
     try:
         asyncio.run(run_router(parse_args()))
     except KeyboardInterrupt:

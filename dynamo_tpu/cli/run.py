@@ -11,6 +11,8 @@ built-in batch load generator.
 from __future__ import annotations
 
 import argparse
+
+from ..utils.dynconfig import EnvDefaultsParser
 import asyncio
 import json
 import statistics
@@ -29,7 +31,7 @@ from ..runtime.engine import AsyncEngine, Context
 
 
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
-    p = argparse.ArgumentParser(prog="dynamo-run")
+    p = EnvDefaultsParser(prog="dynamo-run")
     p.add_argument("positional", nargs="*",
                    help="in=<mode> out=<engine> (order-free)")
     p.add_argument("--model-path", default=None)

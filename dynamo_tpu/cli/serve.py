@@ -1,0 +1,62 @@
+"""`serve`: run a @service graph as local processes.
+
+    python -m dynamo_tpu.cli.serve examples.hello_world:Frontend \
+        [--config examples/configs/hello.yaml] [--store host:port] \
+        [--platform cpu|tpu] [--total-chips 4]
+
+Reference capability: `dynamo serve` (deploy/dynamo/sdk/cli/serve.py +
+serving.py local orchestration).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import time
+
+from ..sdk.serve import LocalServe
+from ..utils.dynconfig import EnvDefaultsParser
+
+log = logging.getLogger("dynamo_tpu.cli.serve")
+
+
+def load_config(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        return yaml.safe_load(f) or {}
+
+
+def main(argv=None) -> None:
+    p = EnvDefaultsParser(prog="dynamo-serve")
+    p.add_argument("entry", help="pkg.module:ServiceClass (graph entry)")
+    p.add_argument("--config", default=None, help="per-service YAML")
+    p.add_argument("--store", default=None,
+                   help="existing dynstore host:port (default: spawn one)")
+    p.add_argument("--platform", default="auto",
+                   choices=["auto", "cpu", "tpu"])
+    p.add_argument("--total-chips", type=int, default=4)
+    args = p.parse_args(argv)
+
+    from ..utils.logging_ext import init_logging
+    init_logging()
+    cfg = load_config(args.config) if args.config else {}
+    serve = LocalServe(args.entry, config=cfg, store=args.store,
+                       platform=args.platform, total_chips=args.total_chips)
+    serve.start()
+    print(f"serving {args.entry} (store {serve.store}); ctrl-c to stop",
+          flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        serve.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,8 @@ publisher + metrics publisher.
 from __future__ import annotations
 
 import argparse
+
+from ..utils.dynconfig import EnvDefaultsParser
 import asyncio
 import json
 import logging
@@ -32,11 +34,9 @@ from ..runtime.component import DistributedRuntime
 
 log = logging.getLogger("dynamo_tpu.worker")
 
-METRICS_PREFIX = "metrics/"
-
-
-def metrics_key(namespace: str, component: str, worker_id: int) -> str:
-    return f"{METRICS_PREFIX}{namespace}/{component}/{worker_id:x}"
+from ..llm.metrics_aggregator import METRICS_PREFIX, metrics_key  # noqa: E402
+# (canonical definitions live with the aggregator; re-exported here for
+# backward compatibility with existing imports)
 
 
 async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
@@ -205,7 +205,7 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
 
 
 def parse_args(argv=None) -> argparse.Namespace:
-    p = argparse.ArgumentParser(prog="dynamo-worker")
+    p = EnvDefaultsParser(prog="dynamo-worker")
     p.add_argument("--engine", choices=("jax", "echo"), default="jax")
     p.add_argument("--namespace", default="dynamo")
     p.add_argument("--component", default="backend")
@@ -228,7 +228,8 @@ def parse_args(argv=None) -> argparse.Namespace:
 
 
 def main() -> None:
-    logging.basicConfig(level=logging.INFO)
+    from ..utils.logging_ext import init_logging
+    init_logging()
     try:
         asyncio.run(run_worker(parse_args()))
     except KeyboardInterrupt:

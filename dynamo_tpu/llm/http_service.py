@@ -176,6 +176,10 @@ class HttpService:
             return _err(404, f"model {model_name!r} not found")
 
         ctx = Context()
+        # request-id span: every log line in this async call chain (and in
+        # remote workers via the wire context_id) carries ctx.id
+        from ..utils.logging_ext import request_id_var
+        request_id_var.set(ctx.id)
         self.m_inflight.inc(model_name)
         status = "200"
         try:

@@ -77,7 +77,7 @@ class KvRouterService:
             self._scrape_task.cancel()
 
     async def _scrape_loop(self) -> None:
-        from ...cli.worker import METRICS_PREFIX
+        from ..metrics_aggregator import METRICS_PREFIX
 
         prefix = f"{METRICS_PREFIX}{self.namespace}/{self.worker_component}/"
         while True:

@@ -1,0 +1,156 @@
+"""Cluster metrics aggregator: worker capacity + KV hit rate -> Prometheus.
+
+Subscribes the namespace ``kv-hit-rate`` event plane (emitted by the KV
+router per routed request) and periodically scrapes every worker's
+ForwardPassMetrics snapshot from the ``metrics/`` store prefix, exposing the
+reference's cluster gauges:
+
+- ``llm_kv_blocks_active`` / ``llm_kv_blocks_total``      (per worker)
+- ``llm_requests_active_slots`` / ``llm_requests_total_slots`` (per worker)
+- ``llm_requests_waiting``                                (per worker)
+- ``llm_load_avg`` / ``llm_load_std``                     (per component)
+- ``llm_kv_hit_rate_percent``                             (cumulative)
+
+Reference capability: components/metrics/src/main.rs:115-241 (the metrics
+binary's event subscription + service scrape + prometheus export) and
+lib/llm/src/kv_router/scoring.rs (load_avg/load_std over active slots).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import math
+from typing import Dict, List, Optional, Sequence
+
+from ..runtime.component import DistributedRuntime
+from ..utils.prometheus import Registry
+from .kv_router.protocols import ForwardPassMetrics, KVHitRateEvent
+
+log = logging.getLogger("dynamo_tpu.metrics")
+
+METRICS_PREFIX = "metrics/"
+
+
+def metrics_key(namespace: str, component: str, worker_id: int) -> str:
+    """Store key a worker refreshes its ForwardPassMetrics under (lease-
+    bound, so dead workers' snapshots vanish with their lease)."""
+    return f"{METRICS_PREFIX}{namespace}/{component}/{worker_id:x}"
+
+
+class ClusterMetricsAggregator:
+    """Aggregates per-worker snapshots and router hit-rate events."""
+
+    def __init__(self, drt: DistributedRuntime, namespace: str,
+                 components: Sequence[str], scrape_interval: float = 1.0):
+        self.drt = drt
+        self.namespace = namespace
+        self.components = list(components)
+        self.scrape_interval = scrape_interval
+        self._task: Optional[asyncio.Task] = None
+
+        self.registry = Registry()
+        g = self.registry.gauge
+        self.g_kv_active = g("llm_kv_blocks_active",
+                             "KV blocks in use on a worker",
+                             ("component", "worker_id"))
+        self.g_kv_total = g("llm_kv_blocks_total",
+                            "KV block capacity of a worker",
+                            ("component", "worker_id"))
+        self.g_slots_active = g("llm_requests_active_slots",
+                                "Active request slots on a worker",
+                                ("component", "worker_id"))
+        self.g_slots_total = g("llm_requests_total_slots",
+                               "Total request slots of a worker",
+                               ("component", "worker_id"))
+        self.g_waiting = g("llm_requests_waiting",
+                           "Requests queued on a worker",
+                           ("component", "worker_id"))
+        self.g_load_avg = g("llm_load_avg",
+                            "Mean active slots across workers",
+                            ("component",))
+        self.g_load_std = g("llm_load_std",
+                            "Stddev of active slots across workers",
+                            ("component",))
+        self.g_hit_rate = g("llm_kv_hit_rate_percent",
+                            "Cumulative prefix-cache hit rate "
+                            "(overlap blocks / isl blocks)", ())
+        self._isl_blocks = 0
+        self._overlap_blocks = 0
+        # last scrape snapshot, for tests/introspection
+        self.workers: Dict[str, Dict[int, ForwardPassMetrics]] = {}
+
+    # ------------------------------------------------------------------
+    async def start(self) -> "ClusterMetricsAggregator":
+        ns = self.drt.namespace(self.namespace)
+
+        async def on_hit_rate(payload: Dict) -> None:
+            ev = KVHitRateEvent.from_dict(payload)
+            self._isl_blocks += ev.isl_blocks
+            self._overlap_blocks += ev.overlap_blocks
+            if self._isl_blocks:
+                self.g_hit_rate.set(
+                    value=100.0 * self._overlap_blocks / self._isl_blocks)
+
+        await ns.subscribe("kv-hit-rate", on_hit_rate)
+        self._task = asyncio.create_task(self._scrape_loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    # ------------------------------------------------------------------
+    async def scrape_once(self) -> None:
+        for comp in self.components:
+            prefix = f"{METRICS_PREFIX}{self.namespace}/{comp}/"
+            items = await self.drt.store.get_prefix(prefix)
+            workers: Dict[int, ForwardPassMetrics] = {}
+            for key, value in items:
+                try:
+                    wid = int(key.rsplit("/", 1)[1], 16)
+                    workers[wid] = ForwardPassMetrics.from_dict(
+                        json.loads(value.decode()))
+                except Exception:
+                    log.warning("malformed metrics at %s", key)
+            self.workers[comp] = workers
+            self._export(comp, workers)
+
+    def _export(self, comp: str,
+                workers: Dict[int, ForwardPassMetrics]) -> None:
+        for g in (self.g_kv_active, self.g_kv_total, self.g_slots_active,
+                  self.g_slots_total, self.g_waiting):
+            g.clear_label(0, comp)
+        loads: List[float] = []
+        for wid, m in workers.items():
+            w = f"{wid:x}"
+            self.g_kv_active.set(comp, w, value=m.kv_active_blocks)
+            self.g_kv_total.set(comp, w, value=m.kv_total_blocks)
+            self.g_slots_active.set(comp, w, value=m.request_active_slots)
+            self.g_slots_total.set(comp, w, value=m.request_total_slots)
+            self.g_waiting.set(comp, w, value=m.num_requests_waiting)
+            loads.append(m.request_active_slots)
+        if loads:
+            avg = sum(loads) / len(loads)
+            var = sum((x - avg) ** 2 for x in loads) / len(loads)
+            self.g_load_avg.set(comp, value=avg)
+            self.g_load_std.set(comp, value=math.sqrt(var))
+        else:
+            # no workers left: the series must vanish, not freeze
+            self.g_load_avg.clear_label(0, comp)
+            self.g_load_std.clear_label(0, comp)
+
+    async def _scrape_loop(self) -> None:
+        while True:
+            try:
+                await self.scrape_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("cluster metrics scrape failed")
+            await asyncio.sleep(self.scrape_interval)
+
+    # ------------------------------------------------------------------
+    def render(self) -> str:
+        return self.registry.render()

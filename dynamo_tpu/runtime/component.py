@@ -175,6 +175,8 @@ class DistributedRuntime:
             return None
         ctx = Context(ctx_id)
         self._active[ctx.id] = ctx
+        from ..utils.logging_ext import request_id_var
+        rid_token = request_id_var.set(ctx.id)  # span: this request's id
         leftover: List[Any] = []
 
         async def watch_control():
@@ -258,7 +260,20 @@ class DistributedRuntime:
         finally:
             if watcher is not None:
                 watcher.cancel()
+                try:
+                    # cancel() only schedules: AWAIT the exit so the
+                    # watcher's pending read fully releases the stream
+                    # before _serve_conn reads the next request frame
+                    await watcher
+                except asyncio.CancelledError:
+                    if not watcher.cancelled():
+                        raise   # OUR task was cancelled, not the watcher
+                except Exception:
+                    pass
             self._active.pop(ctx.id, None)
+            # reset: a reused (pipelined) connection must not tag later
+            # frames/log lines with a finished request's id
+            request_id_var.reset(rid_token)
         return leftover[0] if leftover else None
 
 
@@ -541,6 +556,13 @@ class Client:
                                           control.get("code", 500))
             finally:
                 stopper.cancel()
+                try:
+                    await stopper   # ensure no half-written stop frame races
+                except asyncio.CancelledError:
+                    if not stopper.cancelled():
+                        raise   # OUR task was cancelled, not the stopper
+                except Exception:
+                    pass
         finally:
             if clean:
                 # full exchange completed: the connection sits at a frame

@@ -1,0 +1,32 @@
+"""Deployment SDK: declare serving graphs as decorated Python classes.
+
+    from dynamo_tpu.sdk import service, dynamo_endpoint, depends
+
+    @service(namespace="dynamo")
+    class Backend:
+        @dynamo_endpoint()
+        async def generate(self, request, ctx):
+            yield ...
+
+    @service(namespace="dynamo", resources={"tpu": 0})
+    class Frontend:
+        backend = depends(Backend)
+
+        @dynamo_endpoint()
+        async def generate(self, request, ctx):
+            async for x in self.backend.generate(request):
+                yield x
+
+    Frontend.link(Backend)   # deployable graph
+
+Run locally with ``python -m dynamo_tpu.cli.serve module:Frontend``.
+
+Reference capability: deploy/dynamo/sdk (service.py:32-120, decorators.py:
+26-101, dependency.py) re-expressed without the BentoML dependency.
+"""
+
+from .service import (ServiceConfig, depends, dynamo_endpoint, async_on_start,
+                      service)
+
+__all__ = ["service", "dynamo_endpoint", "depends", "async_on_start",
+           "ServiceConfig"]

@@ -1,0 +1,48 @@
+"""TPU slice allocator for the local `serve` orchestrator.
+
+Assigns each service worker a disjoint set of TPU chips (the reference's GPU
+allocator assigns CUDA_VISIBLE_DEVICES ranges, deploy/dynamo/sdk/cli/
+allocator.py:35-101). On TPU VMs chip visibility is controlled with
+``TPU_VISIBLE_DEVICES``; for hermetic CPU runs the same request becomes a
+virtual device count (``--xla_force_host_platform_device_count``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class AllocationError(RuntimeError):
+    pass
+
+
+class TpuAllocator:
+    """Hands out chip index ranges; ``platform='cpu'`` hands out virtual
+    device counts instead (no exclusivity needed)."""
+
+    def __init__(self, total_chips: int = 4, platform: str = "tpu"):
+        self.total = total_chips
+        self.platform = platform
+        self._next = 0
+
+    def allocate(self, n_chips: int) -> Dict[str, str]:
+        """Env for a worker needing ``n_chips`` accelerator chips (0 => a
+        pure-CPU service; it must not initialize the TPU)."""
+        if n_chips <= 0:
+            return {"JAX_PLATFORMS": "cpu"}
+        if self.platform == "cpu":
+            return {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": ("--xla_force_host_platform_device_count="
+                              f"{n_chips}"),
+            }
+        if self._next + n_chips > self.total:
+            raise AllocationError(
+                f"need {n_chips} chips, only "
+                f"{self.total - self._next}/{self.total} left")
+        chips = list(range(self._next, self._next + n_chips))
+        self._next += n_chips
+        return {"TPU_VISIBLE_DEVICES": ",".join(map(str, chips))}
+
+    def release_all(self) -> None:
+        self._next = 0

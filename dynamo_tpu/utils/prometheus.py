@@ -49,6 +49,15 @@ class Counter(_Metric):
     def get(self, *label_values: str) -> float:
         return self._values.get(tuple(str(v) for v in label_values), 0.0)
 
+    def clear_label(self, pos: int, value: str) -> None:
+        """Drop every series whose label at ``pos`` equals ``value`` (e.g.
+        re-exporting a component's worker set after a scrape: dead workers'
+        series must vanish rather than freeze at their last value)."""
+        v = str(value)
+        with self._lock:
+            for key in [k for k in self._values if k[pos] == v]:
+                del self._values[key]
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
         for key, v in sorted(self._values.items()):

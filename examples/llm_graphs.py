@@ -1,0 +1,125 @@
+"""Deployable LLM serving graphs (the reference's agg / agg_router /
+disagg_router shapes) as @service classes wrapping the framework binaries.
+
+    # aggregated: HTTP frontend + one engine worker
+    python -m dynamo_tpu.cli.serve examples.llm_graphs:AggGraph \
+        --config examples/configs/agg.yaml
+
+    # KV-routed: frontend + KV router + replicated workers
+    python -m dynamo_tpu.cli.serve examples.llm_graphs:AggRouterGraph \
+        --config examples/configs/agg_router.yaml
+
+    # disaggregated: + prefill workers pulling the shared queue
+    python -m dynamo_tpu.cli.serve examples.llm_graphs:DisaggRouterGraph \
+        --config examples/configs/disagg_router.yaml
+
+Per-service options come from the YAML section named after the class
+(Frontend/Router/Worker/PrefillWorker); any key is the matching CLI flag of
+the wrapped binary with dashes as underscores (e.g. ``extra_engine_args``).
+
+Reference capability: examples/llm/components/* + examples/llm/configs/*.yaml
+(frontend.py:29-87, kv_router.py, worker.py:37-198, prefill_worker.py:46-158).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dynamo_tpu.sdk import async_on_start, dynamo_endpoint, service
+
+
+def _args(parse, config, **forced):
+    ns = parse([])
+    for k, v in {**config, **forced}.items():
+        setattr(ns, k, v)
+    return ns
+
+
+async def _boot(coro_factory) -> asyncio.Task:
+    ready = asyncio.Event()
+    task = asyncio.create_task(coro_factory(ready))
+    done, _ = await asyncio.wait(
+        {task, asyncio.ensure_future(ready.wait())},
+        return_when=asyncio.FIRST_COMPLETED)
+    if task in done:
+        task.result()   # surface the boot failure
+    return task
+
+
+@service(namespace="dynamo", name="frontend")
+class Frontend:
+    """OpenAI HTTP frontend with store-watched model discovery."""
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.cli.http import parse_args, run_http
+
+        args = _args(parse_args, self.config)
+        self._task = await _boot(lambda ev: run_http(
+            args, ready_event=ev, drt=self.runtime))
+
+
+@service(namespace="dynamo", name="router")
+class Router:
+    """KV-aware router service over the worker component."""
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.cli.router import parse_args, run_router
+
+        args = _args(parse_args, self.config)
+        self._task = await _boot(lambda ev: run_router(
+            args, ready_event=ev, drt=self.runtime))
+
+
+@service(namespace="dynamo", name="backend", resources={"tpu": 1})
+class Worker:
+    """Engine worker (out=jax by default; engine=echo for hermetic runs)."""
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.cli.worker import parse_args, run_worker
+
+        args = _args(parse_args, self.config, component="backend")
+        self._task = await _boot(lambda ev: run_worker(
+            args, ready_event=ev, drt=self.runtime))
+
+
+@service(namespace="dynamo", name="prefill", resources={"tpu": 1})
+class PrefillWorker:
+    """Zero-registration prefill worker pulling the shared queue."""
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.cli.prefill_worker import (parse_args,
+                                                   run_prefill_worker)
+
+        args = _args(parse_args, self.config)
+        self._task = await _boot(lambda ev: run_prefill_worker(
+            args, ready_event=ev, drt=self.runtime))
+
+
+# --- graphs -----------------------------------------------------------
+@service(namespace="dynamo", name="agg_graph")
+class AggGraph:
+    pass
+
+
+AggGraph.link(Frontend).link(Worker)
+
+
+@service(namespace="dynamo", name="agg_router_graph")
+class AggRouterGraph:
+    pass
+
+
+AggRouterGraph.link(Frontend).link(Router).link(Worker)
+
+
+@service(namespace="dynamo", name="disagg_router_graph")
+class DisaggRouterGraph:
+    pass
+
+
+DisaggRouterGraph.link(Frontend).link(Router).link(Worker) \
+    .link(PrefillWorker)

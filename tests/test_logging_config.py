@@ -1,0 +1,111 @@
+"""DYN_* env config layering + JSONL logging with request-id propagation
+(VERDICT round-1 next #10)."""
+
+import asyncio
+import io
+import json
+import logging
+
+import pytest
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.store_server import StoreServer
+from dynamo_tpu.utils.dynconfig import EnvDefaultsParser, env_default
+from dynamo_tpu.utils.logging_ext import init_logging, request_id_var
+
+
+def test_env_layering(monkeypatch):
+    """flags beat DYN_* env beats built-in defaults."""
+    monkeypatch.setenv("DYN_STORE", "example:9999")
+    monkeypatch.setenv("DYN_HTTP_PORT", "1234")
+    p = EnvDefaultsParser("t")
+    p.add_argument("--store", default="127.0.0.1:4222")
+    p.add_argument("--http-port", type=int, default=8080)
+    p.add_argument("--namespace", default="dynamo")
+
+    a = p.parse_args([])
+    assert a.store == "example:9999"          # env beats default
+    assert a.http_port == 1234                # env cast to the flag type
+    assert a.namespace == "dynamo"            # default survives
+
+    a = p.parse_args(["--store", "flag:1"])
+    assert a.store == "flag:1"                # flag beats env
+
+
+def test_env_default_bool(monkeypatch):
+    monkeypatch.setenv("DYN_VERBOSE", "false")
+    assert env_default("--verbose", True) is False
+    monkeypatch.setenv("DYN_VERBOSE", "1")
+    assert env_default("--verbose", False) is True
+
+
+def test_jsonl_logging_request_id(monkeypatch):
+    monkeypatch.setenv("DYN_LOG", "info")
+    monkeypatch.setenv("DYN_LOGGING_JSONL", "1")
+    buf = io.StringIO()
+    init_logging(stream=buf)
+    try:
+        log = logging.getLogger("dynamo_tpu.test")
+        request_id_var.set("req-abc")
+        log.info("with id")
+        request_id_var.set(None)
+        log.info("without id")
+        lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+        assert lines[0]["message"] == "with id"
+        assert lines[0]["request_id"] == "req-abc"
+        assert lines[0]["level"] == "INFO"
+        assert "request_id" not in lines[1]
+    finally:
+        monkeypatch.delenv("DYN_LOGGING_JSONL")
+        init_logging()   # restore plain handler
+
+
+async def test_request_id_crosses_the_wire(monkeypatch):
+    """One request's id appears in BOTH caller-side and worker-side log
+    lines: the data plane rebinds the contextvar from the wire context_id."""
+    monkeypatch.setenv("DYN_LOG", "info")
+    monkeypatch.setenv("DYN_LOGGING_JSONL", "1")
+    buf = io.StringIO()
+    init_logging(stream=buf)
+    try:
+        srv = StoreServer()
+        port = await srv.start()
+        worker = await DistributedRuntime(store_port=port,
+                                          advertise_host="127.0.0.1").connect()
+        wlog = logging.getLogger("dynamo_tpu.test.worker")
+
+        async def handler(request, ctx):
+            wlog.info("handling %s", request["x"])
+            yield {"ok": True}
+
+        ep = worker.namespace("log").component("c").endpoint("generate")
+        await ep.serve(handler)
+
+        caller = await DistributedRuntime(store_port=port).connect()
+        cl = await caller.namespace("log").component("c") \
+            .endpoint("generate").client().start()
+        await cl.wait_for_instances(1)
+
+        from dynamo_tpu.runtime.engine import Context
+        ctx = Context()
+        request_id_var.set(ctx.id)   # what the HTTP frontend does at ingress
+        clog = logging.getLogger("dynamo_tpu.test.frontend")
+        clog.info("routing request")
+        items = [x async for x in cl.generate({"x": 1}, context=ctx)]
+        assert items == [{"ok": True}]
+        request_id_var.set(None)
+
+        lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+        frontend = [l for l in lines
+                    if l["target"] == "dynamo_tpu.test.frontend"]
+        workerl = [l for l in lines if l["target"] == "dynamo_tpu.test.worker"]
+        assert frontend and workerl
+        assert frontend[0]["request_id"] == ctx.id
+        assert workerl[0]["request_id"] == ctx.id
+
+        await caller.close()
+        await worker.close()
+        await srv.stop()
+    finally:
+        monkeypatch.delenv("DYN_LOGGING_JSONL")
+        init_logging()

@@ -1,0 +1,139 @@
+"""SDK layer: @service/@dynamo_endpoint/depends/.link(), the serve
+orchestrator and the TPU allocator (VERDICT round-1 missing #4/L6)."""
+
+import asyncio
+import sys
+
+import pytest
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.store_server import StoreServer
+from dynamo_tpu.sdk import depends, dynamo_endpoint, async_on_start, service
+from dynamo_tpu.sdk.allocator import AllocationError, TpuAllocator
+from dynamo_tpu.sdk.service import collect_graph
+from dynamo_tpu.sdk.serve_child import run_service
+
+
+@service(namespace="t")
+class Leaf:
+    @dynamo_endpoint()
+    async def generate(self, request, ctx):
+        yield {"n": request["n"] * 2}
+
+
+@service(namespace="t")
+class Mid:
+    leaf = depends(Leaf)
+    started = False
+
+    @async_on_start
+    async def boot(self):
+        type(self).started = True
+
+    @dynamo_endpoint()
+    async def generate(self, request, ctx):
+        async for item in self.leaf.generate(request):
+            yield {"n": item["n"] + 1}
+
+
+@service(namespace="t")
+class Entry:
+    mid = depends(Mid)
+
+    @dynamo_endpoint()
+    async def generate(self, request, ctx):
+        async for item in self.mid.generate(request):
+            yield item
+
+
+Entry.link(Mid).link(Leaf)
+
+
+def test_spec_and_graph_collection():
+    spec = Mid._dynamo_spec
+    assert spec.name == "mid" and spec.namespace == "t"
+    assert spec.endpoints == {"generate": "generate"}
+    assert spec.on_start == ["boot"]
+    assert list(spec.dependencies) == ["leaf"]
+    # dependency-first order: leaves before their callers
+    order = collect_graph(Entry)
+    assert order.index(Leaf) < order.index(Mid) < order.index(Entry)
+
+
+def test_allocator():
+    a = TpuAllocator(total_chips=4, platform="tpu")
+    assert a.allocate(2)["TPU_VISIBLE_DEVICES"] == "0,1"
+    assert a.allocate(2)["TPU_VISIBLE_DEVICES"] == "2,3"
+    with pytest.raises(AllocationError):
+        a.allocate(1)
+    assert a.allocate(0) == {"JAX_PLATFORMS": "cpu"}
+    cpu = TpuAllocator(platform="cpu")
+    env = cpu.allocate(8)
+    assert "host_platform_device_count=8" in env["XLA_FLAGS"]
+
+
+def test_unwired_dependency_raises():
+    with pytest.raises(RuntimeError, match="not wired"):
+        Entry().mid
+
+
+async def test_three_stage_graph_in_process():
+    """The full Entry->Mid->Leaf chain, each service brought up exactly the
+    way serve_child does, exchanging data over the real data plane."""
+    srv = StoreServer()
+    port = await srv.start()
+    store = f"127.0.0.1:{port}"
+    tasks = []
+    try:
+        for cls in collect_graph(Entry):
+            ev = asyncio.Event()
+            tasks.append(asyncio.create_task(
+                run_service(cls, store, ready_event=ev)))
+            await asyncio.wait_for(ev.wait(), 15)
+        assert Mid.started
+
+        caller = await DistributedRuntime(store_port=port).connect()
+        cl = await caller.namespace("t").component("entry") \
+            .endpoint("generate").client().start()
+        await cl.wait_for_instances(1)
+        items = [x async for x in cl.generate({"n": 20})]
+        assert items == [{"n": 41}]   # (20*2)+1 through the chain
+        await caller.close()
+    finally:
+        for t in tasks:
+            t.cancel()
+        await srv.stop()
+
+
+@pytest.mark.slow
+def test_local_serve_subprocesses(tmp_path):
+    """End-to-end orchestration: LocalServe spawns the hello_world graph as
+    real processes (plus a dynstore) and the frontend answers."""
+    import subprocess
+
+    from dynamo_tpu.sdk.serve import LocalServe
+
+    serve = LocalServe("examples.hello_world:Frontend", platform="cpu")
+    try:
+        serve.start(timeout=90)
+        code = f"""
+import asyncio
+from dynamo_tpu.runtime.component import DistributedRuntime
+
+async def main():
+    drt = await DistributedRuntime(store_port={serve.store.split(':')[1]}).connect()
+    cl = await (drt.namespace("hello").component("frontend")
+                .endpoint("generate").client().start())
+    await cl.wait_for_instances(1)
+    out = [x async for x in cl.generate({{"text": "a b"}})]
+    print("RESULT", out)
+    await drt.close()
+
+asyncio.run(main())
+"""
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=60, cwd=".")
+        assert "A-BACK" in r.stdout and "B-BACK" in r.stdout, \
+            r.stdout + r.stderr
+    finally:
+        serve.stop()
