@@ -211,16 +211,8 @@ async def run_batch(args, card, chat_engine, _c, path: str) -> Dict[str, Any]:
     return stats
 
 
-def _honor_jax_platforms_env() -> None:
-    """Some PJRT plugins (axon) override the JAX_PLATFORMS env var at import;
-    re-assert the operator's choice via the config flag, which wins."""
-    import os
-
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat and plat != "axon":
-        import jax
-
-        jax.config.update("jax_platforms", plat)
+from ..utils.hostmesh import honor_jax_platforms_env as \
+    _honor_jax_platforms_env  # one home for the axon-plugin workaround
 
 
 async def amain(argv: Optional[List[str]] = None) -> None:

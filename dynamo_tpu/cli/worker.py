@@ -39,8 +39,64 @@ from ..llm.metrics_aggregator import METRICS_PREFIX, metrics_key  # noqa: E402
 # backward compatibility with existing imports)
 
 
+def run_follower(args) -> None:
+    """Follower node (rank > 0) of a multi-host worker: join the global
+    mesh via jax.distributed, build the identical engine core, then replay
+    the leader's dispatch stream forever. No endpoint, no registration —
+    the multi-host slice is ONE logical worker published by the leader."""
+    from ..engine.engine import EngineCore
+    from ..parallel.multihost import FollowerLoop, init_distributed
+
+    init_distributed(args.coordinator, args.num_nodes, args.node_rank)
+    cfg = _engine_cfg(args)
+    core = EngineCore(cfg)
+    leader_host = args.coordinator.split(":")[0]
+    print(f"follower {args.node_rank}/{args.num_nodes} joined mesh; "
+          f"replaying dispatches from {leader_host}:{args.dispatch_port}",
+          flush=True)
+    FollowerLoop(core, leader_host, args.dispatch_port).run()
+
+
+def _build_card(args) -> ModelDeploymentCard:
+    if args.model_path:
+        card = ModelDeploymentCard.from_local_path(args.model_path,
+                                                   args.model_name)
+    else:
+        card = ModelDeploymentCard.synthetic(args.model_name or "echo")
+    card.kv_block_size = args.kv_block_size
+    return card
+
+
+def _engine_cfg(args, card: Optional[ModelDeploymentCard] = None):
+    from ..engine.engine import JaxEngineConfig
+
+    if card is None:
+        card = _build_card(args)
+    extra = json.loads(args.extra_engine_args) if args.extra_engine_args else {}
+    if getattr(args, "num_nodes", 1) > 1:
+        # multi-host lockstep covers exactly the dispatch-hooked programs:
+        # host-tier restores / disagg injection are per-leader device ops
+        # and must stay off
+        extra["enable_prefix_reuse"] = False
+        extra["host_cache_blocks"] = 0
+        extra["disk_cache_blocks"] = 0
+    return JaxEngineConfig.from_card(card, tensor_parallel=args.tp, **extra)
+
+
 async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                      drt: Optional[DistributedRuntime] = None) -> None:
+    multihost = getattr(args, "num_nodes", 1) > 1
+    publisher = None
+    if multihost:
+        if args.engine != "jax":
+            raise SystemExit("--num-nodes > 1 requires --engine jax")
+        if getattr(args, "enable_disagg", False):
+            raise SystemExit("--enable-disagg is not supported with "
+                             "--num-nodes > 1 yet")
+        from ..parallel.multihost import DispatchPublisher, init_distributed
+
+        init_distributed(args.coordinator, args.num_nodes, args.node_rank)
+        publisher = DispatchPublisher(args.dispatch_port, args.num_nodes - 1)
     host, port = args.store.split(":")
     own_drt = drt is None
     if own_drt:
@@ -51,24 +107,26 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
     component = ns.component(args.component)
 
     # --- engine -------------------------------------------------------
-    if args.model_path:
-        card = ModelDeploymentCard.from_local_path(args.model_path,
-                                                   args.model_name)
-    else:
-        card = ModelDeploymentCard.synthetic(args.model_name or "echo")
-    card.kv_block_size = args.kv_block_size
+    card = _build_card(args)
 
     core = None
     if args.engine == "jax":
-        from ..engine.engine import JaxEngine, JaxEngineConfig
+        from ..engine.engine import JaxEngine
 
-        extra = json.loads(args.extra_engine_args) if args.extra_engine_args else {}
-        cfg = JaxEngineConfig.from_card(card, tensor_parallel=args.tp, **extra)
+        cfg = _engine_cfg(args, card)
         # engine bring-up (jax init, weight load, device_put) can exceed the
         # lease TTL — run it off-loop so lease keepalives keep flowing
         engine = await asyncio.get_running_loop().run_in_executor(
             None, lambda: JaxEngine(cfg))
         core = engine.core
+        if publisher is not None:
+            # every follower must see the dispatch stream from the first
+            # dispatch: block until the full slice has joined
+            await asyncio.get_running_loop().run_in_executor(
+                None, publisher.wait_for_followers)
+            core.dispatch_hook = publisher.hook
+            print(f"multi-host leader: {args.num_nodes - 1} followers "
+                  f"in lockstep", flush=True)
     else:
         from ..llm.engines import EchoCoreEngine
 
@@ -224,14 +282,28 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--remote-prefill-timeout", type=float, default=120.0)
     p.add_argument("--extra-engine-args", default=None,
                    help="inline JSON engine kwargs")
+    # multi-host slice (one process per TPU host; rank 0 is the leader)
+    p.add_argument("--num-nodes", type=int, default=1)
+    p.add_argument("--node-rank", type=int, default=0)
+    p.add_argument("--coordinator", default="127.0.0.1:9731",
+                   help="jax.distributed coordinator host:port")
+    p.add_argument("--dispatch-port", type=int, default=9732,
+                   help="leader's dispatch-replay channel port")
     return p.parse_args(argv)
 
 
 def main() -> None:
     from ..utils.logging_ext import init_logging
+    from ..utils.hostmesh import honor_jax_platforms_env
+
     init_logging()
+    honor_jax_platforms_env()
+    args = parse_args()
+    if args.num_nodes > 1 and args.node_rank > 0:
+        run_follower(args)
+        return
     try:
-        asyncio.run(run_worker(parse_args()))
+        asyncio.run(run_worker(args))
     except KeyboardInterrupt:
         pass
 

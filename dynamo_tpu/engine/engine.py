@@ -45,6 +45,18 @@ from .sampling import STATIC_K, SamplingState, sample
 log = logging.getLogger("dynamo_tpu.engine")
 
 
+def global_put(host_array, sharding) -> jax.Array:
+    """device_put that also works on a multi-process mesh: every process
+    contributes only its addressable shards (all processes must call this
+    with the same host data)."""
+    if all(d.process_index == jax.process_index()
+           for d in sharding.device_set):
+        return jax.device_put(host_array, sharding)
+    return jax.make_array_from_callback(
+        host_array.shape, sharding,
+        lambda idx: np.asarray(host_array[idx]))
+
+
 def _buckets(lo: int, hi: int) -> List[int]:
     out = []
     b = lo
@@ -161,7 +173,7 @@ class EngineCore:
         else:
             params = llama.init_params(m, jax.random.PRNGKey(cfg.seed))
             self.params = jax.tree.map(
-                lambda a, s: jax.device_put(a, s), params, shardings)
+                lambda a, s: global_put(a, s), params, shardings)
 
         # --- attention backend ---------------------------------------
         impl = cfg.attn_impl
@@ -198,11 +210,14 @@ class EngineCore:
         # pool[l] is directly the TPU paged-attention kernel layout) ----
         kv_spec = llama.kv_cache_spec(m, cfg.tp)
         self.kv_sharding = NamedSharding(self.mesh, kv_spec)
-        self.k_pool = jax.device_put(
-            jnp.zeros((m.num_layers, m.num_kv_heads, num_pages,
-                       cfg.page_size, m.head_dim), m.dtype), self.kv_sharding)
-        self.v_pool = jax.device_put(
-            jnp.zeros_like(self.k_pool), self.kv_sharding)
+        pool_shape = (m.num_layers, m.num_kv_heads, num_pages,
+                      cfg.page_size, m.head_dim)
+        # jitted zeros with explicit out_sharding: allocates straight into
+        # the (possibly multi-process) sharded layout, no host staging
+        zeros = jax.jit(lambda: jnp.zeros(pool_shape, m.dtype),
+                        out_shardings=self.kv_sharding)
+        self.k_pool = zeros()
+        self.v_pool = zeros()
 
         # --- KV block manager: tiered offload + prefix reuse ----------
         from ..llm.kvbm.transfer import CopyStream
@@ -245,8 +260,9 @@ class EngineCore:
         # include argument shardings, so an uncommitted key would recompile
         # every bucket once more after the first on-device key update
         self._rep_sharding = NamedSharding(self.mesh, P())
-        self.sampling.key = jax.device_put(self.sampling.key,
-                                           self._rep_sharding)
+        self.sampling.key = jax.jit(
+            lambda: jax.random.split(jax.random.key(0), cfg.max_batch),
+            out_shardings=self._rep_sharding)()
 
         # --- compiled programs ---------------------------------------
         # decode reads are indexed through page tables of width S/page_size:
@@ -268,6 +284,11 @@ class EngineCore:
         # round-trip) overlaps device execution instead of gating it.
         self._inflight: Deque[Dict[str, Any]] = collections.deque()
         self._deferred_release: List[str] = []
+        self._pending_seeds: List[Tuple[int, int]] = []
+        self._last_final_tok = None   # device [B] from the last decode
+        # multi-host lockstep: called with (kind, meta, arrays) right before
+        # every device dispatch so follower processes can replay it
+        self.dispatch_hook: Optional[Any] = None
 
     # ------------------------------------------------------------------
     # compiled program builders
@@ -499,6 +520,7 @@ class EngineCore:
         self.slots[slot_idx] = slot
         self.by_seq[seq_id] = slot
         self._load_sampling(slot_idx, request)
+        self._apply_pending_seeds()
         if request.sampling.seed is not None:
             # the prefill worker consumed one key step sampling the first
             # token; advance the freshly-seeded key the same way so token 2
@@ -578,6 +600,11 @@ class EngineCore:
         slot = self.slots[i]
         if slot is None:
             return
+        # a queued-but-unapplied seed for this slot must die with it, or a
+        # later occupant of the slot could get two key writes at one index
+        # (implementation-defined winner)
+        self._pending_seeds = [(ix, sd) for ix, sd in self._pending_seeds
+                               if ix != i]
         if self._inflight:
             # an enqueued decode dispatch may still write into this
             # sequence's pages; hold the release until the window drains so
@@ -709,8 +736,39 @@ class EngineCore:
                                   if req.sampling.top_p is not None else 1.0)
         s.top_k[slot_idx] = int(min(req.sampling.top_k or 0, STATIC_K))
         if req.sampling.seed is not None:
-            s.key = s.key.at[slot_idx].set(
-                jax.random.key(req.sampling.seed))
+            # deferred to the next prefill dispatch: keeps EVERY device op
+            # at a mirrorable dispatch point (multi-host lockstep) and
+            # batches the key writes
+            self._pending_seeds.append((slot_idx, int(req.sampling.seed)))
+
+    def _apply_pending_seeds(self) -> List[Tuple[int, int]]:
+        applied, self._pending_seeds = self._pending_seeds, []
+        if applied:
+            s = self.sampling
+            idx = jnp.asarray([i for i, _ in applied])
+            keys = jax.vmap(jax.random.key)(
+                jnp.asarray([seed for _, seed in applied]))
+            s.key = s.key.at[idx].set(keys)
+        return applied
+
+    def _run_prefill_program(self, Bp, C, S, tokens, positions, write_idx,
+                             read_idx, read_pos, read_valid, last_i, temp,
+                             top_p, top_k, idxs, last_lanes):
+        """Execute the batched prefill program + key bookkeeping. The SAME
+        code path runs on the leader (from _prefill_dispatch) and on
+        followers (from mirror_dispatch) so device state stays in lockstep."""
+        s = self.sampling
+        keys = s.key[jnp.asarray(idxs)]
+        fn = self._prefill_fn(Bp, C, S)
+        packed, _tok, new_keys, self.k_pool, self.v_pool = fn(
+            self.params, tokens, positions, self.k_pool, self.v_pool,
+            write_idx, read_idx, read_pos, read_valid, last_i,
+            temp, top_p, top_k, keys)
+        # persist advanced PRNG keys only for lanes that really sampled
+        if last_lanes:
+            la = jnp.asarray([int(idxs[l]) for l in last_lanes])
+            s.key = s.key.at[la].set(new_keys[jnp.asarray(last_lanes)])
+        return packed
 
     def _prefill_dispatch(self, chunks: List[Tuple[int, _Slot]],
                           out: List[StepOutput]) -> bool:
@@ -766,19 +824,20 @@ class EngineCore:
             top_p[lane] = s.top_p[i]
             top_k[lane] = s.top_k[i]
             idxs[lane] = i
-        keys = s.key[jnp.asarray(idxs)]
-
-        fn = self._prefill_fn(Bp, C, S)
-        packed, _tok, new_keys, self.k_pool, self.v_pool = fn(
-            self.params, tokens, positions, self.k_pool, self.v_pool,
-            write_idx, read_idx, read_pos, read_valid, last_i,
-            temp, top_p, top_k, keys)
-
-        # persist advanced PRNG keys only for lanes that really sampled
+        seeds = self._apply_pending_seeds()
         last_lanes = [lane for lane, w in enumerate(work) if w[4]]
-        if last_lanes:
-            la = jnp.asarray([int(idxs[l]) for l in last_lanes])
-            s.key = s.key.at[la].set(new_keys[jnp.asarray(last_lanes)])
+        if self.dispatch_hook is not None:
+            self.dispatch_hook("prefill", {
+                "Bp": Bp, "C": C, "S": S, "seeds": seeds,
+                "last_lanes": last_lanes,
+            }, {"tokens": tokens, "positions": positions,
+                "write_idx": write_idx, "read_idx": read_idx,
+                "read_pos": read_pos, "read_valid": read_valid,
+                "last_i": last_i, "temp": temp, "top_p": top_p,
+                "top_k": top_k, "idxs": idxs})
+        packed = self._run_prefill_program(
+            Bp, C, S, tokens, positions, write_idx, read_idx, read_pos,
+            read_valid, last_i, temp, top_p, top_k, idxs, last_lanes)
 
         packed_np = np.asarray(packed)            # ONE host fetch
         for lane, (i, slot, start, count, is_last) in enumerate(work):
@@ -904,20 +963,66 @@ class EngineCore:
             page_tables[i] = self.pool.page_table_row(slot.seq_id, P)
             slot.sched_len = phys + N
         if chain:
-            tokens = self._inflight[-1]["final_tok"]   # device [B], unfetched
+            tokens = None   # resolved to the previous dispatch's device toks
         else:
             tokens = np.zeros(B, np.int32)
             for i, slot, _ in active:
                 tokens[i] = slot.last_token
 
         s = self.sampling
+        if self.dispatch_hook is not None:
+            payload = {"page_tables": page_tables, "lengths": lengths,
+                       "temp": s.temperature, "top_p": s.top_p,
+                       "top_k": s.top_k}
+            if tokens is not None:
+                payload["tokens"] = tokens
+            self.dispatch_hook("decode", {"S": S, "chain": chain}, payload)
+        packed, final_tok = self._run_decode_program(
+            S, tokens, page_tables, lengths)
+        self._inflight.append({"packed": packed, "final_tok": final_tok,
+                               "active": active})
+
+    def _run_decode_program(self, S: int, tokens, page_tables, lengths):
+        """Execute the multi-step decode program. ``tokens=None`` chains off
+        the previous dispatch's on-device final tokens. The SAME code path
+        runs on the leader and on follower mirrors (multi-host lockstep)."""
+        if tokens is None:
+            tokens = self._last_final_tok
+        s = self.sampling
         fn = self._decode_fn(S)
         packed, final_tok, new_key, self.k_pool, self.v_pool = fn(
             self.params, tokens, self.k_pool, self.v_pool,
             page_tables, lengths, s.temperature, s.top_p, s.top_k, s.key)
         s.key = new_key
-        self._inflight.append({"packed": packed, "final_tok": final_tok,
-                               "active": active})
+        self._last_final_tok = final_tok
+        return packed, final_tok
+
+    def mirror_dispatch(self, kind: str, meta: Dict[str, Any],
+                        arrs: Dict[str, np.ndarray]) -> None:
+        """Follower-side replay of a leader dispatch (multi-host mode): runs
+        the identical jitted program with the identical inputs so every
+        process's sharded params/KV/key state advances in lockstep. Results
+        are not fetched — only the leader streams tokens to clients."""
+        if kind == "prefill":
+            for slot_idx, seed in meta.get("seeds", []):
+                self._pending_seeds.append((int(slot_idx), int(seed)))
+            self._apply_pending_seeds()
+            self._run_prefill_program(
+                meta["Bp"], meta["C"], meta["S"], arrs["tokens"],
+                arrs["positions"], arrs["write_idx"], arrs["read_idx"],
+                arrs["read_pos"], arrs["read_valid"], arrs["last_i"],
+                arrs["temp"], arrs["top_p"], arrs["top_k"], arrs["idxs"],
+                [int(x) for x in meta.get("last_lanes", [])])
+        elif kind == "decode":
+            s = self.sampling
+            s.temperature = arrs["temp"]
+            s.top_p = arrs["top_p"]
+            s.top_k = arrs["top_k"]
+            self._run_decode_program(
+                meta["S"], arrs.get("tokens"), arrs["page_tables"],
+                arrs["lengths"])
+        else:
+            raise ValueError(f"unknown dispatch kind {kind!r}")
 
     def _process_oldest_inflight(self) -> List[StepOutput]:
         """Fetch (blocking) and account the oldest in-flight dispatch."""

@@ -83,7 +83,9 @@ def load_llama_params(path: str, cfg: LlamaConfig,
                 else f"{pfx}lm_head.weight")
         params["lm_head"] = _get(tensors, head).astype(dt).T
 
-    return jax.tree.map(lambda a, s: jax.device_put(a, s), params, shardings)
+    from .engine import global_put
+
+    return jax.tree.map(lambda a, s: global_put(a, s), params, shardings)
 
 
 def save_llama_params(path: str, params: Dict[str, Any], cfg: LlamaConfig) -> None:

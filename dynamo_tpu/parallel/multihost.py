@@ -1,0 +1,177 @@
+"""Multi-host worker model: one process per TPU host, one logical worker.
+
+JAX is SPMD multi-controller: every process must execute the same program
+over the global mesh. The serving engine is request-driven on ONE process,
+so the leader (node rank 0) broadcasts a descriptor of every device dispatch
+(program kind + bucket shapes + host input arrays) to the followers over a
+TCP dispatch channel, and each follower replays it through
+``EngineCore.mirror_dispatch`` — identical jitted programs, identical
+inputs, lockstep device state. Only the leader serves the endpoint,
+registers in the store and streams tokens; followers join the mesh silently
+and die with the leader.
+
+Failure detection is two-layered: a dispatch-channel socket error kills the
+worker immediately (see DispatchPublisher.hook), and silent member death is
+caught by jax.distributed's own coordination-service heartbeat, which
+terminates every surviving process of the slice within its timeout
+(~1 minute) — after which the leader's lease expires and clients shrink
+their live set. The slice fails as one unit, like the reference's Ray
+cluster does.
+
+Reference capability: the multi-node engine bootstrap the reference
+delegates to Ray/torch-distributed (lib/llm/src/engines.rs:40-58
+MultiNodeConfig, engines/vllm/src/ray.rs:66-229 leader/follower), rebuilt on
+jax.distributed.initialize + an explicit dispatch-replay plane (SURVEY §7
+"Multi-host process model").
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+from ..runtime.wire import MAX_FRAME, pack as wire_pack
+
+log = logging.getLogger("dynamo_tpu.multihost")
+
+_HDR = struct.Struct(">I")
+
+
+def init_distributed(coordinator: str, num_nodes: int,
+                     node_rank: int) -> None:
+    """``jax.distributed.initialize`` wrapper: call BEFORE any jax backend
+    use. After it, ``jax.devices()`` is the global device list."""
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_nodes,
+                               process_id=node_rank)
+
+
+def _pack_arrays(arrs: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    out = {}
+    for k, a in arrs.items():
+        a = np.ascontiguousarray(a)
+        out[k] = [str(a.dtype), list(a.shape), a.tobytes()]
+    return out
+
+
+def _unpack_arrays(d: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, (dtype, shape, raw) in d.items():
+        out[k] = np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape)
+    return out
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    sock.sendall(wire_pack(obj))   # the one wire framing (runtime/wire.py)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    hdr = _recv_exact(sock, _HDR.size)
+    (n,) = _HDR.unpack(hdr)
+    if n > MAX_FRAME:
+        raise ConnectionError(f"dispatch frame of {n} bytes exceeds "
+                              f"MAX_FRAME — corrupt channel")
+    return msgpack.unpackb(_recv_exact(sock, n), raw=False)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("dispatch channel closed")
+        buf += chunk
+    return buf
+
+
+class DispatchPublisher:
+    """Leader side: accepts follower connections, then broadcasts every
+    engine dispatch in order. ``hook`` plugs into EngineCore.dispatch_hook
+    (called from the engine thread; sends are blocking — lockstep SPMD means
+    a stalled follower must stall the leader rather than diverge)."""
+
+    def __init__(self, port: int, expected_followers: int):
+        self.expected = expected_followers
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", port))
+        self._srv.listen(expected_followers)
+        self.port = self._srv.getsockname()[1]
+        self._socks: List[socket.socket] = []
+        self._lock = threading.Lock()
+
+    def wait_for_followers(self, timeout: float = 300.0) -> None:
+        self._srv.settimeout(timeout)
+        while len(self._socks) < self.expected:
+            sock, addr = self._srv.accept()
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(sock)
+            log.info("follower %s connected (%d/%d)", addr,
+                     len(self._socks), self.expected)
+
+    def hook(self, kind: str, meta: Dict[str, Any],
+             arrs: Dict[str, np.ndarray]) -> None:
+        frame = [kind, meta, _pack_arrays(arrs)]
+        with self._lock:
+            for sock in self._socks:
+                try:
+                    _send_frame(sock, frame)
+                except OSError:
+                    # SPMD divergence is unrecoverable: a follower that
+                    # missed a dispatch can never rejoin the lockstep, and
+                    # surviving followers may already be blocked inside a
+                    # collective the leader would never run again. Die hard:
+                    # the lease expires, the endpoint deregisters, clients
+                    # shrink their live set — clean slice failure.
+                    log.critical("dispatch channel to a follower failed; "
+                                 "terminating the multi-host worker")
+                    import os as _os
+
+                    _os._exit(13)
+
+    def close(self) -> None:
+        for s in self._socks:
+            s.close()
+        self._srv.close()
+
+
+class FollowerLoop:
+    """Follower side: connect to the leader's dispatch channel and replay
+    every dispatch through the local EngineCore mirror. Blocks forever
+    (until the channel closes — leader death ends the follower)."""
+
+    def __init__(self, core, leader_host: str, dispatch_port: int,
+                 connect_timeout: float = 300.0):
+        self.core = core
+        deadline = connect_timeout
+        import time
+
+        t0 = time.monotonic()
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (leader_host, dispatch_port), timeout=5)
+                break
+            except OSError:
+                if time.monotonic() - t0 > deadline:
+                    raise
+                time.sleep(0.2)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def run(self) -> None:
+        n = 0
+        try:
+            while True:
+                kind, meta, packed = _recv_frame(self._sock)
+                self.core.mirror_dispatch(kind, meta, _unpack_arrays(packed))
+                n += 1
+        except ConnectionError:
+            log.info("dispatch channel closed after %d dispatches", n)

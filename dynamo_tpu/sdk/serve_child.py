@@ -68,26 +68,15 @@ async def run_service(cls: Type, store: str,
         await drt.close()
 
 
-def _honor_jax_platforms_env() -> None:
-    """The axon TPU PJRT plugin overrides JAX_PLATFORMS at import; the
-    allocator's platform choice (e.g. cpu for a frontend, or a chip subset)
-    must win — re-assert it via the jax config flag, which does."""
-    import os
-
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat and plat != "axon":
-        import jax
-
-        jax.config.update("jax_platforms", plat)
-
-
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser("dynamo-serve-child")
     ap.add_argument("service", help="pkg.module:ServiceClass")
     ap.add_argument("--store", default="127.0.0.1:4222")
     args = ap.parse_args(argv)
+    from ..utils.hostmesh import honor_jax_platforms_env
+
     init_logging()
-    _honor_jax_platforms_env()
+    honor_jax_platforms_env()
     sys.path.insert(0, ".")
     asyncio.run(run_service(load_class(args.service), args.store))
 

@@ -35,6 +35,17 @@ def set_host_device_count_env(n: int) -> None:
     os.environ["XLA_FLAGS"] = flags
 
 
+def honor_jax_platforms_env() -> None:
+    """Some PJRT plugins (axon) override the JAX_PLATFORMS env var at
+    import; re-assert the operator's choice via the config flag, which
+    wins. Call before any backend init in every CLI entry point."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat and plat != "axon":
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
 def force_cpu(n_devices: int = 1) -> bool:
     """Force the cpu platform with >= n_devices virtual devices.
 
