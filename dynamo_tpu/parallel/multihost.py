@@ -173,5 +173,9 @@ class FollowerLoop:
                 kind, meta, packed = _recv_frame(self._sock)
                 self.core.mirror_dispatch(kind, meta, _unpack_arrays(packed))
                 n += 1
-        except ConnectionError:
-            log.info("dispatch channel closed after %d dispatches", n)
+        except ConnectionError as e:
+            # leader loss is a SLICE failure, not a clean exit: re-raise so
+            # the process exits nonzero and a restart policy brings the
+            # whole slice back together
+            log.error("dispatch channel lost after %d dispatches: %s", n, e)
+            raise

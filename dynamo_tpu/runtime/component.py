@@ -38,6 +38,46 @@ log = logging.getLogger("dynamo_tpu.runtime")
 Handler = Callable[[Any, Context], AsyncIterator[Any]]
 
 
+async def drive_handler_stream(stream, send) -> None:
+    """Drive a handler's response stream through ``await send(control,
+    payload)`` — the ONE implementation of the response wire protocol
+    (error-before-stream prologue, data / bin frames, sentinel, mid-stream
+    error frames) shared by the asyncio and native data planes. Connection
+    errors raised by ``send`` propagate to the caller."""
+    try:
+        first = await stream.__anext__()
+        have_first = True
+    except StopAsyncIteration:
+        have_first = False
+    except EngineError as e:
+        await send({"kind": "error", "message": str(e), "code": e.code}, None)
+        return
+    except Exception as e:  # noqa: BLE001
+        await send({"kind": "error", "message": str(e), "code": 500}, None)
+        return
+    await send({"kind": "prologue"}, None)
+
+    def enc(item):
+        if isinstance(item, (bytes, bytearray)):
+            return {"kind": "data", "ctype": "bin"}, bytes(item)
+        return {"kind": "data"}, json.dumps(item).encode()
+
+    try:
+        if have_first:
+            await send(*enc(first))
+            async for item in stream:
+                await send(*enc(item))
+        await send({"kind": "sentinel"}, None)
+    except (ConnectionResetError, BrokenPipeError):
+        raise
+    except Exception as e:  # noqa: BLE001 - mid-stream failure
+        try:
+            await send({"kind": "error", "message": str(e), "code": 500},
+                       None)
+        except Exception:
+            pass
+
+
 @dataclass
 class StreamingRequest:
     """A client-streamed request: a JSON meta header plus a sequence of raw
@@ -87,6 +127,7 @@ class DistributedRuntime:
         self.worker_id: int = 0
         self._advertise_host = advertise_host
         self._dp_server: Optional[asyncio.base_events.Server] = None
+        self._native_dp = None   # native (C++) data plane when enabled
         self.dp_host: Optional[str] = None
         self.dp_port: Optional[int] = None
         self._handlers: Dict[str, Handler] = {}
@@ -106,6 +147,9 @@ class DistributedRuntime:
                 pass
         if self._dp_server:
             self._dp_server.close()
+        if self._native_dp is not None:
+            self._native_dp.stop()
+            self._native_dp = None
         await self.store.close()
 
     def namespace(self, name: str) -> "Namespace":
@@ -115,11 +159,19 @@ class DistributedRuntime:
     # data plane (one TCP server per process, endpoints multiplexed by name)
     # ------------------------------------------------------------------
     async def _ensure_data_plane(self) -> None:
-        if self._dp_server is not None:
+        if self._dp_server is not None or self._native_dp is not None:
             return
-        self._dp_server = await asyncio.start_server(
-            self._serve_conn, "0.0.0.0", 0)
-        self.dp_port = self._dp_server.sockets[0].getsockname()[1]
+        import os
+
+        if os.environ.get("DYNAMO_TPU_DATAPLANE") == "native":
+            from .native_dataplane import NativeDataPlane
+
+            self._native_dp = NativeDataPlane(self)
+            self.dp_port = self._native_dp.start("0.0.0.0", 0)
+        else:
+            self._dp_server = await asyncio.start_server(
+                self._serve_conn, "0.0.0.0", 0)
+            self.dp_port = self._dp_server.sockets[0].getsockname()[1]
         self.dp_host = self._advertise_host or _local_ip()
 
     async def _serve_conn(self, reader: asyncio.StreamReader,
@@ -221,42 +273,12 @@ class DistributedRuntime:
         else:
             watcher = asyncio.create_task(watch_control())
         try:
-            stream = handler(request, ctx)
-            # prologue: the first item may raise before anything is sent —
-            # deliver it as a typed error instead of a broken stream
-            try:
-                first = await stream.__anext__()
-                have_first = True
-            except StopAsyncIteration:
-                have_first = False
-            except EngineError as e:
-                await write_frame(writer, [{"kind": "error", "message": str(e),
-                                            "code": e.code}, None])
-                return
-            except Exception as e:  # noqa: BLE001
-                await write_frame(writer, [{"kind": "error", "message": str(e),
-                                            "code": 500}, None])
-                return
-            await write_frame(writer, [{"kind": "prologue"}, None])
+            async def send(control, payload):
+                await write_frame(writer, [control, payload])
 
-            def enc(item):
-                if isinstance(item, (bytes, bytearray)):
-                    return {"kind": "data", "ctype": "bin"}, bytes(item)
-                return {"kind": "data"}, json.dumps(item).encode()
-
-            if have_first:
-                await write_frame(writer, list(enc(first)))
-                async for item in stream:
-                    await write_frame(writer, list(enc(item)))
-            await write_frame(writer, [{"kind": "sentinel"}, None])
+            await drive_handler_stream(handler(request, ctx), send)
         except (ConnectionResetError, BrokenPipeError):
             ctx.stop_generating()
-        except Exception as e:  # noqa: BLE001 - mid-stream failure
-            try:
-                await write_frame(writer, [{"kind": "error", "message": str(e),
-                                            "code": 500}, None])
-            except Exception:
-                pass
         finally:
             if watcher is not None:
                 watcher.cancel()

@@ -1,0 +1,213 @@
+"""ctypes binding for the native (C++) data-plane server.
+
+``DYNAMO_TPU_DATAPLANE=native`` makes :class:`DistributedRuntime` serve its
+endpoints through ``native/build/libdynamo_dataplane.so``: connection
+accept, frame parsing, write buffering and stop/kill demultiplexing run on
+a native epoll thread, and only request EXECUTION crosses into Python —
+the C side calls back with (stream id, endpoint, payload), the handler's
+response items are packed here and queued back through ``dp_send``.
+
+The Python asyncio server (component.py ``_serve_conn``) keeps identical
+wire semantics and remains the test fixture; this module re-implements the
+request-runner contract (prologue, error-before-stream, data/sentinel
+frames, duplicate-context guard, streaming request parts) against the C
+ABI. Reference capability: lib/runtime/src/pipeline/network ingress +
+tcp/server.rs — the reference's native response plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import json
+import logging
+import os
+from typing import Any, Dict, Optional
+
+from .wire import pack
+from .engine import Context, EngineError
+
+log = logging.getLogger("dynamo_tpu.native_dataplane")
+
+_REQUEST_CB = ctypes.CFUNCTYPE(None, ctypes.c_int64, ctypes.c_char_p,
+                               ctypes.c_char_p, ctypes.c_char_p,
+                               ctypes.POINTER(ctypes.c_uint8),
+                               ctypes.c_uint64, ctypes.c_int)
+_PART_CB = ctypes.CFUNCTYPE(None, ctypes.c_int64,
+                            ctypes.POINTER(ctypes.c_uint8),
+                            ctypes.c_uint64, ctypes.c_int)
+_CONTROL_CB = ctypes.CFUNCTYPE(None, ctypes.c_int64, ctypes.c_int)
+
+_STOP, _KILL, _GONE = 0, 1, 2
+
+
+def _load_lib() -> ctypes.CDLL:
+    from .store_server import build_native
+
+    build_dir = build_native("build/libdynamo_dataplane.so")
+    lib = ctypes.CDLL(os.path.join(build_dir, "libdynamo_dataplane.so"))
+    lib.dp_start.restype = ctypes.c_void_p
+    lib.dp_start.argtypes = [ctypes.c_char_p, ctypes.c_int, _REQUEST_CB,
+                             _PART_CB, _CONTROL_CB]
+    lib.dp_port.restype = ctypes.c_int
+    lib.dp_port.argtypes = [ctypes.c_void_p]
+    lib.dp_send.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64]
+    lib.dp_end.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.dp_stop.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class NativeDataPlane:
+    """One per process (like the asyncio data-plane server)."""
+
+    def __init__(self, drt):
+        self.drt = drt          # handlers + active-context registry live here
+        self.lib = _load_lib()
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.handle: Optional[int] = None
+        self.port: int = 0
+        self._contexts: Dict[int, Context] = {}
+        self._part_queues: Dict[int, asyncio.Queue] = {}
+        # keep callback objects alive for the lifetime of the server
+        self._cb_request = _REQUEST_CB(self._on_request)
+        self._cb_part = _PART_CB(self._on_part)
+        self._cb_control = _CONTROL_CB(self._on_control)
+
+    def start(self, host: str = "0.0.0.0", port: int = 0) -> int:
+        self.loop = asyncio.get_running_loop()
+        self.handle = self.lib.dp_start(host.encode(), port,
+                                        self._cb_request, self._cb_part,
+                                        self._cb_control)
+        if not self.handle:
+            raise RuntimeError("native data plane failed to start")
+        self.port = self.lib.dp_port(self.handle)
+        return self.port
+
+    def stop(self) -> None:
+        if self.handle:
+            self.lib.dp_stop(self.handle)
+            self.handle = None
+
+    # ------------------------------------------------------------------
+    # C-thread callbacks: copy data out, hop onto the asyncio loop
+    # ------------------------------------------------------------------
+    def _on_request(self, sid, endpoint, ctx_id, ctype, payload, length,
+                    streaming):
+        data = ctypes.string_at(payload, length) if length else b""
+        self.loop.call_soon_threadsafe(
+            self._begin, sid, (endpoint or b"").decode(),
+            (ctx_id or b"").decode() or None, (ctype or b"").decode(),
+            data, bool(streaming))
+
+    def _on_part(self, sid, data, length, is_end):
+        chunk = ctypes.string_at(data, length) if length else b""
+        self.loop.call_soon_threadsafe(self._deliver_part, sid,
+                                       chunk, bool(is_end))
+
+    def _on_control(self, sid, kind):
+        self.loop.call_soon_threadsafe(self._control, sid, kind)
+
+    # ------------------------------------------------------------------
+    def _send(self, sid: int, control: Dict[str, Any],
+              payload: Optional[bytes]) -> None:
+        if not self.handle:
+            return   # server stopped with streams in flight: drop
+        frame = pack([control, payload])
+        buf = (ctypes.c_uint8 * len(frame)).from_buffer_copy(frame)
+        self.lib.dp_send(self.handle, sid, buf, len(frame))
+
+    def _end(self, sid: int) -> None:
+        if self.handle:
+            self.lib.dp_end(self.handle, sid)
+
+    def _deliver_part(self, sid: int, chunk: bytes, is_end: bool) -> None:
+        q = self._part_queues.get(sid)
+        if q is not None:
+            q.put_nowait(None if is_end else chunk)
+
+    def _control(self, sid: int, kind: int) -> None:
+        ctx = self._contexts.get(sid)
+        if ctx is not None:
+            if kind == _KILL:
+                ctx.kill()
+            else:       # stop, or client gone mid-stream
+                ctx.stop_generating()
+        if kind in (_KILL, _GONE):
+            # a handler blocked on request parts must unblock: the client
+            # can never send the 'end' frame now
+            self._deliver_part(sid, b"", True)
+
+    # ------------------------------------------------------------------
+    def _begin(self, sid: int, endpoint: str, ctx_id: Optional[str],
+               ctype: str, payload: bytes, streaming: bool) -> None:
+        if streaming:
+            # register the part queue NOW: part/end callbacks already queued
+            # behind this one on the loop must find it (the _run coroutine
+            # itself only starts a loop tick later)
+            self._part_queues[sid] = asyncio.Queue()
+        asyncio.ensure_future(
+            self._run(sid, endpoint, ctx_id, ctype, payload, streaming))
+
+    async def _run(self, sid: int, endpoint: str, ctx_id: Optional[str],
+                   ctype: str, payload: bytes, streaming: bool) -> None:
+        drt = self.drt
+        handler = drt._handlers.get(endpoint)
+        if handler is None:
+            self._part_queues.pop(sid, None)
+            self._send(sid, {"kind": "error", "code": 404,
+                             "message": f"no endpoint {endpoint!r}"}, None)
+            self._end(sid)
+            return
+        if ctx_id is not None and ctx_id in drt._active:
+            self._part_queues.pop(sid, None)
+            self._send(sid, {"kind": "error", "code": 409,
+                             "message": f"context {ctx_id} is already "
+                                        f"executing (duplicate delivery)"},
+                       None)
+            self._end(sid)
+            return
+        request: Any
+        if ctype == "bin":
+            request = payload
+        else:
+            request = json.loads(payload.decode()) if payload else None
+        ctx = Context(ctx_id)
+        drt._active[ctx.id] = ctx
+        self._contexts[sid] = ctx
+        from ..utils.logging_ext import request_id_var
+        rid_token = request_id_var.set(ctx.id)
+
+        if streaming:
+            from .component import StreamingRequest
+
+            q = self._part_queues[sid]
+
+            async def parts_gen():
+                while True:
+                    chunk = await q.get()
+                    if chunk is None:
+                        return
+                    yield chunk
+
+            request = StreamingRequest(meta=request, parts=parts_gen())
+
+        try:
+            from .component import drive_handler_stream
+
+            async def send(control, payload):
+                self._send(sid, control, payload)
+
+            await drive_handler_stream(handler(request, ctx), send)
+        except Exception as e:  # noqa: BLE001 - transport-level failure
+            try:
+                self._send(sid, {"kind": "error", "message": str(e),
+                                 "code": 500}, None)
+            except Exception:
+                pass
+        finally:
+            drt._active.pop(ctx.id, None)
+            self._contexts.pop(sid, None)
+            self._part_queues.pop(sid, None)
+            request_id_var.reset(rid_token)
+            self._end(sid)
