@@ -37,7 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..llm.model_card import ModelDeploymentCard
 from ..llm.protocols.common import BackendInput, EngineOutput, FinishReason
 from ..models import llama
-from ..parallel.mesh import AXIS_TP, sp_tp_mesh, tp_mesh
+from ..parallel.mesh import AXIS_TP, serving_mesh
 from ..runtime.engine import AsyncEngine, Context
 from .cache import OutOfPages, PagePool
 from .sampling import STATIC_K, SamplingState, sample
@@ -72,6 +72,7 @@ class JaxEngineConfig:
     model: llama.LlamaConfig
     tp: int = 1
     sp: int = 1                         # sequence-parallel (ring) axis size
+    ep: int = 1                         # expert-parallel axis size (MoE)
     page_size: int = 64
     max_batch: int = 8
     max_context: int = 2048
@@ -106,7 +107,7 @@ class JaxEngineConfig:
             page_size=card.kv_block_size,
             params_path=card.path,
         )
-        for k in ("sp", "max_batch", "max_context", "prefill_chunk",
+        for k in ("sp", "ep", "max_batch", "max_context", "prefill_chunk",
                   "num_pages", "decode_steps", "seed", "preset", "attn_impl",
                   "enable_prefix_reuse", "host_cache_blocks",
                   "disk_cache_blocks", "disk_cache_path"):
@@ -149,9 +150,8 @@ class EngineCore:
                  devices: Optional[List[jax.Device]] = None):
         self.cfg = cfg
         m = cfg.model
-        llama.validate_tp(m, cfg.tp)
-        self.mesh = (sp_tp_mesh(cfg.sp, cfg.tp, devices) if cfg.sp > 1
-                     else tp_mesh(cfg.tp, devices))
+        llama.validate_tp(m, cfg.tp, cfg.ep)
+        self.mesh = serving_mesh(cfg.tp, cfg.sp, cfg.ep, devices)
         self.page_size = cfg.page_size
         # every sequence may overshoot up to 2*decode_steps speculative
         # tokens: one dispatch in flight plus one chained behind it
@@ -163,9 +163,13 @@ class EngineCore:
         self.pool = PagePool(num_pages, cfg.page_size)
 
         # --- params ---------------------------------------------------
+        # sharding() drops spec axes the mesh doesn't carry (e.g. the ep
+        # axis of MoE expert weights on an ep=1 mesh)
+        from ..parallel.mesh import sharding as mk_sharding
+
         specs = llama.param_specs(m, cfg.tp)
         shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), specs,
+            lambda s: mk_sharding(self.mesh, *s), specs,
             is_leaf=lambda x: isinstance(x, P))
         if cfg.params_path and _has_safetensors(cfg.params_path):
             from .loader import load_llama_params

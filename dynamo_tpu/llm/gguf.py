@@ -1,0 +1,279 @@
+"""GGUF model file support: metadata, config, tokenizer and tensor loading.
+
+Parses the GGUF v2/v3 container format (llama.cpp's model distribution
+format): header, string-keyed typed metadata, and the tensor directory. A
+llama-architecture GGUF maps onto :class:`~dynamo_tpu.models.llama.
+LlamaConfig` and the stacked param pytree the engine serves; F32/F16
+tensors load directly (quantized blocks are recognized but rejected with a
+clear error — dequantization kernels are engine roadmap, not container
+parsing).
+
+Reference capability: lib/llm/src/gguf/{content,gguf_metadata,
+gguf_tokenizer}.rs (~950 LoC: metadata parse, tokenizer build, model
+config) — the reference loads GGUF for mistralrs/llamacpp engines and model
+cards.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any, BinaryIO, Dict, List, Optional, Tuple
+
+import numpy as np
+
+GGUF_MAGIC = 0x46554747  # "GGUF" little-endian
+
+# metadata value types (gguf spec)
+_U8, _I8, _U16, _I16, _U32, _I32, _F32, _BOOL, _STR, _ARR, _U64, _I64, _F64 \
+    = range(13)
+
+_SCALAR_FMT = {_U8: "<B", _I8: "<b", _U16: "<H", _I16: "<h", _U32: "<I",
+               _I32: "<i", _F32: "<f", _BOOL: "<?", _U64: "<Q", _I64: "<q",
+               _F64: "<d"}
+
+# tensor ggml dtypes we can load (unquantized)
+_GGML_F32, _GGML_F16 = 0, 1
+_GGML_NAMES = {0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1", 6: "Q5_0",
+               7: "Q5_1", 8: "Q8_0", 9: "Q8_1", 10: "Q2_K", 11: "Q3_K",
+               12: "Q4_K", 13: "Q5_K", 14: "Q6_K", 16: "BF16"}
+
+
+@dataclass
+class GGUFTensorInfo:
+    name: str
+    shape: Tuple[int, ...]      # logical shape, row-major (numpy order)
+    ggml_type: int
+    offset: int                 # within the data section
+
+
+@dataclass
+class GGUFFile:
+    version: int
+    metadata: Dict[str, Any]
+    tensors: Dict[str, GGUFTensorInfo]
+    data_start: int
+    path: str
+
+    # ------------------------------------------------------------------
+    def architecture(self) -> str:
+        return self.metadata.get("general.architecture", "")
+
+    def llama_config(self):
+        """Map llama-architecture metadata onto LlamaConfig."""
+        from ..models.llama import LlamaConfig
+
+        md = self.metadata
+        arch = self.architecture()
+        if arch != "llama":
+            raise ValueError(f"not a llama-architecture GGUF: {arch!r}")
+
+        def g(key, default=None):
+            return md.get(f"{arch}.{key}", default)
+
+        n_heads = int(g("attention.head_count"))
+        emb = int(g("embedding_length"))
+        vocab = md.get("tokenizer.ggml.tokens")
+        vocab_size = (int(md["llama.vocab_size"])
+                      if "llama.vocab_size" in md
+                      else len(vocab) if vocab else 32000)
+        return LlamaConfig(
+            tie_embeddings="output.weight" not in self.tensors,
+            vocab_size=vocab_size,
+            hidden_size=emb,
+            num_layers=int(g("block_count")),
+            num_heads=n_heads,
+            num_kv_heads=int(g("attention.head_count_kv", n_heads)),
+            head_dim=int(g("attention.key_length", emb // n_heads)),
+            intermediate_size=int(g("feed_forward_length")),
+            rope_theta=float(g("rope.freq_base", 10000.0)),
+            rms_eps=float(g("attention.layer_norm_rms_epsilon", 1e-5)),
+            max_position=int(g("context_length", 8192)),
+        )
+
+    def tokenizer_vocab(self) -> Optional[List[str]]:
+        return self.metadata.get("tokenizer.ggml.tokens")
+
+    def load_tensor(self, name: str) -> np.ndarray:
+        info = self.tensors[name]
+        if info.ggml_type not in (_GGML_F32, _GGML_F16):
+            tname = _GGML_NAMES.get(info.ggml_type, str(info.ggml_type))
+            raise NotImplementedError(
+                f"tensor {name!r} uses quantized ggml type {tname}; only "
+                f"F32/F16 GGUF tensors are loadable (dequantize the model "
+                f"or export unquantized)")
+        dtype = np.float32 if info.ggml_type == _GGML_F32 else np.float16
+        count = int(np.prod(info.shape)) if info.shape else 1
+        with open(self.path, "rb") as f:
+            f.seek(self.data_start + info.offset)
+            raw = f.read(count * dtype().itemsize)
+        return np.frombuffer(raw, dtype=dtype).reshape(info.shape)
+
+
+# ---------------------------------------------------------------------------
+# parse
+# ---------------------------------------------------------------------------
+
+def _read_str(f: BinaryIO) -> str:
+    (n,) = struct.unpack("<Q", f.read(8))
+    return f.read(n).decode("utf-8", errors="replace")
+
+
+def _read_value(f: BinaryIO, vtype: int) -> Any:
+    if vtype in _SCALAR_FMT:
+        fmt = _SCALAR_FMT[vtype]
+        (v,) = struct.unpack(fmt, f.read(struct.calcsize(fmt)))
+        return v
+    if vtype == _STR:
+        return _read_str(f)
+    if vtype == _ARR:
+        (etype,) = struct.unpack("<I", f.read(4))
+        (count,) = struct.unpack("<Q", f.read(8))
+        return [_read_value(f, etype) for _ in range(count)]
+    raise ValueError(f"unknown gguf metadata type {vtype}")
+
+
+def read_gguf(path: str) -> GGUFFile:
+    """Parse header + metadata + tensor directory (tensors load lazily)."""
+    with open(path, "rb") as f:
+        magic, version = struct.unpack("<II", f.read(8))
+        if magic != GGUF_MAGIC:
+            raise ValueError(f"{path}: not a GGUF file")
+        if version not in (2, 3):
+            raise ValueError(f"{path}: unsupported GGUF version {version}")
+        n_tensors, n_kv = struct.unpack("<QQ", f.read(16))
+
+        metadata: Dict[str, Any] = {}
+        for _ in range(n_kv):
+            key = _read_str(f)
+            (vtype,) = struct.unpack("<I", f.read(4))
+            metadata[key] = _read_value(f, vtype)
+
+        tensors: Dict[str, GGUFTensorInfo] = {}
+        for _ in range(n_tensors):
+            name = _read_str(f)
+            (ndim,) = struct.unpack("<I", f.read(4))
+            dims = struct.unpack(f"<{ndim}Q", f.read(8 * ndim))
+            (ggml_type,) = struct.unpack("<I", f.read(4))
+            (offset,) = struct.unpack("<Q", f.read(8))
+            # gguf stores dims innermost-first; numpy wants outermost-first
+            tensors[name] = GGUFTensorInfo(name, tuple(reversed(dims)),
+                                           ggml_type, offset)
+
+        align = int(metadata.get("general.alignment", 32))
+        pos = f.tell()
+        data_start = (pos + align - 1) // align * align
+    return GGUFFile(version, metadata, tensors, data_start, path)
+
+
+# ---------------------------------------------------------------------------
+# llama param mapping (gguf tensor names -> our stacked pytree)
+# ---------------------------------------------------------------------------
+
+def load_llama_params_gguf(path: str, cfg=None,
+                           shardings: Optional[Dict[str, Any]] = None,
+                           dtype=None) -> Tuple[Any, Dict[str, Any]]:
+    """Load a llama GGUF into (config, stacked param pytree). With
+    ``shardings`` each tensor is placed straight into its NamedSharding."""
+    import jax
+    import jax.numpy as jnp
+
+    g = read_gguf(path)
+    if cfg is None:
+        cfg = g.llama_config()
+    dt = np.dtype(jnp.bfloat16 if dtype is None else dtype)
+    L, D, Hq, Hkv, Dh = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
+                         cfg.num_kv_heads, cfg.head_dim)
+
+    def t(name):
+        return g.load_tensor(name)
+
+    def stack(fmt, transform):
+        return np.stack([transform(t(fmt.format(i))) for i in range(L)])
+
+    params: Dict[str, Any] = {
+        "embed": t("token_embd.weight").astype(dt),
+        "layers": {
+            "ln1": stack("blk.{}.attn_norm.weight",
+                         lambda w: w.astype(np.float32)),
+            "ln2": stack("blk.{}.ffn_norm.weight",
+                         lambda w: w.astype(np.float32)),
+            "wq": stack("blk.{}.attn_q.weight",
+                        lambda w: w.astype(dt).T.reshape(D, Hq, Dh)),
+            "wk": stack("blk.{}.attn_k.weight",
+                        lambda w: w.astype(dt).T.reshape(D, Hkv, Dh)),
+            "wv": stack("blk.{}.attn_v.weight",
+                        lambda w: w.astype(dt).T.reshape(D, Hkv, Dh)),
+            "wo": stack("blk.{}.attn_output.weight",
+                        lambda w: w.astype(dt).T.reshape(Hq, Dh, D)),
+            "wg": stack("blk.{}.ffn_gate.weight", lambda w: w.astype(dt).T),
+            "wu": stack("blk.{}.ffn_up.weight", lambda w: w.astype(dt).T),
+            "wd": stack("blk.{}.ffn_down.weight", lambda w: w.astype(dt).T),
+        },
+        "final_norm": t("output_norm.weight").astype(np.float32),
+    }
+    if "output.weight" in g.tensors:
+        params["lm_head"] = t("output.weight").astype(dt).T
+    if shardings is not None:
+        from ..engine.engine import global_put
+
+        params = jax.tree.map(lambda a, s: global_put(a, s),
+                              params, shardings)
+    return cfg, params
+
+
+def write_gguf(path: str, metadata: Dict[str, Any],
+               tensors: Dict[str, np.ndarray]) -> None:
+    """Minimal GGUF v3 writer (F32 tensors) — test fixture / export path."""
+    def pstr(s: str) -> bytes:
+        b = s.encode()
+        return struct.pack("<Q", len(b)) + b
+
+    def pval(v) -> bytes:
+        if isinstance(v, bool):
+            return struct.pack("<I", _BOOL) + struct.pack("<?", v)
+        if isinstance(v, int):
+            return struct.pack("<I", _I64) + struct.pack("<q", v)
+        if isinstance(v, float):
+            return struct.pack("<I", _F64) + struct.pack("<d", v)
+        if isinstance(v, str):
+            return struct.pack("<I", _STR) + pstr(v)
+        if isinstance(v, list):
+            if v and isinstance(v[0], str):
+                body = b"".join(pstr(x) for x in v)
+                return (struct.pack("<I", _ARR) + struct.pack("<I", _STR)
+                        + struct.pack("<Q", len(v)) + body)
+            body = b"".join(struct.pack("<q", int(x)) for x in v)
+            return (struct.pack("<I", _ARR) + struct.pack("<I", _I64)
+                    + struct.pack("<Q", len(v)) + body)
+        raise TypeError(f"unsupported metadata value {type(v)}")
+
+    align = 32
+    out = bytearray()
+    out += struct.pack("<II", GGUF_MAGIC, 3)
+    out += struct.pack("<QQ", len(tensors), len(metadata) + 1)
+    out += pstr("general.alignment") + struct.pack("<I", _I64) \
+        + struct.pack("<q", align)
+    for k, v in metadata.items():
+        out += pstr(k) + pval(v)
+
+    data = bytearray()
+    infos = []
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr, dtype=np.float32)
+        off = len(data)
+        data += arr.tobytes()
+        pad = (-len(data)) % align
+        data += b"\x00" * pad
+        infos.append((name, arr.shape, off))
+    for name, shape, off in infos:
+        out += pstr(name)
+        out += struct.pack("<I", len(shape))
+        for d in reversed(shape):          # gguf dims innermost-first
+            out += struct.pack("<Q", d)
+        out += struct.pack("<I", _GGML_F32)
+        out += struct.pack("<Q", off)
+    pad = (-len(out)) % align
+    out += b"\x00" * pad
+    with open(path, "wb") as f:
+        f.write(out + data)

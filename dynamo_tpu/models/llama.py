@@ -53,6 +53,9 @@ class LlamaConfig:
     max_position: int = 8192
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
+    # MoE (0 experts = dense FFN). Experts shard over the ep mesh axis.
+    num_experts: int = 0
+    experts_per_token: int = 2
 
     @classmethod
     def from_hf_config(cls, cfg: Dict[str, Any], dtype=jnp.bfloat16) -> "LlamaConfig":
@@ -81,6 +84,11 @@ PRESETS: Dict[str, Dict[str, Any]] = {
     "tiny-byte": dict(vocab_size=259, hidden_size=64, num_layers=2, num_heads=4,
                       num_kv_heads=2, head_dim=16, intermediate_size=128,
                       rope_theta=10000.0, max_position=1024),
+    # tiny MoE over the byte vocab: 4 experts, top-2 routing (EP tests)
+    "tiny-moe": dict(vocab_size=259, hidden_size=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, head_dim=16, intermediate_size=96,
+                     rope_theta=10000.0, max_position=1024, num_experts=4,
+                     experts_per_token=2),
     "llama-3.2-1b": dict(vocab_size=128256, hidden_size=2048, num_layers=16,
                          num_heads=32, num_kv_heads=8, head_dim=64,
                          intermediate_size=8192, rope_theta=500000.0,
@@ -117,6 +125,20 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
     def norm(k, *shape):
         return (jax.random.normal(k, shape, jnp.float32) * s(*shape)).astype(cfg.dtype)
 
+    E = cfg.num_experts
+    if E:
+        ffn = {
+            "wr": norm(ks[9], L, D, E),
+            "wg": norm(ks[5], L, E, D, F),
+            "wu": norm(ks[6], L, E, D, F),
+            "wd": norm(ks[7], L, E, F, D),
+        }
+    else:
+        ffn = {
+            "wg": norm(ks[5], L, D, F),
+            "wu": norm(ks[6], L, D, F),
+            "wd": norm(ks[7], L, F, D),
+        }
     params = {
         "embed": norm(ks[0], V, D),
         "layers": {
@@ -126,9 +148,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
             "wk": norm(ks[2], L, D, Hkv * Dh).reshape(L, D, Hkv, Dh),
             "wv": norm(ks[3], L, D, Hkv * Dh).reshape(L, D, Hkv, Dh),
             "wo": norm(ks[4], L, Hq * Dh, D).reshape(L, Hq, Dh, D),
-            "wg": norm(ks[5], L, D, F),
-            "wu": norm(ks[6], L, D, F),
-            "wd": norm(ks[7], L, F, D),
+            **ffn,
         },
         "final_norm": jnp.ones((D,), jnp.float32),
     }
@@ -141,8 +161,25 @@ def param_specs(cfg: LlamaConfig, tp_size: int = 1) -> Dict[str, Any]:
     """PartitionSpecs: tp shards attention heads and the ffn dimension.
     KV projections replicate when GQA kv_heads aren't divisible by tp.
     (vocab/embed replicated — vocab-sharding is a later optimization.)"""
+    from ..parallel.mesh import AXIS_EP
+
     tp = AXIS_TP
     kv = tp if cfg.num_kv_heads % max(tp_size, 1) == 0 else None
+    if cfg.num_experts:
+        # experts shard over ep ([L, E, D, F] / [L, E, F, D]); router
+        # replicated. (tp inside expert FFNs is a later optimization.)
+        ffn = {
+            "wr": P(None, None, None),
+            "wg": P(None, AXIS_EP, None, None),
+            "wu": P(None, AXIS_EP, None, None),
+            "wd": P(None, AXIS_EP, None, None),
+        }
+    else:
+        ffn = {
+            "wg": P(None, None, tp),
+            "wu": P(None, None, tp),
+            "wd": P(None, tp, None),
+        }
     specs = {
         "embed": P(None, None),
         "layers": {
@@ -152,9 +189,7 @@ def param_specs(cfg: LlamaConfig, tp_size: int = 1) -> Dict[str, Any]:
             "wk": P(None, None, kv, None),
             "wv": P(None, None, kv, None),
             "wo": P(None, tp, None, None),
-            "wg": P(None, None, tp),
-            "wu": P(None, None, tp),
-            "wd": P(None, tp, None),
+            **ffn,
         },
         "final_norm": P(None),
     }
@@ -163,11 +198,17 @@ def param_specs(cfg: LlamaConfig, tp_size: int = 1) -> Dict[str, Any]:
     return specs
 
 
-def validate_tp(cfg: LlamaConfig, tp: int) -> None:
+def validate_tp(cfg: LlamaConfig, tp: int, ep: int = 1) -> None:
     if cfg.num_heads % tp:
         raise ValueError(f"num_heads {cfg.num_heads} not divisible by tp={tp}")
-    if cfg.intermediate_size % tp:
+    if not cfg.num_experts and cfg.intermediate_size % tp:
         raise ValueError(f"ffn {cfg.intermediate_size} not divisible by tp={tp}")
+    if ep > 1:
+        if not cfg.num_experts:
+            raise ValueError("ep > 1 needs an MoE model (num_experts > 0)")
+        if cfg.num_experts % ep:
+            raise ValueError(f"num_experts {cfg.num_experts} not divisible "
+                             f"by ep={ep}")
 
 
 def kv_cache_spec(cfg: LlamaConfig, tp: int) -> P:
@@ -342,9 +383,15 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
             attn = attend(q, k_ctx, v_ctx, mask)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"][l])
         h2 = rms_norm(x, lp["ln2"][l], cfg.rms_eps)
-        g = jnp.einsum("btd,df->btf", h2, lp["wg"][l])
-        u = jnp.einsum("btd,df->btf", h2, lp["wu"][l])
-        x = x + jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u, lp["wd"][l])
+        if cfg.num_experts:
+            from .moe import moe_ffn
+            x = x + moe_ffn(h2, lp["wr"][l], lp["wg"][l], lp["wu"][l],
+                            lp["wd"][l], cfg.experts_per_token, mesh=mesh)
+        else:
+            g = jnp.einsum("btd,df->btf", h2, lp["wg"][l])
+            u = jnp.einsum("btd,df->btf", h2, lp["wu"][l])
+            x = x + jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u,
+                               lp["wd"][l])
 
     if logits_idx is not None:
         x = jnp.take_along_axis(
@@ -451,9 +498,14 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
             attn = attend(q, k_ctx, v_ctx, mask)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"][l])
         h2 = rms_norm(x, lp["ln2"][l], cfg.rms_eps)
-        g = jnp.einsum("btd,df->btf", h2, lp["wg"][l])
-        u = jnp.einsum("btd,df->btf", h2, lp["wu"][l])
-        x = x + jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u, lp["wd"][l])
+        if cfg.num_experts:
+            from .moe import moe_ffn
+            x = x + moe_ffn(h2, lp["wr"][l], lp["wg"][l], lp["wu"][l],
+                            lp["wd"][l], cfg.experts_per_token, mesh=mesh)
+        else:
+            g = jnp.einsum("btd,df->btf", h2, lp["wg"][l])
+            u = jnp.einsum("btd,df->btf", h2, lp["wu"][l])
+            x = x + jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u, lp["wd"][l])
 
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]

@@ -1,0 +1,76 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``ep`` mesh axis.
+
+Mixtral-style block: a router picks ``top_k`` of ``E`` experts per token;
+each expert is a SwiGLU FFN; outputs combine weighted by renormalized
+router probabilities. Under expert parallelism the expert dimension of the
+weights is sharded over ``ep`` — each shard computes only its local
+experts' contribution for the full token batch and a ``psum`` over the ep
+axis combines them (gate weights for non-local experts are zero on each
+shard, so the sum is exact).
+
+This dense-dispatch formulation (every local expert sees every token) is
+compile-friendly and exact; capacity-based sorted dispatch is a later
+throughput optimization, not a semantic change.
+
+Reference capability: the reference inherits MoE/EP from its engines
+(SURVEY §2.5 — vllm patch touches deepseek_v2.py); on TPU the in-tree
+engine owns it, so this module IS the capability.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import AXIS_EP
+
+
+def _ep_size(mesh) -> int:
+    if mesh is None or AXIS_EP not in mesh.axis_names:
+        return 1
+    return mesh.shape[AXIS_EP]
+
+
+def moe_ffn(x: jax.Array,           # [B, T, D]
+            wr: jax.Array,          # [D, E] router
+            wg: jax.Array,          # [E, D, F] expert gate projections
+            wu: jax.Array,          # [E, D, F] expert up projections
+            wd: jax.Array,          # [E, F, D] expert down projections
+            top_k: int,
+            mesh=None) -> jax.Array:
+    """Routed MoE feed-forward. Returns [B, T, D] in x.dtype."""
+    E = wr.shape[1]
+    logits = jnp.einsum("btd,de->bte", x, wr.astype(x.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    vals, idx = jax.lax.top_k(probs, top_k)               # [B,T,K]
+    vals = vals / jnp.sum(vals, axis=-1, keepdims=True)   # renormalize
+    gates = jnp.sum(jax.nn.one_hot(idx, E, dtype=jnp.float32)
+                    * vals[..., None], axis=-2)           # [B,T,E]
+
+    def experts(x, wg, wu, wd, gates):
+        # shapes per shard: wg/wu [El, D, F], wd [El, F, D], gates [B,T,El]
+        g = jnp.einsum("btd,edf->btef", x, wg)
+        u = jnp.einsum("btd,edf->btef", x, wu)
+        a = jax.nn.silu(g) * u
+        return jnp.einsum("btef,efd,bte->btd", a, wd,
+                          gates.astype(x.dtype))
+
+    ep = _ep_size(mesh)
+    if ep <= 1:
+        return experts(x, wg, wu, wd, gates)
+
+    def local(x, wg, wu, wd, gates):
+        y = experts(x, wg, wu, wd, gates)
+        return jax.lax.psum(y, AXIS_EP)
+
+    espec = P(AXIS_EP, None, None)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, None, None), espec, espec, espec,
+                  P(None, None, AXIS_EP)),
+        out_specs=P(None, None, None),
+        check_vma=False,
+    )(x, wg, wu, wd, gates)

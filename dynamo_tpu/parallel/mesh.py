@@ -72,6 +72,23 @@ def sp_tp_mesh(sp: int, tp: int,
     return Mesh(devs, (AXIS_SP, AXIS_TP))
 
 
+def serving_mesh(tp: int = 1, sp: int = 1, ep: int = 1,
+                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Engine mesh with exactly the axes in use: sp (ring prefill,
+    outermost), ep (experts), tp (innermost, so tp collectives ride
+    neighbor ICI links). Axes of size 1 other than tp are omitted."""
+    devices = list(devices if devices is not None else jax.devices())
+    axes = [(AXIS_SP, sp), (AXIS_EP, ep), (AXIS_TP, tp)]
+    axes = [(n, s) for n, s in axes if s > 1 or n == AXIS_TP]
+    total = math.prod(s for _, s in axes)
+    if total > len(devices):
+        raise ValueError(
+            f"serving mesh tp={tp} sp={sp} ep={ep} needs {total} devices, "
+            f"have {len(devices)}")
+    devs = np.array(devices[:total]).reshape([s for _, s in axes])
+    return Mesh(devs, tuple(n for n, _ in axes))
+
+
 def sharding(mesh: Mesh, *spec) -> NamedSharding:
     # drop axis names the mesh doesn't have (lets one spec serve 1-D and 4-D)
     names = set(mesh.axis_names)

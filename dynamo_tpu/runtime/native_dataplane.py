@@ -54,12 +54,16 @@ def _load_lib() -> ctypes.CDLL:
     lib.dp_send.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                             ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64]
     lib.dp_end.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.dp_backlog.restype = ctypes.c_int64
+    lib.dp_backlog.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.dp_stop.argtypes = [ctypes.c_void_p]
     return lib
 
 
 class NativeDataPlane:
     """One per process (like the asyncio data-plane server)."""
+
+    HIGH_WATER = 8 * 1024 * 1024   # pause the producer above this backlog
 
     def __init__(self, drt):
         self.drt = drt          # handlers + active-context registry live here
@@ -121,6 +125,11 @@ class NativeDataPlane:
         if self.handle:
             self.lib.dp_end(self.handle, sid)
 
+    def _backlog(self, sid: int) -> int:
+        if not self.handle:
+            return 0
+        return max(0, self.lib.dp_backlog(self.handle, sid))
+
     def _deliver_part(self, sid: int, chunk: bytes, is_end: bool) -> None:
         q = self._part_queues.get(sid)
         if q is not None:
@@ -146,35 +155,45 @@ class NativeDataPlane:
             # behind this one on the loop must find it (the _run coroutine
             # itself only starts a loop tick later)
             self._part_queues[sid] = asyncio.Queue()
+        # the Context too: a stop/kill/disconnect control queued right
+        # behind this callback must find it, or the control is lost and the
+        # handler runs to completion against a dead client
+        ctx = Context(ctx_id)
+        self._contexts[sid] = ctx
         asyncio.ensure_future(
-            self._run(sid, endpoint, ctx_id, ctype, payload, streaming))
+            self._run(sid, endpoint, ctx, ctype, payload, streaming))
 
-    async def _run(self, sid: int, endpoint: str, ctx_id: Optional[str],
+    async def _run(self, sid: int, endpoint: str, ctx: Context,
                    ctype: str, payload: bytes, streaming: bool) -> None:
         drt = self.drt
+
+        def reject(code, message):
+            self._part_queues.pop(sid, None)
+            self._contexts.pop(sid, None)
+            self._send(sid, {"kind": "error", "code": code,
+                             "message": message}, None)
+            self._end(sid)
+
         handler = drt._handlers.get(endpoint)
         if handler is None:
-            self._part_queues.pop(sid, None)
-            self._send(sid, {"kind": "error", "code": 404,
-                             "message": f"no endpoint {endpoint!r}"}, None)
-            self._end(sid)
+            reject(404, f"no endpoint {endpoint!r}")
             return
-        if ctx_id is not None and ctx_id in drt._active:
-            self._part_queues.pop(sid, None)
-            self._send(sid, {"kind": "error", "code": 409,
-                             "message": f"context {ctx_id} is already "
-                                        f"executing (duplicate delivery)"},
-                       None)
-            self._end(sid)
+        # the _begin-created Context uses ctx.id == wire ctx_id (or a fresh
+        # one); a duplicate in-flight id is a stale-retry double delivery
+        if ctx.id in drt._active:
+            reject(409, f"context {ctx.id} is already executing "
+                        f"(duplicate delivery)")
             return
         request: Any
-        if ctype == "bin":
-            request = payload
-        else:
-            request = json.loads(payload.decode()) if payload else None
-        ctx = Context(ctx_id)
+        try:
+            if ctype == "bin":
+                request = payload
+            else:
+                request = json.loads(payload.decode()) if payload else None
+        except (ValueError, UnicodeDecodeError) as e:
+            reject(400, f"malformed request payload: {e}")
+            return
         drt._active[ctx.id] = ctx
-        self._contexts[sid] = ctx
         from ..utils.logging_ext import request_id_var
         rid_token = request_id_var.set(ctx.id)
 
@@ -197,6 +216,11 @@ class NativeDataPlane:
 
             async def send(control, payload):
                 self._send(sid, control, payload)
+                # backpressure: the asyncio path awaited writer.drain();
+                # here the native write buffer is polled so a slow client
+                # cannot grow it without bound
+                while self._backlog(sid) > self.HIGH_WATER:
+                    await asyncio.sleep(0.005)
 
             await drive_handler_stream(handler(request, ctx), send)
         except Exception as e:  # noqa: BLE001 - transport-level failure

@@ -107,11 +107,11 @@ struct Server {
     addr.sin_addr.s_addr =
         host && *host ? inet_addr(host) : htonl(INADDR_ANY);
     if (bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
-      return false;
+      return fail_start();
     socklen_t alen = sizeof(addr);
     getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
     port = ntohs(addr.sin_port);
-    if (listen(lfd, 128) != 0) return false;
+    if (listen(lfd, 128) != 0) return fail_start();
     set_nonblock(lfd);
     efd = epoll_create1(0);
     wakefd = eventfd(0, EFD_NONBLOCK);
@@ -125,6 +125,14 @@ struct Server {
     running = true;
     loop = std::thread([this] { run(); });
     return true;
+  }
+
+  bool fail_start() {
+    // close whatever a failed start() opened so retry loops don't leak fds
+    if (lfd >= 0) { close(lfd); lfd = -1; }
+    if (efd >= 0) { close(efd); efd = -1; }
+    if (wakefd >= 0) { close(wakefd); wakefd = -1; }
+    return false;
   }
 
   void stop() {
@@ -401,6 +409,16 @@ struct Server {
     wake();
   }
 
+  int64_t backlog(int64_t sid) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = sid2fd_.find(sid);
+    if (it == sid2fd_.end()) return -1;
+    auto cit = conns_.find(it->second);
+    if (cit == conns_.end()) return -1;
+    return static_cast<int64_t>(cit->second->wbuf.size()
+                                - cit->second->wstart);
+  }
+
   void end_stream(int64_t sid) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = sid2fd_.find(sid);
@@ -439,6 +457,10 @@ void dp_send(void* h, int64_t sid, const uint8_t* frame, uint64_t len) {
 
 void dp_end(void* h, int64_t sid) {
   static_cast<Server*>(h)->end_stream(sid);
+}
+
+int64_t dp_backlog(void* h, int64_t sid) {
+  return static_cast<Server*>(h)->backlog(sid);
 }
 
 void dp_stop(void* h) {

