@@ -174,6 +174,10 @@ class EngineCore:
         if cfg.params_path and _has_safetensors(cfg.params_path):
             from .loader import load_llama_params
             self.params = load_llama_params(cfg.params_path, m, shardings)
+        elif cfg.params_path and _gguf_file(cfg.params_path):
+            from ..llm.gguf import load_llama_params_gguf
+            _, self.params = load_llama_params_gguf(
+                _gguf_file(cfg.params_path), cfg=m, shardings=shardings)
         else:
             params = llama.init_params(m, jax.random.PRNGKey(cfg.seed))
             self.params = jax.tree.map(
@@ -1069,6 +1073,18 @@ def _has_safetensors(path: str) -> bool:
     import os
 
     return bool(glob.glob(os.path.join(path, "*.safetensors")))
+
+
+def _gguf_file(path: str) -> Optional[str]:
+    """The GGUF weights file for ``path``: the file itself or the first
+    *.gguf inside the directory."""
+    import glob
+    import os
+
+    if os.path.isfile(path) and path.endswith(".gguf"):
+        return path
+    hits = sorted(glob.glob(os.path.join(path, "*.gguf")))
+    return hits[0] if hits else None
 
 
 # ---------------------------------------------------------------------------

@@ -54,6 +54,7 @@ class GGUFFile:
     tensors: Dict[str, GGUFTensorInfo]
     data_start: int
     path: str
+    _fh: Optional[BinaryIO] = field(default=None, repr=False, compare=False)
 
     # ------------------------------------------------------------------
     def architecture(self) -> str:
@@ -104,10 +105,17 @@ class GGUFFile:
                 f"or export unquantized)")
         dtype = np.float32 if info.ggml_type == _GGML_F32 else np.float16
         count = int(np.prod(info.shape)) if info.shape else 1
-        with open(self.path, "rb") as f:
-            f.seek(self.data_start + info.offset)
-            raw = f.read(count * dtype().itemsize)
+        # one persistent handle: bulk loads touch every tensor and a 70B-class
+        # model would otherwise pay hundreds of open/close cycles
+        if self._fh is None or self._fh.closed:
+            self._fh = open(self.path, "rb")
+        self._fh.seek(self.data_start + info.offset)
+        raw = self._fh.read(count * dtype().itemsize)
         return np.frombuffer(raw, dtype=dtype).reshape(info.shape)
+
+    def close(self) -> None:
+        if self._fh is not None and not self._fh.closed:
+            self._fh.close()
 
 
 # ---------------------------------------------------------------------------
@@ -214,6 +222,7 @@ def load_llama_params_gguf(path: str, cfg=None,
     }
     if "output.weight" in g.tensors:
         params["lm_head"] = t("output.weight").astype(dt).T
+    g.close()
     if shardings is not None:
         from ..engine.engine import global_put
 

@@ -18,6 +18,12 @@ from typing import Any, Dict, List, Optional
 # Default chat template used when the model dir has none (ChatML — a sane
 # widely-understood default; models with their own template override it).
 CHATML_TEMPLATE = (
+    "{% if tools %}"
+    "{{ '<|im_start|>system\nYou may call one of these tools by answering "
+    "with JSON {\"name\": ..., \"parameters\": {...}}:\n' }}"
+    "{% for tool in tools %}{{ tool['function'] | tojson }}{{ '\n' }}{% endfor %}"
+    "{{ '<|im_end|>\n' }}"
+    "{% endif %}"
     "{% for message in messages %}"
     "{{ '<|im_start|>' + message['role'] + '\n' + message['content'] + '<|im_end|>' + '\n' }}"
     "{% endfor %}"

@@ -8,7 +8,7 @@ ServiceFrontend → OpenAIPreprocessor → Backend → ServiceBackend(engine)
 
 from __future__ import annotations
 
-from typing import Any, AsyncIterator, Dict, Optional
+from typing import Any, AsyncIterator, Dict, List, Optional
 
 from ..runtime.engine import AsyncEngine, Context
 from .backend import Backend
@@ -37,20 +37,41 @@ class OpenAIChatEngine(AsyncEngine[ChatCompletionRequest, Dict[str, Any]]):
 
     async def generate(self, request: ChatCompletionRequest,
                        context: Context) -> AsyncIterator[Dict[str, Any]]:
+        from .tools import ToolCallingMatcher, normalize_tool_choice
+
         pre = self.preprocessor.preprocess_chat(request)
         gen = ChatDeltaGenerator(request.model, request_id=f"chatcmpl-{context.id[:24]}")
         prompt_tokens = len(pre.backend_input.token_ids)
         completion_tokens = 0
+        mode, forced = normalize_tool_choice(request.tool_choice, request.tools)
+        matcher = ToolCallingMatcher(mode, forced) if mode != "none" else None
+        # With tools active the text is buffered: a tool call can only be
+        # recognized on the complete message (reference tools.rs matches whole
+        # messages), and streaming content that later turns out to be a tool
+        # call would hand the client both.
+        buffered: List[str] = []
         if pre.annotations:
             yield {"event": "annotations", "data": pre.annotations}
         async for out in self.backend.generate(pre.backend_input, context):
             completion_tokens += len(out.token_ids)
             if out.text:
-                yield gen.text_chunk(out.text, out.index)
+                if matcher is not None:
+                    buffered.append(out.text)
+                else:
+                    yield gen.text_chunk(out.text, out.index)
             if out.finish_reason is not None:
+                finish_override = None
+                if matcher is not None:
+                    calls = matcher.get_calls("".join(buffered))
+                    if calls:
+                        yield gen.tool_calls_chunk(calls, out.index)
+                        finish_override = "tool_calls"
+                    elif buffered:
+                        yield gen.text_chunk("".join(buffered), out.index)
                 yield gen.finish_chunk(
                     out.finish_reason, out.index,
                     usage=usage_dict(prompt_tokens, completion_tokens),
+                    finish_override=finish_override,
                 )
                 return
 

@@ -71,7 +71,11 @@ class Preprocessor:
             # raw-prompt escape hatch: single user message passed through untemplated
             prompt = "".join(str(m.get("content", "")) for m in req.messages)
         else:
-            prompt = self.render_chat(req.messages, req.raw.get("tools"))
+            # tool_choice='none' disables the matcher, so the tool list must
+            # stay out of the prompt too — otherwise the template invites
+            # tool-call JSON that would stream back as plain content
+            tools = None if req.tool_choice == "none" else req.tools
+            prompt = self.render_chat(req.messages, tools)
         token_ids = self.tokenizer.encode(prompt)
         bi = self._assemble(
             token_ids,

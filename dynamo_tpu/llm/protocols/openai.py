@@ -45,6 +45,8 @@ class ChatCompletionRequest:
     top_logprobs: Optional[int] = None
     min_tokens: Optional[int] = None          # extension
     ignore_eos: bool = False                  # extension
+    tools: Optional[List[Dict[str, Any]]] = None
+    tool_choice: Any = None                   # none|auto|required|{function:...}
     ext: Dict[str, Any] = field(default_factory=dict)  # our nvext equivalent
     raw: Dict[str, Any] = field(default_factory=dict)
 
@@ -61,6 +63,8 @@ class ChatCompletionRequest:
         stop = d.get("stop") or []
         if isinstance(stop, str):
             stop = [stop]
+        from ..tools import normalize_tools  # deferred: avoid import cycle
+
         return cls(
             model=d["model"],
             messages=msgs,
@@ -78,6 +82,8 @@ class ChatCompletionRequest:
             top_logprobs=d.get("top_logprobs"),
             min_tokens=d.get("min_tokens"),
             ignore_eos=bool(d.get("ignore_eos", False)),
+            tools=normalize_tools(d.get("tools")),
+            tool_choice=d.get("tool_choice"),
             ext=dict(d.get("ext", d.get("nvext", {}) or {})),
             raw=d,
         )
@@ -175,9 +181,23 @@ class ChatDeltaGenerator:
             delta["role"] = "assistant"
         return self._chunk(delta, index)
 
+    def tool_calls_chunk(self, calls: List[Dict[str, Any]],
+                         index: int = 0) -> Dict[str, Any]:
+        """One delta carrying complete tool calls (arguments are not split
+        across chunks: the matcher only fires on the finished message)."""
+        delta: Dict[str, Any] = {
+            "tool_calls": [{**c, "index": i} for i, c in enumerate(calls)],
+        }
+        if index not in self._sent_role:
+            self._sent_role.add(index)
+            delta["role"] = "assistant"
+        return self._chunk(delta, index)
+
     def finish_chunk(self, finish_reason: FinishReason, index: int = 0,
-                     usage: Optional[Dict[str, int]] = None) -> Dict[str, Any]:
-        return self._chunk({}, index, finish_reason.to_openai(), usage)
+                     usage: Optional[Dict[str, int]] = None,
+                     finish_override: Optional[str] = None) -> Dict[str, Any]:
+        return self._chunk({}, index,
+                           finish_override or finish_reason.to_openai(), usage)
 
 
 class CompletionDeltaGenerator:
@@ -240,6 +260,22 @@ def aggregate_chat_chunks(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
             d = c.get("delta", {})
             if d.get("content"):
                 acc["message"]["content"] += d["content"]
+            for tc in d.get("tool_calls") or []:
+                calls = acc["message"].setdefault("tool_calls", [])
+                j = tc.get("index", len(calls))
+                while len(calls) <= j:
+                    calls.append({"id": None, "type": "function",
+                                  "function": {"name": "", "arguments": ""}})
+                slot = calls[j]
+                if tc.get("id"):
+                    slot["id"] = tc["id"]
+                if tc.get("type"):
+                    slot["type"] = tc["type"]
+                fn = tc.get("function") or {}
+                if fn.get("name"):
+                    slot["function"]["name"] = fn["name"]
+                if fn.get("arguments"):
+                    slot["function"]["arguments"] += fn["arguments"]
             if c.get("finish_reason"):
                 acc["finish_reason"] = c["finish_reason"]
     first = chunks[0]

@@ -218,8 +218,13 @@ class NativeDataPlane:
                 self._send(sid, control, payload)
                 # backpressure: the asyncio path awaited writer.drain();
                 # here the native write buffer is polled so a slow client
-                # cannot grow it without bound
+                # cannot grow it without bound. A killed/stopped context must
+                # break out — a stalled-but-connected client would otherwise
+                # pin this handler (and its engine slot) forever.
                 while self._backlog(sid) > self.HIGH_WATER:
+                    if ctx.is_killed or ctx.is_stopped:
+                        raise ConnectionResetError(
+                            "stream cancelled while backpressured")
                     await asyncio.sleep(0.005)
 
             await drive_handler_stream(handler(request, ctx), send)

@@ -120,3 +120,18 @@ def test_quantized_tensor_rejected(tmp_path):
     g.tensors["token_embd.weight"].ggml_type = 12  # Q4_K
     with pytest.raises(NotImplementedError, match="Q4_K"):
         g.load_tensor("token_embd.weight")
+
+
+def test_engine_loads_gguf_weights(tmp_path):
+    """A params_path holding a .gguf (no safetensors) must reach the GGUF
+    loader — not silently fall through to random init."""
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+
+    cfg = llama.preset("tiny-byte", tie_embeddings=False)
+    orig = tiny_gguf(tmp_path / "m.gguf", cfg)
+    core = EngineCore(JaxEngineConfig(
+        model=cfg, params_path=str(tmp_path), max_batch=2, max_context=128,
+        prefill_chunk=32, attn_impl="xla"))
+    np.testing.assert_allclose(
+        np.asarray(core.params["embed"], np.float32),
+        np.asarray(orig["embed"], np.float32), atol=2e-2)

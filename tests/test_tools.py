@@ -1,0 +1,195 @@
+"""Tool calling: request validation, the output matcher, streamed tool_calls
+deltas and their aggregation (reference lib/llm/src/preprocessor/tools.rs)."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.pipeline import build_chat_engine
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    ProtocolError,
+    aggregate_chat_chunks,
+)
+from dynamo_tpu.llm.tools import (
+    ToolCallingMatcher,
+    normalize_tool_choice,
+    normalize_tools,
+)
+from dynamo_tpu.runtime.engine import Context, collect
+
+WEATHER_TOOL = {
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "look up the weather",
+        "parameters": {
+            "type": "object",
+            "properties": {"city": {"type": "string"}},
+        },
+    },
+}
+
+
+# ---------------------------------------------------------------------------
+# request-side validation
+# ---------------------------------------------------------------------------
+
+def test_request_accepts_tools():
+    req = ChatCompletionRequest.from_dict({
+        "model": "m",
+        "messages": [{"role": "user", "content": "hi"}],
+        "tools": [WEATHER_TOOL],
+        "tool_choice": "auto",
+    })
+    assert req.tools == [WEATHER_TOOL]
+    assert req.tool_choice == "auto"
+
+
+@pytest.mark.parametrize("tools", ["nope", [{"type": "function"}],
+                                   [{"type": "retrieval"}]])
+def test_request_rejects_malformed_tools(tools):
+    with pytest.raises(ProtocolError):
+        ChatCompletionRequest.from_dict({
+            "model": "m",
+            "messages": [{"role": "user", "content": "hi"}],
+            "tools": tools,
+        })
+
+
+def test_tool_choice_modes():
+    tools = normalize_tools([WEATHER_TOOL])
+    assert normalize_tool_choice(None, tools) == ("auto", None)
+    assert normalize_tool_choice(None, None) == ("none", None)
+    assert normalize_tool_choice("none", tools) == ("none", None)
+    assert normalize_tool_choice("required", tools) == ("required", None)
+    mode, forced = normalize_tool_choice(
+        {"type": "function", "function": {"name": "get_weather"}}, tools)
+    assert (mode, forced) == ("required", "get_weather")
+    with pytest.raises(ProtocolError):
+        normalize_tool_choice(
+            {"type": "function", "function": {"name": "unknown"}}, tools)
+    with pytest.raises(ProtocolError):
+        normalize_tool_choice("required", None)
+
+
+# ---------------------------------------------------------------------------
+# matcher (the four accepted shapes of tools.rs:53-113)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("payload", [
+    {"name": "get_weather", "parameters": {"city": "SF"}},
+    {"name": "get_weather", "arguments": {"city": "SF"}},
+    [{"name": "get_weather", "parameters": {"city": "SF"}}],
+    [{"name": "get_weather", "arguments": {"city": "SF"}}],
+])
+def test_matcher_shapes(payload):
+    calls = ToolCallingMatcher("auto").get_calls(json.dumps(payload))
+    assert len(calls) == 1
+    c = calls[0]
+    assert c["type"] == "function"
+    assert c["id"].startswith("call-")
+    assert c["function"]["name"] == "get_weather"
+    assert json.loads(c["function"]["arguments"]) == {"city": "SF"}
+
+
+def test_matcher_multiple_calls():
+    msg = json.dumps([
+        {"name": "a", "parameters": {}},
+        {"name": "b", "arguments": {"x": 1}},
+    ])
+    calls = ToolCallingMatcher("auto").get_calls(msg)
+    assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+
+def test_matcher_plain_text_is_not_a_call():
+    assert ToolCallingMatcher("auto").get_calls("just words") == []
+    assert ToolCallingMatcher("auto").get_calls('{"no_name": 1}') == []
+
+
+def test_matcher_none_mode_skips_parsing():
+    msg = json.dumps({"name": "get_weather", "parameters": {}})
+    assert ToolCallingMatcher("none").get_calls(msg) == []
+
+
+def test_matcher_required_but_no_call_errors():
+    with pytest.raises(ProtocolError):
+        ToolCallingMatcher("required").get_calls("no call here")
+
+
+def test_matcher_forced_name_mismatch_errors():
+    msg = json.dumps({"name": "other", "parameters": {}})
+    with pytest.raises(ProtocolError):
+        ToolCallingMatcher("required", "get_weather").get_calls(msg)
+
+
+def test_matcher_fenced_json():
+    msg = "```json\n" + json.dumps(
+        {"name": "get_weather", "parameters": {"city": "SF"}}) + "\n```"
+    calls = ToolCallingMatcher("auto").get_calls(msg)
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the chat pipeline (echo core: output == raw prompt)
+# ---------------------------------------------------------------------------
+
+def _chat_request(content: str, **extra) -> ChatCompletionRequest:
+    return ChatCompletionRequest.from_dict({
+        "model": "m",
+        "messages": [{"role": "user", "content": content}],
+        "ext": {"use_raw_prompt": True},  # echo back exactly the content
+        **extra,
+    })
+
+
+async def _run(req):
+    engine = build_chat_engine(ModelDeploymentCard(name="m"), "echo_core")
+    chunks = await collect(engine.generate(req, Context()))
+    return [c for c in chunks if "event" not in c]
+
+
+async def test_pipeline_emits_tool_calls():
+    payload = json.dumps({"name": "get_weather", "parameters": {"city": "SF"}})
+    chunks = await _run(_chat_request(payload, tools=[WEATHER_TOOL]))
+    agg = aggregate_chat_chunks(chunks)
+    choice = agg["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    calls = choice["message"]["tool_calls"]
+    assert len(calls) == 1
+    assert calls[0]["function"]["name"] == "get_weather"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"city": "SF"}
+    assert choice["message"]["content"] == ""
+
+
+async def test_pipeline_plain_text_with_tools_streams_content():
+    chunks = await _run(_chat_request("hello there", tools=[WEATHER_TOOL]))
+    agg = aggregate_chat_chunks(chunks)
+    choice = agg["choices"][0]
+    assert choice["message"]["content"] == "hello there"
+    assert choice["finish_reason"] != "tool_calls"
+    assert "tool_calls" not in choice["message"]
+
+
+async def test_pipeline_without_tools_ignores_json_output():
+    payload = json.dumps({"name": "get_weather", "parameters": {}})
+    chunks = await _run(_chat_request(payload))
+    agg = aggregate_chat_chunks(chunks)
+    assert agg["choices"][0]["message"]["content"] == payload
+    assert agg["choices"][0]["finish_reason"] != "tool_calls"
+
+
+async def test_tools_reach_the_chat_template():
+    """Without use_raw_prompt the default template must render the tool list
+    so the model can see the schemas."""
+    from dynamo_tpu.llm.preprocessor import Preprocessor
+
+    pre = Preprocessor(ModelDeploymentCard(name="m"))
+    req = ChatCompletionRequest.from_dict({
+        "model": "m",
+        "messages": [{"role": "user", "content": "hi"}],
+        "tools": [WEATHER_TOOL],
+    })
+    out = pre.preprocess_chat(req)
+    assert "get_weather" in (out.formatted_prompt or "")
