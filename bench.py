@@ -205,6 +205,13 @@ def main() -> None:
             hbm = int((dev.memory_stats() or {}).get("bytes_limit", 0))
         except Exception:
             hbm = 0
+        if not hbm:
+            # PJRT plugins may expose no memory_stats; fall back to the
+            # chip family's known HBM capacity
+            kind = dev.device_kind.lower()
+            hbm = int(95e9 if "v5p" in kind else 32e9 if "v6" in kind
+                      else 32e9 if "v4" in kind else 16e9)
+            notes.append(f"hbm from device_kind table: {hbm/1e9:.0f}G")
         if hbm >= 22e9:  # 8B bf16 weights are 16G; need headroom for KV+work
             runs.append(("llama-3-8b",
                          llama.preset("llama-3-8b", max_position=2048),

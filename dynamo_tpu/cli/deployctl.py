@@ -1,0 +1,146 @@
+"""deployctl — kubectl-style CLI for Deployment resources.
+
+    python -m dynamo_tpu.cli.deployctl apply -f dep.yaml [--store h:p]
+    python -m dynamo_tpu.cli.deployctl list
+    python -m dynamo_tpu.cli.deployctl status <namespace>/<name>
+    python -m dynamo_tpu.cli.deployctl delete <namespace>/<name>
+    python -m dynamo_tpu.cli.deployctl render -f dep.yaml [--image IMG]
+    python -m dynamo_tpu.cli.deployctl operator [--resync S]
+
+``render`` emits Kubernetes manifests for the resource; ``operator`` runs
+the local reconciling operator in the foreground.
+
+Reference capability: the dynamo deploy/deployment CLI group
+(deploy/dynamo/sdk/cli/deployment.py) + kubectl against the operator CRDs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ..deploy.crd import DEPLOY_PREFIX, Deployment
+from ..runtime.store_client import StoreClient
+
+
+def _load_resource(path: str) -> Deployment:
+    import yaml
+
+    with open(path) as f:
+        return Deployment.from_dict(yaml.safe_load(f))
+
+
+async def _with_client(store: str, fn):
+    host, port = store.split(":")
+    client = await StoreClient(host, int(port)).connect()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("deployctl")
+    ap.add_argument("--store", default="127.0.0.1:4222")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("apply")
+    p.add_argument("-f", "--file", required=True)
+    sub.add_parser("list")
+    p = sub.add_parser("status")
+    p.add_argument("target")
+    p = sub.add_parser("delete")
+    p.add_argument("target")
+    p = sub.add_parser("render")
+    p.add_argument("-f", "--file", required=True)
+    p.add_argument("--image", default="dynamo-tpu:latest")
+    p.add_argument("--no-store", action="store_true")
+    p = sub.add_parser("operator")
+    p.add_argument("--resync", type=float, default=5.0)
+    p.add_argument("--platform", default="cpu")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "apply":
+        dep = _load_resource(args.file)
+
+        async def do(client):
+            from ..deploy.operator import apply
+
+            await apply(client, dep)
+            print(f"applied {dep.key()} (generation {dep.generation})")
+
+        asyncio.run(_with_client(args.store, do))
+        return 0
+
+    if args.cmd == "list":
+        async def do(client):
+            for key, raw in await client.get_prefix(DEPLOY_PREFIX):
+                try:
+                    d = Deployment.from_bytes(raw)
+                except ValueError:
+                    continue
+                print(f"{d.key()}  graph={d.spec.graph} "
+                      f"generation={d.generation}")
+
+        asyncio.run(_with_client(args.store, do))
+        return 0
+
+    if args.cmd in ("status", "delete"):
+        ns, _, name = args.target.partition("/")
+        if not name:
+            ns, name = "default", ns
+
+        async def do(client):
+            from ..deploy.operator import delete, get_status
+
+            if args.cmd == "delete":
+                ok = await delete(client, ns, name)
+                print("deleted" if ok else "not found")
+                return 0 if ok else 1
+            st = await get_status(client, ns, name)
+            if st is None:
+                print("no status recorded")
+                return 1
+            print(json.dumps(st.to_dict(), indent=2))
+            return 0
+
+        return asyncio.run(_with_client(args.store, do)) or 0
+
+    if args.cmd == "render":
+        dep = _load_resource(args.file)
+        from ..deploy.manifests import render_manifests, to_yaml
+        from ..deploy.operator import Operator
+
+        services = Operator._resolve_graph(dep)
+        print(to_yaml(render_manifests(
+            dep, services, image=args.image,
+            include_store=not args.no_store)))
+        return 0
+
+    if args.cmd == "operator":
+        from ..deploy.operator import LocalRunner, Operator
+
+        host, port = args.store.split(":")
+
+        async def run():
+            op = Operator(host, int(port),
+                          runner=LocalRunner(args.store, args.platform),
+                          resync_interval=args.resync)
+            await op.start()
+            print(f"operator watching {DEPLOY_PREFIX} on {args.store}",
+                  flush=True)
+            try:
+                while True:
+                    await asyncio.sleep(3600)
+            finally:
+                await op.close()
+
+        asyncio.run(run())
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

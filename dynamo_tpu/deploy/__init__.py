@@ -1,0 +1,18 @@
+"""Cluster deployment layer (L7): deployment resources, the reconciling
+operator, k8s manifest rendering, and the artifact/api store.
+
+Reference capability: deploy/dynamo/operator (Go CRDs + controllers),
+deploy/dynamo/api-store (FastAPI artifact store), deploy/dynamo/helm and
+deploy/Kubernetes (charts). Re-designed for this stack: desired state lives
+in dynstore (the discovery plane we already run), the operator reconciles it
+into local worker processes or renders k8s manifests for a real cluster, and
+the artifact store is an aiohttp service over a content directory.
+"""
+
+from .crd import Condition, Deployment, DeploymentSpec, DeploymentStatus, ServiceSpec
+from .operator import FakeRunner, LocalRunner, Operator
+
+__all__ = [
+    "Condition", "Deployment", "DeploymentSpec", "DeploymentStatus",
+    "ServiceSpec", "Operator", "LocalRunner", "FakeRunner",
+]

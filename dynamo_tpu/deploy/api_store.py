@@ -1,0 +1,199 @@
+"""API/artifact store: HTTP CRUD for graph artifacts and deployments.
+
+Artifacts (packaged service graphs — a tarball or any bytes) are versioned
+under a content directory; deployments are Deployment resources written into
+dynstore, where the operator watches them. This is the control-plane front
+door the reference runs as its FastAPI api-store.
+
+    POST   /api/v1/artifacts/{name}/versions          (body = bytes)
+    GET    /api/v1/artifacts                          list
+    GET    /api/v1/artifacts/{name}/versions/{v}      download
+    DELETE /api/v1/artifacts/{name}/versions/{v}
+    POST   /api/v1/deployments                        (body = resource JSON)
+    GET    /api/v1/deployments[/{ns}/{name}]          list / get + status
+    DELETE /api/v1/deployments/{ns}/{name}
+
+Reference capability: deploy/dynamo/api-store/ai_dynamo_store/api/
+dynamo.py:62-390 (upload/download, versioning, deployment records).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from ..runtime.store_client import StoreClient
+from .crd import DEPLOY_PREFIX, Deployment, SpecError, deploy_key, status_key
+
+
+class ApiStore:
+    def __init__(self, root: str, store_host: str = "127.0.0.1",
+                 store_port: int = 4222, http_port: int = 0):
+        self.root = root
+        self.store_host = store_host
+        self.store_port = store_port
+        self.http_port = http_port
+        self.client: Optional[StoreClient] = None
+        self._runner: Optional[web.AppRunner] = None
+        os.makedirs(root, exist_ok=True)
+
+    # ------------------------------------------------------------------
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=1 << 30)
+        r = app.router
+        r.add_post("/api/v1/artifacts/{name}/versions", self._upload)
+        r.add_get("/api/v1/artifacts", self._list_artifacts)
+        r.add_get("/api/v1/artifacts/{name}/versions/{v}", self._download)
+        r.add_delete("/api/v1/artifacts/{name}/versions/{v}", self._del_art)
+        r.add_post("/api/v1/deployments", self._apply_deployment)
+        r.add_get("/api/v1/deployments", self._list_deployments)
+        r.add_get("/api/v1/deployments/{ns}/{name}", self._get_deployment)
+        r.add_delete("/api/v1/deployments/{ns}/{name}", self._del_deployment)
+        return app
+
+    async def start(self) -> int:
+        self.client = await StoreClient(self.store_host,
+                                        self.store_port).connect()
+        self._runner = web.AppRunner(self._build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", self.http_port)
+        await site.start()
+        self.http_port = site._server.sockets[0].getsockname()[1]
+        return self.http_port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        if self.client is not None:
+            await self.client.close()
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _safe(name: str) -> str:
+        if not name or "/" in name or name.startswith("."):
+            raise web.HTTPBadRequest(text="invalid name")
+        return name
+
+    def _vdir(self, name: str) -> str:
+        return os.path.join(self.root, self._safe(name))
+
+    async def _upload(self, req: web.Request) -> web.Response:
+        name = self._safe(req.match_info["name"])
+        data = await req.read()
+        digest = hashlib.sha256(data).hexdigest()[:12]
+        vdir = self._vdir(name)
+        os.makedirs(vdir, exist_ok=True)
+        existing = [int(v) for v in os.listdir(vdir) if v.isdigit()]
+        version = max(existing, default=0) + 1
+        with open(os.path.join(vdir, str(version)), "wb") as f:
+            f.write(data)
+        meta = {"version": version, "sha256": digest, "size": len(data),
+                "uploaded": time.time()}
+        with open(os.path.join(vdir, f"{version}.json"), "w") as f:
+            json.dump(meta, f)
+        return web.json_response({"name": name, **meta}, status=201)
+
+    async def _list_artifacts(self, _req: web.Request) -> web.Response:
+        out = {}
+        for name in sorted(os.listdir(self.root)):
+            vdir = os.path.join(self.root, name)
+            if not os.path.isdir(vdir):
+                continue
+            versions = []
+            for v in sorted(int(x) for x in os.listdir(vdir) if x.isdigit()):
+                try:
+                    with open(os.path.join(vdir, f"{v}.json")) as f:
+                        versions.append(json.load(f))
+                except OSError:
+                    versions.append({"version": v})
+            out[name] = versions
+        return web.json_response({"artifacts": out})
+
+    async def _download(self, req: web.Request) -> web.Response:
+        path = os.path.join(self._vdir(req.match_info["name"]),
+                            self._safe(req.match_info["v"]))
+        if not os.path.isfile(path):
+            raise web.HTTPNotFound(text="no such artifact version")
+        with open(path, "rb") as f:
+            return web.Response(body=f.read(),
+                                content_type="application/octet-stream")
+
+    async def _del_art(self, req: web.Request) -> web.Response:
+        name = self._safe(req.match_info["name"])
+        v = self._safe(req.match_info["v"])
+        path = os.path.join(self._vdir(name), v)
+        if not os.path.isfile(path):
+            raise web.HTTPNotFound(text="no such artifact version")
+        os.unlink(path)
+        meta = path + ".json"
+        if os.path.exists(meta):
+            os.unlink(meta)
+        return web.json_response({"deleted": f"{name}/{v}"})
+
+    # ------------------------------------------------------------------
+    async def _apply_deployment(self, req: web.Request) -> web.Response:
+        try:
+            dep = Deployment.from_dict(await req.json())
+        except (SpecError, ValueError) as e:
+            raise web.HTTPBadRequest(text=str(e))
+        from .operator import apply
+
+        await apply(self.client, dep)
+        return web.json_response({"applied": dep.key(),
+                                  "generation": dep.generation}, status=201)
+
+    async def _list_deployments(self, _req: web.Request) -> web.Response:
+        items = []
+        for key, raw in await self.client.get_prefix(DEPLOY_PREFIX):
+            try:
+                items.append(Deployment.from_bytes(raw).to_dict())
+            except (SpecError, ValueError):
+                continue
+        return web.json_response({"deployments": items})
+
+    async def _get_deployment(self, req: web.Request) -> web.Response:
+        ns, name = req.match_info["ns"], req.match_info["name"]
+        raw = await self.client.get(deploy_key(ns, name))
+        if raw is None:
+            raise web.HTTPNotFound(text="no such deployment")
+        out = Deployment.from_bytes(raw).to_dict()
+        sraw = await self.client.get(status_key(ns, name))
+        if sraw is not None:
+            out["status"] = json.loads(sraw.decode())
+        return web.json_response(out)
+
+    async def _del_deployment(self, req: web.Request) -> web.Response:
+        ns, name = req.match_info["ns"], req.match_info["name"]
+        if not await self.client.delete(deploy_key(ns, name)):
+            raise web.HTTPNotFound(text="no such deployment")
+        return web.json_response({"deleted": f"{ns}/{name}"})
+
+
+def main(argv=None) -> None:
+    import argparse
+    import asyncio
+
+    ap = argparse.ArgumentParser("dynamo-api-store")
+    ap.add_argument("--root", default="./artifacts")
+    ap.add_argument("--store", default="127.0.0.1:4222")
+    ap.add_argument("--port", type=int, default=8082)
+    args = ap.parse_args(argv)
+    host, port = args.store.split(":")
+
+    async def run():
+        store = ApiStore(args.root, host, int(port), args.port)
+        p = await store.start()
+        print(f"api-store on 127.0.0.1:{p}", flush=True)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""Render a Deployment resource into Kubernetes manifests.
+
+For a real cluster the operator's job is done by k8s itself: this module
+turns one ``Deployment`` into the child resources the reference's Go
+controller creates — a ConfigMap carrying per-service config, a k8s
+Deployment + Service per graph service, and (once per namespace) the
+dynstore coordination service. TPU workers request ``google.com/tpu``
+resources with the standard TPU-VM node selectors.
+
+Reference capability: deploy/dynamo/operator/internal/controller/
+dynamonimdeployment_controller.go (Deployments/Services/ConfigMaps from the
+CRD) and deploy/Kubernetes charts.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from .crd import Deployment, ServiceSpec
+
+DEFAULT_IMAGE = "dynamo-tpu:latest"
+STORE_PORT = 4222
+
+
+def _meta(name: str, namespace: str, labels: Dict[str, str]) -> Dict[str, Any]:
+    return {"name": name, "namespace": namespace, "labels": labels}
+
+
+def _labels(dep: Deployment, service: str) -> Dict[str, str]:
+    return {"app.kubernetes.io/part-of": "dynamo-tpu",
+            "dynamo.tpu/deployment": dep.name,
+            "dynamo.tpu/service": service}
+
+
+def store_manifests(namespace: str,
+                    image: str = DEFAULT_IMAGE) -> List[Dict[str, Any]]:
+    """dynstore (discovery/request/queue planes) as a single-replica
+    Deployment + stable Service — the analogue of the reference's
+    etcd+NATS dependency charts."""
+    labels = {"app.kubernetes.io/part-of": "dynamo-tpu",
+              "dynamo.tpu/service": "dynstore"}
+    return [
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": _meta("dynstore", namespace, labels),
+         "spec": {
+             "replicas": 1,
+             "selector": {"matchLabels": labels},
+             "template": {
+                 "metadata": {"labels": labels},
+                 "spec": {"containers": [{
+                     "name": "dynstore",
+                     "image": image,
+                     "command": ["python", "-m",
+                                 "dynamo_tpu.runtime.store_server",
+                                 "--port", str(STORE_PORT)],
+                     "ports": [{"containerPort": STORE_PORT}],
+                 }]},
+             },
+         }},
+        {"apiVersion": "v1", "kind": "Service",
+         "metadata": _meta("dynstore", namespace, labels),
+         "spec": {"selector": labels,
+                  "ports": [{"port": STORE_PORT,
+                             "targetPort": STORE_PORT}]}},
+    ]
+
+
+def render_manifests(dep: Deployment,
+                     services: Dict[str, tuple],
+                     image: str = DEFAULT_IMAGE,
+                     include_store: bool = True,
+                     tpu_topology: Optional[str] = None) -> List[Dict[str, Any]]:
+    """``services``: name -> (class import spec, default workers, default
+    chips), the same mapping Operator._resolve_graph produces."""
+    out: List[Dict[str, Any]] = []
+    ns = dep.namespace
+    if include_store:
+        out.extend(store_manifests(ns, image))
+
+    config_name = f"{dep.name}-config"
+    out.append({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": _meta(config_name, ns, _labels(dep, "config")),
+        "data": {"service-config.json": json.dumps(
+            {name: (dep.spec.services.get(name) or ServiceSpec()).config
+             for name in services}, indent=2)},
+    })
+
+    store_addr = dep.spec.store or f"dynstore.{ns}.svc:{STORE_PORT}"
+    for name, (class_spec, default_workers, default_chips) in services.items():
+        sspec = dep.spec.services.get(name) or ServiceSpec(
+            replicas=default_workers, tpu_chips=default_chips)
+        labels = _labels(dep, name)
+        container: Dict[str, Any] = {
+            "name": name.lower(),
+            "image": image,
+            "command": ["python", "-m", "dynamo_tpu.sdk.serve_child",
+                        class_spec, "--store", store_addr],
+            "env": [{"name": "DYN_SERVICE_CONFIG_FILE",
+                     "value": "/etc/dynamo/service-config.json"}]
+            + [{"name": k, "value": v} for k, v in sspec.envs.items()],
+            "volumeMounts": [{"name": "config",
+                              "mountPath": "/etc/dynamo"}],
+        }
+        pod_spec: Dict[str, Any] = {
+            "containers": [container],
+            "volumes": [{"name": "config",
+                         "configMap": {"name": config_name}}],
+        }
+        if sspec.tpu_chips > 0:
+            container["resources"] = {
+                "limits": {"google.com/tpu": sspec.tpu_chips}}
+            sel = {"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"}
+            if tpu_topology:
+                sel["cloud.google.com/gke-tpu-topology"] = tpu_topology
+            pod_spec["nodeSelector"] = sel
+        out.append({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": _meta(f"{dep.name}-{name.lower()}", ns, labels),
+            "spec": {
+                "replicas": sspec.replicas,
+                "selector": {"matchLabels": labels},
+                "template": {"metadata": {"labels": labels},
+                             "spec": pod_spec},
+            },
+        })
+        out.append({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": _meta(f"{dep.name}-{name.lower()}", ns, labels),
+            "spec": {"selector": labels, "clusterIP": "None"},
+        })
+    return out
+
+
+def to_yaml(manifests: List[Dict[str, Any]]) -> str:
+    import yaml
+
+    class _Plain(yaml.SafeDumper):
+        def ignore_aliases(self, _data):
+            return True   # repeated label dicts must render inline, not &id
+
+    return "---\n".join(
+        yaml.dump(m, Dumper=_Plain, sort_keys=False) for m in manifests)

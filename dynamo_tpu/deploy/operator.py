@@ -1,0 +1,303 @@
+"""The deployment operator: reconciles desired state (Deployment resources
+in dynstore) into running service workers.
+
+Level-triggered, like a k8s controller: every event (prefix watch) and every
+resync tick runs the same ``_reconcile_all`` pass that diffs desired workers
+(graph services × replicas) against actual ones and starts/stops the
+difference; dead workers are restarted on the next pass, removed resources
+are torn down, and observed state is written back to ``deploy/status/``.
+
+Runners abstract "how a worker runs": ``LocalRunner`` spawns per-service
+child processes (the same entry the serve orchestrator uses);
+``FakeRunner`` records calls for tests. A real-cluster deployment renders
+manifests instead (see manifests.py) — the operator there is k8s itself.
+
+Reference capability: deploy/dynamo/operator/internal/controller/
+dynamodeployment_controller.go (reconcile loop, conditions, child-resource
+ownership), scoped to this stack's process model.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ..runtime.store_client import StoreClient
+from .crd import (
+    DEPLOY_PREFIX,
+    Deployment,
+    DeploymentStatus,
+    ServiceSpec,
+    SpecError,
+    status_key,
+)
+
+log = logging.getLogger("dynamo_tpu.deploy.operator")
+
+WorkerKey = Tuple[str, str, int]        # (dep key, service, replica index)
+
+
+class Runner:
+    """How a single service worker runs. Handles are opaque."""
+
+    def start(self, dep: Deployment, service: str, idx: int,
+              sspec: ServiceSpec, class_spec: str) -> Any:
+        raise NotImplementedError
+
+    def stop(self, handle: Any) -> None:
+        raise NotImplementedError
+
+    def alive(self, handle: Any) -> bool:
+        raise NotImplementedError
+
+
+class LocalRunner(Runner):
+    """Spawns ``python -m dynamo_tpu.sdk.serve_child`` per worker."""
+
+    def __init__(self, store: str, platform: str = "cpu"):
+        self.store = store
+        self.platform = platform
+
+    def start(self, dep, service, idx, sspec, class_spec):
+        from ..sdk.service import SERVICE_CONFIG_ENV
+
+        env = dict(os.environ)
+        env[SERVICE_CONFIG_ENV] = json.dumps({service: sspec.config}
+                                             if sspec.config else {})
+        env.update(sspec.envs)
+        if sspec.tpu_chips and self.platform == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                                f"{sspec.tpu_chips}")
+        elif not sspec.tpu_chips:
+            env["JAX_PLATFORMS"] = "cpu"
+        return subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.sdk.serve_child",
+             class_spec, "--store", dep.spec.store or self.store],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+    def stop(self, handle):
+        handle.terminate()
+        try:
+            handle.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            handle.kill()
+
+    def alive(self, handle):
+        return handle.poll() is None
+
+
+class FakeRunner(Runner):
+    """Test double: every started worker is a dict whose liveness the test
+    flips."""
+
+    def __init__(self):
+        self.started = []
+        self.stopped = []
+
+    def start(self, dep, service, idx, sspec, class_spec):
+        h = {"dep": dep.key(), "service": service, "idx": idx,
+             "chips": sspec.tpu_chips, "class": class_spec, "alive": True}
+        self.started.append(h)
+        return h
+
+    def stop(self, handle):
+        handle["alive"] = False
+        self.stopped.append(handle)
+
+    def alive(self, handle):
+        return handle["alive"]
+
+
+class Operator:
+    def __init__(self, store_host: str = "127.0.0.1", store_port: int = 4222,
+                 runner: Optional[Runner] = None,
+                 resync_interval: float = 5.0):
+        self.store_host = store_host
+        self.store_port = store_port
+        self.runner = runner or LocalRunner(f"{store_host}:{store_port}")
+        self.resync_interval = resync_interval
+        self.client: Optional[StoreClient] = None
+        self._desired: Dict[str, Deployment] = {}
+        self._workers: Dict[WorkerKey, Any] = {}
+        self._dirty = asyncio.Event()
+        self._stop = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------------
+    async def start(self) -> "Operator":
+        self.client = await StoreClient(self.store_host,
+                                        self.store_port).connect()
+        await self.client.watch_prefix(DEPLOY_PREFIX, self._on_event)
+        for key, value in await self.client.get_prefix(DEPLOY_PREFIX):
+            self._ingest(key, value)
+        self._task = asyncio.create_task(self._run())
+        self._dirty.set()
+        return self
+
+    async def close(self) -> None:
+        self._stop.set()
+        self._dirty.set()
+        if self._task is not None:
+            await self._task
+        for handle in self._workers.values():
+            self.runner.stop(handle)
+        self._workers.clear()
+        if self.client is not None:
+            await self.client.close()
+
+    # ------------------------------------------------------------------
+    def _ingest(self, key: str, value: Optional[bytes]) -> None:
+        dep_key = key[len(DEPLOY_PREFIX):]
+        if value is None:
+            self._desired.pop(dep_key, None)
+            return
+        try:
+            dep = Deployment.from_bytes(value)
+        except (SpecError, ValueError) as e:
+            log.error("invalid deployment at %s: %s", key, e)
+            return
+        self._desired[dep_key] = dep
+
+    async def _on_event(self, key: str, value: Optional[bytes],
+                        deleted: bool = False) -> None:
+        self._ingest(key, None if deleted else value)
+        self._dirty.set()
+
+    # ------------------------------------------------------------------
+    async def _run(self) -> None:
+        while not self._stop.is_set():
+            self._dirty.clear()
+            try:
+                await self._reconcile_all()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                log.exception("reconcile pass failed")
+            try:
+                await asyncio.wait_for(self._dirty.wait(),
+                                       self.resync_interval)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _reconcile_all(self) -> None:
+        # tear down workers of deleted deployments
+        live = set(self._desired)
+        for wkey in [k for k in self._workers if k[0] not in live]:
+            self.runner.stop(self._workers.pop(wkey))
+        removed_status = []
+        for dep_key, dep in list(self._desired.items()):
+            await self._reconcile_one(dep_key, dep)
+        # drop status of deployments that no longer exist
+        if self.client is not None:
+            for skey, _ in await self.client.get_prefix("deploy/status/"):
+                if skey[len("deploy/status/"):] not in live:
+                    removed_status.append(skey)
+            for skey in removed_status:
+                await self.client.delete(skey)
+
+    async def _reconcile_one(self, dep_key: str, dep: Deployment) -> None:
+        status = DeploymentStatus(observed_generation=dep.generation)
+        try:
+            services = self._resolve_graph(dep)
+        except Exception as e:  # noqa: BLE001 - bad graph => failed status
+            status.state = "failed"
+            status.set_condition("GraphResolved", "False",
+                                 "ImportError", str(e))
+            await self._write_status(dep, status)
+            return
+        status.set_condition("GraphResolved", "True", "Resolved",
+                             f"{len(services)} services")
+
+        desired: Dict[WorkerKey, Tuple[ServiceSpec, str]] = {}
+        for name, (class_spec, default_workers, default_chips) in \
+                services.items():
+            sspec = dep.spec.services.get(name) or ServiceSpec(
+                replicas=default_workers, tpu_chips=default_chips)
+            for idx in range(sspec.replicas):
+                desired[(dep_key, name, idx)] = (sspec, class_spec)
+
+        # stop: actual workers not desired anymore, or dead ones
+        for wkey in [k for k in self._workers
+                     if k[0] == dep_key and k not in desired]:
+            self.runner.stop(self._workers.pop(wkey))
+        for wkey in [k for k, h in self._workers.items()
+                     if k[0] == dep_key and not self.runner.alive(h)]:
+            self._workers.pop(wkey)
+
+        # start: desired workers with no live handle
+        for wkey, (sspec, class_spec) in desired.items():
+            if wkey not in self._workers:
+                self._workers[wkey] = self.runner.start(
+                    dep, wkey[1], wkey[2], sspec, class_spec)
+
+        ready: Dict[str, int] = {}
+        for wkey, h in self._workers.items():
+            if wkey[0] == dep_key and self.runner.alive(h):
+                ready[wkey[1]] = ready.get(wkey[1], 0) + 1
+        status.ready_replicas = ready
+        want = len(desired)
+        have = sum(ready.values())
+        status.state = "ready" if have >= want else "deploying"
+        status.set_condition("WorkersReady",
+                             "True" if have >= want else "False",
+                             "Reconciled", f"{have}/{want} workers")
+        await self._write_status(dep, status)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _resolve_graph(dep: Deployment) -> Dict[str, Tuple[str, int, int]]:
+        """service name -> (class import spec, default workers, default
+        chips) for every runnable service reachable from the entry."""
+        from ..sdk.serve_child import load_class
+        from ..sdk.service import collect_graph
+
+        entry = load_class(dep.spec.graph)
+        out: Dict[str, Tuple[str, int, int]] = {}
+        for cls in collect_graph(entry):
+            spec = cls._dynamo_spec
+            if not (spec.endpoints or spec.on_start or spec.dependencies):
+                continue  # pure grouping node
+            out[spec.name] = (f"{cls.__module__}:{cls.__name__}",
+                              spec.workers, int(spec.resources.get("tpu", 0)))
+        return out
+
+    async def _write_status(self, dep: Deployment,
+                            status: DeploymentStatus) -> None:
+        if self.client is None:
+            return
+        await self.client.put(
+            status_key(dep.namespace, dep.name),
+            json.dumps(status.to_dict()).encode())
+
+
+async def apply(client: StoreClient, dep: Deployment) -> None:
+    """kubectl-apply equivalent: upsert the resource (bumping generation)."""
+    from .crd import deploy_key
+
+    key = deploy_key(dep.namespace, dep.name)
+    old = await client.get(key)
+    if old is not None:
+        try:
+            dep.generation = Deployment.from_bytes(old).generation + 1
+        except (SpecError, ValueError):
+            pass
+    await client.put(key, dep.to_bytes())
+
+
+async def delete(client: StoreClient, namespace: str, name: str) -> bool:
+    from .crd import deploy_key
+
+    return await client.delete(deploy_key(namespace, name))
+
+
+async def get_status(client: StoreClient, namespace: str,
+                     name: str) -> Optional[DeploymentStatus]:
+    raw = await client.get(status_key(namespace, name))
+    if raw is None:
+        return None
+    return DeploymentStatus.from_dict(json.loads(raw.decode()))

@@ -174,10 +174,10 @@ class EngineCore:
         if cfg.params_path and _has_safetensors(cfg.params_path):
             from .loader import load_llama_params
             self.params = load_llama_params(cfg.params_path, m, shardings)
-        elif cfg.params_path and _gguf_file(cfg.params_path):
+        elif cfg.params_path and (gguf := _gguf_file(cfg.params_path)):
             from ..llm.gguf import load_llama_params_gguf
             _, self.params = load_llama_params_gguf(
-                _gguf_file(cfg.params_path), cfg=m, shardings=shardings)
+                gguf, cfg=m, shardings=shardings, dtype=m.dtype)
         else:
             params = llama.init_params(m, jax.random.PRNGKey(cfg.seed))
             self.params = jax.tree.map(

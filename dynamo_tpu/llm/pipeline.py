@@ -62,7 +62,9 @@ class OpenAIChatEngine(AsyncEngine[ChatCompletionRequest, Dict[str, Any]]):
             if out.finish_reason is not None:
                 finish_override = None
                 if matcher is not None:
-                    calls = matcher.get_calls("".join(buffered))
+                    complete = out.finish_reason in (FinishReason.STOP,
+                                                     FinishReason.EOS)
+                    calls = matcher.get_calls("".join(buffered), complete)
                     if calls:
                         yield gen.tool_calls_chunk(calls, out.index)
                         finish_override = "tool_calls"

@@ -63,8 +63,15 @@ class ChatCompletionRequest:
         stop = d.get("stop") or []
         if isinstance(stop, str):
             stop = [stop]
-        from ..tools import normalize_tools  # deferred: avoid import cycle
+        from ..tools import (  # deferred: avoid import cycle
+            normalize_tool_choice,
+            normalize_tools,
+        )
 
+        tools = normalize_tools(d.get("tools"))
+        # validate at parse time so a bad tool_choice is a clean 400, not a
+        # mid-stream error after the SSE response has committed
+        normalize_tool_choice(d.get("tool_choice"), tools)
         return cls(
             model=d["model"],
             messages=msgs,
@@ -82,7 +89,7 @@ class ChatCompletionRequest:
             top_logprobs=d.get("top_logprobs"),
             min_tokens=d.get("min_tokens"),
             ignore_eos=bool(d.get("ignore_eos", False)),
-            tools=normalize_tools(d.get("tools")),
+            tools=tools,
             tool_choice=d.get("tool_choice"),
             ext=dict(d.get("ext", d.get("nvext", {}) or {})),
             raw=d,

@@ -91,11 +91,16 @@ class ToolCallingMatcher:
         self.mode = mode
         self.forced_name = forced_name
 
-    def get_calls(self, message: str) -> List[Dict[str, Any]]:
+    def get_calls(self, message: str,
+                  complete: bool = True) -> List[Dict[str, Any]]:
+        """``complete=False`` marks a cancelled/truncated generation: parsing
+        is still attempted (a finished JSON call that ran into max_tokens is
+        fine), but the 'required' violation is not raised — the model never
+        got the chance to finish its call."""
         if self.mode == CHOICE_NONE:
             return []
         calls = self._parse(message)
-        if not calls and self.mode == CHOICE_REQUIRED:
+        if not calls and self.mode == CHOICE_REQUIRED and complete:
             raise ProtocolError(
                 "tool_choice required a tool call but the model produced none")
         if self.forced_name and calls:

@@ -75,8 +75,8 @@ def _flash_kernel(qpos_ref, kpos_ref, kval_ref, q_ref, k_ref, v_ref, o_ref,
         preferred_element_type=jnp.float32) * scale        # [G, BT, BS]
 
     qp = qpos_ref[0]                                       # [BT, 1]
-    kp = kpos_ref[:]                                       # [1, BS]
-    kv = kval_ref[:]
+    kp = kpos_ref[0]                                       # [1, BS]
+    kv = kval_ref[0]
     mask = ((kp <= qp) & (kv > 0))[None]                   # [1, BT, BS]
 
     m_prev = m_scr[:]
@@ -123,7 +123,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     q5 = q5.reshape(B * Hkv, G, T, Dh)
     k3 = k.transpose(0, 2, 1, 3).reshape(B * Hkv, S, Dh)
     v3 = v.transpose(0, 2, 1, 3).reshape(B * Hkv, S, Dh)
-    kval = k_valid.astype(jnp.int32)
+    # positions/validity carry a singleton middle axis: a [B, S] array with
+    # block (1, BS) violates Mosaic's last-two-dims tiling rule whenever
+    # B > 1 (block dim 1 is neither 8-divisible nor equal to B); as
+    # [B, 1, S] the trailing dims are (1, BS) against overall (1, S), legal
+    # for every batch size
+    kval = k_valid.astype(jnp.int32)[:, None, :]       # [B, 1, S]
+    kpos3 = k_pos[:, None, :]                          # [B, 1, S]
     qpos_col = q_pos[:, :, None]                       # [B, T, 1]
 
     grid = (B * Hkv, T // BT, S // BS)
@@ -132,8 +138,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, BT, 1), lambda bh, i, j: (bh // Hkv, i, 0)),
-            pl.BlockSpec((1, BS), lambda bh, i, j: (bh // Hkv, j)),
-            pl.BlockSpec((1, BS), lambda bh, i, j: (bh // Hkv, j)),
+            pl.BlockSpec((1, 1, BS), lambda bh, i, j: (bh // Hkv, 0, j)),
+            pl.BlockSpec((1, 1, BS), lambda bh, i, j: (bh // Hkv, 0, j)),
             pl.BlockSpec((1, G, BT, Dh), lambda bh, i, j: (bh, 0, i, 0)),
             pl.BlockSpec((1, BS, Dh), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, BS, Dh), lambda bh, i, j: (bh, j, 0)),
@@ -146,7 +152,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             pltpu.VMEM((G, BT, Dh), jnp.float32),   # acc
         ],
         interpret=interpret,
-    )(qpos_col, k_pos, kval, q5, k3, v3)
+    )(qpos_col, kpos3, kval, q5, k3, v3)
 
     out = out.reshape(B, Hkv, G, T, Dh).transpose(0, 3, 1, 2, 4)
     return out.reshape(B, T, Hq, Dh)

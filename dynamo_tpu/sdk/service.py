@@ -167,7 +167,14 @@ class ServiceConfig:
     @classmethod
     def load(cls) -> "ServiceConfig":
         raw = os.environ.get(SERVICE_CONFIG_ENV, "")
-        return cls(json.loads(raw) if raw else {})
+        if raw:
+            return cls(json.loads(raw))
+        # k8s path: config mounted as a file (deploy/manifests.py ConfigMap)
+        path = os.environ.get(SERVICE_CONFIG_ENV + "_FILE", "")
+        if path and os.path.exists(path):
+            with open(path) as f:
+                return cls(json.load(f))
+        return cls({})
 
     def get(self, section: str, default: Any = None) -> Any:
         return self.data.get(section, default if default is not None else {})

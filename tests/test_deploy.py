@@ -1,0 +1,258 @@
+"""L7 deployment layer: resource model, reconciling operator, manifest
+rendering, api-store CRUD (VERDICT round-1 missing #8 / SURVEY §2.1 operator
++ api-store + helm rows)."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.deploy.crd import (
+    Deployment,
+    DeploymentSpec,
+    ServiceSpec,
+    SpecError,
+    deploy_key,
+)
+from dynamo_tpu.deploy.operator import (
+    FakeRunner,
+    Operator,
+    apply,
+    delete,
+    get_status,
+)
+from dynamo_tpu.runtime.store_client import StoreClient
+from dynamo_tpu.runtime.store_server import StoreServer
+from dynamo_tpu.sdk import depends, dynamo_endpoint, service
+
+
+# --- a tiny runnable graph for the operator to resolve -------------------
+
+@service(namespace="dep")
+class Backend:
+    @dynamo_endpoint()
+    async def generate(self, request, ctx):
+        yield request
+
+
+@service(namespace="dep", workers=2, resources={"tpu": 4})
+class Frontend:
+    backend = depends(Backend)
+
+    @dynamo_endpoint()
+    async def generate(self, request, ctx):
+        yield request
+
+
+# --- resource model ------------------------------------------------------
+
+def test_resource_roundtrip():
+    dep = Deployment(
+        name="agg", namespace="prod",
+        spec=DeploymentSpec(
+            graph="tests.test_deploy:Frontend",
+            services={"frontend": ServiceSpec(replicas=3, tpu_chips=8,
+                                              config={"port": 8000})}))
+    d = dep.to_dict()
+    assert d["kind"] == "DynamoDeployment"
+    back = Deployment.from_dict(d)
+    assert back.key() == "prod/agg"
+    assert back.spec.services["frontend"].replicas == 3
+    assert back.spec.services["frontend"].tpu_chips == 8
+
+
+@pytest.mark.parametrize("bad", [
+    {"kind": "Other", "metadata": {"name": "x"}, "spec": {"graph": "a:B"}},
+    {"metadata": {}, "spec": {"graph": "a:B"}},
+    {"metadata": {"name": "x"}, "spec": {}},
+    {"metadata": {"name": "x"},
+     "spec": {"graph": "a:B", "services": {"S": {"replicas": -1}}}},
+])
+def test_resource_validation(bad):
+    with pytest.raises(SpecError):
+        Deployment.from_dict(bad)
+
+
+# --- operator reconcile loop ---------------------------------------------
+
+async def _store():
+    srv = StoreServer()
+    port = await srv.start()
+    return srv, port
+
+
+async def _wait(predicate, timeout=5.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+async def test_operator_reconciles_create_scale_delete():
+    srv, port = await _store()
+    runner = FakeRunner()
+    op = await Operator("127.0.0.1", port, runner=runner,
+                        resync_interval=0.2).start()
+    client = await StoreClient("127.0.0.1", port).connect()
+    try:
+        dep = Deployment(name="g", spec=DeploymentSpec(
+            graph="tests.test_deploy:Frontend"))
+        await apply(client, dep)
+
+        # graph: Frontend (workers=2, tpu=4) + Backend (workers=1)
+        assert await _wait(lambda: len(
+            [h for h in runner.started if h["alive"]]) == 3)
+        chips = sorted(h["chips"] for h in runner.started)
+        assert chips == [0, 4, 4]
+
+        st = await get_status(client, "default", "g")
+        assert st is not None and st.state == "ready"
+        assert st.ready_replicas == {"frontend": 2, "backend": 1}
+        assert any(c.type == "WorkersReady" and c.status == "True"
+                   for c in st.conditions)
+
+        # scale Frontend down to 1 via an override
+        dep.spec.services["frontend"] = ServiceSpec(replicas=1, tpu_chips=4)
+        await apply(client, dep)
+        assert await _wait(lambda: sum(
+            1 for k in op._workers if k[1] == "frontend") == 1)
+
+        # a worker dying gets restarted on resync
+        victim = next(h for h in runner.started
+                      if h["service"] == "backend" and h["alive"])
+        victim["alive"] = False
+        assert await _wait(lambda: sum(
+            1 for h in runner.started
+            if h["service"] == "backend" and h["alive"]) == 1, timeout=3)
+
+        # delete tears everything down and removes status
+        await delete(client, "default", "g")
+        assert await _wait(lambda: not op._workers)
+        assert await _wait(
+            lambda: True)  # give one pass for status cleanup
+        await asyncio.sleep(0.5)
+        assert await get_status(client, "default", "g") is None
+    finally:
+        await client.close()
+        await op.close()
+        await srv.stop()
+
+
+async def test_operator_marks_bad_graph_failed():
+    srv, port = await _store()
+    op = await Operator("127.0.0.1", port, runner=FakeRunner(),
+                        resync_interval=0.2).start()
+    client = await StoreClient("127.0.0.1", port).connect()
+    try:
+        await apply(client, Deployment(
+            name="broken",
+            spec=DeploymentSpec(graph="no.such.module:Nope")))
+        ok = await _wait(lambda: True)
+        await asyncio.sleep(0.5)
+        st = await get_status(client, "default", "broken")
+        assert st is not None and st.state == "failed"
+        assert any(c.type == "GraphResolved" and c.status == "False"
+                   for c in st.conditions)
+    finally:
+        await client.close()
+        await op.close()
+        await srv.stop()
+
+
+# --- manifests -----------------------------------------------------------
+
+def test_render_manifests():
+    from dynamo_tpu.deploy.manifests import render_manifests, to_yaml
+
+    dep = Deployment(name="agg", spec=DeploymentSpec(
+        graph="tests.test_deploy:Frontend",
+        services={"frontend": ServiceSpec(replicas=2, tpu_chips=4)}))
+    services = Operator._resolve_graph(dep)
+    ms = render_manifests(dep, services, image="reg/dynamo:1")
+    kinds = [(m["kind"], m["metadata"]["name"]) for m in ms]
+    assert ("Deployment", "dynstore") in kinds
+    assert ("ConfigMap", "agg-config") in kinds
+    assert ("Deployment", "agg-frontend") in kinds
+    assert ("Deployment", "agg-backend") in kinds
+
+    fe = next(m for m in ms if m["metadata"]["name"] == "agg-frontend"
+              and m["kind"] == "Deployment")
+    assert fe["spec"]["replicas"] == 2
+    c = fe["spec"]["template"]["spec"]["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == 4
+    assert c["image"] == "reg/dynamo:1"
+    assert "nodeSelector" in fe["spec"]["template"]["spec"]
+
+    be = next(m for m in ms if m["metadata"]["name"] == "agg-backend"
+              and m["kind"] == "Deployment")
+    assert "resources" not in be["spec"]["template"]["spec"]["containers"][0]
+
+    # yaml multi-doc renders
+    text = to_yaml(ms)
+    assert "google.com/tpu" in text and text.count("---") >= len(ms) - 1
+
+
+# --- api store -----------------------------------------------------------
+
+async def test_api_store_crud(tmp_path):
+    import aiohttp
+
+    from dynamo_tpu.deploy.api_store import ApiStore
+
+    srv, port = await _store()
+    store = ApiStore(str(tmp_path / "artifacts"), "127.0.0.1", port)
+    http_port = await store.start()
+    base = f"http://127.0.0.1:{http_port}/api/v1"
+    client = await StoreClient("127.0.0.1", port).connect()
+    try:
+        async with aiohttp.ClientSession() as s:
+            # artifact upload / list / download / delete
+            r = await s.post(f"{base}/artifacts/graph1/versions",
+                             data=b"bundle-bytes")
+            assert r.status == 201
+            v = (await r.json())["version"]
+            r = await s.get(f"{base}/artifacts")
+            arts = (await r.json())["artifacts"]
+            assert "graph1" in arts and arts["graph1"][0]["version"] == v
+            r = await s.get(f"{base}/artifacts/graph1/versions/{v}")
+            assert await r.read() == b"bundle-bytes"
+
+            # second upload bumps the version
+            r = await s.post(f"{base}/artifacts/graph1/versions", data=b"x2")
+            assert (await r.json())["version"] == v + 1
+
+            r = await s.delete(f"{base}/artifacts/graph1/versions/{v}")
+            assert r.status == 200
+            r = await s.get(f"{base}/artifacts/graph1/versions/{v}")
+            assert r.status == 404
+
+            # deployments CRUD lands in the dynstore
+            dep = Deployment(name="d1", spec=DeploymentSpec(
+                graph="tests.test_deploy:Frontend")).to_dict()
+            r = await s.post(f"{base}/deployments", json=dep)
+            assert r.status == 201
+            raw = await client.get(deploy_key("default", "d1"))
+            assert raw is not None
+
+            r = await s.get(f"{base}/deployments")
+            assert len((await r.json())["deployments"]) == 1
+            r = await s.get(f"{base}/deployments/default/d1")
+            assert (await r.json())["metadata"]["name"] == "d1"
+
+            # re-apply bumps generation
+            r = await s.post(f"{base}/deployments", json=dep)
+            assert (await r.json())["generation"] == 2
+
+            r = await s.delete(f"{base}/deployments/default/d1")
+            assert r.status == 200
+            assert await client.get(deploy_key("default", "d1")) is None
+
+            # malformed resource => 400
+            r = await s.post(f"{base}/deployments", json={"kind": "Nope"})
+            assert r.status == 400
+    finally:
+        await client.close()
+        await store.stop()
+        await srv.stop()

@@ -193,3 +193,28 @@ async def test_tools_reach_the_chat_template():
     })
     out = pre.preprocess_chat(req)
     assert "get_weather" in (out.formatted_prompt or "")
+
+
+async def test_truncated_generation_does_not_raise_required():
+    """A length-truncated output under tool_choice='required' must flush the
+    partial text with the real finish reason, not error (the model never got
+    to finish its call); matcher-level 'required' still errors on complete
+    non-call output."""
+    req = _chat_request("definitely not a tool call",
+                        tools=[WEATHER_TOOL], tool_choice="required",
+                        max_tokens=5)
+    chunks = await _run(req)   # echo core finishes with LENGTH
+    agg = aggregate_chat_chunks(chunks)
+    choice = agg["choices"][0]
+    assert choice["finish_reason"] == "length"
+    assert "tool_calls" not in choice["message"]
+
+
+def test_bad_tool_choice_rejected_at_parse_time():
+    with pytest.raises(ProtocolError):
+        ChatCompletionRequest.from_dict({
+            "model": "m",
+            "messages": [{"role": "user", "content": "x"}],
+            "tools": [WEATHER_TOOL],
+            "tool_choice": "banana",
+        })
