@@ -83,8 +83,16 @@ def _engine_cfg(args, card: Optional[ModelDeploymentCard] = None):
     return JaxEngineConfig.from_card(card, tensor_parallel=args.tp, **extra)
 
 
+async def _connect_drt(args) -> DistributedRuntime:
+    host, port = args.store.split(":")
+    return await DistributedRuntime(
+        store_host=host, store_port=int(port),
+        advertise_host=args.advertise_host).connect()
+
+
 async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
-                     drt: Optional[DistributedRuntime] = None) -> None:
+                     drt: Optional[DistributedRuntime] = None,
+                     token=None) -> None:
     multihost = getattr(args, "num_nodes", 1) > 1
     publisher = None
     if multihost:
@@ -97,12 +105,9 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
 
         init_distributed(args.coordinator, args.num_nodes, args.node_rank)
         publisher = DispatchPublisher(args.dispatch_port, args.num_nodes - 1)
-    host, port = args.store.split(":")
     own_drt = drt is None
     if own_drt:
-        drt = await DistributedRuntime(
-            store_host=host, store_port=int(port),
-            advertise_host=args.advertise_host).connect()
+        drt = await _connect_drt(args)
     ns = drt.namespace(args.namespace)
     component = ns.component(args.component)
 
@@ -253,8 +258,11 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
     if ready_event is not None:
         ready_event.set()
     try:
-        while True:
-            await asyncio.sleep(3600)
+        if token is not None:
+            await token.wait()     # Worker shell: serve until shutdown signal
+        else:
+            while True:
+                await asyncio.sleep(3600)
     finally:
         mtask.cancel()
         await pub.stop()
@@ -302,8 +310,19 @@ def main() -> None:
     if args.num_nodes > 1 and args.node_rank > 0:
         run_follower(args)
         return
+    # Worker shell: SIGINT/SIGTERM cancel the root token, in-flight requests
+    # get stop (then kill after the grace window), leases revoke on close
+    from ..runtime.worker import Worker
+
+    shell = Worker()
+
+    async def app(token):
+        drt = await _connect_drt(args)
+        shell.add_runtime(drt)
+        await run_worker(args, drt=drt, token=token)
+
     try:
-        asyncio.run(run_worker(args))
+        shell.execute(app)
     except KeyboardInterrupt:
         pass
 

@@ -79,6 +79,8 @@ class JaxEngineConfig:
     prefill_chunk: int = 512
     num_pages: Optional[int] = None     # default: max_batch*max_context worth
     decode_steps: int = 8               # decode iterations per XLA dispatch
+    prefill_lanes: Optional[int] = None  # sequences per prefill dispatch
+    #                                      (None => max_batch: whole wave)
     params_path: Optional[str] = None   # safetensors dir; None => random init
     seed: int = 0
     preset: Optional[str] = None
@@ -108,7 +110,8 @@ class JaxEngineConfig:
             params_path=card.path,
         )
         for k in ("sp", "ep", "max_batch", "max_context", "prefill_chunk",
-                  "num_pages", "decode_steps", "seed", "preset", "attn_impl",
+                  "num_pages", "decode_steps", "prefill_lanes", "seed",
+                  "preset", "attn_impl",
                   "enable_prefix_reuse", "host_cache_blocks",
                   "disk_cache_blocks", "disk_cache_path"):
             if k in extra:
@@ -280,8 +283,12 @@ class EngineCore:
         raw = _buckets(min(256, cfg.max_context), cfg.max_context + self._spec_pad)
         self.s_buckets = sorted({-(-b // pg) * pg for b in raw})
         self.c_buckets = _buckets(min(32, cfg.prefill_chunk), cfg.prefill_chunk)
-        # prefill runs up to 8 sequences per dispatch (batched lanes)
-        self.b_buckets = _buckets(1, min(8, cfg.max_batch))
+        # prefill lane budget: the whole admission wave prefills in one
+        # dispatch by default — splitting a 32-request wave into 8-lane
+        # dispatches quadruples the per-dispatch host round-trips, which
+        # dominate TTFT when the host link is slow
+        lanes = cfg.prefill_lanes or cfg.max_batch
+        self.b_buckets = _buckets(1, max(1, min(lanes, cfg.max_batch)))
         self._decode_fns: Dict[int, Any] = {}
         self._prefill_batch_fns: Dict[Tuple[int, int, int], Any] = {}
 

@@ -78,6 +78,17 @@ def load_llama_params(path: str, cfg: LlamaConfig,
         },
         "final_norm": _get(tensors, f"{pfx}norm.weight").astype(np.float32),
     }
+    if cfg.attention_bias:
+        def bias(i, name, h):
+            return _get(tensors, f"{pfx}layers.{i}.{name}.bias") \
+                .astype(dt).reshape(h, Dh)
+
+        params["layers"]["bq"] = np.stack(
+            [bias(i, "self_attn.q_proj", Hq) for i in range(L)])
+        params["layers"]["bk"] = np.stack(
+            [bias(i, "self_attn.k_proj", Hkv) for i in range(L)])
+        params["layers"]["bv"] = np.stack(
+            [bias(i, "self_attn.v_proj", Hkv) for i in range(L)])
     if not cfg.tie_embeddings:
         head = ("lm_head.weight" if "lm_head.weight" in tensors
                 else f"{pfx}lm_head.weight")
@@ -118,6 +129,13 @@ def save_llama_params(path: str, params: Dict[str, Any], cfg: LlamaConfig) -> No
         out[p + "mlp.gate_proj.weight"] = C(np.asarray(lp["wg"][i], np.float32).T)
         out[p + "mlp.up_proj.weight"] = C(np.asarray(lp["wu"][i], np.float32).T)
         out[p + "mlp.down_proj.weight"] = C(np.asarray(lp["wd"][i], np.float32).T)
+        if "bq" in lp:
+            out[p + "self_attn.q_proj.bias"] = C(np.asarray(
+                lp["bq"][i], np.float32).reshape(-1))
+            out[p + "self_attn.k_proj.bias"] = C(np.asarray(
+                lp["bk"][i], np.float32).reshape(-1))
+            out[p + "self_attn.v_proj.bias"] = C(np.asarray(
+                lp["bv"][i], np.float32).reshape(-1))
     if "lm_head" in params:
         out["lm_head.weight"] = C(np.asarray(params["lm_head"], np.float32).T)
     save_file(out, os.path.join(path, "model.safetensors"))

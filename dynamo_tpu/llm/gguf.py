@@ -61,13 +61,15 @@ class GGUFFile:
         return self.metadata.get("general.architecture", "")
 
     def llama_config(self):
-        """Map llama-architecture metadata onto LlamaConfig."""
+        """Map llama-family metadata onto LlamaConfig. Covers the
+        llama-shaped architectures GGUF ships (llama/mistral identical;
+        qwen2 adds qkv bias)."""
         from ..models.llama import LlamaConfig
 
         md = self.metadata
         arch = self.architecture()
-        if arch != "llama":
-            raise ValueError(f"not a llama-architecture GGUF: {arch!r}")
+        if arch not in ("llama", "mistral", "qwen2"):
+            raise ValueError(f"not a llama-family GGUF: {arch!r}")
 
         def g(key, default=None):
             return md.get(f"{arch}.{key}", default)
@@ -75,11 +77,12 @@ class GGUFFile:
         n_heads = int(g("attention.head_count"))
         emb = int(g("embedding_length"))
         vocab = md.get("tokenizer.ggml.tokens")
-        vocab_size = (int(md["llama.vocab_size"])
-                      if "llama.vocab_size" in md
+        vocab_size = (int(md[f"{arch}.vocab_size"])
+                      if f"{arch}.vocab_size" in md
                       else len(vocab) if vocab else 32000)
         return LlamaConfig(
             tie_embeddings="output.weight" not in self.tensors,
+            attention_bias="blk.0.attn_q.bias" in self.tensors,
             vocab_size=vocab_size,
             hidden_size=emb,
             num_layers=int(g("block_count")),
@@ -220,6 +223,13 @@ def load_llama_params_gguf(path: str, cfg=None,
         },
         "final_norm": t("output_norm.weight").astype(np.float32),
     }
+    if cfg.attention_bias:
+        params["layers"]["bq"] = stack(
+            "blk.{}.attn_q.bias", lambda w: w.astype(dt).reshape(Hq, Dh))
+        params["layers"]["bk"] = stack(
+            "blk.{}.attn_k.bias", lambda w: w.astype(dt).reshape(Hkv, Dh))
+        params["layers"]["bv"] = stack(
+            "blk.{}.attn_v.bias", lambda w: w.astype(dt).reshape(Hkv, Dh))
     if "output.weight" in g.tensors:
         params["lm_head"] = t("output.weight").astype(dt).T
     g.close()

@@ -52,6 +52,8 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     max_position: int = 8192
     tie_embeddings: bool = False
+    # q/k/v projection biases (Qwen2-style attention; Llama/Mistral: False)
+    attention_bias: bool = False
     dtype: Any = jnp.bfloat16
     # MoE (0 experts = dense FFN). Experts shard over the ep mesh axis.
     num_experts: int = 0
@@ -74,6 +76,11 @@ class LlamaConfig:
             rms_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position=cfg.get("max_position_embeddings", 8192),
             tie_embeddings=cfg.get("tie_word_embeddings", False),
+            # Qwen2 has qkv bias baked into the architecture; HF encodes it
+            # via model class, newer configs carry attention_bias explicitly
+            attention_bias=bool(cfg.get(
+                "attention_bias",
+                any("Qwen2" in a for a in cfg.get("architectures", []) or []))),
             dtype=dtype,
         )
 
@@ -101,6 +108,25 @@ PRESETS: Dict[str, Dict[str, Any]] = {
                         num_heads=64, num_kv_heads=8, head_dim=128,
                         intermediate_size=28672, rope_theta=500000.0,
                         max_position=8192),
+    # tiny Qwen2-style model (qkv bias) over the byte vocab
+    "tiny-qwen": dict(vocab_size=259, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, head_dim=16,
+                      intermediate_size=128, rope_theta=10000.0,
+                      max_position=1024, attention_bias=True,
+                      tie_embeddings=True),
+    "qwen2-1.5b": dict(vocab_size=151936, hidden_size=1536, num_layers=28,
+                       num_heads=12, num_kv_heads=2, head_dim=128,
+                       intermediate_size=8960, rope_theta=1000000.0,
+                       max_position=32768, attention_bias=True,
+                       tie_embeddings=True, rms_eps=1e-6),
+    "qwen2-7b": dict(vocab_size=152064, hidden_size=3584, num_layers=28,
+                     num_heads=28, num_kv_heads=4, head_dim=128,
+                     intermediate_size=18944, rope_theta=1000000.0,
+                     max_position=32768, attention_bias=True, rms_eps=1e-6),
+    "mistral-7b": dict(vocab_size=32000, hidden_size=4096, num_layers=32,
+                       num_heads=32, num_kv_heads=8, head_dim=128,
+                       intermediate_size=14336, rope_theta=10000.0,
+                       max_position=32768, rms_eps=1e-5),
 }
 
 
@@ -152,6 +178,12 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
         },
         "final_norm": jnp.ones((D,), jnp.float32),
     }
+    if cfg.attention_bias:
+        kb = jax.random.split(ks[9], 3)
+        # non-zero random biases so parity tests would catch a dropped bias
+        params["layers"]["bq"] = norm(kb[0], L, Hq, Dh)
+        params["layers"]["bk"] = norm(kb[1], L, Hkv, Dh)
+        params["layers"]["bv"] = norm(kb[2], L, Hkv, Dh)
     if not cfg.tie_embeddings:
         params["lm_head"] = norm(ks[8], D, V)
     return params
@@ -193,6 +225,10 @@ def param_specs(cfg: LlamaConfig, tp_size: int = 1) -> Dict[str, Any]:
         },
         "final_norm": P(None),
     }
+    if cfg.attention_bias:
+        specs["layers"]["bq"] = P(None, tp, None)
+        specs["layers"]["bk"] = P(None, kv, None)
+        specs["layers"]["bv"] = P(None, kv, None)
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(None, None)
     return specs
@@ -357,6 +393,10 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
         q = jnp.einsum("btd,dhk->bthk", h, lp["wq"][l])
         k = jnp.einsum("btd,dhk->bthk", h, lp["wk"][l])
         v = jnp.einsum("btd,dhk->bthk", h, lp["wv"][l])
+        if cfg.attention_bias:
+            q = q + lp["bq"][l]
+            k = k + lp["bk"][l]
+            v = v + lp["bv"][l]
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         # scatter chunk KV into the pool (write-then-gather). The scalar
@@ -478,6 +518,10 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
         q = jnp.einsum("btd,dhk->bthk", h, lp["wq"][l])
         k = jnp.einsum("btd,dhk->bthk", h, lp["wk"][l])
         v = jnp.einsum("btd,dhk->bthk", h, lp["wv"][l])
+        if cfg.attention_bias:
+            q = q + lp["bq"][l]
+            k = k + lp["bk"][l]
+            v = v + lp["bv"][l]
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         # [l, :, w_page, w_off] batches over the scalar l too, so the

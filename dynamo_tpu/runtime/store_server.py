@@ -76,10 +76,37 @@ class _Conn:
         self.pulling: Dict[str, List[int]] = {}    # queue -> pending pull ids
         self.unacked: Dict[Tuple[str, int], _QueueMsg] = {}
         self._send_lock = asyncio.Lock()
+        # detached push: an ordered per-connection outbox drained by one
+        # pump task, so a watcher/subscriber that stops reading its socket
+        # blocks only its own pump — never the put/publish that notified it
+        self._outbox: "asyncio.Queue[Any]" = asyncio.Queue()
+        self._pump_task: Optional[asyncio.Task] = None
+
+    OUTBOX_LIMIT = 4096   # frames; beyond this the consumer is defunct
 
     async def push(self, obj: Any) -> None:
         async with self._send_lock:
             await write_frame(self.writer, obj)
+
+    def push_nowait(self, obj: Any) -> None:
+        """Enqueue a push frame, preserving per-connection order, without
+        awaiting the (possibly stalled) socket."""
+        if self._outbox.qsize() >= self.OUTBOX_LIMIT:
+            self.writer.close()   # defunct consumer: drop the connection
+            return
+        self._outbox.put_nowait(obj)
+        if self._pump_task is None or self._pump_task.done():
+            self._pump_task = asyncio.get_running_loop().create_task(
+                self._pump())
+
+    async def _pump(self) -> None:
+        try:
+            while not self._outbox.empty():
+                obj = self._outbox.get_nowait()
+                async with self._send_lock:
+                    await write_frame(self.writer, obj)
+        except Exception:
+            pass   # broken pipe: the reader loop will reap the connection
 
 
 class StoreServer:
@@ -227,14 +254,13 @@ class StoreServer:
         return {"deleted": kv is not None}
 
     async def _notify_watchers(self, key: str, value: Optional[bytes]) -> None:
+        # detached delivery: the put/delete must not block on any watcher's
+        # socket; per-connection order is preserved by the outbox pump
         for conn, wid, prefix in list(self._watchers.values()):
             if key.startswith(prefix):
-                try:
-                    await conn.push({"push": "watch", "watch_id": wid,
-                                     "key": key, "value": value,
-                                     "deleted": value is None})
-                except Exception:
-                    pass
+                conn.push_nowait({"push": "watch", "watch_id": wid,
+                                  "key": key, "value": value,
+                                  "deleted": value is None})
 
     # -- leases ----------------------------------------------------------
     async def _op_lease_grant(self, conn, m):
@@ -278,15 +304,11 @@ class StoreServer:
 
     async def _op_publish(self, conn, m):
         subject, payload = m["subject"], m["payload"]
-        n = 0
-        for c, sid in list(self._subs.get(subject, {}).values()):
-            try:
-                await c.push({"push": "msg", "sub_id": sid,
-                              "subject": subject, "payload": payload})
-                n += 1
-            except Exception:
-                pass
-        return {"delivered": n}
+        targets = list(self._subs.get(subject, {}).values())
+        for c, sid in targets:
+            c.push_nowait({"push": "msg", "sub_id": sid,
+                           "subject": subject, "payload": payload})
+        return {"delivered": len(targets)}
 
     # -- work queues ------------------------------------------------------
     async def _op_q_push(self, conn, m):

@@ -1,0 +1,169 @@
+"""Worker lifecycle: signal-driven graceful shutdown over a cancellation
+token tree.
+
+``Worker.execute(main)`` is the process entry used by every long-running
+binary: it installs SIGINT/SIGTERM handlers that cancel the root
+``CancellationToken``; the app receives the token (and usually hands child
+tokens to its runtimes/endpoints). On cancellation the worker stops taking
+new work, asks in-flight requests to stop, waits up to ``grace`` seconds
+for them to drain, then hard-kills the rest. A second signal skips the
+grace period.
+
+Reference capability: lib/runtime/src/worker.rs:60-99,182 (Worker::execute
++ ctrl-c → CancellationToken tree) and the ControlMessage Stop/Kill
+semantics of engine.rs:71-85.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+from typing import Awaitable, Callable, List, Optional
+
+log = logging.getLogger("dynamo_tpu.worker")
+
+
+class CancellationToken:
+    """Hierarchical cancellation: cancelling a parent cancels all children
+    (children cancelling does not propagate up) — the same tree shape the
+    reference hangs off its runtime/lease/endpoint layers."""
+
+    def __init__(self, parent: Optional["CancellationToken"] = None):
+        self._event = asyncio.Event()
+        self._children: List["CancellationToken"] = []
+        self._callbacks: List[Callable[[], None]] = []
+        self.parent = parent
+        if parent is not None:
+            parent._children.append(self)
+            if parent.cancelled:
+                self._event.set()
+
+    def child(self) -> "CancellationToken":
+        return CancellationToken(self)
+
+    @property
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    def cancel(self) -> None:
+        if self._event.is_set():
+            return
+        self._event.set()
+        for cb in self._callbacks:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 - callbacks must not stop fanout
+                log.exception("cancellation callback failed")
+        for c in self._children:
+            c.cancel()
+
+    def on_cancel(self, cb: Callable[[], None]) -> None:
+        """Register a sync callback; fires immediately if already cancelled."""
+        if self.cancelled:
+            cb()
+        else:
+            self._callbacks.append(cb)
+
+    async def wait(self) -> None:
+        await self._event.wait()
+
+
+class Worker:
+    """Process shell: runs an async app under a root cancellation token with
+    signal-driven graceful shutdown.
+
+        async def app(token):
+            drt = await DistributedRuntime(...).connect()
+            worker.add_runtime(drt)
+            ...
+            await token.wait()          # serve until shutdown
+
+        Worker().execute(app)
+    """
+
+    def __init__(self, grace: float = 10.0):
+        self.grace = grace
+        self.token = CancellationToken()
+        self._runtimes: List[object] = []
+        self._signals = 0
+        self._force = False   # second signal: skip the grace window
+
+    def add_runtime(self, drt) -> None:
+        """Runtimes registered here get their in-flight requests stopped
+        (then killed) and their connections closed during shutdown."""
+        self._runtimes.append(drt)
+
+    # ------------------------------------------------------------------
+    def _on_signal(self) -> None:
+        self._signals += 1
+        if self._signals == 1:
+            log.info("shutdown signal: draining (grace %.1fs); "
+                     "signal again to skip", self.grace)
+            self.token.cancel()
+        else:
+            log.warning("second signal: hard shutdown")
+            self._force = True
+            for drt in self._runtimes:
+                for ctx in list(getattr(drt, "_active", {}).values()):
+                    ctx.kill()
+
+    async def _run(self, app: Callable[[CancellationToken], Awaitable]) -> None:
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, self._on_signal)
+            except (NotImplementedError, RuntimeError):
+                pass   # non-main thread / platform without signal support
+        app_task = asyncio.create_task(app(self.token))
+        cancel_wait = asyncio.create_task(self.token.wait())
+        try:
+            done, _ = await asyncio.wait(
+                {app_task, cancel_wait},
+                return_when=asyncio.FIRST_COMPLETED)
+            if app_task in done and not self.token.cancelled:
+                # app returned (or raised) on its own, no shutdown signal
+                cancel_wait.cancel()
+                await app_task
+                return
+            # a cancelled token ALWAYS takes the shutdown path — even if
+            # the app task completed in the same event-loop pass (the
+            # documented 'await token.wait(); return' app pattern does),
+            # in-flight requests must still be drained and leases revoked
+            await self._shutdown(app_task)
+        finally:
+            cancel_wait.cancel()
+
+    async def _shutdown(self, app_task: asyncio.Task) -> None:
+        # 1. stop taking new work + ask in-flight requests to stop
+        for drt in self._runtimes:
+            for ctx in list(getattr(drt, "_active", {}).values()):
+                ctx.stop_generating()
+        # 2. wait for drain (or the app to exit) within the grace window
+        deadline = asyncio.get_event_loop().time() + self.grace
+        while asyncio.get_event_loop().time() < deadline and not self._force:
+            active = sum(len(getattr(drt, "_active", {}))
+                         for drt in self._runtimes)
+            if active == 0:
+                break
+            await asyncio.sleep(0.05)
+        # 3. kill whatever is left
+        for drt in self._runtimes:
+            for ctx in list(getattr(drt, "_active", {}).values()):
+                ctx.kill()
+        # 4. close runtimes (revokes leases => endpoints deregister)
+        for drt in self._runtimes:
+            close = getattr(drt, "close", None)
+            if close is not None:
+                try:
+                    await close()
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    log.exception("runtime close failed")
+        app_task.cancel()
+        try:
+            await app_task
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            pass
+
+    def execute(self, app: Callable[[CancellationToken], Awaitable]) -> None:
+        asyncio.run(self._run(app))

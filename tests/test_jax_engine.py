@@ -350,3 +350,23 @@ def test_moe_ep2_engine_matches_ep1():
     t1 = [g.token for g in drain(c1, ["m"])["m"]]
     t2 = [g.token for g in drain(c2, ["m"])["m"]]
     assert t1 == t2
+
+
+def test_long_context_over_8k():
+    """SURVEY 5.7: the long-context story must actually hold past 8k tokens —
+    a 9000-token prompt prefills chunk-by-chunk through the paged pool and
+    decodes correctly (tiny model dims keep CPU compile cheap; the sequence
+    machinery — pages, chunking, position handling — is the real thing)."""
+    cfg = make_cfg(
+        model=llama.preset("tiny-byte", max_position=10240),
+        max_batch=2, max_context=10240, page_size=64, prefill_chunk=1024)
+    core = EngineCore(cfg)
+    prompt = [(i * 7 + 3) % 251 for i in range(9001)]
+    core.submit("long8k", req(prompt, max_tokens=4))
+    got = drain(core, ["long8k"])["long8k"]
+    assert len([so for so in got if so.finish is not None]) == 1
+    toks = [so.token for so in got if so.token is not None]
+    assert len(toks) == 4
+    # chunk-size invariance of the prefill path is covered at small scale
+    # by test_chunked_prefill_matches_full; here the point is that >8k
+    # contexts run at all (pages, chunk loop, position handling)
