@@ -33,11 +33,15 @@ from .crd import DEPLOY_PREFIX, Deployment, SpecError, deploy_key, status_key
 
 class ApiStore:
     def __init__(self, root: str, store_host: str = "127.0.0.1",
-                 store_port: int = 4222, http_port: int = 0):
+                 store_port: int = 4222, http_port: int = 0,
+                 advertise_host: str = "127.0.0.1"):
         self.root = root
         self.store_host = store_host
         self.store_port = store_port
         self.http_port = http_port
+        # host operators/workers use to fetch artifacts — must be reachable
+        # from THEIR machines, not just ours
+        self.advertise_host = advertise_host
         self.client: Optional[StoreClient] = None
         self._runner: Optional[web.AppRunner] = None
         os.makedirs(root, exist_ok=True)
@@ -96,6 +100,12 @@ class ApiStore:
                 "uploaded": time.time()}
         with open(os.path.join(vdir, f"{version}.json"), "w") as f:
             json.dump(meta, f)
+        # register in the store so artifact:// graph refs resolve
+        from .artifacts import register
+
+        url = (f"http://{self.advertise_host}:{self.http_port}"
+               f"/api/v1/artifacts/{name}/versions/{version}")
+        await register(self.client, name, version, url, digest, len(data))
         return web.json_response({"name": name, **meta}, status=201)
 
     async def _list_artifacts(self, _req: web.Request) -> web.Response:
@@ -133,6 +143,11 @@ class ApiStore:
         meta = path + ".json"
         if os.path.exists(meta):
             os.unlink(meta)
+        # unregister, or artifact://name (latest) would resolve to a
+        # version whose content is gone
+        from .artifacts import descriptor_key
+
+        await self.client.delete(descriptor_key(name, int(v)))
         return web.json_response({"deleted": f"{name}/{v}"})
 
     # ------------------------------------------------------------------
@@ -182,11 +197,13 @@ def main(argv=None) -> None:
     ap.add_argument("--root", default="./artifacts")
     ap.add_argument("--store", default="127.0.0.1:4222")
     ap.add_argument("--port", type=int, default=8082)
+    ap.add_argument("--advertise-host", default="127.0.0.1")
     args = ap.parse_args(argv)
     host, port = args.store.split(":")
 
     async def run():
-        store = ApiStore(args.root, host, int(port), args.port)
+        store = ApiStore(args.root, host, int(port), args.port,
+                         advertise_host=args.advertise_host)
         p = await store.start()
         print(f"api-store on 127.0.0.1:{p}", flush=True)
         while True:

@@ -103,7 +103,8 @@ class FakeRunner(Runner):
 
     def start(self, dep, service, idx, sspec, class_spec):
         h = {"dep": dep.key(), "service": service, "idx": idx,
-             "chips": sspec.tpu_chips, "class": class_spec, "alive": True}
+             "chips": sspec.tpu_chips, "class": class_spec,
+             "envs": dict(sspec.envs), "alive": True}
         self.started.append(h)
         return h
 
@@ -203,7 +204,16 @@ class Operator:
     async def _reconcile_one(self, dep_key: str, dep: Deployment) -> None:
         status = DeploymentStatus(observed_generation=dep.generation)
         try:
-            services = self._resolve_graph(dep)
+            artifact_dir = None
+            graph = dep.spec.graph
+            from .artifacts import is_artifact_ref, load_entry, resolve
+
+            if is_artifact_ref(graph):
+                artifact_dir, class_spec = await resolve(self.client, graph)
+                entry = load_entry(artifact_dir, class_spec)
+                services = self._collect_services(entry)
+            else:
+                services = self._resolve_graph(dep)
         except Exception as e:  # noqa: BLE001 - bad graph => failed status
             status.state = "failed"
             status.set_condition("GraphResolved", "False",
@@ -218,6 +228,13 @@ class Operator:
                 services.items():
             sspec = dep.spec.services.get(name) or ServiceSpec(
                 replicas=default_workers, tpu_chips=default_chips)
+            if artifact_dir is not None:
+                # worker children must see the extracted bundle on sys.path
+                import dataclasses
+
+                sspec = dataclasses.replace(
+                    sspec, envs={**sspec.envs,
+                                 "DYNAMO_ARTIFACT_PATH": artifact_dir})
             for idx in range(sspec.replicas):
                 desired[(dep_key, name, idx)] = (sspec, class_spec)
 
@@ -250,13 +267,11 @@ class Operator:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def _resolve_graph(dep: Deployment) -> Dict[str, Tuple[str, int, int]]:
+    def _collect_services(entry) -> Dict[str, Tuple[str, int, int]]:
         """service name -> (class import spec, default workers, default
-        chips) for every runnable service reachable from the entry."""
-        from ..sdk.serve_child import load_class
+        chips) for every runnable service reachable from the entry class."""
         from ..sdk.service import collect_graph
 
-        entry = load_class(dep.spec.graph)
         out: Dict[str, Tuple[str, int, int]] = {}
         for cls in collect_graph(entry):
             spec = cls._dynamo_spec
@@ -265,6 +280,12 @@ class Operator:
             out[spec.name] = (f"{cls.__module__}:{cls.__name__}",
                               spec.workers, int(spec.resources.get("tpu", 0)))
         return out
+
+    @staticmethod
+    def _resolve_graph(dep: Deployment) -> Dict[str, Tuple[str, int, int]]:
+        from ..sdk.serve_child import load_class
+
+        return Operator._collect_services(load_class(dep.spec.graph))
 
     async def _write_status(self, dep: Deployment,
                             status: DeploymentStatus) -> None:

@@ -78,6 +78,13 @@ def main(argv=None) -> None:
     init_logging()
     honor_jax_platforms_env()
     sys.path.insert(0, ".")
+    # artifact-deployed graphs: the operator extracts the bundle and hands
+    # its path down (deploy/artifacts.py)
+    import os
+
+    apath = os.environ.get("DYNAMO_ARTIFACT_PATH")
+    if apath:
+        sys.path.insert(0, apath)
     asyncio.run(run_service(load_class(args.service), args.store))
 
 
