@@ -85,10 +85,14 @@ def _run_model(model_cfg, batches, prompt_len, gen_tokens, max_context,
     from dynamo_tpu.llm.protocols.common import BackendInput, StopConditions
 
     def make_core(b: int) -> EngineCore:
+        # decode_steps amortizes the per-dispatch host round-trip (68 ms
+        # through the driver's TPU tunnel): 32 steps/dispatch cuts that
+        # overhead to ~2 ms/step at the cost of up to 31 wasted steps on
+        # the final dispatch of a finished sequence
         return EngineCore(JaxEngineConfig(
             model=model_cfg, tp=1, page_size=64, max_batch=b,
             max_context=max_context, prefill_chunk=min(512, max_context),
-            decode_steps=16 if on_tpu else 8))
+            decode_steps=32 if on_tpu else 8))
 
     core = None
     n_params = None
@@ -166,6 +170,17 @@ def _run_model(model_cfg, batches, prompt_len, gen_tokens, max_context,
         if peak_flops:
             # decode FLOPs/token ~= 2 * params (attention adds <2% at 256 ctx)
             entry["mfu"] = round(tok_s * 2.0 * n_params / peak_flops, 4)
+        try:
+            # prefix-reuse TTFT: the same prompts again — admission matches
+            # the cached blocks, so only the last token truly prefills
+            # (the KV-aware-routing / prefix-cache serving claim, measured)
+            if time.monotonic() < deadline:
+                _, _, warm_ttfts, _, _ = round_(
+                    f"reuse{b}_", b, salt=2 * b + 1)
+                entry["p50_ttft_warm_s"] = round(
+                    warm_ttfts[len(warm_ttfts) // 2], 4)
+        except Exception:  # noqa: BLE001 - warm pass is optional
+            pass
         sweep.append(entry)
     return n_params, sweep
 

@@ -89,11 +89,23 @@ class ApiStore:
     async def _upload(self, req: web.Request) -> web.Response:
         name = self._safe(req.match_info["name"])
         data = await req.read()
-        digest = hashlib.sha256(data).hexdigest()[:12]
+        digest = hashlib.sha256(data).hexdigest()
         vdir = self._vdir(name)
         os.makedirs(vdir, exist_ok=True)
+        # versions are monotonic even across deletes (a counter file, not
+        # max(existing)+1): reusing a deleted version's number would alias
+        # different content under one artifact://name/version
+        counter = os.path.join(vdir, ".next_version")
         existing = [int(v) for v in os.listdir(vdir) if v.isdigit()]
-        version = max(existing, default=0) + 1
+        floor = max(existing, default=0)
+        try:
+            with open(counter) as f:
+                floor = max(floor, int(f.read().strip()) - 1)
+        except (OSError, ValueError):
+            pass
+        version = floor + 1
+        with open(counter, "w") as f:
+            f.write(str(version + 1))
         with open(os.path.join(vdir, str(version)), "wb") as f:
             f.write(data)
         meta = {"version": version, "sha256": digest, "size": len(data),
@@ -136,6 +148,8 @@ class ApiStore:
     async def _del_art(self, req: web.Request) -> web.Response:
         name = self._safe(req.match_info["name"])
         v = self._safe(req.match_info["v"])
+        if not v.isdigit():
+            raise web.HTTPNotFound(text="no such artifact version")
         path = os.path.join(self._vdir(name), v)
         if not os.path.isfile(path):
             raise web.HTTPNotFound(text="no such artifact version")

@@ -100,6 +100,12 @@ async def resolve(client, ref: str) -> Tuple[str, str]:
     if digest != desc["sha256"]:
         raise ArtifactError(
             f"artifact {name!r} digest mismatch: {digest} != {desc['sha256']}")
+    # clear any stale extraction (a differing bundle once lived here):
+    # leftovers would stay importable next to the new content
+    if os.path.isdir(target):
+        import shutil
+
+        shutil.rmtree(target)
     os.makedirs(target, exist_ok=True)
     _extract(data, target, class_spec)
     with open(stamp, "w") as f:

@@ -127,6 +127,7 @@ class Operator:
         self.client: Optional[StoreClient] = None
         self._desired: Dict[str, Deployment] = {}
         self._workers: Dict[WorkerKey, Any] = {}
+        self._artifact_dirs: Dict[str, str] = {}   # dep key -> resolved dir
         self._dirty = asyncio.Event()
         self._stop = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
@@ -190,6 +191,8 @@ class Operator:
         live = set(self._desired)
         for wkey in [k for k in self._workers if k[0] not in live]:
             self.runner.stop(self._workers.pop(wkey))
+        for dk in [k for k in self._artifact_dirs if k not in live]:
+            del self._artifact_dirs[dk]
         removed_status = []
         for dep_key, dep in list(self._desired.items()):
             await self._reconcile_one(dep_key, dep)
@@ -212,6 +215,16 @@ class Operator:
                 artifact_dir, class_spec = await resolve(self.client, graph)
                 entry = load_entry(artifact_dir, class_spec)
                 services = self._collect_services(entry)
+                prev_dir = self._artifact_dirs.get(dep_key)
+                if prev_dir is not None and prev_dir != artifact_dir:
+                    # a new artifact version resolved (latest moved, or the
+                    # spec pinned a different one): restart the whole
+                    # deployment — a key-only diff would leave old workers
+                    # on the previous bundle, a silent mixed-version state
+                    for wkey in [k for k in self._workers
+                                 if k[0] == dep_key]:
+                        self.runner.stop(self._workers.pop(wkey))
+                self._artifact_dirs[dep_key] = artifact_dir
             else:
                 services = self._resolve_graph(dep)
         except Exception as e:  # noqa: BLE001 - bad graph => failed status

@@ -140,10 +140,13 @@ class _Slot:
 class StepOutput:
     seq_id: str
     token: int
-    logprob: float
+    logprob: float                  # cumulative over the sequence
     finish: Optional[FinishReason] = None
     prompt_tokens: int = 0
     error: Optional[str] = None     # cause when finish == ERROR
+    # this token's own logprob (not re-derivable from the cumulative without
+    # float cancellation)
+    token_logprob: float = 0.0
 
 
 class EngineCore:
@@ -547,7 +550,8 @@ class EngineCore:
         slot.cum_logprob = float(first_logprob)
         fin = self._finish_reason(slot, int(first_token))
         so = StepOutput(seq_id, int(first_token), slot.cum_logprob, fin,
-                        prompt_tokens=len(prompt))
+                        prompt_tokens=len(prompt),
+                        token_logprob=float(first_logprob))
         if fin is not None:
             self._free_slot(slot_idx)
         return so
@@ -873,7 +877,8 @@ class EngineCore:
             slot.cum_logprob += lp
             fin = self._finish_reason(slot, t)
             out.append(StepOutput(slot.seq_id, t, slot.cum_logprob, fin,
-                                  prompt_tokens=len(slot.prompt)))
+                                  prompt_tokens=len(slot.prompt),
+                                  token_logprob=lp))
             if fin is not None:
                 self._free_slot(i)
         return True
@@ -1053,9 +1058,11 @@ class EngineCore:
                 self.pool.account_tokens(slot.seq_id, [t])
                 slot.generated += 1
                 slot.last_token = t
-                slot.cum_logprob += float(packed_np[j, i, 1])
+                tok_lp = float(packed_np[j, i, 1])
+                slot.cum_logprob += tok_lp
                 fin = self._finish_reason(slot, t)
-                outs.append(StepOutput(slot.seq_id, t, slot.cum_logprob, fin))
+                outs.append(StepOutput(slot.seq_id, t, slot.cum_logprob, fin,
+                                       token_logprob=tok_lp))
                 if fin is not None:
                     # overshoot tokens beyond the finish are discarded; their
                     # page-pool writes are inside this seq's own pages, which
@@ -1229,6 +1236,7 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                 yield EngineOutput(
                     token_ids=[so.token],
                     cum_log_prob=so.logprob,
+                    logprobs=[{str(so.token): so.token_logprob}],
                     finish_reason=so.finish,
                 )
                 if so.finish is not None:

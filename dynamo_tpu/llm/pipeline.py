@@ -50,15 +50,27 @@ class OpenAIChatEngine(AsyncEngine[ChatCompletionRequest, Dict[str, Any]]):
         # messages), and streaming content that later turns out to be a tool
         # call would hand the client both.
         buffered: List[str] = []
+        buffered_lp: List[Dict[str, Any]] = []
         if pre.annotations:
             yield {"event": "annotations", "data": pre.annotations}
         async for out in self.backend.generate(pre.backend_input, context):
             completion_tokens += len(out.token_ids)
-            if out.text:
+            # with logprobs on, even a token with no visible text (partial
+            # UTF-8, stop-jail) must carry its logprob entry downstream
+            want_lp = bool(request.logprobs and out.logprobs)
+            if out.text or (want_lp and out.token_ids):
                 if matcher is not None:
-                    buffered.append(out.text)
+                    if out.text:
+                        buffered.append(out.text)
+                    if want_lp:
+                        buffered_lp.extend(
+                            self._chat_logprobs(out)["content"])
                 else:
-                    yield gen.text_chunk(out.text, out.index)
+                    chunk = gen.text_chunk(out.text or "", out.index)
+                    if want_lp:
+                        chunk["choices"][0]["logprobs"] = \
+                            self._chat_logprobs(out)
+                    yield chunk
             if out.finish_reason is not None:
                 finish_override = None
                 if matcher is not None:
@@ -69,13 +81,27 @@ class OpenAIChatEngine(AsyncEngine[ChatCompletionRequest, Dict[str, Any]]):
                         yield gen.tool_calls_chunk(calls, out.index)
                         finish_override = "tool_calls"
                     elif buffered:
-                        yield gen.text_chunk("".join(buffered), out.index)
+                        chunk = gen.text_chunk("".join(buffered), out.index)
+                        if buffered_lp:
+                            chunk["choices"][0]["logprobs"] = \
+                                {"content": buffered_lp}
+                        yield chunk
                 yield gen.finish_chunk(
                     out.finish_reason, out.index,
                     usage=usage_dict(prompt_tokens, completion_tokens),
                     finish_override=finish_override,
                 )
                 return
+
+    def _chat_logprobs(self, out: EngineOutput) -> Dict[str, Any]:
+        """OpenAI chat logprobs delta: one content entry per token."""
+        content = []
+        for tid, lp_map in zip(out.token_ids, out.logprobs or []):
+            lp = next(iter(lp_map.values())) if lp_map else 0.0
+            tok = self.preprocessor.tokenizer.decode([tid])
+            content.append({"token": tok, "logprob": lp,
+                            "bytes": list(tok.encode())})
+        return {"content": content}
 
 
 class OpenAICompletionEngine(AsyncEngine[CompletionRequest, Dict[str, Any]]):
@@ -98,8 +124,20 @@ class OpenAICompletionEngine(AsyncEngine[CompletionRequest, Dict[str, Any]]):
         async for out in self.backend.generate(pre.backend_input, context):
             completion_tokens += len(out.token_ids)
             fin = out.finish_reason.to_openai() if out.finish_reason else None
-            if out.text or fin:
-                chunk = gen.text_chunk(out.text or "", out.index, fin)
+            want_lp = request.logprobs is not None and bool(out.logprobs)
+            if out.text or fin or (want_lp and out.token_ids):
+                lp = None
+                if want_lp:
+                    toks = [self.preprocessor.tokenizer.decode([t])
+                            for t in out.token_ids]
+                    lp = {"tokens": toks,
+                          "token_logprobs": [
+                              next(iter(m.values())) if m else 0.0
+                              for m in out.logprobs],
+                          "top_logprobs": None,
+                          "text_offset": []}
+                chunk = gen.text_chunk(out.text or "", out.index, fin,
+                                       logprobs=lp)
                 if fin:
                     chunk["usage"] = usage_dict(prompt_tokens, completion_tokens)
                 yield chunk

@@ -283,6 +283,10 @@ def aggregate_chat_chunks(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
                     slot["function"]["name"] = fn["name"]
                 if fn.get("arguments"):
                     slot["function"]["arguments"] += fn["arguments"]
+            lp = c.get("logprobs")
+            if lp and lp.get("content"):
+                acc.setdefault("logprobs", {"content": []})["content"] \
+                    .extend(lp["content"])
             if c.get("finish_reason"):
                 acc["finish_reason"] = c["finish_reason"]
     first = chunks[0]
@@ -312,6 +316,14 @@ def aggregate_completion_chunks(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
                 i, {"index": i, "text": "", "logprobs": None, "finish_reason": None}
             )
             acc["text"] += c.get("text") or ""
+            lp = c.get("logprobs")
+            if lp and lp.get("tokens"):
+                dst = acc["logprobs"] or {"tokens": [], "token_logprobs": [],
+                                          "top_logprobs": None,
+                                          "text_offset": []}
+                dst["tokens"].extend(lp["tokens"])
+                dst["token_logprobs"].extend(lp["token_logprobs"])
+                acc["logprobs"] = dst
             if c.get("finish_reason"):
                 acc["finish_reason"] = c["finish_reason"]
     first = chunks[0]

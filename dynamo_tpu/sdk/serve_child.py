@@ -84,7 +84,10 @@ def main(argv=None) -> None:
 
     apath = os.environ.get("DYNAMO_ARTIFACT_PATH")
     if apath:
-        sys.path.insert(0, apath)
+        # appended, matching load_entry: bundles must not shadow framework
+        # or stdlib imports (and the worker must resolve the same code the
+        # operator resolved)
+        sys.path.append(apath)
     asyncio.run(run_service(load_class(args.service), args.store))
 
 

@@ -370,3 +370,45 @@ def test_long_context_over_8k():
     # chunk-size invariance of the prefill path is covered at small scale
     # by test_chunked_prefill_matches_full; here the point is that >8k
     # contexts run at all (pages, chunk loop, position handling)
+
+
+async def test_logprobs_flow_to_openai_responses():
+    """Sampled-token logprobs must reach both OpenAI response shapes:
+    completions (tokens/token_logprobs arrays) and chat (content entries)."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.pipeline import (build_chat_engine,
+                                         build_completion_engine)
+    from dynamo_tpu.llm.protocols.openai import (
+        ChatCompletionRequest,
+        CompletionRequest,
+        aggregate_chat_chunks,
+        aggregate_completion_chunks,
+    )
+    from dynamo_tpu.runtime.engine import Context, collect
+
+    eng = JaxEngine(make_cfg(max_batch=2))
+    try:
+        card = ModelDeploymentCard(name="m")
+        comp = build_completion_engine(card, "core", eng)
+        req = CompletionRequest.from_dict({
+            "model": "m", "prompt": "abcd", "max_tokens": 4, "logprobs": 1})
+        chunks = await collect(comp.generate(req, Context()))
+        agg = aggregate_completion_chunks([c for c in chunks
+                                           if "event" not in c])
+        lp = agg["choices"][0]["logprobs"]
+        assert lp is not None
+        assert len(lp["tokens"]) == len(lp["token_logprobs"]) == 4
+        assert all(v <= 0.0 for v in lp["token_logprobs"])
+
+        chat = build_chat_engine(card, "core", eng)
+        creq = ChatCompletionRequest.from_dict({
+            "model": "m", "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4, "logprobs": True})
+        cchunks = await collect(chat.generate(creq, Context()))
+        cagg = aggregate_chat_chunks([c for c in cchunks
+                                      if "event" not in c])
+        content = cagg["choices"][0]["logprobs"]["content"]
+        assert len(content) > 0
+        assert all("token" in e and e["logprob"] <= 0.0 for e in content)
+    finally:
+        eng.shutdown()
