@@ -1,7 +1,8 @@
 """Single-binary style launcher: ``python -m dynamo_tpu.cli.run in=... out=...``
 
 Input modes:  http | text | stdin | batch:<file.jsonl> | none
-Output modes: echo_core | echo_full | jax | dyn://<ns.component.endpoint>
+Output modes: echo_core | echo_full | jax | pystr:<file.py> |
+pytok:<file.py> | dyn://<ns.component.endpoint>
 
 Reference capability: launch/dynamo-run (lib.rs:53-456, opt.rs, flags.rs,
 input/{http,text,batch}.rs) — the in=X out=Y matrix, model flags, and the
@@ -94,6 +95,13 @@ def make_engines(args, card: ModelDeploymentCard):
         core = JaxEngine(cfg)
         return (build_chat_engine(card, "core", core),
                 build_completion_engine(card, "core", core))
+    if out.startswith(("pystr:", "pytok:")):
+        from ..llm.python_engine import PythonEngineError, build_python_engines
+
+        try:
+            return build_python_engines(out, card)
+        except PythonEngineError as e:
+            raise SystemExit(str(e))
     if out.startswith("dyn://"):
         raise SystemExit("out=dyn:// (remote endpoint) requires the distributed "
                          "runtime; use the runtime worker entrypoint instead")

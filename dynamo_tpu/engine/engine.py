@@ -199,6 +199,13 @@ class EngineCore:
             # dense elsewhere or when the model's GQA grouping can't split
             impl = ("pallas" if jax.default_backend() == "tpu"
                     and llama.pallas_tp_ok(m, cfg.tp) else "xla")
+            if impl == "pallas" and not _pallas_probe_ok(m, cfg):
+                # auto must never take the engine down: a Mosaic lowering
+                # regression (chip generation, shape corner) degrades to the
+                # dense XLA path instead of failing every request
+                log.warning("pallas kernel probe failed; auto falling back "
+                            "to attn_impl='xla'")
+                impl = "xla"
         if impl not in ("pallas", "xla", "ring"):
             raise ValueError(
                 f"attn_impl must be auto|pallas|xla|ring, got {impl!r}")
@@ -1080,6 +1087,40 @@ def _set_result(fut, res) -> None:
 def _set_exception(fut, exc) -> None:
     if not fut.done():
         fut.set_exception(exc)
+
+
+def _pallas_probe_ok(m, cfg) -> bool:
+    """Compile+run both Pallas kernels once at engine shapes (tiny batch).
+    Cheap insurance on the auto path: seconds at init versus every request
+    erroring if a kernel fails to lower on this chip."""
+    try:
+        from ..ops.attention import flash_attention, paged_attention
+
+        # probe the PER-SHARD instantiation the shard_map wrappers actually
+        # run at this tp — full-model head counts would validate a kernel
+        # that never executes at tp>1
+        tp = max(1, cfg.tp)
+        Hq = m.num_heads // tp
+        Hkv = (m.num_kv_heads // tp if m.num_kv_heads % tp == 0
+               else m.num_kv_heads)
+        Dh = m.head_dim
+        page = cfg.page_size
+        q = jnp.zeros((2, Hq, Dh), m.dtype)
+        kp = jnp.zeros((Hkv, 3, page, Dh), m.dtype)
+        pt = jnp.zeros((2, 1), jnp.int32)
+        ln = jnp.ones((2,), jnp.int32)
+        paged_attention(q, kp, kp, pt, ln, interpret=False
+                        ).block_until_ready()
+        T = max(8, min(128, cfg.prefill_chunk))
+        qf = jnp.zeros((2, T, Hq, Dh), m.dtype)
+        kf = jnp.zeros((2, T, Hkv, Dh), m.dtype)
+        pos = jnp.zeros((2, T), jnp.int32)
+        flash_attention(qf, kf, kf, pos, pos, pos < 1, interpret=False
+                        ).block_until_ready()
+        return True
+    except Exception:  # noqa: BLE001 - any lowering failure means fall back
+        log.exception("pallas probe failure detail")
+        return False
 
 
 def _has_safetensors(path: str) -> bool:

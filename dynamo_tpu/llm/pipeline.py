@@ -8,6 +8,7 @@ ServiceFrontend → OpenAIPreprocessor → Backend → ServiceBackend(engine)
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, AsyncIterator, Dict, List, Optional
 
 from ..runtime.engine import AsyncEngine, Context
@@ -53,45 +54,50 @@ class OpenAIChatEngine(AsyncEngine[ChatCompletionRequest, Dict[str, Any]]):
         buffered_lp: List[Dict[str, Any]] = []
         if pre.annotations:
             yield {"event": "annotations", "data": pre.annotations}
-        async for out in self.backend.generate(pre.backend_input, context):
-            completion_tokens += len(out.token_ids)
-            # with logprobs on, even a token with no visible text (partial
-            # UTF-8, stop-jail) must carry its logprob entry downstream
-            want_lp = bool(request.logprobs and out.logprobs)
-            if out.text or (want_lp and out.token_ids):
-                if matcher is not None:
-                    if out.text:
-                        buffered.append(out.text)
-                    if want_lp:
-                        buffered_lp.extend(
-                            self._chat_logprobs(out)["content"])
-                else:
-                    chunk = gen.text_chunk(out.text or "", out.index)
-                    if want_lp:
-                        chunk["choices"][0]["logprobs"] = \
-                            self._chat_logprobs(out)
-                    yield chunk
-            if out.finish_reason is not None:
-                finish_override = None
-                if matcher is not None:
-                    complete = out.finish_reason in (FinishReason.STOP,
-                                                     FinishReason.EOS)
-                    calls = matcher.get_calls("".join(buffered), complete)
-                    if calls:
-                        yield gen.tool_calls_chunk(calls, out.index)
-                        finish_override = "tool_calls"
-                    elif buffered:
-                        chunk = gen.text_chunk("".join(buffered), out.index)
-                        if buffered_lp:
+        # aclosing: the early return on finish must close the backend (and
+        # transitively the core engine) generator immediately
+        stream_cm = contextlib.aclosing(
+            self.backend.generate(pre.backend_input, context))
+        async with stream_cm as stream:
+            async for out in stream:
+                completion_tokens += len(out.token_ids)
+                # with logprobs on, even a token with no visible text (partial
+                # UTF-8, stop-jail) must carry its logprob entry downstream
+                want_lp = bool(request.logprobs and out.logprobs)
+                if out.text or (want_lp and out.token_ids):
+                    if matcher is not None:
+                        if out.text:
+                            buffered.append(out.text)
+                        if want_lp:
+                            buffered_lp.extend(
+                                self._chat_logprobs(out)["content"])
+                    else:
+                        chunk = gen.text_chunk(out.text or "", out.index)
+                        if want_lp:
                             chunk["choices"][0]["logprobs"] = \
-                                {"content": buffered_lp}
+                                self._chat_logprobs(out)
                         yield chunk
-                yield gen.finish_chunk(
-                    out.finish_reason, out.index,
-                    usage=usage_dict(prompt_tokens, completion_tokens),
-                    finish_override=finish_override,
-                )
-                return
+                if out.finish_reason is not None:
+                    finish_override = None
+                    if matcher is not None:
+                        complete = out.finish_reason in (FinishReason.STOP,
+                                                         FinishReason.EOS)
+                        calls = matcher.get_calls("".join(buffered), complete)
+                        if calls:
+                            yield gen.tool_calls_chunk(calls, out.index)
+                            finish_override = "tool_calls"
+                        elif buffered:
+                            chunk = gen.text_chunk("".join(buffered), out.index)
+                            if buffered_lp:
+                                chunk["choices"][0]["logprobs"] = \
+                                    {"content": buffered_lp}
+                            yield chunk
+                    yield gen.finish_chunk(
+                        out.finish_reason, out.index,
+                        usage=usage_dict(prompt_tokens, completion_tokens),
+                        finish_override=finish_override,
+                    )
+                    return
 
     def _chat_logprobs(self, out: EngineOutput) -> Dict[str, Any]:
         """OpenAI chat logprobs delta: one content entry per token."""
@@ -121,47 +127,73 @@ class OpenAICompletionEngine(AsyncEngine[CompletionRequest, Dict[str, Any]]):
         completion_tokens = 0
         if request.echo and pre.formatted_prompt:
             yield gen.text_chunk(pre.formatted_prompt)
-        async for out in self.backend.generate(pre.backend_input, context):
-            completion_tokens += len(out.token_ids)
-            fin = out.finish_reason.to_openai() if out.finish_reason else None
-            want_lp = request.logprobs is not None and bool(out.logprobs)
-            if out.text or fin or (want_lp and out.token_ids):
-                lp = None
-                if want_lp:
-                    toks = [self.preprocessor.tokenizer.decode([t])
-                            for t in out.token_ids]
-                    lp = {"tokens": toks,
-                          "token_logprobs": [
-                              next(iter(m.values())) if m else 0.0
-                              for m in out.logprobs],
-                          "top_logprobs": None,
-                          "text_offset": []}
-                chunk = gen.text_chunk(out.text or "", out.index, fin,
-                                       logprobs=lp)
+        async with contextlib.aclosing(
+                self.backend.generate(pre.backend_input,
+                                      context)) as stream:
+            async for out in stream:
+                completion_tokens += len(out.token_ids)
+                fin = out.finish_reason.to_openai() if out.finish_reason else None
+                want_lp = request.logprobs is not None and bool(out.logprobs)
+                if out.text or fin or (want_lp and out.token_ids):
+                    lp = None
+                    if want_lp:
+                        toks = [self.preprocessor.tokenizer.decode([t])
+                                for t in out.token_ids]
+                        lp = {"tokens": toks,
+                              "token_logprobs": [
+                                  next(iter(m.values())) if m else 0.0
+                                  for m in out.logprobs],
+                              "top_logprobs": None,
+                              "text_offset": []}
+                    chunk = gen.text_chunk(out.text or "", out.index, fin,
+                                           logprobs=lp)
+                    if fin:
+                        chunk["usage"] = usage_dict(prompt_tokens, completion_tokens)
+                    yield chunk
                 if fin:
-                    chunk["usage"] = usage_dict(prompt_tokens, completion_tokens)
-                yield chunk
-            if fin:
-                return
+                    return
 
 
 class FullEngineAdapter(AsyncEngine):
-    """Adapts a text-level full engine (streams plain text, e.g. EchoFullEngine)
-    to OpenAI chunk dicts for both chat and completions."""
+    """Adapts a text-level full engine (streams plain text, e.g. EchoFullEngine
+    or a pystr user engine) to OpenAI chunk dicts for both chat and
+    completions. With a ``tokenizer``, usage counts are derived from the
+    request/response text (full engines have no token stream of their own)."""
 
-    def __init__(self, model: str, engine: AsyncEngine, kind: str = "chat"):
+    def __init__(self, model: str, engine: AsyncEngine, kind: str = "chat",
+                 tokenizer=None):
         self.model = model
         self.engine = engine
         self.kind = kind
+        self.tokenizer = tokenizer
 
     async def generate(self, request, context: Context):
         if self.kind == "chat":
             gen = ChatDeltaGenerator(self.model, request_id=f"chatcmpl-{context.id[:24]}")
         else:
             gen = CompletionDeltaGenerator(self.model, request_id=f"cmpl-{context.id[:24]}")
-        async for text in self.engine.generate(request, context):
-            yield gen.text_chunk(text)
-        yield gen.finish_chunk(FinishReason.STOP)
+        parts = []
+        async with contextlib.aclosing(
+                self.engine.generate(request, context)) as stream:
+            async for text in stream:
+                if self.tokenizer is not None:
+                    parts.append(text)
+                yield gen.text_chunk(text)
+        usage = None
+        if self.tokenizer is not None:
+            if self.kind == "chat":
+                from .preprocessor import content_text
+
+                prompt_text = "".join(content_text(m.get("content"))
+                                      for m in request.messages)
+            else:
+                prompt_text = request.prompt if isinstance(request.prompt, str) else ""
+            usage = usage_dict(len(self.tokenizer.encode(prompt_text)),
+                               len(self.tokenizer.encode("".join(parts))))
+        chunk = gen.finish_chunk(FinishReason.STOP)
+        if usage is not None:
+            chunk["usage"] = usage
+        yield chunk
 
 
 def build_chat_engine(card: ModelDeploymentCard, kind: str,

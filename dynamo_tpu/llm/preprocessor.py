@@ -35,6 +35,17 @@ _JINJA_ENV.globals["raise_exception"] = lambda msg: (_ for _ in ()).throw(
 )
 
 
+def content_text(content: Any) -> str:
+    """Message content as text: plain string, OpenAI multipart list of
+    {'type':'text','text':...} parts, or None (tool-call messages)."""
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        return "".join(p.get("text", "") for p in content
+                       if isinstance(p, dict))
+    return "" if content is None else str(content)
+
+
 @dataclass
 class PreprocessedRequest:
     backend_input: BackendInput
@@ -54,9 +65,14 @@ class Preprocessor:
     # ------------------------------------------------------------------
     def render_chat(self, messages: List[Dict[str, Any]],
                     tools: Optional[List[Dict[str, Any]]] = None) -> str:
+        # normalize OpenAI multipart content ([{'type':'text','text':...}])
+        # and None (tool-call messages) to plain strings: chat templates
+        # concatenate content directly
+        msgs = [{**m, "content": content_text(m.get("content"))}
+                for m in messages]
         try:
             return self._template.render(
-                messages=messages,
+                messages=msgs,
                 tools=tools,
                 add_generation_prompt=True,
                 bos_token="",

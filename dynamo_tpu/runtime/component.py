@@ -132,6 +132,7 @@ class DistributedRuntime:
         self.dp_port: Optional[int] = None
         self._handlers: Dict[str, Handler] = {}
         self._active: Dict[str, Context] = {}
+        self._conn_writers: set = set()   # live data-plane connections
 
     async def connect(self) -> "DistributedRuntime":
         await self.store.connect()
@@ -147,6 +148,17 @@ class DistributedRuntime:
                 pass
         if self._dp_server:
             self._dp_server.close()
+        # established connections must die with the runtime (a dead process
+        # would reset them; a merely-closed listener leaves clients hanging
+        # on streams forever) — stop in-flight requests, drop sockets
+        for ctx in list(self._active.values()):
+            ctx.stop_generating()
+        for w in list(self._conn_writers):
+            try:
+                w.close()
+            except Exception:
+                pass
+        self._conn_writers.clear()
         if self._native_dp is not None:
             self._native_dp.stop()
             self._native_dp = None
@@ -178,6 +190,7 @@ class DistributedRuntime:
                           writer: asyncio.StreamWriter) -> None:
         fr = FrameReader(reader)
         pending = None
+        self._conn_writers.add(writer)
         try:
             while True:
                 frame = pending if pending is not None else await fr.read()
@@ -196,6 +209,7 @@ class DistributedRuntime:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            self._conn_writers.discard(writer)
             writer.close()
 
     async def _run_request(self, control: Dict[str, Any],

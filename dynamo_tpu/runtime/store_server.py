@@ -124,6 +124,7 @@ class StoreServer:
         self._queue_msg_ids = itertools.count(1)
         self._server: Optional[asyncio.base_events.Server] = None
         self._reaper: Optional[asyncio.Task] = None
+        self._conns: set = set()
 
     # ------------------------------------------------------------------
     async def start(self) -> int:
@@ -138,6 +139,14 @@ class StoreServer:
             self._reaper.cancel()
         if self._server:
             self._server.close()
+            # force-close live connections: 3.12's wait_closed waits for
+            # every handler, and a client that never disconnects (or a test
+            # that leaked one) would park shutdown forever
+            for conn in list(self._conns):
+                try:
+                    conn.writer.close()
+                except Exception:
+                    pass
             await self._server.wait_closed()
 
     async def _reap_leases(self) -> None:
@@ -161,6 +170,7 @@ class StoreServer:
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
         conn = _Conn(writer)
+        self._conns.add(conn)
         fr = FrameReader(reader)
         try:
             while True:
@@ -174,6 +184,7 @@ class StoreServer:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            self._conns.discard(conn)
             await self._cleanup(conn)
             writer.close()
 

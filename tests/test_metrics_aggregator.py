@@ -55,8 +55,10 @@ async def test_aggregator_scrapes_mock_workers():
         await ns.publish("kv-hit-rate",
                          {"worker_id": w2.worker_id, "isl_blocks": 8,
                           "overlap_blocks": 6})
+        # pub/sub delivery is detached (per-connection outbox pump): wait
+        # for the FINAL value, not merely the first event
         for _ in range(100):
-            if agg.g_hit_rate.get() > 0:
+            if agg.g_hit_rate.get() == 50.0:
                 break
             await asyncio.sleep(0.02)
         assert agg.g_hit_rate.get() == 50.0   # (2+6)/(8+8)

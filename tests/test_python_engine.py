@@ -1,0 +1,204 @@
+"""out=pystr:/pytok: user Python engines (reference lib/engines/python)."""
+
+import pytest
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.protocols.common import BackendInput, StopConditions
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    aggregate_chat_chunks,
+    aggregate_completion_chunks,
+)
+from dynamo_tpu.llm.python_engine import (
+    PythonEngineError,
+    build_python_engines,
+)
+from dynamo_tpu.runtime.engine import Context, collect
+
+PYTOK = '''
+async def generate(request, context):
+    # reverse the prompt tokens, one at a time
+    for t in reversed(request.token_ids):
+        yield t
+'''
+
+PYSTR = '''
+async def generate(prompt, context):
+    yield "you said: "
+    yield prompt.upper()[:20]
+'''
+
+
+@pytest.fixture
+def card():
+    return ModelDeploymentCard(name="pym")
+
+
+async def test_pytok_core_engine(tmp_path, card):
+    f = tmp_path / "eng.py"
+    f.write_text(PYTOK)
+    chat, comp = build_python_engines(f"pytok:{f}", card)
+    req = CompletionRequest.from_dict({
+        "model": "pym", "prompt": "abc", "max_tokens": 3})
+    chunks = await collect(comp.generate(req, Context()))
+    agg = aggregate_completion_chunks([c for c in chunks if "event" not in c])
+    # byte tokenizer: reversed "abc" == "cba"
+    assert agg["choices"][0]["text"] == "cba"
+
+
+async def test_pytok_respects_max_tokens(tmp_path, card):
+    f = tmp_path / "eng.py"
+    f.write_text(PYTOK)
+    _, comp = build_python_engines(f"pytok:{f}", card)
+    req = CompletionRequest.from_dict({
+        "model": "pym", "prompt": "abcdef", "max_tokens": 2})
+    chunks = await collect(comp.generate(req, Context()))
+    agg = aggregate_completion_chunks([c for c in chunks if "event" not in c])
+    assert agg["choices"][0]["text"] == "fe"
+    assert agg["choices"][0]["finish_reason"] == "length"
+
+
+async def test_pystr_full_engine(tmp_path, card):
+    f = tmp_path / "eng.py"
+    f.write_text(PYSTR)
+    chat, comp = build_python_engines(f"pystr:{f}", card)
+    creq = ChatCompletionRequest.from_dict({
+        "model": "pym", "messages": [{"role": "user", "content": "hi"}]})
+    chunks = await collect(chat.generate(creq, Context()))
+    agg = aggregate_chat_chunks([c for c in chunks if "event" not in c])
+    content = agg["choices"][0]["message"]["content"]
+    assert content.startswith("you said: ")
+    # the user engine saw the TEMPLATED prompt (ChatML markers upper-cased)
+    assert "<|IM_START|>" in content
+
+
+async def test_bad_engine_file(tmp_path, card):
+    f = tmp_path / "nogen.py"
+    f.write_text("x = 1\n")
+    with pytest.raises(PythonEngineError, match="generate"):
+        build_python_engines(f"pytok:{f}", card)
+    with pytest.raises(PythonEngineError, match="not found"):
+        build_python_engines("pytok:/nope/missing.py", card)
+    with pytest.raises(PythonEngineError, match="path"):
+        build_python_engines("pystr:", card)
+
+
+PYTOK_EO = '''
+from dynamo_tpu.llm.protocols.common import EngineOutput
+
+async def generate(request, context):
+    for t in request.token_ids:
+        yield EngineOutput(token_ids=[t])
+'''
+
+
+async def test_pytok_engineoutput_budget_enforced(tmp_path, card):
+    """max_tokens binds even when the user yields EngineOutput objects."""
+    f = tmp_path / "eng.py"
+    f.write_text(PYTOK_EO)
+    _, comp = build_python_engines(f"pytok:{f}", card)
+    req = CompletionRequest.from_dict({
+        "model": "pym", "prompt": "abcdef", "max_tokens": 2})
+    chunks = await collect(comp.generate(req, Context()))
+    agg = aggregate_completion_chunks([c for c in chunks if "event" not in c])
+    assert agg["choices"][0]["text"] == "ab"
+    assert agg["choices"][0]["finish_reason"] == "length"
+
+
+async def test_pystr_usage_and_prompt_validation(tmp_path, card):
+    f = tmp_path / "eng.py"
+    f.write_text(PYSTR)
+    chat, comp = build_python_engines(f"pystr:{f}", card)
+    creq = ChatCompletionRequest.from_dict({
+        "model": "pym", "messages": [{"role": "user", "content": "hello"}]})
+    chunks = await collect(chat.generate(creq, Context()))
+    agg = aggregate_chat_chunks([c for c in chunks if "event" not in c])
+    assert agg["usage"]["completion_tokens"] > 0
+    assert agg["usage"]["prompt_tokens"] > 0
+
+    # token-id prompts are rejected like the in-tree preprocessor does
+    from dynamo_tpu.llm.protocols.openai import ProtocolError
+
+    bad = CompletionRequest.from_dict({"model": "pym", "prompt": [1, 2, 3]})
+    with pytest.raises(ProtocolError):
+        await collect(comp.generate(bad, Context()))
+
+
+async def test_pystr_tool_choice_none_strips_tools(tmp_path, card):
+    """tool_choice='none' keeps tool schemas out of the rendered prompt the
+    user engine sees (same contract as the in-tree preprocessor)."""
+    f = tmp_path / "eng.py"
+    f.write_text("async def generate(prompt, context):\n    yield prompt\n")
+    chat, _ = build_python_engines(f"pystr:{f}", card)
+    tool = {"type": "function", "function": {"name": "secret_tool"}}
+    req = ChatCompletionRequest.from_dict({
+        "model": "pym", "messages": [{"role": "user", "content": "x"}],
+        "tools": [tool], "tool_choice": "none"})
+    chunks = await collect(chat.generate(req, Context()))
+    agg = aggregate_chat_chunks([c for c in chunks if "event" not in c])
+    assert "secret_tool" not in agg["choices"][0]["message"]["content"]
+    req2 = ChatCompletionRequest.from_dict({
+        "model": "pym", "messages": [{"role": "user", "content": "x"}],
+        "tools": [tool]})
+    chunks2 = await collect(chat.generate(req2, Context()))
+    agg2 = aggregate_chat_chunks([c for c in chunks2 if "event" not in c])
+    assert "secret_tool" in agg2["choices"][0]["message"]["content"]
+
+
+async def test_pytok_generator_closed_on_stop(tmp_path, card):
+    """Cancelling mid-stream must aclose() the user generator so its
+    cleanup runs immediately (FnEngine discipline)."""
+    sentinel = tmp_path / "closed.txt"
+    f = tmp_path / "eng.py"
+    f.write_text(f"""
+async def generate(request, context):
+    try:
+        for t in request.token_ids:
+            yield t
+    finally:
+        open({str(sentinel)!r}, "w").write("closed")
+""")
+    _, comp = build_python_engines(f"pytok:{f}", card)
+    ctx = Context()
+    n = 0
+    async for ch in comp.generate(CompletionRequest.from_dict(
+            {"model": "pym", "prompt": "abcdefgh", "max_tokens": 8}), ctx):
+        n += 1
+        if n == 2:
+            ctx.stop_generating()
+    # the stream ended via CANCELLED and the user generator's finally ran
+    assert sentinel.exists() and n < 10
+
+
+async def test_pytok_multitoken_yield_truncated_at_budget(tmp_path, card):
+    """A single multi-token yield crossing max_tokens is truncated, not
+    passed through whole."""
+    f = tmp_path / "eng.py"
+    f.write_text('''
+async def generate(request, context):
+    yield list(request.token_ids)   # everything at once
+''')
+    _, comp = build_python_engines(f"pytok:{f}", card)
+    req = CompletionRequest.from_dict({
+        "model": "pym", "prompt": "abcdef", "max_tokens": 2})
+    chunks = await collect(comp.generate(req, Context()))
+    agg = aggregate_completion_chunks([c for c in chunks if "event" not in c])
+    assert agg["choices"][0]["text"] == "ab"
+    assert agg["choices"][0]["finish_reason"] == "length"
+    assert agg["usage"]["completion_tokens"] == 2
+
+
+async def test_multipart_chat_content_usage(tmp_path, card):
+    """OpenAI multipart message content counts its text parts, not a repr."""
+    f = tmp_path / "eng.py"
+    f.write_text("async def generate(prompt, context):\n    yield 'ok'\n")
+    chat, _ = build_python_engines(f"pystr:{f}", card)
+    req = ChatCompletionRequest.from_dict({
+        "model": "pym",
+        "messages": [{"role": "user",
+                      "content": [{"type": "text", "text": "hi"}]}]})
+    chunks = await collect(chat.generate(req, Context()))
+    agg = aggregate_chat_chunks([c for c in chunks if "event" not in c])
+    # byte tokenizer: "hi" == 2 tokens, not the 20+ of the list repr
+    assert agg["usage"]["prompt_tokens"] == 2

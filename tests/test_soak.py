@@ -1,0 +1,101 @@
+"""Churn soak: sustained concurrent load through the runtime while workers
+join and die mid-stream. Every request must terminate cleanly (answer or a
+typed error — never a hang), the live set must shrink/grow with membership,
+and a full drain must leave the store clean.
+
+Reference capability: lib/runtime/tests/soak.rs (long-running churn tier)
+scaled to CI time.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.store_server import StoreServer
+
+pytestmark = pytest.mark.slow
+
+
+async def start_worker(port, tag):
+    drt = await DistributedRuntime(store_port=port,
+                                   advertise_host="127.0.0.1").connect()
+
+    async def handler(request, ctx):
+        for i in range(int(request.get("n", 5))):
+            await asyncio.sleep(0.002)
+            if ctx.is_stopped:
+                return
+            yield {"tag": tag, "i": i}
+
+    await drt.namespace("soak").component("c").endpoint("gen").serve(handler)
+    return drt
+
+
+async def test_churn_soak():
+    rng = random.Random(7)
+    store = StoreServer()
+    port = await store.start()
+    workers = {}
+    try:
+        for i in range(3):
+            workers[i] = await start_worker(port, f"w{i}")
+        caller = await DistributedRuntime(store_port=port).connect()
+        client = await (caller.namespace("soak").component("c")
+                        .endpoint("gen").client().start())
+
+        stats = {"ok": 0, "failed": 0}
+
+        async def one_request(k):
+            try:
+                items = []
+                async for item in client.generate({"n": 5}):
+                    items.append(item)
+                assert len(items) == 5
+                stats["ok"] += 1
+            except Exception:
+                # a request in flight on a killed worker errors — that is
+                # the contract (no silent hang, no wrong answer)
+                stats["failed"] += 1
+
+        next_id = 3
+        for round_ in range(6):
+            burst = [asyncio.create_task(one_request(f"{round_}:{i}"))
+                     for i in range(10)]
+            await asyncio.sleep(0.01)
+            if round_ % 2 == 0 and workers:
+                # kill a random worker mid-burst (hard close: lease revoke)
+                victim = rng.choice(list(workers))
+                await workers.pop(victim).close()
+            else:
+                workers[next_id] = await start_worker(port, f"w{next_id}")
+                next_id += 1
+            await asyncio.wait_for(asyncio.gather(*burst), 30)
+
+        # every request terminated, most succeeded
+        total = stats["ok"] + stats["failed"]
+        assert total == 60
+        assert stats["ok"] >= 45, stats
+
+        # the live set reflects only surviving workers
+        await asyncio.sleep(0.3)
+        live = client.instance_ids()
+        assert len(live) == len(workers)
+
+        # drain: close everything; the store's endpoint prefix must empty
+        await caller.close()
+        for drt in workers.values():
+            await drt.close()
+        workers.clear()
+        from dynamo_tpu.runtime.store_client import StoreClient
+
+        probe = await StoreClient("127.0.0.1", port).connect()
+        left = await probe.get_prefix("soak/")
+        await probe.close()
+        assert left == []
+    finally:
+        for drt in workers.values():
+            await drt.close()
+        await store.stop()
