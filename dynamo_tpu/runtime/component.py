@@ -475,7 +475,8 @@ class Client:
     def instance_ids(self) -> List[int]:
         return sorted(self.instances)
 
-    def _pick(self, mode: str, instance_id: Optional[int]) -> EndpointInfo:
+    def _pick(self, mode: str, instance_id: Optional[int],
+              exclude: Optional[set] = None) -> Tuple[int, EndpointInfo]:
         if not self.instances:
             raise EngineError(f"no live instances of {self.endpoint.path}", 503)
         if mode == "direct":
@@ -483,11 +484,17 @@ class Client:
                 raise EngineError(
                     f"instance {instance_id} of {self.endpoint.path} is gone",
                     503)
-            return self.instances[instance_id]
-        ids = sorted(self.instances)
+            return instance_id, self.instances[instance_id]
+        ids = sorted(i for i in self.instances
+                     if not exclude or i not in exclude)
+        if not ids:
+            raise EngineError(
+                f"all live instances of {self.endpoint.path} unreachable", 503)
         if mode == "round_robin":
-            return self.instances[ids[next(self._rr) % len(ids)]]
-        return self.instances[random.choice(ids)]
+            iid = ids[next(self._rr) % len(ids)]
+        else:
+            iid = random.choice(ids)
+        return iid, self.instances[iid]
 
     async def generate(self, request: Any, context: Optional[Context] = None,
                        mode: str = "random",
@@ -498,72 +505,127 @@ class Client:
         With ``parts`` set, streams the binary chunks after the request header
         (server handler receives a :class:`StreamingRequest`)."""
         ctx = context or Context()
-        info = self._pick(mode, instance_id)
-        key = (info.host, info.port)
-
+        # serialize BEFORE any socket exists: a non-serializable request
+        # must not leak a freshly opened connection
         if isinstance(request, (bytes, bytearray)):
-            req_control = {"kind": "request", "endpoint": info.endpoint,
-                           "context_id": ctx.id, "ctype": "bin"}
+            base_control = {"kind": "request", "context_id": ctx.id,
+                            "ctype": "bin"}
             req_payload = bytes(request)
         else:
-            req_control = {"kind": "request", "endpoint": info.endpoint,
-                           "context_id": ctx.id}
+            base_control = {"kind": "request", "context_id": ctx.id}
             req_payload = json.dumps(request).encode()
         if parts is not None:
-            req_control["streaming"] = True
-
-        # part-streaming requests can't replay their body on a stale pooled
-        # connection, so they always open fresh
-        pooled = None if parts is not None else self._pool_get(key)
-        if pooled is not None:
-            reader, fr, writer = pooled
-        else:
-            reader, writer = await asyncio.open_connection(info.host,
-                                                           info.port)
-            fr = FrameReader(reader)
+            base_control["streaming"] = True
 
         # a stop/kill issued while we wait for the first frame (mid-prefill)
         # must reach the server immediately: the stopper lives for the whole
         # exchange and always writes to the CURRENT connection
-        live = {"writer": writer}
+        live: Dict[str, Any] = {"writer": None}
 
         async def forward_stop():
             await ctx.stopped()
             try:
-                await write_frame(live["writer"], [{"kind": "stop"}, None])
+                if live["writer"] is not None:
+                    await write_frame(live["writer"], [{"kind": "stop"}, None])
             except Exception:
                 pass
 
         stopper = asyncio.create_task(forward_stop())
 
-        # first exchange: on a pooled connection the server may have closed
-        # it while idle — reopen fresh and resend. (If the server instead
-        # died MID-request, the resend could double-execute; the server's
-        # duplicate-context guard turns that rare race into a clean error.)
-        attempts = 2 if pooled is not None else 1
+        # Failover: a worker that died a moment ago may still be in the
+        # watched live set. Connect-refused means the process is gone, so
+        # the request CANNOT have executed there — retrying on another
+        # instance is safe, including after a failed write to a stale
+        # pooled connection (the reconnect probe tells dead apart from
+        # merely-idle-closed). direct mode never fails over; once a server
+        # ANSWERED, a mid-stream failure never retries.
+        failed: set = set()
         try:
-            for attempt in range(attempts):
-                try:
-                    await write_frame(writer, [req_control, req_payload])
-                    if parts is not None:
-                        async for chunk in parts:
-                            await write_frame(
-                                writer, [{"kind": "part", "ctype": "bin"},
-                                         bytes(chunk)])
-                        await write_frame(writer, [{"kind": "end"}, None])
-                    first = await fr.read()
-                    break
-                except (ConnectionResetError, BrokenPipeError,
-                        asyncio.IncompleteReadError) as e:
-                    writer.close()
-                    if attempt == attempts - 1:
-                        raise EngineError(
-                            f"connection to {info.host}:{info.port} failed: "
-                            f"{e}", 503) from e
-                    reader, writer = await asyncio.open_connection(
-                        info.host, info.port)
+            while True:
+                iid, info = self._pick(mode, instance_id, failed)
+                key = (info.host, info.port)
+
+                def _fail(iid=iid, key=key):
+                    failed.add(iid)
+                    self._pool_drop(key)
+
+                # part-streaming requests can't replay their body on a
+                # stale pooled connection, so they always open fresh
+                pooled = None if parts is not None else self._pool_get(key)
+                if pooled is not None:
+                    reader, fr, writer = pooled
+                else:
+                    try:
+                        reader, writer = await asyncio.open_connection(
+                            info.host, info.port)
+                    except OSError as e:
+                        _fail()
+                        if mode == "direct":
+                            raise EngineError(
+                                f"connect to instance {iid:x} at "
+                                f"{info.host}:{info.port} failed: {e}",
+                                503) from e
+                        continue   # _pick raises 503 when none are left
                     fr = FrameReader(reader)
-                    live["writer"] = writer
+                live["writer"] = writer
+
+                req_control = {**base_control, "endpoint": info.endpoint}
+                # first exchange: on a pooled connection the server may have
+                # closed it while idle — reopen fresh and resend once. (If
+                # the server instead died MID-request, the resend could
+                # double-execute; the server's duplicate-context guard turns
+                # that rare race into a clean error.)
+                attempts = 2 if pooled is not None else 1
+                first = None
+                for attempt in range(attempts):
+                    try:
+                        await write_frame(writer, [req_control, req_payload])
+                        if parts is not None:
+                            async for chunk in parts:
+                                await write_frame(
+                                    writer,
+                                    [{"kind": "part", "ctype": "bin"},
+                                     bytes(chunk)])
+                            await write_frame(writer, [{"kind": "end"}, None])
+                        first = await fr.read()
+                        break
+                    except (ConnectionResetError, BrokenPipeError,
+                            asyncio.IncompleteReadError) as e:
+                        writer.close()
+                        if attempt < attempts - 1:
+                            try:
+                                reader, writer = await asyncio.open_connection(
+                                    info.host, info.port)
+                            except OSError:
+                                break   # process gone: fail over below
+                            fr = FrameReader(reader)
+                            live["writer"] = writer
+                            continue
+                        # final attempt failed. Probe: if the PROCESS still
+                        # answers connects, the request may have started
+                        # executing there — cross-instance retry could
+                        # double-execute, so surface the error. Only a dead
+                        # process (connect refused) fails over.
+                        try:
+                            _pr, _pw = await asyncio.open_connection(
+                                info.host, info.port)
+                            _pw.close()
+                            process_alive = True
+                        except OSError:
+                            process_alive = False
+                        if process_alive or parts is not None \
+                                or mode == "direct":
+                            raise EngineError(
+                                f"connection to {info.host}:{info.port} "
+                                f"failed: {e}", 503) from e
+                        break           # dead process: fail over below
+                if first is not None:
+                    break
+                _fail()
+                if mode == "direct":
+                    raise EngineError(
+                        f"instance {iid:x} at {info.host}:{info.port} "
+                        f"unreachable", 503)
         except BaseException:
             stopper.cancel()
             raise

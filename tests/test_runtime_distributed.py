@@ -393,3 +393,55 @@ async def test_pooled_connection_survives_server_restart_of_stream():
         await worker.close()
     finally:
         await srv.stop()
+
+
+async def test_client_fails_over_dead_instance():
+    """A worker that died an instant ago can still be in the watched live
+    set; a connect-refused pick must fail over to a live instance instead of
+    erroring the request (safe: nothing was sent)."""
+    import json as _json
+
+    from dynamo_tpu.runtime.component import EndpointInfo
+
+    srv, port = await start_store()
+    try:
+        w = await DistributedRuntime(store_port=port,
+                                     advertise_host="127.0.0.1").connect()
+        ep = w.namespace("fo").component("c").endpoint("gen")
+
+        async def handler(request, ctx):
+            yield {"ok": True}
+
+        await ep.serve(handler)
+
+        # forge a second registration pointing at a port nobody listens on
+        ghost_lease = await w.store.lease_grant(ttl=30)
+        dead = EndpointInfo(host="127.0.0.1", port=1, endpoint="fo/c/gen",
+                    lease=ghost_lease, worker_id=ghost_lease)
+        await w.store.put(f"fo/components/c/gen:{ghost_lease:x}",
+                          dead.to_bytes(), lease=ghost_lease)
+
+        caller = await DistributedRuntime(store_port=port).connect()
+        client = await (caller.namespace("fo").component("c")
+                        .endpoint("gen").client().start())
+        await client.wait_for_instances(2)
+
+        # every round-robin pick must succeed, including the ones that land
+        # on the ghost first
+        for _ in range(6):
+            out = [x async for x in client.generate({}, mode="round_robin")]
+            assert out == [{"ok": True}]
+
+        # direct to the ghost still errors (no silent rerouting)
+        import pytest as _pytest
+
+        from dynamo_tpu.runtime.engine import EngineError
+
+        with _pytest.raises(EngineError):
+            async for _ in client.generate({}, mode="direct",
+                                           instance_id=ghost_lease):
+                pass
+        await caller.close()
+        await w.close()
+    finally:
+        await srv.stop()
