@@ -190,9 +190,10 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
 
 
 def param_specs(cfg: LlamaConfig, tp_size: int = 1) -> Dict[str, Any]:
-    """PartitionSpecs: tp shards attention heads and the ffn dimension.
-    KV projections replicate when GQA kv_heads aren't divisible by tp.
-    (vocab/embed replicated — vocab-sharding is a later optimization.)"""
+    """PartitionSpecs: tp shards attention heads, the ffn dimension, and —
+    when the model is untied and the vocab divides tp — the LM head's vocab
+    dim. KV projections replicate when GQA kv_heads aren't divisible by tp;
+    the embedding stays replicated (token gathers need the full table)."""
     from ..parallel.mesh import AXIS_EP
 
     tp = AXIS_TP
@@ -230,7 +231,11 @@ def param_specs(cfg: LlamaConfig, tp_size: int = 1) -> Dict[str, Any]:
         specs["layers"]["bk"] = P(None, kv, None)
         specs["layers"]["bv"] = P(None, kv, None)
     if not cfg.tie_embeddings:
-        specs["lm_head"] = P(None, None)
+        # vocab-sharded head: the [B,D]x[D,V] logits matmul partitions over
+        # tp (each chip computes V/tp columns); GSPMD all-gathers the row
+        # only where sampling consumes it. Weight memory drops V*D/tp too.
+        head_tp = tp if cfg.vocab_size % max(tp_size, 1) == 0 else None
+        specs["lm_head"] = P(None, head_tp)
     return specs
 
 

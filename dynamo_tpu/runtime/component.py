@@ -524,21 +524,27 @@ class Client:
 
         async def forward_stop():
             await ctx.stopped()
-            try:
-                if live["writer"] is not None:
-                    await write_frame(live["writer"], [{"kind": "stop"}, None])
-            except Exception:
-                pass
+            # the connect/failover window may not have a writer yet: wait
+            # for one so the stop cannot be silently lost
+            for _ in range(200):
+                w = live["writer"]
+                if w is not None:
+                    try:
+                        await write_frame(w, [{"kind": "stop"}, None])
+                    except Exception:
+                        pass
+                    return
+                await asyncio.sleep(0.05)
 
         stopper = asyncio.create_task(forward_stop())
 
         # Failover: a worker that died a moment ago may still be in the
-        # watched live set. Connect-refused means the process is gone, so
-        # the request CANNOT have executed there — retrying on another
-        # instance is safe, including after a failed write to a stale
-        # pooled connection (the reconnect probe tells dead apart from
-        # merely-idle-closed). direct mode never fails over; once a server
-        # ANSWERED, a mid-stream failure never retries.
+        # watched live set. It engages ONLY while nothing has been
+        # delivered — a refused connect, or a pooled-connection write that
+        # failed immediately (socket already closed: nothing reached the
+        # peer). Once a write SUCCEEDED the request may be executing, so a
+        # cross-instance retry could double-execute and the failure
+        # surfaces instead. direct mode never fails over.
         failed: set = set()
         try:
             while True:
@@ -578,8 +584,10 @@ class Client:
                 attempts = 2 if pooled is not None else 1
                 first = None
                 for attempt in range(attempts):
+                    sent = False
                     try:
                         await write_frame(writer, [req_control, req_payload])
+                        sent = True
                         if parts is not None:
                             async for chunk in parts:
                                 await write_frame(
@@ -593,32 +601,32 @@ class Client:
                             asyncio.IncompleteReadError) as e:
                         writer.close()
                         if attempt < attempts - 1:
+                            # stale pooled socket (server closed it while
+                            # idle): same-instance retry on a fresh
+                            # connection — the server's duplicate-context
+                            # guard de-dupes the rare died-mid-request case
                             try:
                                 reader, writer = await asyncio.open_connection(
                                     info.host, info.port)
                             except OSError:
+                                if sent:
+                                    # something may have reached the peer
+                                    # before it died: no cross-instance retry
+                                    raise EngineError(
+                                        f"connection to {info.host}:"
+                                        f"{info.port} failed: {e}", 503) \
+                                        from e
                                 break   # process gone: fail over below
                             fr = FrameReader(reader)
                             live["writer"] = writer
                             continue
-                        # final attempt failed. Probe: if the PROCESS still
-                        # answers connects, the request may have started
-                        # executing there — cross-instance retry could
-                        # double-execute, so surface the error. Only a dead
-                        # process (connect refused) fails over.
-                        try:
-                            _pr, _pw = await asyncio.open_connection(
-                                info.host, info.port)
-                            _pw.close()
-                            process_alive = True
-                        except OSError:
-                            process_alive = False
-                        if process_alive or parts is not None \
-                                or mode == "direct":
+                        if sent or parts is not None or mode == "direct":
+                            # the request may be executing on the peer — a
+                            # cross-instance retry could double-execute
                             raise EngineError(
                                 f"connection to {info.host}:{info.port} "
                                 f"failed: {e}", 503) from e
-                        break           # dead process: fail over below
+                        break           # nothing delivered: fail over below
                 if first is not None:
                     break
                 _fail()

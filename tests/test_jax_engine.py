@@ -412,3 +412,22 @@ async def test_logprobs_flow_to_openai_responses():
         assert all("token" in e and e["logprob"] <= 0.0 for e in content)
     finally:
         eng.shutdown()
+
+
+def test_tp2_vocab_sharded_head_matches_tp1():
+    """With vocab divisible by tp, the LM head shards over the vocab dim
+    (each chip computes V/tp logit columns); results must match tp=1."""
+    import jax
+
+    mcfg = llama.preset("tiny-byte", vocab_size=260, tie_embeddings=False)
+    from jax.sharding import PartitionSpec as P
+
+    specs = llama.param_specs(mcfg, 2)
+    assert specs["lm_head"] == P(None, "tp")   # actually sharded
+    c1 = EngineCore(make_cfg(model=mcfg, max_batch=2), jax.devices()[:1])
+    c2 = EngineCore(make_cfg(model=mcfg, max_batch=2, tp=2), jax.devices()[:2])
+    c1.submit("x", req([10, 20, 30, 40], max_tokens=5))
+    c2.submit("x", req([10, 20, 30, 40], max_tokens=5))
+    t1 = [g.token for g in drain(c1, ["x"])["x"]]
+    t2 = [g.token for g in drain(c2, ["x"])["x"]]
+    assert t1 == t2

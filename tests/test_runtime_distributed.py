@@ -399,8 +399,6 @@ async def test_client_fails_over_dead_instance():
     """A worker that died an instant ago can still be in the watched live
     set; a connect-refused pick must fail over to a live instance instead of
     erroring the request (safe: nothing was sent)."""
-    import json as _json
-
     from dynamo_tpu.runtime.component import EndpointInfo
 
     srv, port = await start_store()
