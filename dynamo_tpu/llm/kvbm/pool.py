@@ -59,6 +59,10 @@ class DeviceBlockPool:
         self._clock = 0
         # (priority, last_used, page) lazy-deleted eviction heap
         self._evict_heap: List[Tuple[int, int, int]] = []
+        # incremental count of state == "reusable" blocks: allocatable is
+        # probed per page-allocation, an O(num_pages) scan there is the
+        # scheduler's hottest host cost
+        self._n_reusable = 0
         # offload hook: called with (seq_hash, page) BEFORE the page is
         # recycled; the tiered cache copies it out to host DRAM here
         self.on_evict: Optional[Callable[[int, int], None]] = None
@@ -70,7 +74,7 @@ class DeviceBlockPool:
 
     @property
     def reusable_count(self) -> int:
-        return sum(1 for b in self._blocks.values() if b.state == "reusable")
+        return self._n_reusable
 
     @property
     def allocatable(self) -> int:
@@ -105,6 +109,9 @@ class DeviceBlockPool:
                 continue  # stale heap entry
             if self.on_evict is not None and b.seq_hash is not None:
                 self.on_evict(b.seq_hash, page)
+            # decrement only after the offload hook: a hook exception must
+            # leave the counter consistent with the unchanged state
+            self._n_reusable -= 1
             self._unregister(b)
             return page
         raise OutOfBlocks("no free or reusable pages left")
@@ -147,6 +154,7 @@ class DeviceBlockPool:
         b.last_used = self._tick()
         if b.state == "reusable":
             b.state = "leased"
+            self._n_reusable -= 1
             b.refs = 1
         else:
             b.refs += 1
@@ -163,6 +171,7 @@ class DeviceBlockPool:
             return
         if b.seq_hash is not None and b.registered:
             b.state = "reusable"
+            self._n_reusable += 1
             b.last_used = self._tick()
             heapq.heappush(self._evict_heap, (b.priority, b.last_used, b.page))
         else:

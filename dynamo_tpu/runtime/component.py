@@ -524,27 +524,30 @@ class Client:
 
         async def forward_stop():
             await ctx.stopped()
-            # the connect/failover window may not have a writer yet: wait
-            # for one so the stop cannot be silently lost
+            # the connect/failover window may have no writer yet — or a
+            # just-closed one about to be replaced. Keep trying against the
+            # CURRENT writer until a send sticks; a stop must not be lost
+            # to a connection that died the same instant.
             for _ in range(200):
                 w = live["writer"]
-                if w is not None:
+                if w is not None and not w.is_closing():
                     try:
                         await write_frame(w, [{"kind": "stop"}, None])
+                        return
                     except Exception:
-                        pass
-                    return
+                        pass   # writer died mid-send: retry the successor
                 await asyncio.sleep(0.05)
 
         stopper = asyncio.create_task(forward_stop())
 
         # Failover: a worker that died a moment ago may still be in the
-        # watched live set. It engages ONLY while nothing has been
-        # delivered — a refused connect, or a pooled-connection write that
-        # failed immediately (socket already closed: nothing reached the
-        # peer). Once a write SUCCEEDED the request may be executing, so a
-        # cross-instance retry could double-execute and the failure
-        # surfaces instead. direct mode never fails over.
+        # watched live set. It engages ONLY when the connect itself is
+        # refused — then provably no byte reached the peer and a retry on
+        # another instance cannot double-execute. Any failure after a
+        # connection existed (even a write error: the transport may have
+        # delivered the frame before erroring) surfaces, except the
+        # same-instance stale-pool retry whose duplicate-context guard
+        # de-dupes server-side. direct mode never fails over.
         failed: set = set()
         try:
             while True:
@@ -582,12 +585,9 @@ class Client:
                 # double-execute; the server's duplicate-context guard turns
                 # that rare race into a clean error.)
                 attempts = 2 if pooled is not None else 1
-                first = None
                 for attempt in range(attempts):
-                    sent = False
                     try:
                         await write_frame(writer, [req_control, req_payload])
-                        sent = True
                         if parts is not None:
                             async for chunk in parts:
                                 await write_frame(
@@ -600,40 +600,24 @@ class Client:
                     except (ConnectionResetError, BrokenPipeError,
                             asyncio.IncompleteReadError) as e:
                         writer.close()
-                        if attempt < attempts - 1:
-                            # stale pooled socket (server closed it while
-                            # idle): same-instance retry on a fresh
-                            # connection — the server's duplicate-context
-                            # guard de-dupes the rare died-mid-request case
-                            try:
-                                reader, writer = await asyncio.open_connection(
-                                    info.host, info.port)
-                            except OSError:
-                                if sent:
-                                    # something may have reached the peer
-                                    # before it died: no cross-instance retry
-                                    raise EngineError(
-                                        f"connection to {info.host}:"
-                                        f"{info.port} failed: {e}", 503) \
-                                        from e
-                                break   # process gone: fail over below
-                            fr = FrameReader(reader)
-                            live["writer"] = writer
-                            continue
-                        if sent or parts is not None or mode == "direct":
-                            # the request may be executing on the peer — a
-                            # cross-instance retry could double-execute
+                        if attempt == attempts - 1:
                             raise EngineError(
                                 f"connection to {info.host}:{info.port} "
                                 f"failed: {e}", 503) from e
-                        break           # nothing delivered: fail over below
-                if first is not None:
-                    break
-                _fail()
-                if mode == "direct":
-                    raise EngineError(
-                        f"instance {iid:x} at {info.host}:{info.port} "
-                        f"unreachable", 503)
+                        # stale pooled socket (server closed it while idle):
+                        # same-instance retry on a fresh connection — the
+                        # server's duplicate-context guard de-dupes the rare
+                        # died-mid-request case
+                        try:
+                            reader, writer = await asyncio.open_connection(
+                                info.host, info.port)
+                        except OSError as e2:
+                            raise EngineError(
+                                f"instance {iid:x} at {info.host}:"
+                                f"{info.port} unreachable: {e2}", 503) from e2
+                        fr = FrameReader(reader)
+                        live["writer"] = writer
+                break
         except BaseException:
             stopper.cancel()
             raise

@@ -199,3 +199,43 @@ def test_engine_reuse_respects_batching_invariance():
         if done == {"x", "y"}:
             break
     assert got["x"] == solo
+
+
+def test_reusable_count_incremental_consistency():
+    """reusable_count is maintained incrementally (O(1)); it must agree with
+    a full scan through every transition: lease/seal/release/match/evict/
+    flush."""
+    import random
+
+    from dynamo_tpu.llm.kvbm.pool import DeviceBlockPool, OutOfBlocks
+
+    rng = random.Random(3)
+    pool = DeviceBlockPool(18)
+    leased = []
+    h = 0
+
+    def check():
+        scan = sum(1 for b in pool._blocks.values() if b.state == "reusable")
+        assert pool.reusable_count == scan, (pool.reusable_count, scan)
+
+    for step in range(600):
+        op = rng.random()
+        try:
+            if op < 0.4:
+                p = pool.lease_new()
+                h += 1
+                if rng.random() < 0.8:
+                    pool.seal(p, h)
+                leased.append(p)
+            elif op < 0.7 and leased:
+                pool.release(leased.pop(rng.randrange(len(leased))))
+            elif op < 0.85 and h:
+                p = pool.match(rng.randrange(1, h + 1))
+                if p is not None:
+                    leased.append(p)
+            else:
+                pool.flush_reusable()
+        except OutOfBlocks:
+            while leased:
+                pool.release(leased.pop())
+        check()
