@@ -200,12 +200,14 @@ def param_specs(cfg: LlamaConfig, tp_size: int = 1) -> Dict[str, Any]:
     kv = tp if cfg.num_kv_heads % max(tp_size, 1) == 0 else None
     if cfg.num_experts:
         # experts shard over ep ([L, E, D, F] / [L, E, F, D]); router
-        # replicated. (tp inside expert FFNs is a later optimization.)
+        # replicated; the FFN intermediate dim additionally shards over tp
+        # when divisible (matching moe_ffn's shard_map specs)
+        ftp = tp if cfg.intermediate_size % max(tp_size, 1) == 0 else None
         ffn = {
             "wr": P(None, None, None),
-            "wg": P(None, AXIS_EP, None, None),
-            "wu": P(None, AXIS_EP, None, None),
-            "wd": P(None, AXIS_EP, None, None),
+            "wg": P(None, AXIS_EP, None, ftp),
+            "wu": P(None, AXIS_EP, None, ftp),
+            "wd": P(None, AXIS_EP, ftp, None),
         }
     else:
         ffn = {

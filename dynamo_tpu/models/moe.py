@@ -25,13 +25,19 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import AXIS_EP
+from ..parallel.mesh import AXIS_EP, AXIS_TP
 
 
 def _ep_size(mesh) -> int:
     if mesh is None or AXIS_EP not in mesh.axis_names:
         return 1
     return mesh.shape[AXIS_EP]
+
+
+def _tp_size(mesh) -> int:
+    if mesh is None or AXIS_TP not in mesh.axis_names:
+        return 1
+    return mesh.shape[AXIS_TP]
 
 
 def moe_ffn(x: jax.Array,           # [B, T, D]
@@ -59,18 +65,29 @@ def moe_ffn(x: jax.Array,           # [B, T, D]
                           gates.astype(x.dtype))
 
     ep = _ep_size(mesh)
-    if ep <= 1:
+    tp = _tp_size(mesh)
+    F = wg.shape[2]
+    tp_ffn = tp if tp > 1 and F % tp == 0 else 1
+    if ep <= 1 and tp_ffn <= 1:
         return experts(x, wg, wu, wd, gates)
+
+    # expert dim shards over ep; the FFN intermediate dim additionally
+    # shards over tp (each shard computes an F/tp slice of its local
+    # experts — the down-projection contraction leaves partial sums, so
+    # the combine is one psum over BOTH axes)
+    axes = tuple(a for a, n in ((AXIS_EP, ep), (AXIS_TP, tp_ffn)) if n > 1)
 
     def local(x, wg, wu, wd, gates):
         y = experts(x, wg, wu, wd, gates)
-        return jax.lax.psum(y, AXIS_EP)
+        return jax.lax.psum(y, axes)
 
-    espec = P(AXIS_EP, None, None)
+    ftp = AXIS_TP if tp_ffn > 1 else None
+    eax = AXIS_EP if ep > 1 else None
     return jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(None, None, None), espec, espec, espec,
-                  P(None, None, AXIS_EP)),
+        in_specs=(P(None, None, None),
+                  P(eax, None, ftp), P(eax, None, ftp), P(eax, ftp, None),
+                  P(None, None, eax)),
         out_specs=P(None, None, None),
         check_vma=False,
     )(x, wg, wu, wd, gates)

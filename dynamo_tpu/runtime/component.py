@@ -612,6 +612,11 @@ class Client:
                             reader, writer = await asyncio.open_connection(
                                 info.host, info.port)
                         except OSError as e2:
+                            # process gone: every remaining pooled socket to
+                            # it is equally dead — drop them so the NEXT
+                            # request takes the connect-refused failover
+                            # path instead of another stale-pool 503
+                            _fail()
                             raise EngineError(
                                 f"instance {iid:x} at {info.host}:"
                                 f"{info.port} unreachable: {e2}", 503) from e2

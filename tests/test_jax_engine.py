@@ -431,3 +431,19 @@ def test_tp2_vocab_sharded_head_matches_tp1():
     t1 = [g.token for g in drain(c1, ["x"])["x"]]
     t2 = [g.token for g in drain(c2, ["x"])["x"]]
     assert t1 == t2
+
+
+def test_moe_ep2_tp2_matches_unsharded():
+    """MoE with experts over ep=2 AND expert-FFN intermediate over tp=2
+    (4 devices) must reproduce the unsharded tokens exactly."""
+    import jax
+
+    mcfg = llama.preset("tiny-moe")   # intermediate 96 % 2 == 0
+    c1 = EngineCore(make_cfg(model=mcfg, max_batch=2), jax.devices()[:1])
+    c4 = EngineCore(make_cfg(model=mcfg, max_batch=2, ep=2, tp=2),
+                    jax.devices()[:4])
+    c1.submit("x", req([11, 22, 33, 44], max_tokens=5))
+    c4.submit("x", req([11, 22, 33, 44], max_tokens=5))
+    t1 = [g.token for g in drain(c1, ["x"])["x"]]
+    t4 = [g.token for g in drain(c4, ["x"])["x"]]
+    assert t1 == t4
