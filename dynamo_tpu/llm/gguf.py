@@ -32,11 +32,36 @@ _SCALAR_FMT = {_U8: "<B", _I8: "<b", _U16: "<H", _I16: "<h", _U32: "<I",
                _I32: "<i", _F32: "<f", _BOOL: "<?", _U64: "<Q", _I64: "<q",
                _F64: "<d"}
 
-# tensor ggml dtypes we can load (unquantized)
+# tensor ggml dtypes
 _GGML_F32, _GGML_F16 = 0, 1
+_GGML_Q4_0, _GGML_Q8_0, _GGML_BF16 = 2, 8, 16
 _GGML_NAMES = {0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1", 6: "Q5_0",
                7: "Q5_1", 8: "Q8_0", 9: "Q8_1", 10: "Q2_K", 11: "Q3_K",
                12: "Q4_K", 13: "Q5_K", 14: "Q6_K", 16: "BF16"}
+_QBLOCK = 32   # tokens per quant block (Q4_0 / Q8_0)
+
+
+def _dequant_q8_0(raw: bytes, count: int) -> np.ndarray:
+    """Q8_0: per 32-value block, one f16 scale + 32 int8 -> w = d * q."""
+    nb = count // _QBLOCK
+    rec = np.frombuffer(raw, dtype=np.dtype([("d", "<f2"),
+                                             ("q", "i1", (_QBLOCK,))]),
+                        count=nb)
+    return (rec["d"].astype(np.float32)[:, None]
+            * rec["q"].astype(np.float32)).reshape(count)
+
+
+def _dequant_q4_0(raw: bytes, count: int) -> np.ndarray:
+    """Q4_0: per 32-value block, one f16 scale + 16 bytes of nibbles ->
+    w = d * (q - 8); low nibbles are values 0..15, high nibbles 16..31."""
+    nb = count // _QBLOCK
+    rec = np.frombuffer(raw, dtype=np.dtype([("d", "<f2"),
+                                             ("q", "u1", (_QBLOCK // 2,))]),
+                        count=nb)
+    lo = (rec["q"] & 0x0F).astype(np.int8) - 8
+    hi = (rec["q"] >> 4).astype(np.int8) - 8
+    vals = np.concatenate([lo, hi], axis=1).astype(np.float32)
+    return (rec["d"].astype(np.float32)[:, None] * vals).reshape(count)
 
 
 @dataclass
@@ -100,21 +125,42 @@ class GGUFFile:
 
     def load_tensor(self, name: str) -> np.ndarray:
         info = self.tensors[name]
+        count = int(np.prod(info.shape)) if info.shape else 1
+        if info.ggml_type in (_GGML_Q8_0, _GGML_Q4_0):
+            # block-quantized weights dequantize to f32 at load (the engine
+            # casts to its compute dtype; on-device quantized matmuls are a
+            # separate optimization, this is the loading capability)
+            bpb = 2 + (_QBLOCK if info.ggml_type == _GGML_Q8_0
+                       else _QBLOCK // 2)
+            raw = self._read(self.data_start + info.offset,
+                             count // _QBLOCK * bpb)
+            deq = (_dequant_q8_0 if info.ggml_type == _GGML_Q8_0
+                   else _dequant_q4_0)(raw, count)
+            return deq.reshape(info.shape)
+        if info.ggml_type == _GGML_BF16:
+            import ml_dtypes
+
+            raw = self._read(self.data_start + info.offset, count * 2)
+            return np.frombuffer(raw, dtype=ml_dtypes.bfloat16) \
+                .reshape(info.shape)
         if info.ggml_type not in (_GGML_F32, _GGML_F16):
             tname = _GGML_NAMES.get(info.ggml_type, str(info.ggml_type))
             raise NotImplementedError(
-                f"tensor {name!r} uses quantized ggml type {tname}; only "
-                f"F32/F16 GGUF tensors are loadable (dequantize the model "
-                f"or export unquantized)")
+                f"tensor {name!r} uses unsupported ggml type {tname}; "
+                f"F32/F16/BF16/Q8_0/Q4_0 are loadable (dequantize or "
+                f"re-export the model)")
         dtype = np.float32 if info.ggml_type == _GGML_F32 else np.float16
-        count = int(np.prod(info.shape)) if info.shape else 1
-        # one persistent handle: bulk loads touch every tensor and a 70B-class
-        # model would otherwise pay hundreds of open/close cycles
+        raw = self._read(self.data_start + info.offset,
+                         count * dtype().itemsize)
+        return np.frombuffer(raw, dtype=dtype).reshape(info.shape)
+
+    def _read(self, offset: int, size: int) -> bytes:
+        # one persistent handle: bulk loads touch every tensor and a
+        # 70B-class model would otherwise pay hundreds of open/close cycles
         if self._fh is None or self._fh.closed:
             self._fh = open(self.path, "rb")
-        self._fh.seek(self.data_start + info.offset)
-        raw = self._fh.read(count * dtype().itemsize)
-        return np.frombuffer(raw, dtype=dtype).reshape(info.shape)
+        self._fh.seek(offset)
+        return self._fh.read(size)
 
     def close(self) -> None:
         if self._fh is not None and not self._fh.closed:

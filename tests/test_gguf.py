@@ -135,3 +135,71 @@ def test_engine_loads_gguf_weights(tmp_path):
     np.testing.assert_allclose(
         np.asarray(core.params["embed"], np.float32),
         np.asarray(orig["embed"], np.float32), atol=2e-2)
+
+
+def _quantize_q8_0(w: np.ndarray) -> bytes:
+    out = bytearray()
+    for blk in w.reshape(-1, 32):
+        d = np.abs(blk).max() / 127.0 or 1e-8
+        q = np.clip(np.round(blk / d), -127, 127).astype(np.int8)
+        out += np.float16(d).tobytes() + q.tobytes()
+    return bytes(out)
+
+
+def _quantize_q4_0(w: np.ndarray) -> bytes:
+    out = bytearray()
+    for blk in w.reshape(-1, 32):
+        d = np.abs(blk).max() / 7.0 or 1e-8
+        q = np.clip(np.round(blk / d) + 8, 0, 15).astype(np.uint8)
+        lo, hi = q[:16], q[16:]
+        out += np.float16(d).tobytes() + (lo | (hi << 4)).tobytes()
+    return bytes(out)
+
+
+def test_quantized_dequant_q8_0_q4_0(tmp_path):
+    """Q8_0/Q4_0 block-quantized tensors dequantize at load within the
+    quantization error bound (llama.cpp-served models load directly)."""
+    from dynamo_tpu.llm import gguf as G
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 64).astype(np.float32)
+
+    got8 = G._dequant_q8_0(_quantize_q8_0(w), w.size).reshape(w.shape)
+    np.testing.assert_allclose(got8, w, atol=np.abs(w).max() / 100)
+
+    got4 = G._dequant_q4_0(_quantize_q4_0(w), w.size).reshape(w.shape)
+    np.testing.assert_allclose(got4, w, atol=np.abs(w).max() / 6)
+
+
+def test_quantized_tensor_loads_from_file(tmp_path):
+    """A GGUF whose tensor directory marks Q8_0 data loads through
+    GGUFFile.load_tensor (file-level path, not just the dequant kernel)."""
+    from dynamo_tpu.llm import gguf as G
+
+    cfg = llama.preset("tiny-byte", tie_embeddings=False)
+    tiny_gguf(tmp_path / "m.gguf", cfg)
+    g = read_gguf(str(tmp_path / "m.gguf"))
+
+    # splice Q8_0 bytes for one tensor into a copy of the file
+    info = g.tensors["blk.0.ffn_up.weight"]
+    w = g.load_tensor("blk.0.ffn_up.weight").astype(np.float32)
+    qbytes = _quantize_q8_0(w)
+    blob = bytearray(open(tmp_path / "m.gguf", "rb").read())
+    start = g.data_start + info.offset
+    assert len(qbytes) <= w.size * 4
+    blob[start:start + len(qbytes)] = qbytes
+    open(tmp_path / "q.gguf", "wb").write(bytes(blob))
+
+    g2 = read_gguf(str(tmp_path / "q.gguf"))
+    g2.tensors["blk.0.ffn_up.weight"].ggml_type = 8  # Q8_0
+    got = g2.load_tensor("blk.0.ffn_up.weight")
+    np.testing.assert_allclose(got, w, atol=np.abs(w).max() / 100)
+    # BF16 path too
+    bf = (w.view(np.uint32) >> 16).astype(np.uint16)
+    blob2 = bytearray(open(tmp_path / "m.gguf", "rb").read())
+    blob2[start:start + bf.nbytes] = bf.tobytes()
+    open(tmp_path / "b.gguf", "wb").write(bytes(blob2))
+    g3 = read_gguf(str(tmp_path / "b.gguf"))
+    g3.tensors["blk.0.ffn_up.weight"].ggml_type = 16  # BF16
+    got3 = g3.load_tensor("blk.0.ffn_up.weight")
+    np.testing.assert_allclose(got3, w, atol=np.abs(w).max() / 120)
