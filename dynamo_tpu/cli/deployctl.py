@@ -57,6 +57,11 @@ def main(argv=None) -> int:
     p.add_argument("-f", "--file", required=True)
     p.add_argument("--image", default="dynamo-tpu:latest")
     p.add_argument("--no-store", action="store_true")
+    p = sub.add_parser("push")
+    p.add_argument("name")
+    p.add_argument("bundle", help="tarball or single-module .py file")
+    p.add_argument("--api", default="http://127.0.0.1:8082",
+                   help="api-store base URL")
     p = sub.add_parser("operator")
     p.add_argument("--resync", type=float, default=5.0)
     p.add_argument("--platform", default="cpu")
@@ -107,6 +112,29 @@ def main(argv=None) -> int:
             return 0
 
         return asyncio.run(_with_client(args.store, do)) or 0
+
+    if args.cmd == "push":
+        async def push():
+            import aiohttp
+
+            with open(args.bundle, "rb") as f:
+                data = f.read()
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(
+                    f"{args.api}/api/v1/artifacts/{args.name}/versions",
+                    data=data)
+                if r.status != 201:
+                    # error bodies may be plain text (HTTPBadRequest)
+                    print(f"push failed ({r.status}): {await r.text()}")
+                    return 1
+                body = await r.json()
+                print(f"pushed {args.name} v{body['version']} "
+                      f"({body['size']} bytes, sha256 {body['sha256'][:12]}) "
+                      f"-> deploy with graph: "
+                      f"\"artifact://{args.name}#<module>:<Class>\"")
+                return 0
+
+        return asyncio.run(push())
 
     if args.cmd == "render":
         dep = _load_resource(args.file)

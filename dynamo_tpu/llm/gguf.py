@@ -2,11 +2,11 @@
 
 Parses the GGUF v2/v3 container format (llama.cpp's model distribution
 format): header, string-keyed typed metadata, and the tensor directory. A
-llama-architecture GGUF maps onto :class:`~dynamo_tpu.models.llama.
-LlamaConfig` and the stacked param pytree the engine serves; F32/F16
-tensors load directly (quantized blocks are recognized but rejected with a
-clear error — dequantization kernels are engine roadmap, not container
-parsing).
+llama-family GGUF (llama/mistral/qwen2) maps onto :class:`~dynamo_tpu.
+models.llama.LlamaConfig` and the stacked param pytree the engine serves;
+F32/F16/BF16 tensors load directly, Q8_0/Q4_0 block-quantized tensors
+dequantize at load, and the remaining K-quants are rejected with a clear
+error.
 
 Reference capability: lib/llm/src/gguf/{content,gguf_metadata,
 gguf_tokenizer}.rs (~950 LoC: metadata parse, tokenizer build, model
