@@ -81,8 +81,7 @@ async def run(args) -> int:
             return 0
         if args.action == "add":
             if args.model_path:
-                card = ModelDeploymentCard.from_local_path(
-                    args.model_path, args.name)
+                card = ModelDeploymentCard.resolve(args.model_path, args.name)
             else:
                 card = ModelDeploymentCard.synthetic(args.name)
             card.kv_block_size = args.kv_block_size

@@ -5,6 +5,7 @@
     python -m dynamo_tpu.cli.deployctl status <namespace>/<name>
     python -m dynamo_tpu.cli.deployctl delete <namespace>/<name>
     python -m dynamo_tpu.cli.deployctl render -f dep.yaml [--image IMG]
+    python -m dynamo_tpu.cli.deployctl push <name> <bundle> [--api URL]
     python -m dynamo_tpu.cli.deployctl operator [--resync S]
 
 ``render`` emits Kubernetes manifests for the resource; ``operator`` runs

@@ -47,8 +47,7 @@ async def run_prefill_worker(args, *,
     from ..engine.engine import JaxEngine, JaxEngineConfig
 
     if args.model_path:
-        card = ModelDeploymentCard.from_local_path(args.model_path,
-                                                   args.model_name)
+        card = ModelDeploymentCard.resolve(args.model_path, args.model_name)
     else:
         card = ModelDeploymentCard.synthetic(args.model_name or "prefill")
     card.kv_block_size = args.kv_block_size

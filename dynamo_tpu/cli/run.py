@@ -63,7 +63,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 
 def make_card(args) -> ModelDeploymentCard:
     if args.model_path:
-        card = ModelDeploymentCard.from_local_path(args.model_path, args.model_name)
+        card = ModelDeploymentCard.resolve(args.model_path, args.model_name)
     else:
         card = ModelDeploymentCard.synthetic(args.model_name or args.output)
     if args.context_length:

@@ -59,8 +59,7 @@ def run_follower(args) -> None:
 
 def _build_card(args) -> ModelDeploymentCard:
     if args.model_path:
-        card = ModelDeploymentCard.from_local_path(args.model_path,
-                                                   args.model_name)
+        card = ModelDeploymentCard.resolve(args.model_path, args.model_name)
     else:
         card = ModelDeploymentCard.synthetic(args.model_name or "echo")
     card.kv_block_size = args.kv_block_size

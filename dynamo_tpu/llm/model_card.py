@@ -70,6 +70,36 @@ class ModelDeploymentCard:
 
     # ------------------------------------------------------------------
     @classmethod
+    def resolve(cls, spec: str,
+                name: Optional[str] = None) -> "ModelDeploymentCard":
+        """Build a card from a local directory, a GGUF file, or a HF repo id
+        (resolved from the local HF cache; networkless environments get a
+        clear error instead of a retry storm).
+
+        Reference capability: launch/dynamo-run/src/hub.rs (HF-repo auto-
+        download when the model path is missing)."""
+        if os.path.exists(spec):
+            return cls.from_local_path(spec, name)
+        # an "org/name" shape (exactly one slash, relative) is a repo id
+        if (spec.count("/") == 1 and not spec.startswith((".", "/"))
+                and ".." not in spec):
+            try:
+                from huggingface_hub import snapshot_download
+
+                local = snapshot_download(
+                    spec,
+                    local_files_only=(
+                        os.environ.get("HF_HUB_OFFLINE", "1") != "0"))
+            except Exception as e:
+                raise FileNotFoundError(
+                    f"model {spec!r} is neither a local path nor an "
+                    f"HF repo available in the local cache: {e}") from e
+            # a failure past this point is a real model problem (corrupt
+            # config/tokenizer), not a cache miss — let it surface as-is
+            return cls.from_local_path(local, name or spec.split("/")[-1])
+        raise FileNotFoundError(f"model path {spec!r} does not exist")
+
+    @classmethod
     def from_local_path(cls, path: str, name: Optional[str] = None) -> "ModelDeploymentCard":
         """Build a card from a local HF-style model directory."""
         name = name or os.path.basename(os.path.normpath(path))
