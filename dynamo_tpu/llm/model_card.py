@@ -78,18 +78,22 @@ class ModelDeploymentCard:
 
         Reference capability: launch/dynamo-run/src/hub.rs (HF-repo auto-
         download when the model path is missing)."""
+        if os.path.isfile(spec) and spec.endswith(".gguf"):
+            return cls.from_gguf(spec, name)
         if os.path.exists(spec):
             return cls.from_local_path(spec, name)
         # an "org/name" shape (exactly one slash, relative) is a repo id
         if (spec.count("/") == 1 and not spec.startswith((".", "/"))
                 and ".." not in spec):
+            # offline unless EXPLICITLY disabled (HF_HUB_OFFLINE=0/false):
+            # this deviates from huggingface_hub's online-by-default because
+            # an unreachable hub turns every model load into a retry storm
+            env = os.environ.get("HF_HUB_OFFLINE")
+            offline = env is None or env.lower() not in ("0", "false", "")
             try:
                 from huggingface_hub import snapshot_download
 
-                local = snapshot_download(
-                    spec,
-                    local_files_only=(
-                        os.environ.get("HF_HUB_OFFLINE", "1") != "0"))
+                local = snapshot_download(spec, local_files_only=offline)
             except Exception as e:
                 raise FileNotFoundError(
                     f"model {spec!r} is neither a local path nor an "
@@ -98,6 +102,35 @@ class ModelDeploymentCard:
             # config/tokenizer), not a cache miss — let it surface as-is
             return cls.from_local_path(local, name or spec.split("/")[-1])
         raise FileNotFoundError(f"model path {spec!r} does not exist")
+
+    @classmethod
+    def from_gguf(cls, path: str,
+                  name: Optional[str] = None) -> "ModelDeploymentCard":
+        """Build a card from a GGUF model file: config (context length,
+        eos ids) comes from the GGUF metadata; the tokenizer uses an
+        adjacent tokenizer.json when present, else the byte fallback (the
+        GGUF-embedded vocab is weight data the engine loads either way)."""
+        from .gguf import read_gguf
+
+        g = read_gguf(path)
+        md = g.metadata
+        arch = g.architecture() or "gguf"
+        card = cls(name=name or os.path.splitext(os.path.basename(path))[0],
+                   path=path)
+        ctx = md.get(f"{arch}.context_length")
+        if ctx:
+            card.context_length = int(ctx)
+        eos = md.get("tokenizer.ggml.eos_token_id")
+        if eos is not None:
+            card.eos_token_ids = [int(eos)]
+        bos = md.get("tokenizer.ggml.bos_token_id")
+        if bos is not None:
+            card.bos_token_id = int(bos)
+        tok_dir = os.path.dirname(os.path.abspath(path))
+        if os.path.exists(os.path.join(tok_dir, "tokenizer.json")):
+            card.tokenizer = tok_dir
+        g.close()
+        return card
 
     @classmethod
     def from_local_path(cls, path: str, name: Optional[str] = None) -> "ModelDeploymentCard":

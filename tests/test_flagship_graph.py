@@ -1,0 +1,90 @@
+"""The README walkthrough, automated: BASELINE config 4 (disagg_router)
+launched through the SDK orchestrator as REAL processes — store + frontend
++ KV router + disagg-enabled JAX worker + prefill worker — then driven over
+plain HTTP. A long cold prompt must take the remote-prefill path and still
+answer; repeated prompts must hit the prefix cache."""
+
+import asyncio
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def _http_json(url, payload=None, timeout=30):
+    if payload is not None:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+    else:
+        req = url
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def test_disagg_router_graph_serves_http(tmp_path):
+    import socket
+
+    from dynamo_tpu.sdk.serve import LocalServe
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    http_port = s.getsockname()[1]
+    s.close()
+
+    import yaml
+
+    with open("examples/configs/disagg_router.yaml") as f:
+        config = yaml.safe_load(f)
+    config["Frontend"]["port"] = http_port
+    # keep engine shapes tiny for CI wall-clock
+    config["Worker"]["extra_engine_args"] = json.dumps(
+        {"max_batch": 4, "max_context": 512, "prefill_chunk": 64,
+         "preset": "tiny-byte", "decode_steps": 4})
+    config["Worker"]["max_local_prefill_length"] = 100
+    config["PrefillWorker"]["extra_engine_args"] = json.dumps(
+        {"max_batch": 2, "max_context": 512, "prefill_chunk": 64,
+         "preset": "tiny-byte", "decode_steps": 4})
+
+    serve = LocalServe("examples.llm_graphs:DisaggRouterGraph",
+                       config=config, platform="cpu")
+    try:
+        serve.start(timeout=240)
+        base = f"http://127.0.0.1:{http_port}"
+
+        models = _http_json(f"{base}/v1/models")
+        assert any(m["id"] == "demo" for m in models["data"])
+
+        # short prompt: local prefill
+        out = _http_json(f"{base}/v1/completions", {
+            "model": "demo", "prompt": "hi there", "max_tokens": 8})
+        assert out["choices"][0]["text"]
+        assert out["usage"]["completion_tokens"] == 8
+
+        # long cold prompt: beyond max_local_prefill_length=100 -> the
+        # prefill queue path (remote prefill on the PrefillWorker)
+        long_prompt = " ".join(f"tok{i}" for i in range(60))  # ~360 chars
+        out2 = _http_json(f"{base}/v1/completions", {
+            "model": "demo", "prompt": long_prompt, "max_tokens": 6},
+            timeout=120)
+        assert out2["usage"]["prompt_tokens"] > 100
+        assert out2["usage"]["completion_tokens"] == 6
+
+        # same prompt again: prefix cache path still correct
+        out3 = _http_json(f"{base}/v1/completions", {
+            "model": "demo", "prompt": long_prompt, "max_tokens": 6},
+            timeout=60)
+        assert out3["choices"][0]["text"] == out2["choices"][0]["text"]
+
+        # chat endpoint through the same graph
+        chat = _http_json(f"{base}/v1/chat/completions", {
+            "model": "demo",
+            "messages": [{"role": "user", "content": "hello graph"}],
+            "max_tokens": 8})
+        assert chat["choices"][0]["message"]["content"]
+    finally:
+        serve.stop()

