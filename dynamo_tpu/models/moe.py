@@ -8,9 +8,12 @@ experts' contribution for the full token batch and a ``psum`` over the ep
 axis combines them (gate weights for non-local experts are zero on each
 shard, so the sum is exact).
 
-This dense-dispatch formulation (every local expert sees every token) is
-compile-friendly and exact; capacity-based sorted dispatch is a later
-throughput optimization, not a semantic change.
+Two dispatch formulations, both exact (no capacity limit, no dropped
+tokens): compute-bound prefill chunks on an unsharded mesh use SORTED
+dispatch (stable-sort assignments by expert + ``lax.ragged_dot`` segment
+matmuls — K-per-token FFN cost); tiny decode batches and ep/tp-sharded
+meshes use DENSE dispatch (every local expert sees every token —
+compile-friendly, combines across shards with one psum).
 
 Reference capability: the reference inherits MoE/EP from its engines
 (SURVEY §2.5 — vllm patch touches deepseek_v2.py); on TPU the in-tree
@@ -40,6 +43,39 @@ def _tp_size(mesh) -> int:
     return mesh.shape[AXIS_TP]
 
 
+def _sorted_dispatch(x: jax.Array,            # [B, T, D]
+                     wg: jax.Array, wu: jax.Array, wd: jax.Array,
+                     vals: jax.Array,          # [B, T, K] renormalized gates
+                     idx: jax.Array            # [B, T, K] expert ids
+                     ) -> jax.Array:
+    """Exact sorted MoE dispatch: flatten (token, k) assignments, stable-sort
+    by expert, run each expert's contiguous group through `lax.ragged_dot`,
+    scatter-add the weighted outputs back. No capacity limit, no dropped
+    tokens — same math as the dense formulation (summation order aside) —
+    at K-per-token FFN cost
+    instead of E-per-token. TPU lowers ragged_dot onto the MXU with
+    group-size prefetch."""
+    B, T, D = x.shape
+    E = wg.shape[0]
+    K = idx.shape[-1]
+    N = B * T
+    xf = x.reshape(N, D)
+    flat_e = idx.reshape(N * K)
+    flat_g = vals.reshape(N * K)
+    order = jnp.argsort(flat_e, stable=True)           # [N*K]
+    tok = order // K                                   # source token per slot
+    xs = xf[tok]                                       # [N*K, D]
+    counts = jnp.zeros((E,), jnp.int32).at[flat_e].add(1)
+    g = jax.lax.ragged_dot(xs, wg, counts)             # [N*K, F]
+    u = jax.lax.ragged_dot(xs, wu, counts)
+    a = (jax.nn.silu(g.astype(jnp.float32))
+         * u.astype(jnp.float32)).astype(x.dtype)
+    y = jax.lax.ragged_dot(a, wd, counts)              # [N*K, D]
+    y = y.astype(jnp.float32) * flat_g[order][:, None]
+    out = jnp.zeros((N, D), jnp.float32).at[tok].add(y)
+    return out.reshape(B, T, D).astype(x.dtype)
+
+
 def moe_ffn(x: jax.Array,           # [B, T, D]
             wr: jax.Array,          # [D, E] router
             wg: jax.Array,          # [E, D, F] expert gate projections
@@ -53,6 +89,20 @@ def moe_ffn(x: jax.Array,           # [B, T, D]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     vals, idx = jax.lax.top_k(probs, top_k)               # [B,T,K]
     vals = vals / jnp.sum(vals, axis=-1, keepdims=True)   # renormalize
+
+    ep = _ep_size(mesh)
+    tp = _tp_size(mesh)
+    F = wg.shape[2]
+    tp_ffn = tp if tp > 1 and F % tp == 0 else 1
+    if ep <= 1 and tp_ffn <= 1:
+        B, T, _ = x.shape
+        if B * T >= 16:
+            # compute-bound chunks: sorted exact dispatch costs K-per-token
+            # FFN work instead of dense dispatch's E-per-token
+            return _sorted_dispatch(x, wg, wu, wd, vals, idx)
+
+    # dense dispatch (tiny decode batches / sharded meshes) consumes the
+    # one-hot gates tensor; only built where used
     gates = jnp.sum(jax.nn.one_hot(idx, E, dtype=jnp.float32)
                     * vals[..., None], axis=-2)           # [B,T,E]
 
@@ -64,10 +114,6 @@ def moe_ffn(x: jax.Array,           # [B, T, D]
         return jnp.einsum("btef,efd,bte->btd", a, wd,
                           gates.astype(x.dtype))
 
-    ep = _ep_size(mesh)
-    tp = _tp_size(mesh)
-    F = wg.shape[2]
-    tp_ffn = tp if tp > 1 and F % tp == 0 else 1
     if ep <= 1 and tp_ffn <= 1:
         return experts(x, wg, wu, wd, gates)
 

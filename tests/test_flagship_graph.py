@@ -4,10 +4,7 @@ launched through the SDK orchestrator as REAL processes — store + frontend
 plain HTTP. A long cold prompt must take the remote-prefill path and still
 answer; repeated prompts must hit the prefix cache."""
 
-import asyncio
 import json
-import os
-import sys
 import urllib.request
 
 import pytest

@@ -447,3 +447,34 @@ def test_moe_ep2_tp2_matches_unsharded():
     t1 = [g.token for g in drain(c1, ["x"])["x"]]
     t4 = [g.token for g in drain(c4, ["x"])["x"]]
     assert t1 == t4
+
+
+def test_moe_sorted_dispatch_matches_dense():
+    """The ragged_dot sorted dispatch must agree with the dense formulation
+    (summation-order float noise only) across random shapes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.models import moe as M
+
+    rng = jax.random.PRNGKey(0)
+    for B, T, D, E, F, K in ((2, 24, 16, 4, 32, 2), (1, 64, 8, 6, 16, 3)):
+        ks = jax.random.split(rng, 5)
+        x = jax.random.normal(ks[0], (B, T, D), jnp.float32)
+        wr = jax.random.normal(ks[1], (D, E), jnp.float32) * 0.3
+        wg = jax.random.normal(ks[2], (E, D, F), jnp.float32) * 0.3
+        wu = jax.random.normal(ks[3], (E, D, F), jnp.float32) * 0.3
+        wd = jax.random.normal(ks[4], (E, F, D), jnp.float32) * 0.3
+        got = M.moe_ffn(x, wr, wg, wu, wd, K)          # sorted (B*T >= 16)
+        logits = jnp.einsum("btd,de->bte", x, wr)
+        probs = jax.nn.softmax(logits, axis=-1)
+        vals, idx = jax.lax.top_k(probs, K)
+        vals = vals / vals.sum(-1, keepdims=True)
+        gates = jnp.sum(jax.nn.one_hot(idx, E) * vals[..., None], axis=-2)
+        g = jnp.einsum("btd,edf->btef", x, wg)
+        u = jnp.einsum("btd,edf->btef", x, wu)
+        want = jnp.einsum("btef,efd,bte->btd", jax.nn.silu(g) * u, wd, gates)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+        rng = ks[0]

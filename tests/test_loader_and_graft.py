@@ -95,13 +95,28 @@ def test_card_resolve_paths(tmp_path, monkeypatch):
 
     cfg = _llama.preset("tiny-byte", tie_embeddings=False, max_position=777)
     tiny_gguf(tmp_path / "m.gguf", cfg)
-    import dynamo_tpu.llm.gguf as G
-
-    # splice eos metadata in via a rewrite (tiny_gguf doesn't set it)
-    g = G.read_gguf(str(tmp_path / "m.gguf"))
     gcard = ModelDeploymentCard.resolve(str(tmp_path / "m.gguf"))
     assert gcard.context_length == 777
     assert gcard.path.endswith("m.gguf")
+    # no eos in the container: the byte tokenizer's eos fills in so stop
+    # detection still works
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+    assert gcard.eos_token_ids == list(ByteTokenizer().eos_token_ids)
+
+    # eos/bos present in metadata win over the tokenizer fallback
+    from dynamo_tpu.llm.gguf import read_gguf, write_gguf
+
+    g = read_gguf(str(tmp_path / "m.gguf"))
+    meta2 = dict(g.metadata)
+    meta2["tokenizer.ggml.eos_token_id"] = 7
+    meta2["tokenizer.ggml.bos_token_id"] = 5
+    tensors = {n: g.load_tensor(n) for n in g.tensors}
+    g.close()
+    write_gguf(str(tmp_path / "m2.gguf"), meta2, tensors)
+    gcard2 = ModelDeploymentCard.resolve(str(tmp_path / "m2.gguf"))
+    assert gcard2.eos_token_ids == [7]
+    assert gcard2.bos_token_id == 5
 
     with pytest.raises(FileNotFoundError, match="local cache"):
         ModelDeploymentCard.resolve("no-such-org/no-such-model-xyz")
