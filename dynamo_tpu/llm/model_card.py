@@ -120,23 +120,25 @@ class ModelDeploymentCard:
         ctx = md.get(f"{arch}.context_length")
         if ctx:
             card.context_length = int(ctx)
-        eos = md.get("tokenizer.ggml.eos_token_id")
-        bos = md.get("tokenizer.ggml.bos_token_id")
-        if bos is not None:
-            card.bos_token_id = int(bos)
-        tok_dir = os.path.dirname(os.path.abspath(path))
-        if os.path.exists(os.path.join(tok_dir, "tokenizer.json")):
-            card.tokenizer = tok_dir
-        if eos is not None:
-            card.eos_token_ids = [int(eos)]
-        else:
-            # no eos in the container: the serving tokenizer's eos must
-            # still stop generation, or every request runs to max_tokens
-            from .tokenizer import load_tokenizer
+        try:
+            eos = md.get("tokenizer.ggml.eos_token_id")
+            bos = md.get("tokenizer.ggml.bos_token_id")
+            if bos is not None:
+                card.bos_token_id = int(bos)
+            tok_dir = os.path.dirname(os.path.abspath(path))
+            if os.path.exists(os.path.join(tok_dir, "tokenizer.json")):
+                card.tokenizer = tok_dir
+            if eos is not None:
+                card.eos_token_ids = [int(eos)]
+            else:
+                # no eos in the container: the serving tokenizer's eos must
+                # still stop generation, or every request runs to max_tokens
+                from .tokenizer import load_tokenizer
 
-            card.eos_token_ids = list(
-                load_tokenizer(card.tokenizer).eos_token_ids)
-        g.close()
+                card.eos_token_ids = list(
+                    load_tokenizer(card.tokenizer).eos_token_ids)
+        finally:
+            g.close()
         return card
 
     @classmethod

@@ -395,6 +395,9 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
         mask = (read_valid[:, None, :]
                 & (read_pos[:, None, :] <= positions[:, :, None]))
 
+    # NOTE: forward_pp.apply_stage mirrors this layer body for the
+    # pipeline-parallel stages; test_forward_pp pins their exactness —
+    # change them together.
     for l in range(cfg.num_layers):
         h = rms_norm(x, lp["ln1"][l], cfg.rms_eps)
         q = jnp.einsum("btd,dhk->bthk", h, lp["wq"][l])
@@ -446,6 +449,150 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("btd,dv->btv", x, head.astype(x.dtype))
+    return logits.astype(jnp.float32), k_pool, v_pool
+
+
+def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
+               tokens: jax.Array,        # [M, Bm, T] microbatched token ids
+               positions: jax.Array,     # [M, Bm, T]
+               k_pool: jax.Array,        # [L, Hkv, n_pages, page, Dh]
+               v_pool: jax.Array,
+               write_idx: jax.Array,     # [M, Bm, T]
+               read_idx: jax.Array,      # [M, Bm, S]
+               read_pos: jax.Array,      # [M, Bm, S]
+               read_valid: jax.Array,    # [M, Bm, S]
+               mesh,                     # must carry a pp axis > 1 (or == 1)
+               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pipeline-parallel forward: the layer stack is split into ``pp``
+    contiguous stages (params AND the KV pools sharded on the layer dim —
+    each device materializes only its stage's weights and pages, the memory
+    win that fits 70B-class models on small slices). Microbatches enter
+    stage 0 one per step; activations hop stages with ``ppermute``; KV
+    writes land in each stage's local pool shard. Exact vs. the sequential
+    :func:`forward` per microbatch.
+
+    Returns (logits [M, Bm, T, V] fp32, k_pool, v_pool). Embedding/head run
+    replicated outside the stage loop (they are not layer-stacked).
+
+    Reference capability: SURVEY §2.5 pipeline parallelism (the reference
+    delegates to vLLM `pipeline_parallel_size`); here the model compute
+    path itself is pp-partitioned, engine wiring is the follow-up stage.
+    """
+    from ..parallel.mesh import AXIS_PP
+
+    M, Bm, T = tokens.shape
+    L = cfg.num_layers
+    pp = mesh.shape[AXIS_PP] if (mesh is not None
+                                 and AXIS_PP in mesh.axis_names) else 1
+    if pp == 1:
+        outs = []
+        for m in range(M):
+            lg, k_pool, v_pool = forward(
+                params, cfg, tokens[m], positions[m], k_pool, v_pool,
+                write_idx[m], read_idx[m], read_pos[m], read_valid[m])
+            outs.append(lg)
+        return jnp.stack(outs), k_pool, v_pool
+    assert L % pp == 0, f"layers {L} must divide pp {pp}"
+    assert not cfg.num_experts, "pp + MoE staging is a follow-up"
+    page = k_pool.shape[3]
+    lp = params["layers"]
+
+    # embed + rope for every microbatch, replicated (cheap, not stacked);
+    # rope_tables handles arbitrary leading dims
+    x0 = params["embed"][tokens]                       # [M, Bm, T, D]
+    cos, sin = rope_tables(cfg, positions)             # [M, Bm, T, Dh/2]
+
+    perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def local(lp_loc, kp_loc, vp_loc, x0, cos, sin, positions, widx, ridx,
+              rpos, rvalid):
+        idx = jax.lax.axis_index(AXIS_PP)
+        Lloc = L // pp
+        cur = jnp.zeros_like(x0[0])
+        outs = jnp.zeros_like(x0)
+
+        def apply_stage(carry, mb, live):
+            cur, kp, vp = carry
+            c_m = jax.lax.dynamic_index_in_dim(cos, mb, keepdims=False)
+            s_m = jax.lax.dynamic_index_in_dim(sin, mb, keepdims=False)
+            widx_m = jax.lax.dynamic_index_in_dim(widx, mb, keepdims=False)
+            ridx_m = jax.lax.dynamic_index_in_dim(ridx, mb, keepdims=False)
+            rpos_m = jax.lax.dynamic_index_in_dim(rpos, mb, keepdims=False)
+            rval_m = jax.lax.dynamic_index_in_dim(rvalid, mb, keepdims=False)
+            pos_m = jax.lax.dynamic_index_in_dim(positions, mb,
+                                                 keepdims=False)
+            flat_w = widx_m.reshape(-1)
+            # bubble steps write NOTHING: out-of-bounds page index + drop
+            # mode gates the scatter itself (a whole-pool select per step
+            # would copy the dominant HBM tensor twice each step)
+            flat_w = jnp.where(live, flat_w, kp.shape[2] * page)
+            wp, wo = flat_w // page, flat_w % page
+            rp, ro = ridx_m // page, ridx_m % page
+            mask = (rval_m[:, None, :]
+                    & (rpos_m[:, None, :] <= pos_m[:, :, None]))
+            # mirrors forward's xla layer body (see the NOTE there);
+            # test_forward_pp pins exactness between the two
+            x = cur
+            for l in range(Lloc):
+                h = rms_norm(x, lp_loc["ln1"][l], cfg.rms_eps)
+                q = jnp.einsum("btd,dhk->bthk", h, lp_loc["wq"][l])
+                k = jnp.einsum("btd,dhk->bthk", h, lp_loc["wk"][l])
+                v = jnp.einsum("btd,dhk->bthk", h, lp_loc["wv"][l])
+                if cfg.attention_bias:
+                    q = q + lp_loc["bq"][l]
+                    k = k + lp_loc["bk"][l]
+                    v = v + lp_loc["bv"][l]
+                q = apply_rope(q, c_m, s_m)
+                k = apply_rope(k, c_m, s_m)
+                kp = kp.at[l, :, wp, wo].set(
+                    k.reshape(-1, *k.shape[2:]), mode="drop")
+                vp = vp.at[l, :, wp, wo].set(
+                    v.reshape(-1, *v.shape[2:]), mode="drop")
+                k_ctx = kp[l, :, rp, ro]
+                v_ctx = vp[l, :, rp, ro]
+                attn = attend(q, k_ctx, v_ctx, mask)
+                x = x + jnp.einsum("bthk,hkd->btd", attn, lp_loc["wo"][l])
+                h2 = rms_norm(x, lp_loc["ln2"][l], cfg.rms_eps)
+                g = jnp.einsum("btd,df->btf", h2, lp_loc["wg"][l])
+                u = jnp.einsum("btd,df->btf", h2, lp_loc["wu"][l])
+                x = x + jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u,
+                                   lp_loc["wd"][l])
+            return x, kp, vp
+
+        for t in range(M + pp - 1):
+            if t < M:
+                cur = jnp.where(idx == 0, x0[t], cur)
+            # the microbatch THIS stage processes at step t entered at
+            # t - idx; clamp keeps the index legal during bubble steps
+            # (their results are masked out)
+            mb = jnp.clip(t - idx, 0, M - 1)
+            live = (t - idx >= 0) & (t - idx < M)
+            y, kp_loc, vp_loc = apply_stage((cur, kp_loc, vp_loc), mb, live)
+            if t >= pp - 1:
+                m_out = t - (pp - 1)
+                outs = outs.at[m_out].set(
+                    jnp.where(idx == pp - 1, y, outs[m_out]))
+            cur = jax.lax.ppermute(y, AXIS_PP, perm_fwd)
+        outs = jax.lax.psum(
+            jnp.where(jax.lax.axis_index(AXIS_PP) == pp - 1, outs, 0.0),
+            AXIS_PP)
+        return outs, kp_loc, vp_loc
+
+    pspec = jax.tree.map(lambda _: P(AXIS_PP), lp)
+    pool_spec = P(AXIS_PP)        # pools sharded on the layer dim
+    rep = P()
+    xs, k_pool, v_pool = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(pspec, pool_spec, pool_spec, rep, rep, rep, rep, rep,
+                  rep, rep, rep),
+        out_specs=(rep, pool_spec, pool_spec),
+        check_vma=False,
+    )(lp, k_pool, v_pool, x0, cos, sin, positions, write_idx, read_idx,
+      read_pos, read_valid)
+
+    xs = rms_norm(xs, params["final_norm"], cfg.rms_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("mbtd,dv->mbtv", xs, head.astype(xs.dtype))
     return logits.astype(jnp.float32), k_pool, v_pool
 
 
