@@ -44,6 +44,10 @@ class ApiStore:
         self.advertise_host = advertise_host
         self.client: Optional[StoreClient] = None
         self._runner: Optional[web.AppRunner] = None
+        # version allocation is a read-modify-write on .next_version; two
+        # concurrent uploads of the same artifact must not alias one version
+        # (one lock for all uploads: bounded, and uploads are rare)
+        self._upload_lock = None
         os.makedirs(root, exist_ok=True)
 
     # ------------------------------------------------------------------
@@ -87,9 +91,18 @@ class ApiStore:
         return os.path.join(self.root, self._safe(name))
 
     async def _upload(self, req: web.Request) -> web.Response:
+        import asyncio
+
         name = self._safe(req.match_info["name"])
         data = await req.read()
         digest = hashlib.sha256(data).hexdigest()
+        if self._upload_lock is None:
+            self._upload_lock = asyncio.Lock()
+        async with self._upload_lock:
+            return await self._upload_locked(name, data, digest)
+
+    async def _upload_locked(self, name: str, data: bytes,
+                             digest: str) -> web.Response:
         vdir = self._vdir(name)
         os.makedirs(vdir, exist_ok=True)
         # versions are monotonic even across deletes (a counter file, not

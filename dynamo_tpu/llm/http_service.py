@@ -191,6 +191,12 @@ class HttpService:
                 async for ch in engine.generate(oai_req, ctx):
                     if "event" in ch:
                         continue  # annotations only meaningful when streaming
+                    if "error" in ch:
+                        # a pipeline that already yielded chunks reports
+                        # failures in-stream; here nothing is committed yet
+                        # so it can still be a clean 4xx
+                        status = "400"
+                        return _err(400, ch["error"]["message"])
                     chunks.append(ch)
                     u = ch.get("usage")
                     if u:
@@ -226,6 +232,11 @@ class HttpService:
             return _err(400, str(e))
         except EngineError as e:
             return _err(e.code, str(e))
+        if isinstance(first_item, dict) and "error" in first_item:
+            # a pipeline that reports failures in-stream (tool matcher) may
+            # fail before any content chunk; nothing is committed yet so it
+            # can still be a proper 4xx
+            return _err(400, first_item["error"]["message"])
 
         resp = web.StreamResponse(
             status=200,

@@ -22,6 +22,7 @@ from .protocols.openai import (
     ChatDeltaGenerator,
     CompletionDeltaGenerator,
     CompletionRequest,
+    ProtocolError,
     usage_dict,
 )
 from .tokenizer import load_tokenizer
@@ -82,7 +83,17 @@ class OpenAIChatEngine(AsyncEngine[ChatCompletionRequest, Dict[str, Any]]):
                     if matcher is not None:
                         complete = out.finish_reason in (FinishReason.STOP,
                                                          FinishReason.EOS)
-                        calls = matcher.get_calls("".join(buffered), complete)
+                        try:
+                            calls = matcher.get_calls("".join(buffered),
+                                                      complete)
+                        except ProtocolError as e:
+                            # streaming has begun (annotation/role chunks may
+                            # be committed): surface as a terminal in-stream
+                            # error, not an exception after a 200 header —
+                            # parse-time validation already gave clean 400s
+                            yield {"error": {"message": str(e),
+                                             "type": "invalid_request_error"}}
+                            return
                         if calls:
                             yield gen.tool_calls_chunk(calls, out.index)
                             finish_override = "tool_calls"

@@ -22,6 +22,7 @@ a user Python file via PyO3); this is the same contract bridged natively.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import importlib.util
 import os
 from typing import AsyncIterator
@@ -92,11 +93,14 @@ class PyTokCoreEngine(AsyncEngine[BackendInput, EngineOutput]):
                         [int(t) for t in item]
                     out = EngineOutput(token_ids=ids)
                 # the client's max_tokens binds whichever shape the user
-                # yields — truncate a multi-token item at the boundary
+                # yields — truncate a multi-token item at the boundary.
+                # Copy rather than mutate: a user engine may retain the
+                # object it yielded.
                 if budget is not None and emitted + len(out.token_ids) >= budget:
-                    out.token_ids = out.token_ids[:budget - emitted]
-                    if out.finish_reason is None:
-                        out.finish_reason = FinishReason.LENGTH
+                    out = dataclasses.replace(
+                        out,
+                        token_ids=out.token_ids[:budget - emitted],
+                        finish_reason=out.finish_reason or FinishReason.LENGTH)
                 emitted += len(out.token_ids)
                 yield out
                 if out.finish_reason is not None:

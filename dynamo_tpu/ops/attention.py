@@ -406,6 +406,11 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     Hkv, n_pages, page, _ = k_pages.shape
     G = Hq // Hkv
     P = page_tables.shape[1]
+    # The TPU kernel's prefetch chain assumes every lane covers >=1 block
+    # (nblocks==0 would leave a DMA slot un-consumed and stall the next
+    # active lane). Enforce the invariant here rather than relying on
+    # callers to pad lengths.
+    lengths = jnp.maximum(lengths, 1)
     if interpret is None:
         interpret = _interpret_default()
     if not interpret:

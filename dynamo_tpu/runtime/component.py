@@ -526,9 +526,11 @@ class Client:
             await ctx.stopped()
             # the connect/failover window may have no writer yet — or a
             # just-closed one about to be replaced. Keep trying against the
-            # CURRENT writer until a send sticks; a stop must not be lost
-            # to a connection that died the same instant.
-            for _ in range(200):
+            # CURRENT writer until a send sticks (or the exchange itself
+            # ends and this task is cancelled); a stop must not be lost to
+            # a connection that died the same instant, nor abandoned while
+            # connect/failover churns longer than any fixed window.
+            while True:
                 w = live["writer"]
                 if w is not None and not w.is_closing():
                     try:
