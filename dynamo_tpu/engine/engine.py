@@ -73,6 +73,7 @@ class JaxEngineConfig:
     tp: int = 1
     sp: int = 1                         # sequence-parallel (ring) axis size
     ep: int = 1                         # expert-parallel axis size (MoE)
+    pp: int = 1                         # pipeline-parallel stage count
     page_size: int = 64
     max_batch: int = 8
     max_context: int = 2048
@@ -109,7 +110,7 @@ class JaxEngineConfig:
             page_size=card.kv_block_size,
             params_path=card.path,
         )
-        for k in ("sp", "ep", "max_batch", "max_context", "prefill_chunk",
+        for k in ("sp", "ep", "pp", "max_batch", "max_context", "prefill_chunk",
                   "num_pages", "decode_steps", "prefill_lanes", "seed",
                   "preset", "attn_impl",
                   "enable_prefix_reuse", "host_cache_blocks",
@@ -157,7 +158,10 @@ class EngineCore:
         self.cfg = cfg
         m = cfg.model
         llama.validate_tp(m, cfg.tp, cfg.ep)
-        self.mesh = serving_mesh(cfg.tp, cfg.sp, cfg.ep, devices)
+        llama.validate_pp(m, cfg.pp, cfg.tp)
+        if cfg.pp > 1 and (cfg.sp > 1 or cfg.ep > 1):
+            raise ValueError("pp > 1 composes with tp only (sp/ep must be 1)")
+        self.mesh = serving_mesh(cfg.tp, cfg.sp, cfg.ep, cfg.pp, devices)
         self.page_size = cfg.page_size
         # every sequence may overshoot up to 2*decode_steps speculative
         # tokens: one dispatch in flight plus one chained behind it
@@ -173,7 +177,7 @@ class EngineCore:
         # axis of MoE expert weights on an ep=1 mesh)
         from ..parallel.mesh import sharding as mk_sharding
 
-        specs = llama.param_specs(m, cfg.tp)
+        specs = llama.param_specs(m, cfg.tp, cfg.pp)
         shardings = jax.tree.map(
             lambda s: mk_sharding(self.mesh, *s), specs,
             is_leaf=lambda x: isinstance(x, P))
@@ -194,6 +198,14 @@ class EngineCore:
         if impl == "auto":
             import os
             impl = os.environ.get("DYNAMO_TPU_ATTN", "auto")
+        if cfg.pp > 1:
+            # the staged loop computes attention inside shard_map (manual
+            # SPMD over pp×tp) — the pallas/ring wrappers don't apply there
+            if impl not in ("auto", "xla"):
+                raise ValueError(
+                    f"pp > 1 serves attention in-stage (xla); "
+                    f"attn_impl={impl!r} is not supported with pp")
+            impl = "xla"
         if impl == "auto":
             # Pallas kernels on TPU (shard_map-wrapped per tp shard); XLA
             # dense elsewhere or when the model's GQA grouping can't split
@@ -229,7 +241,7 @@ class EngineCore:
 
         # --- KV pools (head-major: [L, Hkv, n_pages, page, Dh] so that
         # pool[l] is directly the TPU paged-attention kernel layout) ----
-        kv_spec = llama.kv_cache_spec(m, cfg.tp)
+        kv_spec = llama.kv_cache_spec(m, cfg.tp, cfg.pp)
         self.kv_sharding = NamedSharding(self.mesh, kv_spec)
         pool_shape = (m.num_layers, m.num_kv_heads, num_pages,
                       cfg.page_size, m.head_dim)
@@ -346,9 +358,14 @@ class EngineCore:
                      temp, top_p, top_k, key):
                 def one(carry, _):
                     tokens, lengths, k_pool, v_pool, key = carry
-                    logits, k_pool, v_pool = llama.forward_decode(
-                        params, cfg.model, tokens, k_pool, v_pool,
-                        page_tables, lengths, attn_impl=impl, mesh=mesh)
+                    if cfg.pp > 1:
+                        logits, k_pool, v_pool = llama.forward_decode_pp(
+                            params, cfg.model, tokens, k_pool, v_pool,
+                            page_tables, lengths, mesh=mesh)
+                    else:
+                        logits, k_pool, v_pool = llama.forward_decode(
+                            params, cfg.model, tokens, k_pool, v_pool,
+                            page_tables, lengths, attn_impl=impl, mesh=mesh)
                     tok, logp, new_key = sample(
                         logits[:, 0], temp, top_p, top_k, key)
                     return ((tok, lengths + 1, k_pool, v_pool, new_key),
@@ -379,15 +396,28 @@ class EngineCore:
             mesh = self.mesh
             rep, kv = self._rep_sharding, self.kv_sharding
 
+            # pp microbatching: shared rule with forward_decode_pp
+            M = llama.pp_microbatches(Bp, cfg.pp)
+
             @partial(jax.jit, donate_argnums=(3, 4),
                      out_shardings=(rep, rep, rep, kv, kv))
             def fn(params, tokens, positions, k_pool, v_pool, write_idx,
                    read_idx, read_pos, read_valid, last_i, temp, top_p,
                    top_k, keys):
-                logits, k_pool, v_pool = llama.forward(
-                    params, cfg.model, tokens, positions, k_pool, v_pool,
-                    write_idx, read_idx, read_pos, read_valid,
-                    attn_impl=impl, mesh=mesh, logits_idx=last_i)
+                if cfg.pp > 1:
+                    def mb(a):
+                        return a.reshape(M, Bp // M, *a.shape[1:])
+                    logits, k_pool, v_pool = llama.forward_pp(
+                        params, cfg.model, mb(tokens), mb(positions),
+                        k_pool, v_pool, mb(write_idx), mb(read_idx),
+                        mb(read_pos), mb(read_valid), mesh,
+                        logits_idx=mb(last_i))
+                    logits = logits.reshape(Bp, 1, -1)
+                else:
+                    logits, k_pool, v_pool = llama.forward(
+                        params, cfg.model, tokens, positions, k_pool, v_pool,
+                        write_idx, read_idx, read_pos, read_valid,
+                        attn_impl=impl, mesh=mesh, logits_idx=last_i)
                 tok, logp, new_keys = sample(
                     logits[:, 0], temp, top_p, top_k, keys)
                 packed = jnp.stack([tok.astype(jnp.float32), logp], -1)

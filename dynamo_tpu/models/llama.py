@@ -189,13 +189,17 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
     return params
 
 
-def param_specs(cfg: LlamaConfig, tp_size: int = 1) -> Dict[str, Any]:
+def param_specs(cfg: LlamaConfig, tp_size: int = 1,
+                pp: int = 1) -> Dict[str, Any]:
     """PartitionSpecs: tp shards attention heads, the ffn dimension, and —
     when the model is untied and the vocab divides tp — the LM head's vocab
     dim. KV projections replicate when GQA kv_heads aren't divisible by tp;
-    the embedding stays replicated (token gathers need the full table)."""
-    from ..parallel.mesh import AXIS_EP
+    the embedding stays replicated (token gathers need the full table).
+    With ``pp > 1`` the stacked layer dim of every per-layer param shards
+    over the pipeline axis (each stage materializes only its layers)."""
+    from ..parallel.mesh import AXIS_EP, AXIS_PP
 
+    st = AXIS_PP if pp > 1 else None     # the [L, ...] stack dim
     tp = AXIS_TP
     kv = tp if cfg.num_kv_heads % max(tp_size, 1) == 0 else None
     if cfg.num_experts:
@@ -204,34 +208,34 @@ def param_specs(cfg: LlamaConfig, tp_size: int = 1) -> Dict[str, Any]:
         # when divisible (matching moe_ffn's shard_map specs)
         ftp = tp if cfg.intermediate_size % max(tp_size, 1) == 0 else None
         ffn = {
-            "wr": P(None, None, None),
-            "wg": P(None, AXIS_EP, None, ftp),
-            "wu": P(None, AXIS_EP, None, ftp),
-            "wd": P(None, AXIS_EP, ftp, None),
+            "wr": P(st, None, None),
+            "wg": P(st, AXIS_EP, None, ftp),
+            "wu": P(st, AXIS_EP, None, ftp),
+            "wd": P(st, AXIS_EP, ftp, None),
         }
     else:
         ffn = {
-            "wg": P(None, None, tp),
-            "wu": P(None, None, tp),
-            "wd": P(None, tp, None),
+            "wg": P(st, None, tp),
+            "wu": P(st, None, tp),
+            "wd": P(st, tp, None),
         }
     specs = {
         "embed": P(None, None),
         "layers": {
-            "ln1": P(None, None),
-            "ln2": P(None, None),
-            "wq": P(None, None, tp, None),
-            "wk": P(None, None, kv, None),
-            "wv": P(None, None, kv, None),
-            "wo": P(None, tp, None, None),
+            "ln1": P(st, None),
+            "ln2": P(st, None),
+            "wq": P(st, None, tp, None),
+            "wk": P(st, None, kv, None),
+            "wv": P(st, None, kv, None),
+            "wo": P(st, tp, None, None),
             **ffn,
         },
         "final_norm": P(None),
     }
     if cfg.attention_bias:
-        specs["layers"]["bq"] = P(None, tp, None)
-        specs["layers"]["bk"] = P(None, kv, None)
-        specs["layers"]["bv"] = P(None, kv, None)
+        specs["layers"]["bq"] = P(st, tp, None)
+        specs["layers"]["bk"] = P(st, kv, None)
+        specs["layers"]["bv"] = P(st, kv, None)
     if not cfg.tie_embeddings:
         # vocab-sharded head: the [B,D]x[D,V] logits matmul partitions over
         # tp (each chip computes V/tp columns); GSPMD all-gathers the row
@@ -254,12 +258,32 @@ def validate_tp(cfg: LlamaConfig, tp: int, ep: int = 1) -> None:
                              f"by ep={ep}")
 
 
-def kv_cache_spec(cfg: LlamaConfig, tp: int) -> P:
+def validate_pp(cfg: LlamaConfig, pp: int, tp: int = 1) -> None:
+    """Pipeline-parallel constraints for the staged serving path."""
+    if pp <= 1:
+        return
+    if cfg.num_layers % pp:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by pp={pp}")
+    if cfg.num_experts:
+        raise ValueError("pp > 1 with MoE staging is not supported yet")
+    if tp > 1 and cfg.num_kv_heads % tp:
+        raise ValueError(
+            f"pp > 1 with tp={tp} needs kv heads divisible by tp "
+            f"(got {cfg.num_kv_heads}): the staged path shards the KV pool")
+
+
+def kv_cache_spec(cfg: LlamaConfig, tp: int, pp: int = 1) -> P:
     """KV pool sharding ([L, Hkv, n_pages, page, Dh]): shard kv heads over tp
-    when divisible, else replicate (GQA with kv_heads < tp)."""
+    when divisible, else replicate (GQA with kv_heads < tp). With ``pp > 1``
+    the layer dim additionally shards over the pipeline axis — each stage
+    holds only its layers' pages (the memory win that fits 70B on slices)."""
+    from ..parallel.mesh import AXIS_PP
+
+    st = AXIS_PP if pp > 1 else None
     if cfg.num_kv_heads % tp == 0:
-        return P(None, AXIS_TP, None, None, None)
-    return P(None, None, None, None, None)
+        return P(st, AXIS_TP, None, None, None)
+    return P(st, None, None, None, None)
 
 
 # ---------------------------------------------------------------------------
@@ -462,6 +486,7 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
                read_pos: jax.Array,      # [M, Bm, S]
                read_valid: jax.Array,    # [M, Bm, S]
                mesh,                     # must carry a pp axis > 1 (or == 1)
+               logits_idx: Optional[jax.Array] = None,  # [M, Bm] positions
                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Pipeline-parallel forward: the layer stack is split into ``pp``
     contiguous stages (params AND the KV pools sharded on the layer dim —
@@ -471,29 +496,45 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
     writes land in each stage's local pool shard. Exact vs. the sequential
     :func:`forward` per microbatch.
 
-    Returns (logits [M, Bm, T, V] fp32, k_pool, v_pool). Embedding/head run
-    replicated outside the stage loop (they are not layer-stacked).
+    Composes with tensor parallelism: when the mesh carries a tp axis > 1,
+    heads/ffn shard over tp WITHIN each stage (manual-SPMD psum after the
+    wo/wd contractions — the scaling-book megatron recipe), and the KV pool
+    shards over (pp: layers, tp: kv heads).
+
+    Returns (logits [M, Bm, T, V] fp32, k_pool, v_pool); with ``logits_idx``
+    ([M, Bm] int32), the LM head runs only at each lane's given chunk
+    position and logits are [M, Bm, 1, V] (the prefill fast path). Embedding
+    and head run outside the stage loop under GSPMD (they are not
+    layer-stacked).
 
     Reference capability: SURVEY §2.5 pipeline parallelism (the reference
     delegates to vLLM `pipeline_parallel_size`); here the model compute
-    path itself is pp-partitioned, engine wiring is the follow-up stage.
+    path itself is pp-partitioned and engine-served (JaxEngineConfig.pp).
     """
     from ..parallel.mesh import AXIS_PP
 
     M, Bm, T = tokens.shape
     L = cfg.num_layers
-    pp = mesh.shape[AXIS_PP] if (mesh is not None
-                                 and AXIS_PP in mesh.axis_names) else 1
+    pp = _pp_size(mesh)
     if pp == 1:
         outs = []
+        li = None
         for m in range(M):
+            if logits_idx is not None:
+                li = logits_idx[m]
             lg, k_pool, v_pool = forward(
                 params, cfg, tokens[m], positions[m], k_pool, v_pool,
-                write_idx[m], read_idx[m], read_pos[m], read_valid[m])
+                write_idx[m], read_idx[m], read_pos[m], read_valid[m],
+                logits_idx=li)
             outs.append(lg)
         return jnp.stack(outs), k_pool, v_pool
     assert L % pp == 0, f"layers {L} must divide pp {pp}"
     assert not cfg.num_experts, "pp + MoE staging is a follow-up"
+    tp_sz = _tp_size(mesh)
+    # per-shard GQA grouping must stay integral: with kv heads replicated a
+    # shard would silently pair its local q heads with the wrong kv heads
+    assert cfg.num_kv_heads % tp_sz == 0, \
+        f"pp with tp={tp_sz} needs kv heads divisible (got {cfg.num_kv_heads})"
     page = k_pool.shape[3]
     lp = params["layers"]
 
@@ -531,7 +572,9 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
             mask = (rval_m[:, None, :]
                     & (rpos_m[:, None, :] <= pos_m[:, :, None]))
             # mirrors forward's xla layer body (see the NOTE there);
-            # test_forward_pp pins exactness between the two
+            # test_forward_pp pins exactness between the two. With tp > 1
+            # each shard computes its head/ffn slice; the wo/wd
+            # contractions produce partial sums reduced over tp.
             x = cur
             for l in range(Lloc):
                 h = rms_norm(x, lp_loc["ln1"][l], cfg.rms_eps)
@@ -551,12 +594,18 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
                 k_ctx = kp[l, :, rp, ro]
                 v_ctx = vp[l, :, rp, ro]
                 attn = attend(q, k_ctx, v_ctx, mask)
-                x = x + jnp.einsum("bthk,hkd->btd", attn, lp_loc["wo"][l])
+                o = jnp.einsum("bthk,hkd->btd", attn, lp_loc["wo"][l])
+                if tp_sz > 1:
+                    o = jax.lax.psum(o, AXIS_TP)
+                x = x + o
                 h2 = rms_norm(x, lp_loc["ln2"][l], cfg.rms_eps)
                 g = jnp.einsum("btd,df->btf", h2, lp_loc["wg"][l])
                 u = jnp.einsum("btd,df->btf", h2, lp_loc["wu"][l])
-                x = x + jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u,
-                                   lp_loc["wd"][l])
+                f = jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u,
+                               lp_loc["wd"][l])
+                if tp_sz > 1:
+                    f = jax.lax.psum(f, AXIS_TP)
+                x = x + f
             return x, kp, vp
 
         for t in range(M + pp - 1):
@@ -578,8 +627,13 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
             AXIS_PP)
         return outs, kp_loc, vp_loc
 
-    pspec = jax.tree.map(lambda _: P(AXIS_PP), lp)
-    pool_spec = P(AXIS_PP)        # pools sharded on the layer dim
+    # per-layer params carry their tp sharding INTO the stage (manual SPMD
+    # over both axes); pools shard (pp: layer dim, tp: kv heads). Axis
+    # names the mesh doesn't carry (pp-only meshes) are dropped.
+    from ..parallel.mesh import filter_spec
+    pspec = param_specs(cfg, tp_sz, pp=pp)["layers"]
+    pspec = {k: filter_spec(mesh, pspec[k]) for k in lp}
+    pool_spec = filter_spec(mesh, kv_cache_spec(cfg, tp_sz, pp=pp))
     rep = P()
     xs, k_pool, v_pool = jax.shard_map(
         local, mesh=mesh,
@@ -590,10 +644,61 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
     )(lp, k_pool, v_pool, x0, cos, sin, positions, write_idx, read_idx,
       read_pos, read_valid)
 
+    if logits_idx is not None:
+        xs = jnp.take_along_axis(
+            xs, logits_idx[:, :, None, None].astype(jnp.int32), axis=2)
     xs = rms_norm(xs, params["final_norm"], cfg.rms_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("mbtd,dv->mbtv", xs, head.astype(xs.dtype))
     return logits.astype(jnp.float32), k_pool, v_pool
+
+
+def forward_decode_pp(params: Dict[str, Any], cfg: LlamaConfig,
+                      tokens: jax.Array,        # [B] int32 last sampled
+                      k_pool: jax.Array,        # [L, Hkv, n_pages, page, Dh]
+                      v_pool: jax.Array,
+                      page_tables: jax.Array,   # [B, P] int32
+                      lengths: jax.Array,       # [B] tokens incl. current
+                      mesh,
+                      microbatches: int = 0,    # 0 => pp stages
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Single-token decode through the pipeline-parallel stage loop.
+
+    Builds the (write, read) pool addressing on device from the page tables
+    — exactly :func:`forward_decode`'s XLA path — then microbatches the B
+    lanes through :func:`forward_pp` to keep every stage busy. Returns
+    (logits [B, 1, vocab] fp32, k_pool, v_pool).
+    """
+    B = tokens.shape[0]
+    page = k_pool.shape[3]
+    M = pp_microbatches(B, microbatches or _pp_size(mesh))
+    Bm = B // M
+
+    pos = lengths - 1                                       # [B]
+    w_page = jnp.take_along_axis(page_tables, (pos // page)[:, None],
+                                 axis=1)[:, 0]
+    write_idx = w_page * page + pos % page                  # [B]
+    S = page_tables.shape[1] * page
+    t = jnp.arange(S, dtype=jnp.int32)
+    rp = jnp.take_along_axis(
+        page_tables, jnp.broadcast_to((t // page)[None], (B, S)), axis=1)
+    read_idx = rp * page + (t % page)[None]                 # [B, S]
+    read_pos = jnp.broadcast_to(t[None], (B, S))
+    read_valid = t[None] < lengths[:, None]                 # [B, S]
+
+    logits, k_pool, v_pool = forward_pp(
+        params, cfg,
+        tokens.reshape(M, Bm, 1),
+        pos.reshape(M, Bm, 1),
+        k_pool, v_pool,
+        write_idx.reshape(M, Bm, 1),
+        read_idx.reshape(M, Bm, S),
+        read_pos.reshape(M, Bm, S),
+        read_valid.reshape(M, Bm, S),
+        mesh,
+        logits_idx=jnp.zeros((M, Bm), jnp.int32),
+    )
+    return logits.reshape(B, 1, -1), k_pool, v_pool
 
 
 def pallas_tp_ok(cfg: LlamaConfig, tp: int) -> bool:
@@ -614,6 +719,23 @@ def _tp_size(mesh) -> int:
     if mesh is None or _TP not in mesh.axis_names:
         return 1
     return mesh.shape[_TP]
+
+
+def _pp_size(mesh) -> int:
+    from ..parallel.mesh import AXIS_PP as _PP
+    if mesh is None or _PP not in mesh.axis_names:
+        return 1
+    return mesh.shape[_PP]
+
+
+def pp_microbatches(B: int, pp: int) -> int:
+    """Largest microbatch count <= pp that divides B (keeps every pipeline
+    stage busy without padding lanes). Shared by the engine's prefill
+    program and :func:`forward_decode_pp` so both pipeline identically."""
+    M = max(1, min(B, pp))
+    while B % M:
+        M -= 1
+    return M
 
 
 def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,

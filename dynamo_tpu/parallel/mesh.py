@@ -72,25 +72,27 @@ def sp_tp_mesh(sp: int, tp: int,
     return Mesh(devs, (AXIS_SP, AXIS_TP))
 
 
-def serving_mesh(tp: int = 1, sp: int = 1, ep: int = 1,
+def serving_mesh(tp: int = 1, sp: int = 1, ep: int = 1, pp: int = 1,
                  devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Engine mesh with exactly the axes in use: sp (ring prefill,
-    outermost), ep (experts), tp (innermost, so tp collectives ride
-    neighbor ICI links). Axes of size 1 other than tp are omitted."""
+    """Engine mesh with exactly the axes in use: pp (pipeline stages,
+    outermost — stage hops tolerate DCN), sp (ring prefill), ep (experts),
+    tp (innermost, so tp collectives ride neighbor ICI links). Axes of
+    size 1 other than tp are omitted."""
     devices = list(devices if devices is not None else jax.devices())
-    axes = [(AXIS_SP, sp), (AXIS_EP, ep), (AXIS_TP, tp)]
+    axes = [(AXIS_PP, pp), (AXIS_SP, sp), (AXIS_EP, ep), (AXIS_TP, tp)]
     axes = [(n, s) for n, s in axes if s > 1 or n == AXIS_TP]
     total = math.prod(s for _, s in axes)
     if total > len(devices):
         raise ValueError(
-            f"serving mesh tp={tp} sp={sp} ep={ep} needs {total} devices, "
-            f"have {len(devices)}")
+            f"serving mesh tp={tp} sp={sp} ep={ep} pp={pp} needs {total} "
+            f"devices, have {len(devices)}")
     devs = np.array(devices[:total]).reshape([s for _, s in axes])
     return Mesh(devs, tuple(n for n, _ in axes))
 
 
-def sharding(mesh: Mesh, *spec) -> NamedSharding:
-    # drop axis names the mesh doesn't have (lets one spec serve 1-D and 4-D)
+def filter_spec(mesh: Mesh, spec: P) -> P:
+    """Drop axis names the mesh doesn't carry from a PartitionSpec (lets
+    one spec serve 1-D and 4-D meshes)."""
     names = set(mesh.axis_names)
 
     def keep(s):
@@ -101,7 +103,11 @@ def sharding(mesh: Mesh, *spec) -> NamedSharding:
             return kept if kept else None
         return s if s in names else None
 
-    return NamedSharding(mesh, P(*(keep(s) for s in spec)))
+    return P(*(keep(s) for s in spec))
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, filter_spec(mesh, P(*spec)))
 
 
 def shard_divisible(n: int, mesh: Mesh, axis: str) -> bool:
