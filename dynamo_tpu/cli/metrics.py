@@ -39,26 +39,61 @@ def build_app(agg: ClusterMetricsAggregator) -> web.Application:
     return app
 
 
-async def run_metrics(args) -> None:
+async def push_loop(agg: ClusterMetricsAggregator, url: str,
+                    interval: float) -> None:
+    """Pushgateway mode: PUT the rendered exposition text to ``url``
+    every ``interval`` seconds (the reference binary's serve-or-push
+    switch, components/metrics/src/main.rs:26-31)."""
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        while True:
+            try:
+                async with session.put(
+                        url, data=agg.render().encode(),
+                        headers={"Content-Type": "text/plain"}) as resp:
+                    if resp.status >= 400:
+                        log.warning("pushgateway %s returned %d", url,
+                                    resp.status)
+            except Exception as e:
+                log.warning("pushgateway push failed: %s", e)
+            await asyncio.sleep(interval)
+
+
+async def run_metrics(args, *, ready_event=None) -> None:
     host, port = args.store.split(":")
     drt = await DistributedRuntime(store_host=host,
                                    store_port=int(port)).connect()
     agg = await ClusterMetricsAggregator(
         drt, args.namespace, args.component,
         scrape_interval=args.scrape_interval).start()
-    runner = web.AppRunner(build_app(agg))
-    await runner.setup()
-    site = web.TCPSite(runner, "0.0.0.0", args.port)
-    await site.start()
-    log.info("metrics aggregator on :%d (ns=%s components=%s)",
-             args.port, args.namespace, args.component)
-    print(f"metrics aggregator on :{args.port}", flush=True)
+    runner = None
+    pusher = None
+    if args.push_url:
+        pusher = asyncio.create_task(
+            push_loop(agg, args.push_url, args.push_interval))
+        log.info("metrics aggregator pushing to %s every %.1fs",
+                 args.push_url, args.push_interval)
+        print(f"metrics aggregator pushing to {args.push_url}", flush=True)
+    else:
+        runner = web.AppRunner(build_app(agg))
+        await runner.setup()
+        site = web.TCPSite(runner, "0.0.0.0", args.port)
+        await site.start()
+        log.info("metrics aggregator on :%d (ns=%s components=%s)",
+                 args.port, args.namespace, args.component)
+        print(f"metrics aggregator on :{args.port}", flush=True)
+    if ready_event is not None:
+        ready_event.set()
     try:
         while True:
             await asyncio.sleep(3600)
     finally:
+        if pusher is not None:
+            pusher.cancel()
         await agg.stop()
-        await runner.cleanup()
+        if runner is not None:
+            await runner.cleanup()
         await drt.close()
 
 
@@ -70,6 +105,9 @@ def main(argv=None) -> None:
                     help="worker component to scrape (repeatable)")
     ap.add_argument("--port", type=int, default=9091)
     ap.add_argument("--scrape-interval", type=float, default=1.0)
+    ap.add_argument("--push-url", default=None,
+                    help="pushgateway URL; set => push instead of serve")
+    ap.add_argument("--push-interval", type=float, default=5.0)
     args = ap.parse_args(argv)
     if not args.component:
         args.component = ["backend"]

@@ -4,9 +4,9 @@ Parses the GGUF v2/v3 container format (llama.cpp's model distribution
 format): header, string-keyed typed metadata, and the tensor directory. A
 llama-family GGUF (llama/mistral/qwen2) maps onto :class:`~dynamo_tpu.
 models.llama.LlamaConfig` and the stacked param pytree the engine serves;
-F32/F16/BF16 tensors load directly, Q8_0/Q4_0 block-quantized tensors
-dequantize at load, and the remaining K-quants are rejected with a clear
-error.
+F32/F16/BF16 tensors load directly; Q8_0/Q4_0 block-quantized and
+Q4_K/Q5_K/Q6_K super-block-quantized tensors (the formats stock *_K_M
+exports ship) dequantize at load.
 
 Reference capability: lib/llm/src/gguf/{content,gguf_metadata,
 gguf_tokenizer}.rs (~950 LoC: metadata parse, tokenizer build, model
@@ -35,10 +35,12 @@ _SCALAR_FMT = {_U8: "<B", _I8: "<b", _U16: "<H", _I16: "<h", _U32: "<I",
 # tensor ggml dtypes
 _GGML_F32, _GGML_F16 = 0, 1
 _GGML_Q4_0, _GGML_Q8_0, _GGML_BF16 = 2, 8, 16
+_GGML_Q4_K, _GGML_Q5_K, _GGML_Q6_K = 12, 13, 14
 _GGML_NAMES = {0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1", 6: "Q5_0",
                7: "Q5_1", 8: "Q8_0", 9: "Q8_1", 10: "Q2_K", 11: "Q3_K",
                12: "Q4_K", 13: "Q5_K", 14: "Q6_K", 16: "BF16"}
-_QBLOCK = 32   # tokens per quant block (Q4_0 / Q8_0)
+_QBLOCK = 32   # values per quant block (Q4_0 / Q8_0)
+_QK_K = 256    # values per K-quant super-block
 
 
 def _dequant_q8_0(raw: bytes, count: int) -> np.ndarray:
@@ -62,6 +64,84 @@ def _dequant_q4_0(raw: bytes, count: int) -> np.ndarray:
     hi = (rec["q"] >> 4).astype(np.int8) - 8
     vals = np.concatenate([lo, hi], axis=1).astype(np.float32)
     return (rec["d"].astype(np.float32)[:, None] * vals).reshape(count)
+
+
+def _kquant_scale_min(scales: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Unpack the 12-byte 6-bit scale/min table of Q4_K/Q5_K super-blocks.
+    scales: [nb, 12] uint8 -> (sc [nb, 8], mn [nb, 8]) float32."""
+    s = scales.astype(np.uint16)
+    sc = np.empty(s.shape[:-1] + (8,), np.uint16)
+    mn = np.empty_like(sc)
+    sc[..., :4] = s[..., 0:4] & 63
+    mn[..., :4] = s[..., 4:8] & 63
+    sc[..., 4:] = (s[..., 8:12] & 0x0F) | ((s[..., 0:4] >> 6) << 4)
+    mn[..., 4:] = (s[..., 8:12] >> 4) | ((s[..., 4:8] >> 6) << 4)
+    return sc.astype(np.float32), mn.astype(np.float32)
+
+
+def _dequant_q4_k(raw: bytes, count: int) -> np.ndarray:
+    """Q4_K: 256-value super-blocks of 8 sub-blocks; w = d*sc*q - dmin*m,
+    q in 0..15. Layout per 64 values: 32 bytes, low nibbles -> sub-block
+    2j, high nibbles -> sub-block 2j+1 (llama.cpp dequantize_row_q4_K)."""
+    nb = count // _QK_K
+    rec = np.frombuffer(raw, dtype=np.dtype(
+        [("d", "<f2"), ("dmin", "<f2"), ("scales", "u1", (12,)),
+         ("qs", "u1", (128,))]), count=nb)
+    sc, mn = _kquant_scale_min(rec["scales"])
+    d = rec["d"].astype(np.float32)[:, None] * sc       # [nb, 8]
+    m = rec["dmin"].astype(np.float32)[:, None] * mn
+    qs = rec["qs"].reshape(nb, 4, 32)                   # 4 groups of 64
+    lo = (qs & 0x0F).astype(np.float32)                 # sub-block 2j
+    hi = (qs >> 4).astype(np.float32)                   # sub-block 2j+1
+    q = np.stack([lo, hi], axis=2).reshape(nb, 8, 32)
+    out = d[:, :, None] * q - m[:, :, None]
+    return out.reshape(count)
+
+
+def _dequant_q5_k(raw: bytes, count: int) -> np.ndarray:
+    """Q5_K: Q4_K's scale scheme + one high bit per value from qh."""
+    nb = count // _QK_K
+    rec = np.frombuffer(raw, dtype=np.dtype(
+        [("d", "<f2"), ("dmin", "<f2"), ("scales", "u1", (12,)),
+         ("qh", "u1", (32,)), ("qs", "u1", (128,))]), count=nb)
+    sc, mn = _kquant_scale_min(rec["scales"])
+    d = rec["d"].astype(np.float32)[:, None] * sc
+    m = rec["dmin"].astype(np.float32)[:, None] * mn
+    qs = rec["qs"].reshape(nb, 4, 32)
+    qh = rec["qh"][:, None, :]                          # [nb, 1, 32]
+    group = np.arange(4)[None, :, None]
+    lo = (qs & 0x0F) + (((qh >> (2 * group)) & 1) << 4)       # u1 bit
+    hi = (qs >> 4) + (((qh >> (2 * group + 1)) & 1) << 4)     # u2 bit
+    q = np.stack([lo, hi], axis=2).reshape(nb, 8, 32).astype(np.float32)
+    out = d[:, :, None] * q - m[:, :, None]
+    return out.reshape(count)
+
+
+def _dequant_q6_k(raw: bytes, count: int) -> np.ndarray:
+    """Q6_K: 256-value super-blocks, 16 int8 scales, 6-bit values
+    (4 low bits in ql, 2 high bits in qh); w = d * sc * (q - 32)."""
+    nb = count // _QK_K
+    rec = np.frombuffer(raw, dtype=np.dtype(
+        [("ql", "u1", (128,)), ("qh", "u1", (64,)),
+         ("scales", "i1", (16,)), ("d", "<f2")]), count=nb)
+    d = rec["d"].astype(np.float32)                 # [nb]
+    sc = rec["scales"].astype(np.float32).reshape(nb, 2, 8)  # per 128-half
+    ql = rec["ql"].reshape(nb, 2, 64)               # 64 bytes per half
+    qh = rec["qh"].reshape(nb, 2, 32)               # 32 bytes per half
+    l = np.arange(32)
+    out = np.empty((nb, 2, 4, 32), np.float32)      # [nb, half, quarter, l]
+    for quarter in range(4):
+        src = ql[:, :, 32 * (quarter & 1):32 * (quarter & 1) + 32]
+        nib = (src & 0x0F) if quarter < 2 else (src >> 4)
+        q = (nib | (((qh >> (2 * quarter)) & 3) << 4)).astype(np.int32) - 32
+        scale = sc[:, :, 2 * quarter + l // 16]     # [nb, 2, 32]
+        out[:, :, quarter, :] = d[:, None, None] * scale * q
+    return out.reshape(count)
+
+
+_KQUANT_BYTES = {_GGML_Q4_K: 144, _GGML_Q5_K: 176, _GGML_Q6_K: 210}
+_KQUANT_FNS = {_GGML_Q4_K: _dequant_q4_k, _GGML_Q5_K: _dequant_q5_k,
+               _GGML_Q6_K: _dequant_q6_k}
 
 
 @dataclass
@@ -137,6 +217,11 @@ class GGUFFile:
             deq = (_dequant_q8_0 if info.ggml_type == _GGML_Q8_0
                    else _dequant_q4_0)(raw, count)
             return deq.reshape(info.shape)
+        if info.ggml_type in _KQUANT_FNS:
+            raw = self._read(self.data_start + info.offset,
+                             count // _QK_K * _KQUANT_BYTES[info.ggml_type])
+            return _KQUANT_FNS[info.ggml_type](raw, count) \
+                .reshape(info.shape)
         if info.ggml_type == _GGML_BF16:
             import ml_dtypes
 
@@ -147,8 +232,8 @@ class GGUFFile:
             tname = _GGML_NAMES.get(info.ggml_type, str(info.ggml_type))
             raise NotImplementedError(
                 f"tensor {name!r} uses unsupported ggml type {tname}; "
-                f"F32/F16/BF16/Q8_0/Q4_0 are loadable (dequantize or "
-                f"re-export the model)")
+                f"F32/F16/BF16/Q8_0/Q4_0/Q4_K/Q5_K/Q6_K are loadable "
+                f"(dequantize or re-export the model)")
         dtype = np.float32 if info.ggml_type == _GGML_F32 else np.float16
         raw = self._read(self.data_start + info.offset,
                          count * dtype().itemsize)

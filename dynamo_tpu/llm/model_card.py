@@ -108,8 +108,8 @@ class ModelDeploymentCard:
                   name: Optional[str] = None) -> "ModelDeploymentCard":
         """Build a card from a GGUF model file: config (context length,
         eos ids) comes from the GGUF metadata; the tokenizer uses an
-        adjacent tokenizer.json when present, else the byte fallback (the
-        GGUF-embedded vocab is weight data the engine loads either way)."""
+        adjacent tokenizer.json when present, else the GGUF-embedded SPM
+        vocab via the native SP tokenizer, else the byte fallback."""
         from .gguf import read_gguf
 
         g = read_gguf(path)
@@ -128,6 +128,11 @@ class ModelDeploymentCard:
             tok_dir = os.path.dirname(os.path.abspath(path))
             if os.path.exists(os.path.join(tok_dir, "tokenizer.json")):
                 card.tokenizer = tok_dir
+            elif (md.get("tokenizer.ggml.model") == "llama"
+                  and md.get("tokenizer.ggml.tokens")):
+                # SPM vocab embedded in the container (stock Mistral/Llama
+                # exports): serve it with the native SP tokenizer
+                card.tokenizer = f"gguf-sp:{os.path.abspath(path)}"
             if eos is not None:
                 card.eos_token_ids = [int(eos)]
             else:

@@ -117,8 +117,8 @@ def test_quantized_tensor_rejected(tmp_path):
     cfg = llama.preset("tiny-byte", tie_embeddings=False)
     tiny_gguf(tmp_path / "m.gguf", cfg)
     g = read_gguf(str(tmp_path / "m.gguf"))
-    g.tensors["token_embd.weight"].ggml_type = 12  # Q4_K
-    with pytest.raises(NotImplementedError, match="Q4_K"):
+    g.tensors["token_embd.weight"].ggml_type = 10  # Q2_K (unsupported)
+    with pytest.raises(NotImplementedError, match="Q2_K"):
         g.load_tensor("token_embd.weight")
 
 
@@ -203,3 +203,163 @@ def test_quantized_tensor_loads_from_file(tmp_path):
     g3.tensors["blk.0.ffn_up.weight"].ggml_type = 16  # BF16
     got3 = g3.load_tensor("blk.0.ffn_up.weight")
     np.testing.assert_allclose(got3, w, atol=np.abs(w).max() / 120)
+
+
+# ----------------------------------------------------------------------
+# K-quants: vectorized dequant vs a scalar transcription of the llama.cpp
+# reference loops, over randomly synthesized packed super-blocks
+# ----------------------------------------------------------------------
+
+def _scalar_q4_k(raw: bytes, count: int) -> np.ndarray:
+    out = np.empty(count, np.float32)
+    nb = count // 256
+    o = 0
+    for i in range(nb):
+        blk = raw[i * 144:(i + 1) * 144]
+        d = np.frombuffer(blk[0:2], "<f2")[0].astype(np.float32)
+        dmin = np.frombuffer(blk[2:4], "<f2")[0].astype(np.float32)
+        scales = blk[4:16]
+        qs = blk[16:144]
+        def sc_m(j):
+            if j < 4:
+                return scales[j] & 63, scales[j + 4] & 63
+            sc = (scales[j + 4] & 0xF) | ((scales[j - 4] >> 6) << 4)
+            m = (scales[j + 4] >> 4) | ((scales[j] >> 6) << 4)
+            return sc, m
+        is_ = 0
+        q = 0
+        for _ in range(0, 256, 64):
+            s1, m1 = sc_m(is_)
+            s2, m2 = sc_m(is_ + 1)
+            for l in range(32):
+                out[o + l] = d * s1 * (qs[q + l] & 0xF) - dmin * m1
+            for l in range(32):
+                out[o + 32 + l] = d * s2 * (qs[q + l] >> 4) - dmin * m2
+            o += 64
+            q += 32
+            is_ += 2
+    return out
+
+
+def _scalar_q6_k(raw: bytes, count: int) -> np.ndarray:
+    out = np.empty(count, np.float32)
+    nb = count // 256
+    o = 0
+    for i in range(nb):
+        blk = raw[i * 210:(i + 1) * 210]
+        ql = blk[0:128]
+        qh = blk[128:192]
+        sc = np.frombuffer(blk[192:208], np.int8)
+        d = np.frombuffer(blk[208:210], "<f2")[0].astype(np.float32)
+        for half in range(2):
+            qlh = ql[half * 64:(half + 1) * 64]
+            qhh = qh[half * 32:(half + 1) * 32]
+            sch = sc[half * 8:(half + 1) * 8]
+            for l in range(32):
+                is_ = l // 16
+                q1 = ((qlh[l] & 0xF) | (((qhh[l] >> 0) & 3) << 4)) - 32
+                q2 = ((qlh[l + 32] & 0xF) | (((qhh[l] >> 2) & 3) << 4)) - 32
+                q3 = ((qlh[l] >> 4) | (((qhh[l] >> 4) & 3) << 4)) - 32
+                q4 = ((qlh[l + 32] >> 4) | (((qhh[l] >> 6) & 3) << 4)) - 32
+                base = o + half * 128
+                out[base + l] = d * sch[is_ + 0] * q1
+                out[base + l + 32] = d * sch[is_ + 2] * q2
+                out[base + l + 64] = d * sch[is_ + 4] * q3
+                out[base + l + 96] = d * sch[is_ + 6] * q4
+        o += 256
+    return out
+
+
+def _scalar_q5_k(raw: bytes, count: int) -> np.ndarray:
+    out = np.empty(count, np.float32)
+    nb = count // 256
+    o = 0
+    for i in range(nb):
+        blk = raw[i * 176:(i + 1) * 176]
+        d = np.frombuffer(blk[0:2], "<f2")[0].astype(np.float32)
+        dmin = np.frombuffer(blk[2:4], "<f2")[0].astype(np.float32)
+        scales = blk[4:16]
+        qh = blk[16:48]
+        qs = blk[48:176]
+        def sc_m(j):
+            if j < 4:
+                return scales[j] & 63, scales[j + 4] & 63
+            sc = (scales[j + 4] & 0xF) | ((scales[j - 4] >> 6) << 4)
+            m = (scales[j + 4] >> 4) | ((scales[j] >> 6) << 4)
+            return sc, m
+        is_ = 0
+        q = 0
+        u1, u2 = 1, 2
+        for _ in range(0, 256, 64):
+            s1, m1 = sc_m(is_)
+            s2, m2 = sc_m(is_ + 1)
+            for l in range(32):
+                hi = 16 if qh[l] & u1 else 0
+                out[o + l] = d * s1 * ((qs[q + l] & 0xF) + hi) - dmin * m1
+            for l in range(32):
+                hi = 16 if qh[l] & u2 else 0
+                out[o + 32 + l] = d * s2 * ((qs[q + l] >> 4) + hi) - dmin * m2
+            o += 64
+            q += 32
+            is_ += 2
+            u1 <<= 2
+            u2 <<= 2
+    return out
+
+
+def test_kquant_dequant_matches_reference_loops():
+    import dynamo_tpu.llm.gguf as G
+
+    rng = np.random.default_rng(0)
+    nb = 7
+    count = nb * 256
+    q4 = rng.integers(0, 256, nb * 144, dtype=np.uint8).tobytes()
+    q5 = rng.integers(0, 256, nb * 176, dtype=np.uint8).tobytes()
+    q6 = rng.integers(0, 256, nb * 210, dtype=np.uint8).tobytes()
+    # random f16 bit patterns can be inf/nan: rewrite d/dmin with sane values
+    def fix_q4(raw, bpb):
+        a = bytearray(raw)
+        for i in range(nb):
+            a[i * bpb:i * bpb + 4] = np.array(
+                [0.01 * (i + 1), 0.002 * (i + 1)], "<f2").tobytes()
+        return bytes(a)
+    q4 = fix_q4(q4, 144)
+    q5 = fix_q4(q5, 176)
+    a6 = bytearray(q6)
+    for i in range(nb):
+        a6[i * 210 + 208:i * 210 + 210] = np.array(
+            [0.01 * (i + 1)], "<f2").tobytes()
+    q6 = bytes(a6)
+
+    np.testing.assert_allclose(
+        G._dequant_q4_k(q4, count), _scalar_q4_k(q4, count), rtol=1e-5)
+    np.testing.assert_allclose(
+        G._dequant_q5_k(q5, count), _scalar_q5_k(q5, count), rtol=1e-5)
+    np.testing.assert_allclose(
+        G._dequant_q6_k(q6, count), _scalar_q6_k(q6, count), rtol=1e-5)
+
+
+def test_kquant_loads_from_file(tmp_path):
+    """A GGUF whose directory marks Q6_K data loads via load_tensor."""
+    import dynamo_tpu.llm.gguf as G
+
+    cfg = llama.preset("tiny-byte", tie_embeddings=False)
+    tiny_gguf(tmp_path / "m.gguf", cfg)
+    g = read_gguf(str(tmp_path / "m.gguf"))
+    info = g.tensors["blk.0.ffn_up.weight"]
+    count = int(np.prod(info.shape))
+    assert count % 256 == 0, "test tensor must be K-quant alignable"
+    rng = np.random.default_rng(1)
+    raw = bytearray(rng.integers(0, 256, count // 256 * 210,
+                                 dtype=np.uint8).tobytes())
+    for i in range(count // 256):
+        raw[i * 210 + 208:i * 210 + 210] = np.array([0.05], "<f2").tobytes()
+    data = open(tmp_path / "m.gguf", "rb").read()
+    patched = (data[:g.data_start + info.offset] + bytes(raw)
+               + data[g.data_start + info.offset + len(raw):])
+    (tmp_path / "k.gguf").write_bytes(patched)
+    g2 = read_gguf(str(tmp_path / "k.gguf"))
+    g2.tensors["blk.0.ffn_up.weight"].ggml_type = 14  # Q6_K
+    got = g2.load_tensor("blk.0.ffn_up.weight")
+    want = _scalar_q6_k(bytes(raw), count).reshape(info.shape)
+    np.testing.assert_allclose(got, want, rtol=1e-5)

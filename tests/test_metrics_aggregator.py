@@ -108,3 +108,48 @@ async def test_mock_worker_cli_loop():
         await agg_rt.close()
     finally:
         await srv.stop()
+
+
+async def test_metrics_binary_pushgateway_mode():
+    """--push-url makes the binary PUSH the exposition text instead of
+    serving /metrics (ref components/metrics serve-or-push switch)."""
+    import argparse
+
+    from aiohttp import web
+
+    from dynamo_tpu.cli.metrics import run_metrics
+
+    pushes = []
+    got_push = asyncio.Event()
+
+    async def sink(request: web.Request) -> web.Response:
+        pushes.append(await request.text())
+        got_push.set()
+        return web.Response(text="ok")
+
+    app = web.Application()
+    app.router.add_put("/metrics/job/dynamo", sink)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    sink_port = site._server.sockets[0].getsockname()[1]
+
+    srv, port = await start_store()
+    task = None
+    try:
+        args = argparse.Namespace(
+            store=f"127.0.0.1:{port}", namespace="ns", component=["c"],
+            port=0, scrape_interval=0.1, push_interval=0.1,
+            push_url=f"http://127.0.0.1:{sink_port}/metrics/job/dynamo")
+        ready = asyncio.Event()
+        task = asyncio.create_task(run_metrics(args, ready_event=ready))
+        await asyncio.wait_for(ready.wait(), 10)
+        await asyncio.wait_for(got_push.wait(), 10)
+        assert "llm_kv_hit_rate_percent" in pushes[0]
+    finally:
+        if task is not None:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+        await runner.cleanup()
+        await srv.stop()

@@ -1,0 +1,118 @@
+"""Native SentencePiece (SPM unigram) tokenizer from GGUF metadata.
+
+Reference capability: lib/llm/src/tokenizers/sp.rs +
+lib/llm/src/gguf/gguf_tokenizer.rs — stock Mistral/Llama GGUF artifacts
+carry only an embedded SPM vocab; serving must tokenize from it.
+"""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.llm.sp_tokenizer import SpTokenizer, _TYPE_BYTE, \
+    _TYPE_CONTROL, _TYPE_NORMAL, _TYPE_UNKNOWN
+
+
+def make_vocab():
+    pieces = ["<unk>", "<s>", "</s>"]
+    types = [_TYPE_UNKNOWN, _TYPE_CONTROL, _TYPE_CONTROL]
+    scores = [0.0, 0.0, 0.0]
+    for b in range(256):
+        pieces.append(f"<0x{b:02X}>")
+        types.append(_TYPE_BYTE)
+        scores.append(-10.0)
+    words = {"▁Hello": -1.0, "▁world": -1.2, "▁the": -0.5, "▁t": -4.0,
+             "he": -3.0, "▁He": -3.5, "llo": -3.2, "▁wor": -4.0, "ld": -3.8,
+             "l": -6.0, "o": -6.0, "H": -7.0, "e": -6.5, "w": -7.0,
+             "r": -6.8, "d": -6.6, "t": -6.2, "▁": -5.0, "!": -6.0}
+    for p, s in words.items():
+        pieces.append(p)
+        types.append(_TYPE_NORMAL)
+        scores.append(s)
+    return pieces, scores, types
+
+
+def make_tok(**kw):
+    pieces, scores, types = make_vocab()
+    return SpTokenizer(pieces, scores, types, bos_id=1, eos_id=2,
+                       unk_id=0, **kw)
+
+
+def test_viterbi_picks_best_segmentation():
+    tok = make_tok(add_bos=False)
+    ids = tok.encode("Hello world")
+    # whole-word pieces outscore any character split
+    assert [tok.pieces[i] for i in ids] == ["▁Hello", "▁world"]
+    assert tok.decode(ids) == " Hello world"
+
+
+def test_bos_and_roundtrip():
+    tok = make_tok()
+    ids = tok.encode("the world")
+    assert ids[0] == tok.bos_token_id == 1
+    assert tok.decode(ids) == " the world"   # control bos renders empty
+
+
+def test_byte_fallback_for_oov():
+    tok = make_tok(add_bos=False)
+    ids = tok.encode("Hello é!")          # é is not in the vocab
+    text = tok.decode(ids)
+    assert text == " Hello é!"
+    # the é must have gone through <0x..> byte pieces (2 UTF-8 bytes)
+    byte_ids = [i for i in ids if tok.types[i] == _TYPE_BYTE]
+    assert len(byte_ids) == 2
+
+
+def test_matches_hf_unigram_model():
+    """Independent cross-check: the HF tokenizers Unigram model with the
+    same (piece, score) table segments identically."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer, models
+
+    pieces, scores, types = make_vocab()
+    vocab = list(zip(pieces, [float(s) for s in scores]))
+    hf = Tokenizer(models.Unigram(vocab, unk_id=0, byte_fallback=True))
+
+    ours = make_tok(add_bos=False)
+    for text in ["Hello world", "the world!", "Hello the world",
+                 "world world world", "t"]:
+        norm = "▁" + text.replace(" ", "▁")
+        got = ours.encode(text)
+        want = hf.encode(norm).ids
+        assert got == want, (text, [pieces[i] for i in got],
+                             [pieces[i] for i in want])
+
+
+def test_gguf_card_uses_sp_tokenizer(tmp_path):
+    """A GGUF with an embedded SPM vocab (no adjacent tokenizer.json) gets
+    the native SP tokenizer through the model card + load_tokenizer path."""
+    from dynamo_tpu.llm.gguf import write_gguf
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.tokenizer import DecodeStream, load_tokenizer
+
+    pieces, scores, types = make_vocab()
+    meta = {
+        "general.architecture": "llama",
+        "llama.embedding_length": 64,
+        "llama.block_count": 2,
+        "llama.attention.head_count": 4,
+        "llama.context_length": 512,
+        "tokenizer.ggml.model": "llama",
+        "tokenizer.ggml.tokens": pieces,
+        "tokenizer.ggml.scores": [float(s) for s in scores],
+        "tokenizer.ggml.token_type": list(types),
+        "tokenizer.ggml.bos_token_id": 1,
+        "tokenizer.ggml.eos_token_id": 2,
+    }
+    write_gguf(str(tmp_path / "m.gguf"), meta,
+               {"dummy": np.zeros((4, 4), np.float32)})
+    card = ModelDeploymentCard.from_gguf(str(tmp_path / "m.gguf"))
+    assert card.tokenizer.startswith("gguf-sp:")
+    assert card.eos_token_ids == [2]
+    tok = load_tokenizer(card.tokenizer)
+    ids = tok.encode("Hello world")
+    assert tok.decode(ids) == " Hello world"
+
+    # streaming detokenization emits exactly the full decode
+    ds = DecodeStream(tok)
+    text = "".join(ds.step(t) for t in ids)
+    assert text == tok.decode(ids)
