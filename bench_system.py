@@ -1,0 +1,357 @@
+"""System-level A/B performance harness.
+
+Launches REAL serving topologies (store + frontend + router + workers as
+separate processes, via the SDK orchestrator) from the example graph shapes,
+replays a prompt set with controlled prefix overlap over plain HTTP, and
+reports per-topology TTFT p50/p99, throughput and KV hit rate — the same
+system-level deltas the reference headlines (disagg uplift, KV-routing TTFT;
+ref docs/architecture.md:57-96) and its batch load generator measures
+(ref launch/dynamo-run/src/input/batch.rs:65).
+
+    python bench_system.py                  # all A/Bs, tiny model, CPU-safe
+    python bench_system.py --pairs routing  # just the routed-vs-random A/B
+    python bench_system.py --json out.json
+
+Topologies:
+- agg_random   — frontend + 2 jax workers, frontend picks workers at random
+- agg_router   — identical, but routed through the KV-aware router
+- agg          — frontend + 1 jax worker (disagg baseline)
+- disagg_router— + prefill worker; long cold prompts take the queue path
+
+A/B pairs:
+- routing: agg_random vs agg_router on prefix-overlapped prompts. The router
+  sends same-prefix requests to the worker that already holds the prefix'
+  KV blocks -> prefix-cache hits -> lower TTFT.
+- disagg: agg vs disagg_router on long cold prompts fired while decode-heavy
+  background requests occupy the worker. The dedicated prefill worker keeps
+  TTFT flat where the aggregated worker serializes prefill behind decode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import socket
+import statistics
+import string
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+# ---------------------------------------------------------------------------
+# workload
+# ---------------------------------------------------------------------------
+
+def make_workload(groups: int, requests: int, prefix_len: int,
+                  suffix_len: int, seed: int = 0) -> List[str]:
+    """Prompts in ``groups`` families sharing a long common prefix (byte
+    tokenizer: 1 char = 1 token). Interleaved round-robin so consecutive
+    requests come from different families (the routing-unfriendly order)."""
+    rng = random.Random(seed)
+    alphabet = string.ascii_letters + string.digits + " "
+    prefixes = ["".join(rng.choice(alphabet) for _ in range(prefix_len))
+                for _ in range(groups)]
+    prompts = []
+    for i in range(requests):
+        p = prefixes[i % groups]
+        sfx = "".join(rng.choice(alphabet) for _ in range(suffix_len))
+        prompts.append(p + sfx)
+    return prompts
+
+
+# ---------------------------------------------------------------------------
+# topologies
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+ENGINE_ARGS = {"preset": "tiny-byte", "max_batch": 4, "max_context": 1024,
+               "prefill_chunk": 64, "decode_steps": 4, "page_size": 16,
+               # precompile every bucket program at startup: measured TTFTs
+               # are scheduling+caching, never mid-run XLA compiles
+               "warmup": True}
+
+
+def topology_config(name: str, http_port: int,
+                    engine_args: Optional[Dict[str, Any]] = None
+                    ) -> Tuple[str, Dict[str, Any]]:
+    """(graph entry, per-service config) for a named topology."""
+    ea = dict(ENGINE_ARGS)
+    ea.update(engine_args or {})
+    worker = {
+        "engine": "jax",
+        "register_model": True,
+        "model_name": "demo",
+        "extra_engine_args": json.dumps(ea),
+    }
+    frontend: Dict[str, Any] = {"port": http_port}
+    if name == "agg":
+        return "examples.llm_graphs:AggGraph", {
+            "Frontend": frontend, "Worker": worker}
+    if name in ("agg_random", "agg_router"):
+        if name == "agg_router":
+            frontend["router_component"] = "router"
+        return "examples.llm_graphs:AggRouterGraph", {
+            "Frontend": frontend,
+            "Router": {"worker_component": "backend",
+                       "block_size": ea["page_size"]},
+            "Worker": {**worker, "workers": 2},
+        }
+    if name == "disagg_router":
+        frontend["router_component"] = "router"
+        pea = dict(ea)
+        pea["max_batch"] = 2
+        return "examples.llm_graphs:DisaggRouterGraph", {
+            "Frontend": frontend,
+            "Router": {"worker_component": "backend",
+                       "block_size": ea["page_size"]},
+            "Worker": {**worker, "enable_disagg": True,
+                       "max_local_prefill_length": 64,
+                       "max_prefill_queue_size": 4},
+            "PrefillWorker": {"decode_component": "backend",
+                              "extra_engine_args": json.dumps(pea)},
+        }
+    raise ValueError(f"unknown topology {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# HTTP replay
+# ---------------------------------------------------------------------------
+
+async def _stream_one(session, base: str, prompt: str, max_tokens: int
+                      ) -> Tuple[float, float, int]:
+    """(ttft_s, total_s, completion_tokens) for one streamed completion."""
+    t0 = time.monotonic()
+    ttft = None
+    toks = 0
+    payload = {"model": "demo", "prompt": prompt, "max_tokens": max_tokens,
+               "stream": True}
+    async with session.post(f"{base}/v1/completions", json=payload) as resp:
+        resp.raise_for_status()
+        async for raw in resp.content:
+            line = raw.decode().strip()
+            if not line.startswith("data:"):
+                continue
+            data = line[5:].strip()
+            if data == "[DONE]":
+                break
+            ch = json.loads(data)
+            if "error" in ch:
+                raise RuntimeError(ch["error"].get("message", "stream error"))
+            if ch.get("choices") and (
+                    ch["choices"][0].get("text")
+                    or ch["choices"][0].get("finish_reason")):
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+                toks += 1 if ch["choices"][0].get("text") else 0
+    return (ttft if ttft is not None else time.monotonic() - t0,
+            time.monotonic() - t0, toks)
+
+
+def _pcts(xs: List[float]) -> Dict[str, float]:
+    if not xs:
+        return {"p50": None, "p99": None}
+    xs = sorted(xs)
+    return {"p50": round(statistics.median(xs), 4),
+            "p99": round(xs[int(0.99 * (len(xs) - 1))], 4)}
+
+
+async def replay(base: str, prompts: List[str], max_tokens: int,
+                 concurrency: int) -> Dict[str, Any]:
+    import aiohttp
+
+    sem = asyncio.Semaphore(concurrency)
+    ttfts: List[float] = []
+    totals: List[float] = []
+    toks = 0
+    errors = 0
+
+    async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=600)) as session:
+
+        async def one(p):
+            nonlocal toks, errors
+            async with sem:
+                try:
+                    tt, tot, n = await _stream_one(session, base, p,
+                                                   max_tokens)
+                except Exception:
+                    errors += 1
+                    return
+                ttfts.append(tt)
+                totals.append(tot)
+                toks += n
+
+        t0 = time.monotonic()
+        await asyncio.gather(*(one(p) for p in prompts))
+        wall = time.monotonic() - t0
+    return {
+        "requests": len(prompts),
+        "errors": errors,
+        "wall_s": round(wall, 3),
+        "tok_per_s": round(toks / wall, 1) if wall else None,
+        "ttft": _pcts(ttfts),
+        "latency": _pcts(totals),
+    }
+
+
+async def scrape_hit_rate(store: str, namespace: str = "dynamo") -> Optional[float]:
+    """Mean prefix-cache hit rate over the topology's backend workers."""
+    from dynamo_tpu.llm.metrics_aggregator import ClusterMetricsAggregator
+    from dynamo_tpu.runtime.component import DistributedRuntime
+
+    host, port = store.split(":")
+    drt = await DistributedRuntime(store_host=host,
+                                   store_port=int(port)).connect()
+    try:
+        agg = ClusterMetricsAggregator(drt, namespace, ["backend"])
+        await agg.scrape_once()
+        rates = [m.gpu_prefix_cache_hit_rate
+                 for m in agg.workers.get("backend", {}).values()]
+        return round(sum(rates) / len(rates), 4) if rates else None
+    finally:
+        await drt.close()
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+def run_topology(name: str, scenario, timeout: float = 240.0,
+                 engine_args: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+    """Launch a topology, run ``scenario(base_url, store_addr)`` -> stats."""
+    from dynamo_tpu.sdk.serve import LocalServe
+
+    port = _free_port()
+    entry, config = topology_config(name, port, engine_args)
+    serve = LocalServe(entry, config=config, platform="cpu")
+    try:
+        serve.start(timeout=max(timeout, 400.0))   # warmup compiles
+        return asyncio.run(scenario(f"http://127.0.0.1:{port}",
+                                    serve.store))
+    finally:
+        serve.stop()
+
+
+def routing_ab(requests: int = 24, groups: int = 4, prefix_len: int = 256,
+               suffix_len: int = 16, max_tokens: int = 8,
+               concurrency: int = 4,
+               engine_args: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """agg_random vs agg_router on prefix-overlapped prompts.
+
+    The KV pool is sized so ONE worker cannot cache every prefix family:
+    KV-aware routing partitions families across workers and keeps hitting;
+    random routing sends every family everywhere and LRU-thrashes. The
+    measured pass is the SECOND full replay (fresh suffixes) — compiles and
+    cold caches land in the first."""
+    # pool sizing: a full batch of actives ALWAYS fits (capacity errors are
+    # not the phenomenon under test) + cached prefixes for half the families
+    # — so a router that partitions families keeps hitting while random
+    # placement LRU-thrashes
+    pages_per_family = prefix_len // ENGINE_ARGS["page_size"]
+    active_pages = pages_per_family + 4      # suffix + generation + spec pad
+    num_pages = (ENGINE_ARGS["max_batch"] * active_pages
+                 + (groups // 2) * pages_per_family + 8)
+    ea = {"num_pages": num_pages, **(engine_args or {})}
+
+    async def scenario(base, store):
+        warm = make_workload(groups, requests, prefix_len, suffix_len, seed=1)
+        await replay(base, warm, max_tokens, concurrency)
+        prompts = make_workload(groups, requests, prefix_len, suffix_len,
+                                seed=2)
+        stats = await replay(base, prompts, max_tokens, concurrency)
+        stats["kv_hit_rate"] = await scrape_hit_rate(store)
+        return stats
+
+    return {
+        "workload": {"requests": requests, "groups": groups,
+                     "prefix_tokens": prefix_len, "suffix_tokens": suffix_len,
+                     "num_pages": num_pages},
+        "agg_random": run_topology("agg_random", scenario, engine_args=ea),
+        "agg_router": run_topology("agg_router", scenario, engine_args=ea),
+    }
+
+
+def disagg_ab(long_prompts: int = 6, prefix_len: int = 512,
+              max_tokens: int = 4, decode_load: int = 3,
+              decode_tokens: int = 256) -> Dict[str, Any]:
+    """agg vs disagg_router: TTFT of long cold prompts under decode load."""
+
+    async def scenario(base, _store):
+        import aiohttp
+
+        # warm the compile caches (prefill buckets for long prompts +
+        # decode) so the measured TTFTs are scheduling, not XLA compiles
+        warmup = make_workload(2, 2, prefix_len, 8, seed=3)
+        await replay(base, warmup, 8, concurrency=2)
+
+        # saturate decode: background requests generating many tokens
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=600)) as session:
+            bg = [asyncio.create_task(_stream_one(
+                session, base, f"background request number {i}",
+                decode_tokens)) for i in range(decode_load)]
+            await asyncio.sleep(2.0)   # let decode reach steady state
+            prompts = make_workload(long_prompts, long_prompts,
+                                    prefix_len, 8, seed=7)
+            try:
+                stats = await replay(base, prompts, max_tokens,
+                                     concurrency=2)
+            finally:
+                for t in bg:
+                    t.cancel()
+                await asyncio.gather(*bg, return_exceptions=True)
+        return stats
+
+    ea = {"max_batch": 8}
+    return {
+        "workload": {"long_prompts": long_prompts,
+                     "prefix_tokens": prefix_len,
+                     "decode_load": decode_load},
+        "agg": run_topology("agg", scenario, engine_args=ea),
+        "disagg_router": run_topology("disagg_router", scenario,
+                                      engine_args=ea),
+    }
+
+
+# ---------------------------------------------------------------------------
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pairs", default="routing,disagg",
+                    help="comma list: routing, disagg")
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--json", dest="json_out", default=None)
+    args = ap.parse_args()
+
+    out: Dict[str, Any] = {}
+    pairs = [p.strip() for p in args.pairs.split(",") if p.strip()]
+    if "routing" in pairs:
+        out["routing"] = routing_ab(requests=args.requests)
+        a = out["routing"]["agg_random"]
+        b = out["routing"]["agg_router"]
+        out["routing"]["ttft_p50_speedup"] = round(
+            a["ttft"]["p50"] / b["ttft"]["p50"], 2) if b["ttft"]["p50"] else None
+    if "disagg" in pairs:
+        out["disagg"] = disagg_ab()
+        a = out["disagg"]["agg"]
+        b = out["disagg"]["disagg_router"]
+        out["disagg"]["ttft_p50_speedup"] = round(
+            a["ttft"]["p50"] / b["ttft"]["p50"], 2) if b["ttft"]["p50"] else None
+    print(json.dumps(out, indent=2))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(out, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

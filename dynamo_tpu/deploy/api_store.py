@@ -35,7 +35,12 @@ class ApiStore:
     def __init__(self, root: str, store_host: str = "127.0.0.1",
                  store_port: int = 4222, http_port: int = 0,
                  advertise_host: str = "127.0.0.1"):
-        self.root = root
+        """``root``: a storage URL — a local directory / ``file://`` path
+        (PVC analogue) or ``s3://bucket?endpoint=...`` (S3-compatible
+        object storage, ref dynamo.py:550-565)."""
+        from .object_store import open_object_store
+
+        self.objects = open_object_store(root)
         self.store_host = store_host
         self.store_port = store_port
         self.http_port = http_port
@@ -48,7 +53,6 @@ class ApiStore:
         # concurrent uploads of the same artifact must not alias one version
         # (one lock for all uploads: bounded, and uploads are rare)
         self._upload_lock = None
-        os.makedirs(root, exist_ok=True)
 
     # ------------------------------------------------------------------
     def _build_app(self) -> web.Application:
@@ -79,16 +83,23 @@ class ApiStore:
             await self._runner.cleanup()
         if self.client is not None:
             await self.client.close()
+        await self.objects.close()
 
     # ------------------------------------------------------------------
-    @staticmethod
-    def _safe(name: str) -> str:
-        if not name or "/" in name or name.startswith("."):
+    _NAME_RE = None
+
+    @classmethod
+    def _safe(cls, name: str) -> str:
+        # strict charset: artifact names become object-store keys and URL
+        # path segments on every backend — '?', '#', '%', spaces etc. would
+        # change meaning downstream
+        import re
+
+        if cls._NAME_RE is None:
+            cls._NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+        if not name or name.startswith(".") or not cls._NAME_RE.match(name):
             raise web.HTTPBadRequest(text="invalid name")
         return name
-
-    def _vdir(self, name: str) -> str:
-        return os.path.join(self.root, self._safe(name))
 
     async def _upload(self, req: web.Request) -> web.Response:
         import asyncio
@@ -103,28 +114,27 @@ class ApiStore:
 
     async def _upload_locked(self, name: str, data: bytes,
                              digest: str) -> web.Response:
-        vdir = self._vdir(name)
-        os.makedirs(vdir, exist_ok=True)
-        # versions are monotonic even across deletes (a counter file, not
+        # versions are monotonic even across deletes (a counter object, not
         # max(existing)+1): reusing a deleted version's number would alias
         # different content under one artifact://name/version
-        counter = os.path.join(vdir, ".next_version")
-        existing = [int(v) for v in os.listdir(vdir) if v.isdigit()]
+        counter_key = f"{name}/.next_version"
+        existing = [int(k.rsplit("/", 1)[1])
+                    for k in await self.objects.list(f"{name}/")
+                    if k.rsplit("/", 1)[1].isdigit()]
         floor = max(existing, default=0)
-        try:
-            with open(counter) as f:
-                floor = max(floor, int(f.read().strip()) - 1)
-        except (OSError, ValueError):
-            pass
+        raw = await self.objects.get(counter_key)
+        if raw is not None:
+            try:
+                floor = max(floor, int(raw.decode().strip()) - 1)
+            except ValueError:
+                pass
         version = floor + 1
-        with open(counter, "w") as f:
-            f.write(str(version + 1))
-        with open(os.path.join(vdir, str(version)), "wb") as f:
-            f.write(data)
+        await self.objects.put(counter_key, str(version + 1).encode())
+        await self.objects.put(f"{name}/{version}", data)
         meta = {"version": version, "sha256": digest, "size": len(data),
                 "uploaded": time.time()}
-        with open(os.path.join(vdir, f"{version}.json"), "w") as f:
-            json.dump(meta, f)
+        await self.objects.put(f"{name}/{version}.json",
+                               json.dumps(meta).encode())
         # register in the store so artifact:// graph refs resolve
         from .artifacts import register
 
@@ -134,42 +144,43 @@ class ApiStore:
         return web.json_response({"name": name, **meta}, status=201)
 
     async def _list_artifacts(self, _req: web.Request) -> web.Response:
-        out = {}
-        for name in sorted(os.listdir(self.root)):
-            vdir = os.path.join(self.root, name)
-            if not os.path.isdir(vdir):
-                continue
-            versions = []
-            for v in sorted(int(x) for x in os.listdir(vdir) if x.isdigit()):
-                try:
-                    with open(os.path.join(vdir, f"{v}.json")) as f:
-                        versions.append(json.load(f))
-                except OSError:
-                    versions.append({"version": v})
-            out[name] = versions
-        return web.json_response({"artifacts": out})
+        import asyncio
+
+        pairs = []
+        for key in await self.objects.list():
+            parts = key.split("/")
+            if len(parts) == 2 and parts[1].isdigit():
+                pairs.append((parts[0], int(parts[1])))
+        # metadata fetches go out concurrently: on the S3 backend each is a
+        # network round-trip and a serial loop would be N+1
+        raws = await asyncio.gather(
+            *(self.objects.get(f"{n}/{v}.json") for n, v in pairs))
+        out: dict = {}
+        for (name, v), raw in zip(pairs, raws):
+            out.setdefault(name, []).append(
+                json.loads(raw.decode()) if raw else {"version": v})
+        for versions in out.values():
+            versions.sort(key=lambda m: m["version"])
+        return web.json_response(
+            {"artifacts": {k: out[k] for k in sorted(out)}})
 
     async def _download(self, req: web.Request) -> web.Response:
-        path = os.path.join(self._vdir(req.match_info["name"]),
-                            self._safe(req.match_info["v"]))
-        if not os.path.isfile(path):
+        name = self._safe(req.match_info["name"])
+        v = self._safe(req.match_info["v"])
+        data = await self.objects.get(f"{name}/{v}")
+        if data is None:
             raise web.HTTPNotFound(text="no such artifact version")
-        with open(path, "rb") as f:
-            return web.Response(body=f.read(),
-                                content_type="application/octet-stream")
+        return web.Response(body=data,
+                            content_type="application/octet-stream")
 
     async def _del_art(self, req: web.Request) -> web.Response:
         name = self._safe(req.match_info["name"])
         v = self._safe(req.match_info["v"])
         if not v.isdigit():
             raise web.HTTPNotFound(text="no such artifact version")
-        path = os.path.join(self._vdir(name), v)
-        if not os.path.isfile(path):
+        if not await self.objects.delete(f"{name}/{v}"):
             raise web.HTTPNotFound(text="no such artifact version")
-        os.unlink(path)
-        meta = path + ".json"
-        if os.path.exists(meta):
-            os.unlink(meta)
+        await self.objects.delete(f"{name}/{v}.json")
         # unregister, or artifact://name (latest) would resolve to a
         # version whose content is gone
         from .artifacts import descriptor_key
@@ -221,7 +232,9 @@ def main(argv=None) -> None:
     import asyncio
 
     ap = argparse.ArgumentParser("dynamo-api-store")
-    ap.add_argument("--root", default="./artifacts")
+    ap.add_argument("--root", default="./artifacts",
+                    help="artifact storage: a directory / file:// path, or "
+                         "s3://bucket?endpoint=http://host:port")
     ap.add_argument("--store", default="127.0.0.1:4222")
     ap.add_argument("--port", type=int, default=8082)
     ap.add_argument("--advertise-host", default="127.0.0.1")

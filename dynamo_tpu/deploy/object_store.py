@@ -1,0 +1,241 @@
+"""Artifact object storage backends for the api-store.
+
+The reference api-store uploads artifact bundles to S3 or a PVC
+(ai_dynamo_store/api/dynamo.py:48,550-565); here the same seam is an async
+key/value object interface with two backends:
+
+- :class:`LocalFsStore` — keys are paths under a root directory (the PVC
+  analogue, and the default).
+- :class:`S3Store` — a minimal S3 REST subset (PUT/GET/DELETE object +
+  ListObjectsV2) against any S3-compatible endpoint; unsigned requests, so
+  it pairs with in-cluster minio-style gateways or :class:`MinioStub`.
+
+:class:`MinioStub` is an in-process aiohttp server speaking that same
+subset, used by tests (and usable as a dev fixture).
+
+Pick a backend with a storage URL: ``file:///var/artifacts`` or
+``s3://bucket?endpoint=http://minio:9000``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlsplit
+from xml.sax.saxutils import escape
+
+
+class ObjectStore:
+    async def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    async def get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    async def delete(self, key: str) -> bool:
+        raise NotImplementedError
+
+    async def list(self, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+def open_object_store(url: str) -> ObjectStore:
+    """``file:///path`` (or a bare path) | ``s3://bucket?endpoint=...``."""
+    if url.startswith("s3://"):
+        parts = urlsplit(url)
+        q = parse_qs(parts.query)
+        endpoint = (q.get("endpoint") or [None])[0]
+        if not endpoint:
+            raise ValueError("s3:// storage needs ?endpoint=http://host:port")
+        return S3Store(endpoint, parts.netloc)
+    if url.startswith("file://"):
+        url = urlsplit(url).path
+    return LocalFsStore(url)
+
+
+class LocalFsStore(ObjectStore):
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, key))
+        if not p.startswith(os.path.abspath(self.root) + os.sep) \
+                and p != os.path.abspath(self.root):
+            p2 = os.path.abspath(p)
+            if not p2.startswith(os.path.abspath(self.root)):
+                raise ValueError(f"key escapes root: {key!r}")
+        return p
+
+    async def put(self, key: str, data: bytes) -> None:
+        p = self._path(key)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "wb") as f:
+            f.write(data)
+
+    async def get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except (OSError, ValueError):
+            return None
+
+    async def delete(self, key: str) -> bool:
+        try:
+            os.unlink(self._path(key))
+            return True
+        except OSError:
+            return False
+
+    async def list(self, prefix: str = "") -> List[str]:
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            for f in files:
+                rel = os.path.relpath(os.path.join(dirpath, f), self.root)
+                rel = rel.replace(os.sep, "/")
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return sorted(out)
+
+
+class S3Store(ObjectStore):
+    """Minimal S3 REST client (path-style, unsigned)."""
+
+    def __init__(self, endpoint: str, bucket: str):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self._session = None
+
+    async def _sess(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    def _url(self, key: str) -> str:
+        from urllib.parse import quote
+
+        return f"{self.endpoint}/{self.bucket}/{quote(key)}"
+
+    async def put(self, key: str, data: bytes) -> None:
+        s = await self._sess()
+        async with s.put(self._url(key), data=data) as resp:
+            if resp.status >= 300:
+                raise IOError(f"s3 put {key}: {resp.status}")
+
+    async def get(self, key: str) -> Optional[bytes]:
+        s = await self._sess()
+        async with s.get(self._url(key)) as resp:
+            if resp.status == 404:
+                return None
+            if resp.status >= 300:
+                raise IOError(f"s3 get {key}: {resp.status}")
+            return await resp.read()
+
+    async def delete(self, key: str) -> bool:
+        # S3 DELETE is 204 whether or not the key existed; the ObjectStore
+        # contract (and the api-store's 404 path) needs the truth
+        if await self.get(key) is None:
+            return False
+        s = await self._sess()
+        async with s.delete(self._url(key)) as resp:
+            return resp.status < 300
+
+    async def list(self, prefix: str = "") -> List[str]:
+        from urllib.parse import quote
+
+        s = await self._sess()
+        keys: List[str] = []
+        token: Optional[str] = None
+        while True:     # ListObjectsV2 pages at 1000 keys
+            url = (f"{self.endpoint}/{self.bucket}"
+                   f"?list-type=2&prefix={quote(prefix)}")
+            if token:
+                url += f"&continuation-token={quote(token)}"
+            async with s.get(url) as resp:
+                if resp.status >= 300:
+                    raise IOError(f"s3 list {prefix}: {resp.status}")
+                text = await resp.text()
+            keys.extend(re.findall(r"<Key>([^<]*)</Key>", text))
+            m = re.search(r"<NextContinuationToken>([^<]*)"
+                          r"</NextContinuationToken>", text)
+            truncated = re.search(r"<IsTruncated>true</IsTruncated>", text)
+            if not (truncated and m):
+                return sorted(keys)
+            token = m.group(1)
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class MinioStub:
+    """In-process S3-compatible object server (the subset S3Store speaks):
+    PUT/GET/DELETE ``/{bucket}/{key}`` and ListObjectsV2."""
+
+    def __init__(self):
+        self.buckets: Dict[str, Dict[str, bytes]] = {}
+        self._runner = None
+        self.port = 0
+
+    async def start(self, port: int = 0) -> int:
+        from aiohttp import web
+
+        app = web.Application(client_max_size=1 << 30)
+        app.router.add_get("/{bucket}", self._list)
+        app.router.add_put("/{bucket}/{key:.+}", self._put)
+        app.router.add_get("/{bucket}/{key:.+}", self._get)
+        app.router.add_delete("/{bucket}/{key:.+}", self._delete)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # ------------------------------------------------------------------
+    async def _put(self, req):
+        from aiohttp import web
+
+        b = self.buckets.setdefault(req.match_info["bucket"], {})
+        b[req.match_info["key"]] = await req.read()
+        return web.Response(text="")
+
+    async def _get(self, req):
+        from aiohttp import web
+
+        b = self.buckets.get(req.match_info["bucket"], {})
+        data = b.get(req.match_info["key"])
+        if data is None:
+            raise web.HTTPNotFound()
+        return web.Response(body=data,
+                            content_type="application/octet-stream")
+
+    async def _delete(self, req):
+        from aiohttp import web
+
+        b = self.buckets.get(req.match_info["bucket"], {})
+        b.pop(req.match_info["key"], None)
+        return web.Response(status=204)
+
+    async def _list(self, req):
+        from aiohttp import web
+
+        prefix = req.query.get("prefix", "")
+        b = self.buckets.get(req.match_info["bucket"], {})
+        keys = sorted(k for k in b if k.startswith(prefix))
+        body = ("<?xml version=\"1.0\"?><ListBucketResult>"
+                + "".join(f"<Contents><Key>{escape(k)}</Key></Contents>"
+                          for k in keys)
+                + "</ListBucketResult>")
+        return web.Response(text=body, content_type="application/xml")

@@ -89,6 +89,10 @@ class JaxEngineConfig:
     # Explicit values: "pallas" | "xla" | "ring" (sequence-parallel prefill
     # over the sp mesh axis; decode stays pallas/xla).
     attn_impl: str = "auto"
+    # precompile every (lanes, chunk, context) prefill bucket and every
+    # decode context bucket at init — tail latency becomes predictable
+    # (the reference engines' startup warmup / CUDA-graph capture role)
+    warmup: bool = False
     # KV block manager (SURVEY §2.4): prefix reuse + tiered offload
     enable_prefix_reuse: bool = True
     host_cache_blocks: int = 0          # host-DRAM KV tier capacity (0 = off)
@@ -110,13 +114,18 @@ class JaxEngineConfig:
             page_size=card.kv_block_size,
             params_path=card.path,
         )
-        for k in ("sp", "ep", "pp", "max_batch", "max_context", "prefill_chunk",
-                  "num_pages", "decode_steps", "prefill_lanes", "seed",
-                  "preset", "attn_impl",
-                  "enable_prefix_reuse", "host_cache_blocks",
-                  "disk_cache_blocks", "disk_cache_path"):
-            if k in extra:
-                kw[k] = extra[k]
+        # every config field is overridable from extra args; unknown keys
+        # raise instead of being silently dropped (a typo'd or unplumbed
+        # key — e.g. page_size once — must not ship a different engine
+        # than the config asked for)
+        managed = {"model", "params_path"}
+        for k, v in extra.items():
+            if k == "preset":
+                continue
+            if k in cls.__dataclass_fields__ and k not in managed:
+                kw[k] = v
+            else:
+                raise ValueError(f"unknown engine arg {k!r}")
         cfg = cls(**kw)
         cfg.max_context = min(cfg.max_context, card.context_length)
         return cfg
@@ -326,6 +335,63 @@ class EngineCore:
         # multi-host lockstep: called with (kind, meta, arrays) right before
         # every device dispatch so follower processes can replay it
         self.dispatch_hook: Optional[Any] = None
+
+        if cfg.warmup:
+            self.warmup()
+
+    # ------------------------------------------------------------------
+    def warmup(self) -> None:
+        """Compile every bucket program up front on dummy inputs.
+
+        Without this, the first request that lands in a fresh (lanes,
+        chunk, context) bucket pays a full XLA compile mid-serving — a
+        multi-second TTFT outlier on CPU, tens of seconds on TPU. All
+        dummy writes go to scratch page 0 (what padded lanes use), so
+        engine state is untouched. Runs identically on multi-host leader
+        and followers (same ctor, same dummy data — lockstep holds).
+        """
+        cfg = self.cfg
+        t0 = time.monotonic()
+        n = 0
+        s = self.sampling
+        B = cfg.max_batch
+        # argument TYPES must match serving exactly (host numpy for tables/
+        # lengths/sampling vectors, device arrays for keys/chained tokens):
+        # jit cache keys include arg placement, so a device-array warmup
+        # would compile a different program than the serving dispatch uses
+        zb = np.zeros(B, np.int32)
+        zf = np.zeros(B, np.float32)
+        ones = np.ones(B, np.int32)
+        for S in self.s_buckets:
+            fn = self._decode_fn(S)
+            pt = np.zeros((B, S // self.page_size), np.int32)
+            # non-chained (host tokens) ...
+            _, final_tok, key2, self.k_pool, self.v_pool = fn(
+                self.params, zb, self.k_pool, self.v_pool, pt, ones,
+                s.temperature, s.top_p, s.top_k, s.key)
+            # ... and chained (previous dispatch's on-device tokens/key)
+            _, _, _, self.k_pool, self.v_pool = fn(
+                self.params, final_tok, self.k_pool, self.v_pool, pt, ones,
+                s.temperature, s.top_p, s.top_k, key2)
+            n += 2
+        for Bp in self.b_buckets:
+            for C in self.c_buckets:
+                for S in self.s_buckets:
+                    fn = self._prefill_fn(Bp, C, S)
+                    zt = np.zeros((Bp, C), np.int32)
+                    keys = s.key[jnp.asarray(np.zeros(Bp, np.int32))]
+                    _, _, _, self.k_pool, self.v_pool = fn(
+                        self.params, zt, zt, self.k_pool, self.v_pool,
+                        zt, np.zeros((Bp, S), np.int32),
+                        np.zeros((Bp, S), np.int32),
+                        np.zeros((Bp, S), bool),
+                        np.zeros(Bp, np.int32), np.zeros(Bp, np.float32),
+                        np.ones(Bp, np.float32), np.zeros(Bp, np.int32),
+                        keys)
+                    n += 1
+        jax.block_until_ready(self.k_pool)
+        log.info("warmup compiled %d bucket programs in %.1fs",
+                 n, time.monotonic() - t0)
 
     # ------------------------------------------------------------------
     # compiled program builders

@@ -94,11 +94,20 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 class DispatchPublisher:
     """Leader side: accepts follower connections, then broadcasts every
-    engine dispatch in order. ``hook`` plugs into EngineCore.dispatch_hook
-    (called from the engine thread; sends are blocking — lockstep SPMD means
-    a stalled follower must stall the leader rather than diverge)."""
+    engine dispatch in order.
 
-    def __init__(self, port: int, expected_followers: int):
+    ``hook`` plugs into EngineCore.dispatch_hook (called from the engine
+    thread). Broadcast is PIPELINED: the hook packs the frame and enqueues
+    it on a bounded queue; a sender thread drains the queue, coalescing
+    every queued dispatch into ONE socket write per follower — the engine
+    never blocks on follower sockets at steady state, while the bounded
+    depth keeps lockstep backpressure (a stalled follower stalls the
+    leader within ``queue_depth`` dispatches rather than diverging)."""
+
+    def __init__(self, port: int, expected_followers: int,
+                 queue_depth: int = 8):
+        import queue as _queue
+
         self.expected = expected_followers
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -107,23 +116,51 @@ class DispatchPublisher:
         self.port = self._srv.getsockname()[1]
         self._socks: List[socket.socket] = []
         self._lock = threading.Lock()
+        self._q: "_queue.Queue[bytes]" = _queue.Queue(maxsize=queue_depth)
+        self._sender = threading.Thread(target=self._drain, daemon=True,
+                                        name="dispatch-publisher")
+        self._sender.start()
+        # Follower death must be detected even when the engine is WEDGED
+        # inside a collective waiting for the dead peer (no further sends
+        # ever happen). Followers never write on the dispatch channel, so
+        # a readable socket means EOF/RST: poll for it.
+        self._monitor = threading.Thread(target=self._watch_followers,
+                                         daemon=True,
+                                         name="dispatch-monitor")
+        self._monitor.start()
 
     def wait_for_followers(self, timeout: float = 300.0) -> None:
         self._srv.settimeout(timeout)
         while len(self._socks) < self.expected:
             sock, addr = self._srv.accept()
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._socks.append(sock)
+            with self._lock:
+                self._socks.append(sock)
             log.info("follower %s connected (%d/%d)", addr,
                      len(self._socks), self.expected)
 
     def hook(self, kind: str, meta: Dict[str, Any],
              arrs: Dict[str, np.ndarray]) -> None:
-        frame = [kind, meta, _pack_arrays(arrs)]
-        with self._lock:
-            for sock in self._socks:
+        # pack on the engine thread (deterministic dispatch order), send on
+        # the sender thread (overlaps the next device dispatch)
+        self._q.put(wire_pack([kind, meta, _pack_arrays(arrs)]))
+
+    def _drain(self) -> None:
+        import queue as _queue
+
+        while True:
+            buf = [self._q.get()]
+            while True:
                 try:
-                    _send_frame(sock, frame)
+                    buf.append(self._q.get_nowait())
+                except _queue.Empty:
+                    break
+            data = b"".join(buf)     # coalesced: one write per follower
+            with self._lock:
+                socks = list(self._socks)
+            for sock in socks:
+                try:
+                    sock.sendall(data)
                 except OSError:
                     # SPMD divergence is unrecoverable: a follower that
                     # missed a dispatch can never rejoin the lockstep, and
@@ -137,7 +174,36 @@ class DispatchPublisher:
 
                     _os._exit(13)
 
+    def _watch_followers(self) -> None:
+        import select
+        import time as _time
+
+        while True:
+            with self._lock:
+                socks = list(self._socks)
+            if not socks:
+                _time.sleep(0.2)
+                continue
+            try:
+                readable, _, errored = select.select(socks, [], socks, 0.5)
+            except (OSError, ValueError):
+                _time.sleep(0.2)   # close() raced us; clean shutdown path
+                continue
+            if self._closing:
+                return
+            if readable or errored:
+                # EOF/reset — or a protocol violation (followers are
+                # silent): the slice can no longer stay in lockstep
+                log.critical("dispatch channel lost (follower died); "
+                             "terminating the multi-host worker")
+                import os as _os
+
+                _os._exit(13)
+
+    _closing = False
+
     def close(self) -> None:
+        self._closing = True
         for s in self._socks:
             s.close()
         self._srv.close()

@@ -175,12 +175,25 @@ class DistributedRuntime:
             return
         import os
 
-        if os.environ.get("DYNAMO_TPU_DATAPLANE") == "native":
-            from .native_dataplane import NativeDataPlane
+        # native C++ epoll plane is the deployed default; "python" forces
+        # the asyncio fixture, "native" forces native (failure = error),
+        # unset = auto (native when the library builds/ships, else python)
+        mode = os.environ.get("DYNAMO_TPU_DATAPLANE", "auto")
+        if mode not in ("auto", "python", "native"):
+            raise ValueError(f"DYNAMO_TPU_DATAPLANE={mode!r}")
+        if mode in ("auto", "native"):
+            try:
+                from .native_dataplane import NativeDataPlane
 
-            self._native_dp = NativeDataPlane(self)
-            self.dp_port = self._native_dp.start("0.0.0.0", 0)
-        else:
+                self._native_dp = NativeDataPlane(self)
+                self.dp_port = self._native_dp.start("0.0.0.0", 0)
+            except Exception:
+                self._native_dp = None   # half-started plane must not
+                if mode == "native":     # block the asyncio fallback
+                    raise
+                log.info("native data plane unavailable; using asyncio",
+                         exc_info=True)
+        if self._native_dp is None:
             self._dp_server = await asyncio.start_server(
                 self._serve_conn, "0.0.0.0", 0)
             self.dp_port = self._dp_server.sockets[0].getsockname()[1]

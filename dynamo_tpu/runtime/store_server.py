@@ -465,5 +465,25 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(prog="dynstore")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=4222)
+    env_impl = _os.environ.get("DYNAMO_TPU_STORE", "auto")
+    if env_impl not in ("auto", "python", "native"):
+        # argparse validates choices only for CLI-supplied values, not
+        # defaults — a typo'd env var must not silently run the wrong store
+        ap.error(f"DYNAMO_TPU_STORE={env_impl!r} "
+                 f"(expected auto|python|native)")
+    ap.add_argument("--impl", choices=("auto", "python", "native"),
+                    default=env_impl,
+                    help="auto = C++ dynstore when it builds/ships, "
+                         "falling back to the asyncio fixture")
     a = ap.parse_args()
+    if a.impl == "native":
+        StoreServer = NativeStoreServer  # type: ignore[misc]
+    elif a.impl == "auto":
+        try:
+            build_native("build/dynstore")
+            StoreServer = NativeStoreServer  # type: ignore[misc]
+        except RuntimeError:
+            log.info("native dynstore unavailable; using asyncio server")
+    elif a.impl == "python":
+        StoreServer = PyStoreServer  # type: ignore[misc]
     asyncio.run(main(host=a.host, port=a.port))

@@ -275,8 +275,22 @@ class Server {
     }
   }
 
+  // slow-consumer policy (NATS semantics the reference inherits,
+  // lib/runtime/src/transports/nats.rs): a peer whose unconsumed write
+  // backlog exceeds the cap is disconnected rather than growing server
+  // memory without bound. Subscribers re-subscribe on reconnect; queue
+  // messages are lease-tracked and redelivered to the next consumer.
+  static constexpr size_t kMaxWriteBacklog = 8 << 20;  // 8 MiB per conn
+
   void send(Conn* c, const Value& v) {
     if (c->closing) return;
+    if (c->wbuf.size() - c->wstart > kMaxWriteBacklog) {
+      fprintf(stderr,
+              "dynstore: disconnecting slow consumer fd=%d (backlog %zu)\n",
+              c->fd, c->wbuf.size() - c->wstart);
+      drop_conn(c);
+      return;
+    }
     c->wbuf += dynwire::frame(v);
     flush(c);
   }

@@ -105,6 +105,17 @@ def test_pp_yaml_config_reaches_engine():
     assert cfg.pp == 2 and cfg.tp == 1
 
 
+def test_warmup_engine_matches_cold():
+    """warmup=True precompiles every bucket program without disturbing
+    engine state: greedy outputs match a cold engine token-for-token."""
+    cold = run_tokens(make_cfg(max_batch=2, max_context=128,
+                               prefill_chunk=32, decode_steps=2), 1)
+    warm = run_tokens(make_cfg(max_batch=2, max_context=128,
+                               prefill_chunk=32, decode_steps=2,
+                               warmup=True), 1)
+    assert warm == cold
+
+
 def test_pp_rejects_bad_combos():
     with pytest.raises(ValueError, match="not divisible by pp"):
         EngineCore(make_cfg(model=llama.preset("tiny-byte", num_layers=3),

@@ -1,0 +1,103 @@
+"""k8s-real operator pass (VERDICT round-2 missing #2): the reconcile loop
+runs against a (fake, envtest-style) k8s API — apply/diff idempotence,
+owner-ref garbage collection, scale up/down, pod-crash restart, conditions.
+
+Reference capability: deploy/dynamo/operator/internal/controller/
+dynamodeployment_controller.go:68.
+"""
+
+import copy
+
+from dynamo_tpu.deploy.crd import Deployment, DeploymentSpec, ServiceSpec
+from dynamo_tpu.deploy.kube import CR_KIND, FakeKubeApi, KubeReconciler
+
+SERVICES = {
+    "Frontend": ("examples.llm_graphs:Frontend", 1, 0),
+    "Worker": ("examples.llm_graphs:Worker", 2, 0),
+}
+
+
+def make_dep(**services):
+    spec = DeploymentSpec(graph="examples.llm_graphs:AggGraph",
+                          services={k: ServiceSpec(**v)
+                                    for k, v in services.items()})
+    return Deployment(name="demo", namespace="prod", spec=spec)
+
+
+def test_reconcile_is_idempotent():
+    api = FakeKubeApi()
+    rec = KubeReconciler(api, SERVICES)
+    dep = make_dep(Worker={"replicas": 2})
+    status = rec.reconcile(dep)
+    assert status["conditions"][0]["type"] == "Available"
+    n = api.apply_count
+    # a second pass with unchanged desired state applies NOTHING
+    rec.reconcile(dep)
+    assert api.apply_count == n, "reconcile applied without drift"
+    # child objects exist with owner refs to the CR
+    cr = api.get(CR_KIND, "prod", "demo")
+    worker = api.get("Deployment", "prod", "demo-worker")
+    assert worker is not None
+    assert worker["metadata"]["ownerReferences"][0]["uid"] == \
+        cr["metadata"]["uid"]
+
+
+def test_scale_up_and_down_via_api():
+    api = FakeKubeApi()
+    rec = KubeReconciler(api, SERVICES)
+    rec.reconcile(make_dep(Worker={"replicas": 2}))
+    labels = api.get("Deployment", "prod",
+                     "demo-worker")["spec"]["selector"]["matchLabels"]
+    assert len(api.list("Pod", "prod", labels)) == 2
+
+    rec.reconcile(make_dep(Worker={"replicas": 4}))
+    assert len(api.list("Pod", "prod", labels)) == 4
+
+    status = rec.reconcile(make_dep(Worker={"replicas": 1}))
+    assert len(api.list("Pod", "prod", labels)) == 1
+    assert status["services"]["Worker"] == {"want": 1, "ready": 1}
+
+
+def test_pod_crash_restarts_through_api():
+    api = FakeKubeApi()
+    rec = KubeReconciler(api, SERVICES)
+    rec.reconcile(make_dep(Worker={"replicas": 2}))
+    labels = api.get("Deployment", "prod",
+                     "demo-worker")["spec"]["selector"]["matchLabels"]
+    victim = api.list("Pod", "prod", labels)[0]["metadata"]["name"]
+    api.fail_pod("prod", victim)
+    status = rec.reconcile(make_dep(Worker={"replicas": 2}))
+    pods = api.list("Pod", "prod", labels)
+    assert len(pods) == 2
+    assert all(p["status"]["phase"] == "Running" for p in pods)
+    assert victim not in [p["metadata"]["name"] for p in pods]
+    assert status["services"]["Worker"]["ready"] == 2
+
+
+def test_removed_service_is_garbage_collected():
+    api = FakeKubeApi()
+    rec = KubeReconciler(api, SERVICES)
+    rec.reconcile(make_dep(Worker={"replicas": 2}))
+    assert api.get("Deployment", "prod", "demo-worker") is not None
+
+    slim = {"Frontend": SERVICES["Frontend"]}
+    rec2 = KubeReconciler(api, slim)
+    rec2.reconcile(make_dep())
+    assert api.get("Deployment", "prod", "demo-worker") is None
+    assert api.get("Service", "prod", "demo-worker") is None
+    assert api.get("Deployment", "prod", "demo-frontend") is not None
+
+
+def test_deleting_cr_cascades_all_children():
+    api = FakeKubeApi()
+    rec = KubeReconciler(api, SERVICES)
+    rec.reconcile(make_dep(Worker={"replicas": 2}))
+    assert api.list("Deployment", "prod")
+    api.delete(CR_KIND, "prod", "demo")
+    # owner-ref cascade removed everything the CR owned (pods transitively
+    # via their Deployments); only dynstore infra (unowned) remains
+    remaining = [o["metadata"]["name"] for o in api.objects.values()]
+    assert all(n == "dynstore" or n.startswith("dynstore-pod")
+               for n in remaining), remaining
+    assert api.get("Deployment", "prod", "demo-worker") is None
+    assert api.get("Deployment", "prod", "dynstore") is not None

@@ -123,3 +123,141 @@ async def test_two_process_worker_pair_serves_one_endpoint(tmp_path):
         store.terminate()
         for lf in logs:
             lf.close()
+
+
+@pytest.mark.slow
+async def test_follower_death_kills_slice_and_client_fails_over(tmp_path):
+    """SURVEY §5.3 / multihost failure story: kill the follower mid-stream;
+    the leader must die hard (dispatch channel), its lease must expire, and
+    a client must carry on against a replacement worker."""
+    store_port = free_port()
+    coord_port = free_port()
+    dispatch_port = free_port()
+
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "DYN_LOG": "info"}
+    store = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.runtime.store_server",
+         "--port", str(store_port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", store_port), 0.5).close()
+            break
+        except OSError:
+            time.sleep(0.1)
+    procs = {}
+    logs = []
+    try:
+        common = ["--engine", "jax", "--store", f"127.0.0.1:{store_port}",
+                  "--advertise-host", "127.0.0.1",
+                  "--num-nodes", "2",
+                  "--coordinator", f"127.0.0.1:{coord_port}",
+                  "--dispatch-port", str(dispatch_port),
+                  "--tp", "2",
+                  "--extra-engine-args",
+                  json.dumps({"preset": "tiny-byte", "max_batch": 2,
+                              "max_context": 256, "prefill_chunk": 32,
+                              "decode_steps": 2})]
+        for rank in (0, 1):
+            lf = open(tmp_path / f"node{rank}.log", "w")
+            logs.append(lf)
+            procs[rank] = subprocess.Popen(
+                [sys.executable, "-m", "dynamo_tpu.cli.worker",
+                 *common, "--node-rank", str(rank)],
+                env=env, stdout=lf, stderr=subprocess.STDOUT)
+
+        from dynamo_tpu.llm.protocols.common import (BackendInput,
+                                                     StopConditions)
+        from dynamo_tpu.runtime.component import DistributedRuntime
+
+        caller = await DistributedRuntime(store_port=store_port).connect()
+        cl = await caller.namespace("dynamo").component("backend") \
+            .endpoint("generate").client().start()
+        deadline = time.monotonic() + 120
+        while not cl.instances and time.monotonic() < deadline:
+            assert all(p.poll() is None for p in procs.values()), \
+                "worker died during bring-up"
+            await asyncio.sleep(0.25)
+        assert len(cl.instances) == 1
+
+        # long-running stream, then kill the follower mid-generation
+        req = BackendInput(token_ids=[5, 6, 7, 8],
+                           stop=StopConditions(max_tokens=400,
+                                               ignore_eos=True)).to_dict()
+        got_any = asyncio.Event()
+        stream_dead = asyncio.Event()
+
+        async def consume():
+            try:
+                async for item in cl.generate(req):
+                    got_any.set()
+            except Exception:
+                pass
+            finally:
+                stream_dead.set()
+
+        task = asyncio.create_task(consume())
+        await asyncio.wait_for(got_any.wait(), 120)
+        procs[1].kill()                       # follower dies mid-stream
+
+        # leader detects the dead dispatch channel and exits hard
+        deadline = time.monotonic() + 60
+        while procs[0].poll() is None and time.monotonic() < deadline:
+            await asyncio.sleep(0.25)
+        assert procs[0].poll() is not None, "leader survived follower death"
+        await asyncio.wait_for(stream_dead.wait(), 30)
+
+        # lease expiry drops the instance from the watched live set
+        deadline = time.monotonic() + 30
+        while cl.instances and time.monotonic() < deadline:
+            await asyncio.sleep(0.25)
+        assert not cl.instances, "dead leader still in the live set"
+
+        # a replacement worker comes up; the client serves against it
+        # without being rebuilt (failover at the watched-live-set level)
+        lf = open(tmp_path / "replacement.log", "w")
+        logs.append(lf)
+        procs["r"] = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.cli.worker",
+             "--engine", "jax", "--store", f"127.0.0.1:{store_port}",
+             "--advertise-host", "127.0.0.1",
+             "--extra-engine-args",
+             json.dumps({"preset": "tiny-byte", "max_batch": 2,
+                         "max_context": 256, "prefill_chunk": 32,
+                         "decode_steps": 2})],
+            env=env, stdout=lf, stderr=subprocess.STDOUT)
+        deadline = time.monotonic() + 120
+        while not cl.instances and time.monotonic() < deadline:
+            assert procs["r"].poll() is None, "replacement died"
+            await asyncio.sleep(0.25)
+        assert len(cl.instances) == 1
+
+        req2 = BackendInput(token_ids=[9, 10, 11],
+                            stop=StopConditions(max_tokens=5,
+                                                ignore_eos=True)).to_dict()
+        outs = []
+
+        async def run2():
+            async for item in cl.generate(req2):
+                outs.append(item)
+
+        await asyncio.wait_for(run2(), 120)
+        toks = [t for o in outs for t in o.get("token_ids", [])]
+        assert len(toks) == 5
+
+        await caller.close()
+    finally:
+        for p in procs.values():
+            p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        store.terminate()
+        for lf in logs:
+            lf.close()

@@ -209,3 +209,56 @@ async def test_c_abi_publisher_feeds_python_indexer():
         if pub is not None:
             pub.shutdown()
         await srv.stop()
+
+
+# ----------------------------------------------------------------------
+# 3. slow-consumer policy: a stuck subscriber is disconnected, not OOM
+# ----------------------------------------------------------------------
+
+async def test_native_slow_subscriber_disconnected():
+    """A subscriber that never reads must be dropped once its write backlog
+    exceeds the server cap (NATS slow-consumer semantics); publishers and
+    healthy subscribers keep working throughout."""
+    from dynamo_tpu.runtime.store_client import StoreClient
+    from dynamo_tpu.runtime.wire import write_frame
+
+    _build()
+    srv, port = await _native_store()
+    try:
+        # stuck subscriber: subscribes, then never reads again
+        sr, sw = await asyncio.open_connection("127.0.0.1", port)
+        await write_frame(sw, {"op": "subscribe", "id": 1, "sub_id": 1,
+                               "subject": "bench.slow"})
+        await sr.readexactly(4)  # ack frame length only; then stop reading
+
+        # healthy subscriber on the same subject
+        healthy = await StoreClient(port=port).connect()
+        got = []
+        await healthy.subscribe("bench.slow", lambda s, p: got.append(len(p)))
+
+        pub = await StoreClient(port=port).connect()
+        payload = b"x" * (256 * 1024)
+        # 128 * 256 KiB = 32 MiB >> the 8 MiB per-conn backlog cap
+        for _ in range(128):
+            await pub.publish("bench.slow", payload)
+
+        # the stuck conn must be closed by the server: draining what the
+        # kernel already buffered ends in EOF instead of blocking forever
+        async def drain_to_eof():
+            while await sr.read(1 << 20):
+                pass
+
+        await asyncio.wait_for(drain_to_eof(), 30.0)
+
+        # the healthy subscriber saw everything and the plane still works
+        for _ in range(200):
+            if len(got) >= 128:
+                break
+            await asyncio.sleep(0.05)
+        assert len(got) == 128
+        assert await pub.publish("bench.slow", b"tail") >= 1
+        await healthy.close()
+        await pub.close()
+        sw.close()
+    finally:
+        await srv.stop()
