@@ -63,6 +63,16 @@ def main(argv=None) -> int:
     p.add_argument("bundle", help="tarball or single-module .py file")
     p.add_argument("--api", default="http://127.0.0.1:8082",
                    help="api-store base URL")
+    p = sub.add_parser("build")
+    p.add_argument("path", help="graph module .py or package directory")
+    p.add_argument("--tag", default="dynamo-tpu-graph:latest")
+    p.add_argument("--base", default="dynamo-tpu:latest")
+    p.add_argument("--out", default=None,
+                   help="write the OCI build context tar here "
+                        "(default <name>-context.tar)")
+    p.add_argument("--builder", default=None,
+                   help="image builder command to run on the context, "
+                        "e.g. 'docker build' or 'buildctl ...'")
     p = sub.add_parser("operator")
     p.add_argument("--resync", type=float, default=5.0)
     p.add_argument("--platform", default="cpu")
@@ -146,6 +156,20 @@ def main(argv=None) -> int:
         print(to_yaml(render_manifests(
             dep, services, image=args.image,
             include_store=not args.no_store)))
+        return 0
+
+    if args.cmd == "build":
+        from ..deploy.imagebuild import build_context, run_builder
+
+        ctx = build_context(args.path, base_image=args.base,
+                            out_path=args.out)
+        print(f"build context: {ctx} (Dockerfile + graph bundle)")
+        if args.builder:
+            rc = run_builder(args.builder, ctx, args.tag)
+            print(f"builder exited {rc}")
+            return rc
+        print(f"no --builder given; build with e.g.\n"
+              f"  docker build -t {args.tag} - < {ctx}")
         return 0
 
     if args.cmd == "operator":

@@ -173,7 +173,7 @@ class GGUFFile:
 
         md = self.metadata
         arch = self.architecture()
-        if arch not in ("llama", "mistral", "qwen2"):
+        if arch not in ("llama", "mistral", "qwen2", "gemma"):
             raise ValueError(f"not a llama-family GGUF: {arch!r}")
 
         def g(key, default=None):
@@ -188,6 +188,12 @@ class GGUFFile:
         return LlamaConfig(
             tie_embeddings="output.weight" not in self.tensors,
             attention_bias="blk.0.attn_q.bias" in self.tensors,
+            hidden_act="gelu_tanh" if arch == "gemma" else "silu",
+            # llama.cpp's gemma converter bakes the +1 into norm weights at
+            # export, so GGUF files store the EFFECTIVE scale — applying the
+            # offset again would compute 2+w
+            norm_offset=False,
+            embed_scale=arch == "gemma",
             vocab_size=vocab_size,
             hidden_size=emb,
             num_layers=int(g("block_count")),

@@ -38,6 +38,35 @@ from ..parallel.mesh import AXIS_TP
 # Config
 # ---------------------------------------------------------------------------
 
+def _is_gemma(cfg: Dict[str, Any]) -> bool:
+    archs = cfg.get("architectures", []) or []
+    # Gemma2/3 need softcapping / sliding-window / extra norms this model
+    # does not implement — refuse rather than serve wrong logits
+    unsupported = [a for a in archs
+                   if "Gemma" in a and a != "GemmaForCausalLM"]
+    if unsupported:
+        raise ValueError(f"unsupported architecture {unsupported[0]!r} "
+                         f"(Gemma v1 is supported; Gemma2/3 are not)")
+    return "GemmaForCausalLM" in archs
+
+
+def _map_act(cfg: Dict[str, Any]) -> str:
+    """HF activation name -> ours; exact vs tanh-approx GELU matters for
+    logits parity, so unknown names raise instead of guessing."""
+    if _is_gemma(cfg):
+        return "gelu_tanh"
+    act = str(cfg.get("hidden_activation")
+              or cfg.get("hidden_act") or "silu")
+    if act in ("silu", "swish"):
+        return "silu"
+    if act in ("gelu_pytorch_tanh", "gelu_tanh", "gelu_new",
+               "gelu_fast"):
+        return "gelu_tanh"
+    if act == "gelu":
+        return "gelu"
+    raise ValueError(f"unsupported hidden_act {act!r}")
+
+
 @dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 32000
@@ -54,6 +83,11 @@ class LlamaConfig:
     tie_embeddings: bool = False
     # q/k/v projection biases (Qwen2-style attention; Llama/Mistral: False)
     attention_bias: bool = False
+    # Gemma-style family knobs: tanh-GELU gating (GeGLU), zero-centered
+    # RMSNorm weights (output scales by 1+w), sqrt(D)-scaled embeddings
+    hidden_act: str = "silu"            # "silu" | "gelu_tanh"
+    norm_offset: bool = False
+    embed_scale: bool = False
     dtype: Any = jnp.bfloat16
     # MoE (0 experts = dense FFN). Experts shard over the ep mesh axis.
     num_experts: int = 0
@@ -81,6 +115,9 @@ class LlamaConfig:
             attention_bias=bool(cfg.get(
                 "attention_bias",
                 any("Qwen2" in a for a in cfg.get("architectures", []) or []))),
+            hidden_act=_map_act(cfg),
+            norm_offset=_is_gemma(cfg),
+            embed_scale=_is_gemma(cfg),
             dtype=dtype,
         )
 
@@ -127,6 +164,25 @@ PRESETS: Dict[str, Dict[str, Any]] = {
                        num_heads=32, num_kv_heads=8, head_dim=128,
                        intermediate_size=14336, rope_theta=10000.0,
                        max_position=32768, rms_eps=1e-5),
+    # tiny Gemma-style model (GeGLU, offset norms, scaled embed)
+    "tiny-gemma": dict(vocab_size=259, hidden_size=64, num_layers=2,
+                       num_heads=4, num_kv_heads=1, head_dim=16,
+                       intermediate_size=128, rope_theta=10000.0,
+                       max_position=1024, tie_embeddings=True,
+                       hidden_act="gelu_tanh", norm_offset=True,
+                       embed_scale=True, rms_eps=1e-6),
+    "gemma-2b": dict(vocab_size=256000, hidden_size=2048, num_layers=18,
+                     num_heads=8, num_kv_heads=1, head_dim=256,
+                     intermediate_size=16384, rope_theta=10000.0,
+                     max_position=8192, tie_embeddings=True,
+                     hidden_act="gelu_tanh", norm_offset=True,
+                     embed_scale=True, rms_eps=1e-6),
+    "gemma-7b": dict(vocab_size=256000, hidden_size=3072, num_layers=28,
+                     num_heads=16, num_kv_heads=16, head_dim=256,
+                     intermediate_size=24576, rope_theta=10000.0,
+                     max_position=8192, tie_embeddings=True,
+                     hidden_act="gelu_tanh", norm_offset=True,
+                     embed_scale=True, rms_eps=1e-6),
 }
 
 
@@ -290,10 +346,33 @@ def kv_cache_spec(cfg: LlamaConfig, tp: int, pp: int = 1) -> P:
 # Ops
 # ---------------------------------------------------------------------------
 
-def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def rms_norm(x: jax.Array, w: jax.Array, eps: float,
+             offset: bool = False) -> jax.Array:
+    """RMSNorm; ``offset=True`` = Gemma convention (weights stored
+    zero-centered, output scales by 1 + w)."""
     xf = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
-    return (xf * scale * w).astype(x.dtype)
+    wf = w.astype(jnp.float32)
+    if offset:
+        wf = 1.0 + wf
+    return (xf * scale * wf).astype(x.dtype)
+
+
+def _act(cfg: "LlamaConfig"):
+    if cfg.hidden_act == "gelu_tanh":
+        return partial(jax.nn.gelu, approximate=True)
+    if cfg.hidden_act == "gelu":
+        return partial(jax.nn.gelu, approximate=False)
+    return jax.nn.silu
+
+
+def _embed(params: Dict[str, Any], cfg: "LlamaConfig",
+           tokens: jax.Array) -> jax.Array:
+    x = params["embed"][tokens]
+    if cfg.embed_scale:
+        # Gemma scales inputs by sqrt(D), rounded through the embed dtype
+        x = x * jnp.asarray(math.sqrt(cfg.hidden_size), x.dtype)
+    return x
 
 
 def _rope_inv_freq(cfg: LlamaConfig) -> np.ndarray:
@@ -386,7 +465,7 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
     B, T = tokens.shape
     page = k_pool.shape[3]
     lp = params["layers"]
-    x = params["embed"][tokens]  # [B,T,D] bf16
+    x = _embed(params, cfg, tokens)  # [B,T,D] bf16
     cos, sin = rope_tables(cfg, positions)
     flat_w = write_idx.reshape(-1)
     wp, wo = flat_w // page, flat_w % page
@@ -423,7 +502,7 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
     # pipeline-parallel stages; test_forward_pp pins their exactness —
     # change them together.
     for l in range(cfg.num_layers):
-        h = rms_norm(x, lp["ln1"][l], cfg.rms_eps)
+        h = rms_norm(x, lp["ln1"][l], cfg.rms_eps, cfg.norm_offset)
         q = jnp.einsum("btd,dhk->bthk", h, lp["wq"][l])
         k = jnp.einsum("btd,dhk->bthk", h, lp["wk"][l])
         v = jnp.einsum("btd,dhk->bthk", h, lp["wv"][l])
@@ -456,7 +535,7 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
         else:
             attn = attend(q, k_ctx, v_ctx, mask)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"][l])
-        h2 = rms_norm(x, lp["ln2"][l], cfg.rms_eps)
+        h2 = rms_norm(x, lp["ln2"][l], cfg.rms_eps, cfg.norm_offset)
         if cfg.num_experts:
             from .moe import moe_ffn
             x = x + moe_ffn(h2, lp["wr"][l], lp["wg"][l], lp["wu"][l],
@@ -464,13 +543,13 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
         else:
             g = jnp.einsum("btd,df->btf", h2, lp["wg"][l])
             u = jnp.einsum("btd,df->btf", h2, lp["wu"][l])
-            x = x + jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u,
+            x = x + jnp.einsum("btf,fd->btd", _act(cfg)(g) * u,
                                lp["wd"][l])
 
     if logits_idx is not None:
         x = jnp.take_along_axis(
             x, logits_idx[:, None, None].astype(jnp.int32), axis=1)  # [B,1,D]
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps, cfg.norm_offset)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("btd,dv->btv", x, head.astype(x.dtype))
     return logits.astype(jnp.float32), k_pool, v_pool
@@ -540,7 +619,7 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
 
     # embed + rope for every microbatch, replicated (cheap, not stacked);
     # rope_tables handles arbitrary leading dims
-    x0 = params["embed"][tokens]                       # [M, Bm, T, D]
+    x0 = _embed(params, cfg, tokens)                   # [M, Bm, T, D]
     cos, sin = rope_tables(cfg, positions)             # [M, Bm, T, Dh/2]
 
     perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
@@ -577,7 +656,7 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
             # contractions produce partial sums reduced over tp.
             x = cur
             for l in range(Lloc):
-                h = rms_norm(x, lp_loc["ln1"][l], cfg.rms_eps)
+                h = rms_norm(x, lp_loc["ln1"][l], cfg.rms_eps, cfg.norm_offset)
                 q = jnp.einsum("btd,dhk->bthk", h, lp_loc["wq"][l])
                 k = jnp.einsum("btd,dhk->bthk", h, lp_loc["wk"][l])
                 v = jnp.einsum("btd,dhk->bthk", h, lp_loc["wv"][l])
@@ -598,10 +677,10 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
                 if tp_sz > 1:
                     o = jax.lax.psum(o, AXIS_TP)
                 x = x + o
-                h2 = rms_norm(x, lp_loc["ln2"][l], cfg.rms_eps)
+                h2 = rms_norm(x, lp_loc["ln2"][l], cfg.rms_eps, cfg.norm_offset)
                 g = jnp.einsum("btd,df->btf", h2, lp_loc["wg"][l])
                 u = jnp.einsum("btd,df->btf", h2, lp_loc["wu"][l])
-                f = jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u,
+                f = jnp.einsum("btf,fd->btd", _act(cfg)(g) * u,
                                lp_loc["wd"][l])
                 if tp_sz > 1:
                     f = jax.lax.psum(f, AXIS_TP)
@@ -647,7 +726,7 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
     if logits_idx is not None:
         xs = jnp.take_along_axis(
             xs, logits_idx[:, :, None, None].astype(jnp.int32), axis=2)
-    xs = rms_norm(xs, params["final_norm"], cfg.rms_eps)
+    xs = rms_norm(xs, params["final_norm"], cfg.rms_eps, cfg.norm_offset)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("mbtd,dv->mbtv", xs, head.astype(xs.dtype))
     return logits.astype(jnp.float32), k_pool, v_pool
@@ -760,7 +839,7 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
     page = k_pool.shape[3]
     lp = params["layers"]
     pos = lengths - 1                                  # [B]
-    x = params["embed"][tokens][:, None]               # [B,1,D]
+    x = _embed(params, cfg, tokens)[:, None]           # [B,1,D]
     cos, sin = rope_tables(cfg, pos[:, None])
     w_page = jnp.take_along_axis(page_tables, (pos // page)[:, None],
                                  axis=1)[:, 0]
@@ -790,7 +869,7 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
         mask = (t[None] < lengths[:, None])[:, None, :]  # [B,1,S]
 
     for l in range(cfg.num_layers):
-        h = rms_norm(x, lp["ln1"][l], cfg.rms_eps)
+        h = rms_norm(x, lp["ln1"][l], cfg.rms_eps, cfg.norm_offset)
         q = jnp.einsum("btd,dhk->bthk", h, lp["wq"][l])
         k = jnp.einsum("btd,dhk->bthk", h, lp["wk"][l])
         v = jnp.einsum("btd,dhk->bthk", h, lp["wv"][l])
@@ -817,7 +896,7 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
             v_ctx = v_pool[l, :, rp, ro]
             attn = attend(q, k_ctx, v_ctx, mask)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"][l])
-        h2 = rms_norm(x, lp["ln2"][l], cfg.rms_eps)
+        h2 = rms_norm(x, lp["ln2"][l], cfg.rms_eps, cfg.norm_offset)
         if cfg.num_experts:
             from .moe import moe_ffn
             x = x + moe_ffn(h2, lp["wr"][l], lp["wg"][l], lp["wu"][l],
@@ -825,9 +904,9 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
         else:
             g = jnp.einsum("btd,df->btf", h2, lp["wg"][l])
             u = jnp.einsum("btd,df->btf", h2, lp["wu"][l])
-            x = x + jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u, lp["wd"][l])
+            x = x + jnp.einsum("btf,fd->btd", _act(cfg)(g) * u, lp["wd"][l])
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps, cfg.norm_offset)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("btd,dv->btv", x, head.astype(x.dtype))
     return logits.astype(jnp.float32), k_pool, v_pool

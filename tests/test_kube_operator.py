@@ -101,3 +101,54 @@ def test_deleting_cr_cascades_all_children():
                for n in remaining), remaining
     assert api.get("Deployment", "prod", "demo-worker") is None
     assert api.get("Deployment", "prod", "dynstore") is not None
+
+
+# ----------------------------------------------------------------------
+# image-build orchestration (the operator's artifact -> image pipeline)
+# ----------------------------------------------------------------------
+
+def test_build_context_and_builder_dispatch(tmp_path):
+    import os
+    import stat
+    import tarfile
+
+    from dynamo_tpu.deploy.imagebuild import build_context, run_builder
+
+    mod = tmp_path / "my_graph.py"
+    mod.write_text("GRAPH = 'hello'\n")
+    (tmp_path / "__pycache__").mkdir()
+    (tmp_path / "__pycache__" / "junk.pyc").write_bytes(b"x")
+
+    ctx = build_context(str(mod), base_image="dynamo-tpu:test",
+                        out_path=str(tmp_path / "ctx.tar"))
+    with tarfile.open(ctx) as tar:
+        names = tar.getnames()
+        assert "Dockerfile" in names
+        assert "app/my_graph.py" in names
+        df = tar.extractfile("Dockerfile").read().decode()
+        assert "FROM dynamo-tpu:test" in df
+        assert "COPY app/ /app/" in df
+
+    # a package dir context excludes bytecode caches
+    pkg = tmp_path / "graphpkg"
+    pkg.mkdir()
+    (pkg / "svc.py").write_text("x = 1\n")
+    (pkg / "__pycache__").mkdir()
+    (pkg / "__pycache__" / "svc.pyc").write_bytes(b"x")
+    ctx2 = build_context(str(pkg), out_path=str(tmp_path / "ctx2.tar"))
+    with tarfile.open(ctx2) as tar:
+        names = tar.getnames()
+        assert "app/graphpkg/svc.py" in names
+        assert not any("pycache" in n or n.endswith(".pyc") for n in names)
+
+    # builder dispatch: docker-build contract (-t tag, context on stdin)
+    fake = tmp_path / "fakebuilder.sh"
+    fake.write_text("#!/bin/sh\necho \"$@\" > %s/args.txt\n"
+                    "wc -c > %s/stdin_bytes.txt\n"
+                    % (tmp_path, tmp_path))
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    rc = run_builder(str(fake), ctx, "graph:1")
+    assert rc == 0
+    assert (tmp_path / "args.txt").read_text().split() == ["-t", "graph:1", "-"]
+    assert int((tmp_path / "stdin_bytes.txt").read_text()) == \
+        os.path.getsize(ctx)
