@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -398,9 +399,11 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     Returns [B, Hq, Dh]. Sequences attend to tokens [0, length).
 
     On a real TPU this runs the multi-page double-buffered DMA kernel
-    above; off-TPU (and under ``interpret=True``) the simple one-page-per-
-    step kernel below runs in interpreter mode so the CPU test suite
-    exercises the same contract.
+    above (``DYNAMO_TPU_PAGED_KERNEL=simple`` falls back to the
+    BlockSpec-pipelined one-page-per-step kernel below, compiled — the
+    variant proven on-chip before the DMA rewrite); off-TPU (and under
+    ``interpret=True``) the simple kernel runs in interpreter mode so the
+    CPU test suite exercises the same contract.
     """
     B, Hq, Dh = q.shape
     Hkv, n_pages, page, _ = k_pages.shape
@@ -413,7 +416,13 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     lengths = jnp.maximum(lengths, 1)
     if interpret is None:
         interpret = _interpret_default()
-    if not interpret:
+    variant = os.environ.get("DYNAMO_TPU_PAGED_KERNEL", "dma")
+    if variant not in ("dma", "simple"):
+        # repo convention: a typo'd env flag must not silently select the
+        # slow path (cf. DYNAMO_TPU_DATAPLANE / DYNAMO_TPU_STORE)
+        raise ValueError(f"DYNAMO_TPU_PAGED_KERNEL={variant!r} "
+                         f"(expected dma|simple)")
+    if not interpret and variant == "dma":
         q4 = q.reshape(B, Hkv, G, Dh)
         out = _paged_attention_tpu(q4, k_pages, v_pages, page_tables, lengths)
         return out.reshape(B, Hq, Dh)

@@ -45,6 +45,19 @@ def main() -> int:
         return 0
     print(f"device: {dev.device_kind}")
     failures = 0
+    try:
+        failures = _run_queue(jax, jnp, flash_attention, paged_attention)
+    finally:
+        # the tunnel can drop mid-run: whatever completed must still be
+        # recorded, and the kernel-variant env must not leak
+        os.environ.pop("DYNAMO_TPU_PAGED_KERNEL", None)
+        _record(dev.device_kind, failures)
+    print("PASS" if failures == 0 else f"{failures} FAILURES")
+    return 1 if failures else 0
+
+
+def _run_queue(jax, jnp, flash_attention, paged_attention) -> int:
+    failures = 0
 
     # GQA shape family the engine serves (Llama 1B/8B: G=4)
     Hq, Hkv, Dh = 8, 2, 64
@@ -64,52 +77,87 @@ def main() -> int:
             ref = np.asarray(dense_ref(q, k, v, q_pos, k_pos, k_valid),
                              np.float32)
             err = np.abs(out - ref).max()
-            ok = err < 0.05
+            ok = bool(err < 0.05)
             print(f"flash  B={B:3d}: max_err={err:.4f} {'OK' if ok else 'FAIL'}")
+            RESULTS.append({"case": f"flash B={B}", "ok": ok,
+                            "max_err": float(err)})
             failures += 0 if ok else 1
         except Exception as e:  # noqa: BLE001
             print(f"flash  B={B:3d}: COMPILE/RUN FAIL: {type(e).__name__}: "
                   f"{str(e)[:200]}")
+            RESULTS.append({"case": f"flash B={B}", "ok": False,
+                            "error": f"{type(e).__name__}: {str(e)[:200]}"})
             failures += 1
 
     page, P = 64, 8
-    for B in (1, 8, 32):
-        n_pages = B * P + 1
-        key = jax.random.PRNGKey(100 + B)
-        kq, kk, kv_ = jax.random.split(key, 3)
-        q = jax.random.normal(kq, (B, Hq, Dh), jnp.bfloat16)
-        k_pages = jax.random.normal(kk, (Hkv, n_pages, page, Dh), jnp.bfloat16)
-        v_pages = jax.random.normal(kv_, (Hkv, n_pages, page, Dh), jnp.bfloat16)
-        pt = (np.arange(P)[None] + np.arange(B)[:, None] * P + 1).astype(np.int32)
-        page_tables = jnp.asarray(pt)
-        lengths = jnp.asarray(
-            np.random.RandomState(B).randint(1, P * page, B), jnp.int32)
-        try:
-            out = np.asarray(paged_attention(q, k_pages, v_pages, page_tables,
-                                             lengths, interpret=False),
-                             np.float32)
-            # gather the pages into dense context and reuse the flash ref
-            kg = np.asarray(k_pages, np.float32)[:, pt] \
-                .transpose(1, 2, 3, 0, 4).reshape(B, P * page, Hkv, Dh)
-            vg = np.asarray(v_pages, np.float32)[:, pt] \
-                .transpose(1, 2, 3, 0, 4).reshape(B, P * page, Hkv, Dh)
-            kp = jnp.broadcast_to(jnp.arange(P * page), (B, P * page))
-            valid = kp < np.asarray(lengths)[:, None]
-            ref = np.asarray(dense_ref(
-                jnp.asarray(q)[:, None],
-                jnp.asarray(kg, jnp.bfloat16), jnp.asarray(vg, jnp.bfloat16),
-                (lengths - 1)[:, None], kp, valid), np.float32)[:, 0]
-            err = np.abs(out - ref.reshape(out.shape)).max()
-            ok = err < 0.05
-            print(f"paged  B={B:3d}: max_err={err:.4f} {'OK' if ok else 'FAIL'}")
-            failures += 0 if ok else 1
-        except Exception as e:  # noqa: BLE001
-            print(f"paged  B={B:3d}: COMPILE/RUN FAIL: {type(e).__name__}: "
-                  f"{str(e)[:200]}")
-            failures += 1
+    for variant in ("dma", "simple"):
+        os.environ["DYNAMO_TPU_PAGED_KERNEL"] = variant
+        for B in (1, 8, 32):
+            case = f"paged[{variant}] B={B:3d}"
+            try:
+                n_pages = B * P + 1
+                key = jax.random.PRNGKey(100 + B)
+                kq, kk, kv_ = jax.random.split(key, 3)
+                q = jax.random.normal(kq, (B, Hq, Dh), jnp.bfloat16)
+                k_pages = jax.random.normal(kk, (Hkv, n_pages, page, Dh),
+                                            jnp.bfloat16)
+                v_pages = jax.random.normal(kv_, (Hkv, n_pages, page, Dh),
+                                            jnp.bfloat16)
+                pt = (np.arange(P)[None]
+                      + np.arange(B)[:, None] * P + 1).astype(np.int32)
+                page_tables = jnp.asarray(pt)
+                lengths = jnp.asarray(
+                    np.random.RandomState(B).randint(1, P * page, B),
+                    jnp.int32)
+                out = np.asarray(
+                    paged_attention(q, k_pages, v_pages, page_tables,
+                                    lengths, interpret=False), np.float32)
+                # gather the pages into dense context, reuse the flash ref
+                kg = np.asarray(k_pages, np.float32)[:, pt] \
+                    .transpose(1, 2, 3, 0, 4).reshape(B, P * page, Hkv, Dh)
+                vg = np.asarray(v_pages, np.float32)[:, pt] \
+                    .transpose(1, 2, 3, 0, 4).reshape(B, P * page, Hkv, Dh)
+                kp = jnp.broadcast_to(jnp.arange(P * page), (B, P * page))
+                valid = kp < np.asarray(lengths)[:, None]
+                ref = np.asarray(dense_ref(
+                    jnp.asarray(q)[:, None],
+                    jnp.asarray(kg, jnp.bfloat16),
+                    jnp.asarray(vg, jnp.bfloat16),
+                    (lengths - 1)[:, None], kp, valid), np.float32)[:, 0]
+                err = np.abs(out - ref.reshape(out.shape)).max()
+                ok = bool(err < 0.05)
+                print(f"{case}: max_err={err:.4f} {'OK' if ok else 'FAIL'}")
+                RESULTS.append({"case": case, "ok": ok,
+                                "max_err": float(err)})
+                failures += 0 if ok else 1
+            except Exception as e:  # noqa: BLE001
+                print(f"{case}: COMPILE/RUN FAIL: {type(e).__name__}: "
+                      f"{str(e)[:200]}")
+                RESULTS.append({"case": case, "ok": False,
+                                "error": f"{type(e).__name__}: {str(e)[:200]}"})
+                failures += 1
+    return failures
 
-    print("PASS" if failures == 0 else f"{failures} FAILURES")
-    return 1 if failures else 0
+
+RESULTS = []
+
+
+def _record(device_kind: str, failures: int) -> None:
+    """Write the per-round smoke record the judge/driver can read.
+    ``failures`` counts completed-and-failed cases; an aborted run is
+    visible as pass=False with fewer results than cases."""
+    import json
+    import time
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "TPU_SMOKE.json")
+    with open(path, "w") as f:
+        complete = len(RESULTS) >= 10   # 4 flash + 2x3 paged cases
+        json.dump({"device": device_kind, "failures": failures,
+                   "pass": failures == 0 and complete,
+                   "complete": complete, "when": time.time(),
+                   "results": RESULTS}, f, indent=2)
+    print(f"recorded -> {path}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,56 @@
+#!/bin/bash
+# TPU tunnel watcher: probe until the chip answers, then run the full
+# validation queue (kernel smoke -> bench -> perf probe) and record
+# artifacts. Designed to run detached:
+#   setsid bash scripts/tpu_watch.sh > /tmp/tpu_watch.log 2>&1 &
+# The axon tunnel drops for hours at a time; this catches any window.
+set -u
+cd "$(dirname "$0")/.."
+
+probe() {
+    timeout 90 python -c "import jax; d=jax.devices()[0]; \
+print(d.platform, d.device_kind)" 2>/dev/null | tail -1
+}
+
+echo "$(date -u +%H:%M:%S) tpu_watch: starting"
+while true; do
+    out=$(probe)
+    if echo "$out" | grep -qi tpu; then
+        echo "$(date -u +%H:%M:%S) TUNNEL UP: $out"
+        failed=0
+
+        echo "$(date -u +%H:%M:%S) running tpu_smoke..."
+        timeout 1200 python scripts/tpu_smoke.py 2>&1 | tail -20
+        rc=${PIPESTATUS[0]}
+        [ "$rc" -ne 0 ] && { echo "tpu_smoke FAILED (rc=$rc)"; failed=1; }
+
+        echo "$(date -u +%H:%M:%S) running bench.py..."
+        # bench budgets 1500s measurement + up to 300s of backend probes,
+        # plus compile time — 2700 leaves room for its final JSON line
+        timeout 2700 python bench.py > /tmp/bench_tpu_out.json \
+            2>/tmp/bench_tpu_err.log
+        rc=$?
+        if [ "$rc" -ne 0 ] || [ ! -s /tmp/bench_tpu_out.json ]; then
+            echo "bench FAILED (rc=$rc); stderr tail:"
+            tail -c 1000 /tmp/bench_tpu_err.log
+            failed=1
+        else
+            tail -c 2000 /tmp/bench_tpu_out.json
+            echo
+        fi
+
+        echo "$(date -u +%H:%M:%S) running perf_probe..."
+        timeout 900 python scripts/perf_probe.py 2>&1 | tail -30
+        rc=${PIPESTATUS[0]}
+        [ "$rc" -ne 0 ] && { echo "perf_probe FAILED (rc=$rc)"; failed=1; }
+
+        if [ "$failed" -ne 0 ]; then
+            echo "$(date -u +%H:%M:%S) queue FAILED (see above)"
+            exit 1
+        fi
+        echo "$(date -u +%H:%M:%S) queue complete: all stages passed"
+        exit 0
+    fi
+    echo "$(date -u +%H:%M:%S) tunnel down ($out)"
+    sleep 300
+done
