@@ -4,7 +4,7 @@ Parses the GGUF v2/v3 container format (llama.cpp's model distribution
 format): header, string-keyed typed metadata, and the tensor directory. A
 llama-family GGUF (llama/mistral/qwen2) maps onto :class:`~dynamo_tpu.
 models.llama.LlamaConfig` and the stacked param pytree the engine serves;
-F32/F16/BF16 tensors load directly; Q8_0/Q4_0 block-quantized and
+F32/F16/BF16 tensors load directly; Q8_0/Q4_0/Q5_0/Q5_1 block-quantized and
 Q4_K/Q5_K/Q6_K super-block-quantized tensors (the formats stock *_K_M
 exports ship) dequantize at load.
 
@@ -35,6 +35,7 @@ _SCALAR_FMT = {_U8: "<B", _I8: "<b", _U16: "<H", _I16: "<h", _U32: "<I",
 # tensor ggml dtypes
 _GGML_F32, _GGML_F16 = 0, 1
 _GGML_Q4_0, _GGML_Q8_0, _GGML_BF16 = 2, 8, 16
+_GGML_Q5_0, _GGML_Q5_1 = 6, 7
 _GGML_Q4_K, _GGML_Q5_K, _GGML_Q6_K = 12, 13, 14
 _GGML_NAMES = {0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1", 6: "Q5_0",
                7: "Q5_1", 8: "Q8_0", 9: "Q8_1", 10: "Q2_K", 11: "Q3_K",
@@ -64,6 +65,42 @@ def _dequant_q4_0(raw: bytes, count: int) -> np.ndarray:
     hi = (rec["q"] >> 4).astype(np.int8) - 8
     vals = np.concatenate([lo, hi], axis=1).astype(np.float32)
     return (rec["d"].astype(np.float32)[:, None] * vals).reshape(count)
+
+
+def _q5_bits(qh: np.ndarray) -> np.ndarray:
+    """[nb, 4] uint8 -> [nb, 32] the per-value 5th bit (llama.cpp order:
+    bit i of the packed u32 belongs to value i; values 0..15 are low
+    nibbles, 16..31 high nibbles)."""
+    bits32 = qh.view(np.uint32).reshape(-1, 1)          # [nb, 1] LE
+    idx = np.arange(32, dtype=np.uint32)[None, :]
+    return ((bits32 >> idx) & 1).astype(np.uint8)        # [nb, 32]
+
+
+def _dequant_q5_0(raw: bytes, count: int) -> np.ndarray:
+    """Q5_0: f16 scale + 32 high bits + 16 nibble bytes; w = d*(q-16)."""
+    nb = count // _QBLOCK
+    rec = np.frombuffer(raw, dtype=np.dtype(
+        [("d", "<f2"), ("qh", "u1", (4,)), ("q", "u1", (_QBLOCK // 2,))]),
+        count=nb)
+    h = _q5_bits(rec["qh"])
+    lo = (rec["q"] & 0x0F) | (h[:, :16] << 4)
+    hi = (rec["q"] >> 4) | (h[:, 16:] << 4)
+    vals = np.concatenate([lo, hi], axis=1).astype(np.float32) - 16.0
+    return (rec["d"].astype(np.float32)[:, None] * vals).reshape(count)
+
+
+def _dequant_q5_1(raw: bytes, count: int) -> np.ndarray:
+    """Q5_1: f16 scale + f16 min + 32 high bits + nibbles; w = d*q + m."""
+    nb = count // _QBLOCK
+    rec = np.frombuffer(raw, dtype=np.dtype(
+        [("d", "<f2"), ("m", "<f2"), ("qh", "u1", (4,)),
+         ("q", "u1", (_QBLOCK // 2,))]), count=nb)
+    h = _q5_bits(rec["qh"])
+    lo = (rec["q"] & 0x0F) | (h[:, :16] << 4)
+    hi = (rec["q"] >> 4) | (h[:, 16:] << 4)
+    vals = np.concatenate([lo, hi], axis=1).astype(np.float32)
+    return (rec["d"].astype(np.float32)[:, None] * vals
+            + rec["m"].astype(np.float32)[:, None]).reshape(count)
 
 
 def _kquant_scale_min(scales: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -142,6 +179,13 @@ def _dequant_q6_k(raw: bytes, count: int) -> np.ndarray:
 _KQUANT_BYTES = {_GGML_Q4_K: 144, _GGML_Q5_K: 176, _GGML_Q6_K: 210}
 _KQUANT_FNS = {_GGML_Q4_K: _dequant_q4_k, _GGML_Q5_K: _dequant_q5_k,
                _GGML_Q6_K: _dequant_q6_k}
+# 32-value block formats: ggml type -> (bytes per block, dequant fn)
+_QBLOCK_FMT = {
+    _GGML_Q8_0: (2 + _QBLOCK, _dequant_q8_0),
+    _GGML_Q4_0: (2 + _QBLOCK // 2, _dequant_q4_0),
+    _GGML_Q5_0: (2 + 4 + _QBLOCK // 2, _dequant_q5_0),
+    _GGML_Q5_1: (4 + 4 + _QBLOCK // 2, _dequant_q5_1),
+}
 
 
 @dataclass
@@ -212,17 +256,14 @@ class GGUFFile:
     def load_tensor(self, name: str) -> np.ndarray:
         info = self.tensors[name]
         count = int(np.prod(info.shape)) if info.shape else 1
-        if info.ggml_type in (_GGML_Q8_0, _GGML_Q4_0):
+        if info.ggml_type in _QBLOCK_FMT:
             # block-quantized weights dequantize to f32 at load (the engine
             # casts to its compute dtype; on-device quantized matmuls are a
             # separate optimization, this is the loading capability)
-            bpb = 2 + (_QBLOCK if info.ggml_type == _GGML_Q8_0
-                       else _QBLOCK // 2)
+            bpb, deq_fn = _QBLOCK_FMT[info.ggml_type]
             raw = self._read(self.data_start + info.offset,
                              count // _QBLOCK * bpb)
-            deq = (_dequant_q8_0 if info.ggml_type == _GGML_Q8_0
-                   else _dequant_q4_0)(raw, count)
-            return deq.reshape(info.shape)
+            return deq_fn(raw, count).reshape(info.shape)
         if info.ggml_type in _KQUANT_FNS:
             raw = self._read(self.data_start + info.offset,
                              count // _QK_K * _KQUANT_BYTES[info.ggml_type])
@@ -238,7 +279,7 @@ class GGUFFile:
             tname = _GGML_NAMES.get(info.ggml_type, str(info.ggml_type))
             raise NotImplementedError(
                 f"tensor {name!r} uses unsupported ggml type {tname}; "
-                f"F32/F16/BF16/Q8_0/Q4_0/Q4_K/Q5_K/Q6_K are loadable "
+                f"F32/F16/BF16/Q8_0/Q4_0/Q5_0/Q5_1/Q4_K/Q5_K/Q6_K are loadable "
                 f"(dequantize or re-export the model)")
         dtype = np.float32 if info.ggml_type == _GGML_F32 else np.float16
         raw = self._read(self.data_start + info.offset,

@@ -363,3 +363,71 @@ def test_kquant_loads_from_file(tmp_path):
     got = g2.load_tensor("blk.0.ffn_up.weight")
     want = _scalar_q6_k(bytes(raw), count).reshape(info.shape)
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_q5_0_q5_1_dequant_roundtrip():
+    """Q5_0/Q5_1 32-value block formats: quantize (scalar reference pack)
+    then dequantize within the format's error bound."""
+    import dynamo_tpu.llm.gguf as G
+
+    rng = np.random.RandomState(3)
+    w = rng.randn(4, 64).astype(np.float32)
+
+    def pack_q5_0(w):
+        out = bytearray()
+        for blk in w.reshape(-1, 32):
+            d = np.abs(blk).max() / 15.0 or 1e-8
+            q = np.clip(np.round(blk / d) + 16, 0, 31).astype(np.uint8)
+            qh = 0
+            for i in range(32):
+                qh |= int(q[i] >> 4) << i
+            lo = (q[:16] & 0xF) | ((q[16:] & 0xF) << 4)
+            out += np.float16(d).tobytes()
+            out += int(qh).to_bytes(4, "little") + lo.tobytes()
+        return bytes(out)
+
+    def pack_q5_1(w):
+        out = bytearray()
+        for blk in w.reshape(-1, 32):
+            mn = blk.min()
+            d = (blk.max() - mn) / 31.0 or 1e-8
+            q = np.clip(np.round((blk - mn) / d), 0, 31).astype(np.uint8)
+            qh = 0
+            for i in range(32):
+                qh |= int(q[i] >> 4) << i
+            lo = (q[:16] & 0xF) | ((q[16:] & 0xF) << 4)
+            out += np.float16(d).tobytes() + np.float16(mn).tobytes()
+            out += int(qh).to_bytes(4, "little") + lo.tobytes()
+        return bytes(out)
+
+    got0 = G._dequant_q5_0(pack_q5_0(w), w.size).reshape(w.shape)
+    np.testing.assert_allclose(got0, w, atol=np.abs(w).max() / 12)
+    got1 = G._dequant_q5_1(pack_q5_1(w), w.size).reshape(w.shape)
+    np.testing.assert_allclose(got1, w, atol=np.abs(w).max() / 12)
+
+
+def test_q5_0_loads_from_file(tmp_path):
+    """Q5_0 through GGUFFile.load_tensor: the _QBLOCK_FMT per-block byte
+    size must carve the right raw span out of the file."""
+    import dynamo_tpu.llm.gguf as G
+
+    cfg = llama.preset("tiny-byte", tie_embeddings=False)
+    tiny_gguf(tmp_path / "m.gguf", cfg)
+    g = read_gguf(str(tmp_path / "m.gguf"))
+    info = g.tensors["blk.0.ffn_up.weight"]
+    count = int(np.prod(info.shape))
+    nb = count // 32
+    rng = np.random.default_rng(11)
+    raw = bytearray()
+    for _ in range(nb):
+        raw += np.array([0.03], "<f2").tobytes()
+        raw += rng.integers(0, 256, 20, dtype=np.uint8).tobytes()
+    data = open(tmp_path / "m.gguf", "rb").read()
+    patched = (data[:g.data_start + info.offset] + bytes(raw)
+               + data[g.data_start + info.offset + len(raw):])
+    (tmp_path / "q5.gguf").write_bytes(patched)
+    g2 = read_gguf(str(tmp_path / "q5.gguf"))
+    g2.tensors["blk.0.ffn_up.weight"].ggml_type = 6  # Q5_0
+    got = g2.load_tensor("blk.0.ffn_up.weight")
+    want = G._dequant_q5_0(bytes(raw), count).reshape(info.shape)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
