@@ -40,7 +40,7 @@ from ..models import llama
 from ..parallel.mesh import AXIS_TP, serving_mesh
 from ..runtime.engine import AsyncEngine, Context
 from .cache import OutOfPages, PagePool
-from .sampling import STATIC_K, SamplingState, sample
+from .sampling import STATIC_K, SamplingState, apply_penalties, sample
 
 log = logging.getLogger("dynamo_tpu.engine")
 
@@ -305,6 +305,15 @@ class EngineCore:
         self.sampling.key = jax.jit(
             lambda: jax.random.split(jax.random.key(0), cfg.max_batch),
             out_shardings=self._rep_sharding)()
+        # generated-token occurrence counts per lane (frequency/presence
+        # penalties): persistent device state threaded through every decode
+        # dispatch like the KV pools; lanes reset in-program when a new
+        # sequence enters decode (multi-host lockstep holds — the resets
+        # ride the mirrored dispatch, never a side op)
+        self.gen_counts = jax.jit(
+            lambda: jnp.zeros((cfg.max_batch, m.vocab_size), jnp.int32),
+            out_shardings=self._rep_sharding)()
+        self._decode_seen: Dict[int, str] = {}
 
         # --- compiled programs ---------------------------------------
         # decode reads are indexed through page tables of width S/page_size:
@@ -362,17 +371,22 @@ class EngineCore:
         zb = np.zeros(B, np.int32)
         zf = np.zeros(B, np.float32)
         ones = np.ones(B, np.int32)
+        fresh = np.zeros(B, bool)
+        act = np.zeros(B, bool)
         for S in self.s_buckets:
             fn = self._decode_fn(S)
             pt = np.zeros((B, S // self.page_size), np.int32)
             # non-chained (host tokens) ...
-            _, final_tok, key2, self.k_pool, self.v_pool = fn(
+            (_, final_tok, key2, self.k_pool, self.v_pool,
+             self.gen_counts) = fn(
                 self.params, zb, self.k_pool, self.v_pool, pt, ones,
-                s.temperature, s.top_p, s.top_k, s.key)
+                s.temperature, s.top_p, s.top_k, s.key,
+                self.gen_counts, fresh, act, s.freq_pen, s.pres_pen)
             # ... and chained (previous dispatch's on-device tokens/key)
-            _, _, _, self.k_pool, self.v_pool = fn(
+            (_, _, _, self.k_pool, self.v_pool, self.gen_counts) = fn(
                 self.params, final_tok, self.k_pool, self.v_pool, pt, ones,
-                s.temperature, s.top_p, s.top_k, key2)
+                s.temperature, s.top_p, s.top_k, key2,
+                self.gen_counts, fresh, act, s.freq_pen, s.pres_pen)
             n += 2
         for Bp in self.b_buckets:
             for C in self.c_buckets:
@@ -418,12 +432,25 @@ class EngineCore:
             # sharding across programs: without this, XLA may emit an
             # equivalent-but-differently-spec'd sharding and every *other*
             # bucket program compiles a second variant against it
-            @partial(jax.jit, donate_argnums=(2, 3),
-                     out_shardings=(rep, rep, rep, kv, kv))
+            B = self.cfg.max_batch
+
+            @partial(jax.jit, donate_argnums=(2, 3, 10),
+                     out_shardings=(rep, rep, rep, kv, kv, rep))
             def step(params, tokens, k_pool, v_pool, page_tables, lengths,
-                     temp, top_p, top_k, key):
+                     temp, top_p, top_k, key, counts, fresh, active,
+                     freq_pen, pres_pen):
+                # lanes whose sequence just entered decode restart their
+                # generated-token counts at one-hot(first generated token);
+                # chained dispatches pass fresh all-False
+                lane = jnp.arange(B)
+                counts = jnp.where(
+                    fresh[:, None],
+                    jnp.zeros_like(counts).at[lane, tokens].add(1),
+                    counts)
+                act = active.astype(jnp.int32)
+
                 def one(carry, _):
-                    tokens, lengths, k_pool, v_pool, key = carry
+                    tokens, lengths, k_pool, v_pool, key, counts = carry
                     if cfg.pp > 1:
                         logits, k_pool, v_pool = llama.forward_decode_pp(
                             params, cfg.model, tokens, k_pool, v_pool,
@@ -432,18 +459,23 @@ class EngineCore:
                         logits, k_pool, v_pool = llama.forward_decode(
                             params, cfg.model, tokens, k_pool, v_pool,
                             page_tables, lengths, attn_impl=impl, mesh=mesh)
-                    tok, logp, new_key = sample(
-                        logits[:, 0], temp, top_p, top_k, key)
-                    return ((tok, lengths + 1, k_pool, v_pool, new_key),
-                            (tok, logp))
+                    lg = apply_penalties(logits[:, 0], counts, freq_pen,
+                                         pres_pen)
+                    tok, logp, new_key = sample(lg, temp, top_p, top_k, key)
+                    # only lanes ACTIVE in this dispatch count their sample:
+                    # a deferred (pool-pressure) lane's garbage tokens must
+                    # not poison its penalties when it resumes
+                    counts = counts.at[lane, tok].add(act)
+                    return ((tok, lengths + 1, k_pool, v_pool, new_key,
+                             counts), (tok, logp))
 
-                carry = (tokens, lengths, k_pool, v_pool, key)
-                (tok, lengths, k_pool, v_pool, key), (toks, logps) = \
-                    jax.lax.scan(one, carry, None, length=N)
+                carry = (tokens, lengths, k_pool, v_pool, key, counts)
+                (tok, lengths, k_pool, v_pool, key, counts), (toks, logps) \
+                    = jax.lax.scan(one, carry, None, length=N)
                 # token ids < 2^24 are exact in f32, so one packed array
                 # (one host fetch) carries both streams losslessly
                 packed = jnp.stack([toks.astype(jnp.float32), logps], -1)
-                return packed, tok, key, k_pool, v_pool
+                return packed, tok, key, k_pool, v_pool, counts
 
             self._decode_fns[S] = step
         return self._decode_fns[S]
@@ -727,6 +759,7 @@ class EngineCore:
         # (implementation-defined winner)
         self._pending_seeds = [(ix, sd) for ix, sd in self._pending_seeds
                                if ix != i]
+        self._decode_seen.pop(i, None)
         if self._inflight:
             # an enqueued decode dispatch may still write into this
             # sequence's pages; hold the release until the window drains so
@@ -857,6 +890,8 @@ class EngineCore:
         s.top_p[slot_idx] = float(req.sampling.top_p
                                   if req.sampling.top_p is not None else 1.0)
         s.top_k[slot_idx] = int(min(req.sampling.top_k or 0, STATIC_K))
+        s.freq_pen[slot_idx] = float(req.sampling.frequency_penalty or 0.0)
+        s.pres_pen[slot_idx] = float(req.sampling.presence_penalty or 0.0)
         if req.sampling.seed is not None:
             # deferred to the next prefill dispatch: keeps EVERY device op
             # at a mirrorable dispatch point (multi-host lockstep) and
@@ -1092,20 +1127,36 @@ class EngineCore:
             for i, slot, _ in active:
                 tokens[i] = slot.last_token
 
+        # lanes whose SEQUENCE changed since their last decode dispatch
+        # restart their penalty counts in-program (a chained dispatch has
+        # identical membership by _can_chain, so fresh is all-False there)
+        fresh = np.zeros(B, bool)
+        if not chain:
+            for i, slot, _ in active:
+                if self._decode_seen.get(i) != slot.seq_id:
+                    fresh[i] = True
+                    self._decode_seen[i] = slot.seq_id
+        active_mask = np.zeros(B, bool)
+        for i, _, _ in active:
+            active_mask[i] = True
+
         s = self.sampling
         if self.dispatch_hook is not None:
             payload = {"page_tables": page_tables, "lengths": lengths,
                        "temp": s.temperature, "top_p": s.top_p,
-                       "top_k": s.top_k}
+                       "top_k": s.top_k, "fresh": fresh,
+                       "active_mask": active_mask,
+                       "freq_pen": s.freq_pen, "pres_pen": s.pres_pen}
             if tokens is not None:
                 payload["tokens"] = tokens
             self.dispatch_hook("decode", {"S": S, "chain": chain}, payload)
         packed, final_tok = self._run_decode_program(
-            S, tokens, page_tables, lengths)
+            S, tokens, page_tables, lengths, fresh, active_mask)
         self._inflight.append({"packed": packed, "final_tok": final_tok,
                                "active": active})
 
-    def _run_decode_program(self, S: int, tokens, page_tables, lengths):
+    def _run_decode_program(self, S: int, tokens, page_tables, lengths,
+                            fresh, active_mask):
         """Execute the multi-step decode program. ``tokens=None`` chains off
         the previous dispatch's on-device final tokens. The SAME code path
         runs on the leader and on follower mirrors (multi-host lockstep)."""
@@ -1113,9 +1164,11 @@ class EngineCore:
             tokens = self._last_final_tok
         s = self.sampling
         fn = self._decode_fn(S)
-        packed, final_tok, new_key, self.k_pool, self.v_pool = fn(
+        (packed, final_tok, new_key, self.k_pool, self.v_pool,
+         self.gen_counts) = fn(
             self.params, tokens, self.k_pool, self.v_pool,
-            page_tables, lengths, s.temperature, s.top_p, s.top_k, s.key)
+            page_tables, lengths, s.temperature, s.top_p, s.top_k, s.key,
+            self.gen_counts, fresh, active_mask, s.freq_pen, s.pres_pen)
         s.key = new_key
         self._last_final_tok = final_tok
         return packed, final_tok
@@ -1141,9 +1194,11 @@ class EngineCore:
             s.temperature = arrs["temp"]
             s.top_p = arrs["top_p"]
             s.top_k = arrs["top_k"]
+            s.freq_pen = arrs["freq_pen"]
+            s.pres_pen = arrs["pres_pen"]
             self._run_decode_program(
                 meta["S"], arrs.get("tokens"), arrs["page_tables"],
-                arrs["lengths"])
+                arrs["lengths"], arrs["fresh"], arrs["active_mask"])
         else:
             raise ValueError(f"unknown dispatch kind {kind!r}")
 

@@ -27,6 +27,8 @@ class SamplingState:
     top_p: jax.Array        # f32 in (0,1], 1 => off
     top_k: jax.Array        # i32, 0 => off (capped at STATIC_K)
     key: jax.Array          # [B] typed PRNG keys (new-style jax.random.key)
+    freq_pen: jax.Array     # f32, 0 => off (OpenAI frequency_penalty)
+    pres_pen: jax.Array     # f32, 0 => off (OpenAI presence_penalty)
 
     @classmethod
     def host_init(cls, max_batch: int) -> "SamplingState":
@@ -35,7 +37,19 @@ class SamplingState:
             top_p=np.ones(max_batch, np.float32),
             top_k=np.zeros(max_batch, np.int32),
             key=jax.random.split(jax.random.key(0), max_batch),
+            freq_pen=np.zeros(max_batch, np.float32),
+            pres_pen=np.zeros(max_batch, np.float32),
         )
+
+
+def apply_penalties(logits: jax.Array, counts: jax.Array,
+                    freq_pen: jax.Array, pres_pen: jax.Array) -> jax.Array:
+    """OpenAI frequency/presence penalties over GENERATED-token counts
+    (completion text only, the vLLM-compatible reading): zero-penalty lanes
+    are a bitwise no-op. logits [B,V] f32, counts [B,V] i32."""
+    cf = counts.astype(jnp.float32)
+    return (logits - freq_pen[:, None] * cf
+            - pres_pen[:, None] * (cf > 0).astype(jnp.float32))
 
 
 def sample(logits: jax.Array, temperature: jax.Array, top_p: jax.Array,

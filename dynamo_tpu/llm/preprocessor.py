@@ -146,6 +146,8 @@ class Preprocessor:
             top_k=req.top_k,
             n=req.n,
             seed=req.seed,
+            frequency_penalty=req.frequency_penalty,
+            presence_penalty=req.presence_penalty,
             min_tokens=req.min_tokens,
             ignore_eos=req.ignore_eos,
             logprobs=req.logprobs,

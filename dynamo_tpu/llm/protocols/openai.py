@@ -112,6 +112,8 @@ class CompletionRequest:
     logprobs: Optional[int] = None
     min_tokens: Optional[int] = None
     ignore_eos: bool = False
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
     ext: Dict[str, Any] = field(default_factory=dict)
     raw: Dict[str, Any] = field(default_factory=dict)
 
@@ -139,6 +141,8 @@ class CompletionRequest:
             logprobs=d.get("logprobs"),
             min_tokens=d.get("min_tokens"),
             ignore_eos=bool(d.get("ignore_eos", False)),
+            frequency_penalty=d.get("frequency_penalty"),
+            presence_penalty=d.get("presence_penalty"),
             ext=dict(d.get("ext", d.get("nvext", {}) or {})),
             raw=d,
         )
