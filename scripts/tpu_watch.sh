@@ -45,8 +45,18 @@ while true; do
         [ "$rc" -ne 0 ] && { echo "perf_probe FAILED (rc=$rc)"; failed=1; }
 
         if [ "$failed" -ne 0 ]; then
-            echo "$(date -u +%H:%M:%S) queue FAILED (see above)"
-            exit 1
+            # disambiguate: if the tunnel is GONE the failure was the drop
+            # — keep watching and retry the queue on the next window. If
+            # the chip still answers, the failure is real (e.g. Mosaic
+            # rejects a kernel): exit nonzero, don't burn TPU windows
+            # re-running an 80-minute queue forever.
+            if echo "$(probe)" | grep -qi tpu; then
+                echo "$(date -u +%H:%M:%S) queue FAILED with tunnel up -> real failure"
+                exit 1
+            fi
+            echo "$(date -u +%H:%M:%S) queue FAILED (tunnel dropped); resuming watch"
+            sleep 300
+            continue
         fi
         echo "$(date -u +%H:%M:%S) queue complete: all stages passed"
         exit 0
