@@ -105,15 +105,29 @@ def test_pp_yaml_config_reaches_engine():
     assert cfg.pp == 2 and cfg.tp == 1
 
 
-def test_warmup_engine_matches_cold():
-    """warmup=True precompiles every bucket program without disturbing
-    engine state: greedy outputs match a cold engine token-for-token."""
-    cold = run_tokens(make_cfg(max_batch=2, max_context=128,
-                               prefill_chunk=32, decode_steps=2), 1)
-    warm = run_tokens(make_cfg(max_batch=2, max_context=128,
-                               prefill_chunk=32, decode_steps=2,
-                               warmup=True), 1)
+@pytest.mark.parametrize("pp,nd", [(1, 1), (2, 2)])
+def test_warmup_engine_matches_cold(pp, nd):
+    """warmup=True precompiles EVERY bucket program (staged variants when
+    pp>1) without disturbing engine state: the program caches are full
+    before the first request, no new programs compile while serving, and
+    greedy outputs match a cold engine token-for-token."""
+    kw = dict(max_batch=2, max_context=128, prefill_chunk=32,
+              decode_steps=2, pp=pp)
+    cold = run_tokens(make_cfg(**kw), nd)
+
+    core = EngineCore(make_cfg(**kw, warmup=True), jax.devices()[:nd])
+    assert set(core._decode_fns) == set(core.s_buckets)
+    n_prefill = (len(core.b_buckets) * len(core.c_buckets)
+                 * len(core.s_buckets))
+    assert len(core._prefill_batch_fns) == n_prefill
+    for i, (prompt, mt) in enumerate(PROMPTS):
+        core.submit(f"s{i}", req(prompt, max_tokens=mt))
+    got = drain(core, [f"s{i}" for i in range(len(PROMPTS))])
+    warm = {s: [g.token for g in outs] for s, outs in got.items()}
     assert warm == cold
+    # serving touched no bucket combination warmup missed
+    assert len(core._prefill_batch_fns) == n_prefill
+    assert set(core._decode_fns) == set(core.s_buckets)
 
 
 def test_pp_rejects_bad_combos():
