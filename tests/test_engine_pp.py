@@ -31,12 +31,15 @@ PROMPTS = [
 ]
 
 
-def run_tokens(cfg, n_devices):
-    core = EngineCore(cfg, jax.devices()[:n_devices])
+def run_on(core):
     for i, (prompt, mt) in enumerate(PROMPTS):
         core.submit(f"s{i}", req(prompt, max_tokens=mt))
     got = drain(core, [f"s{i}" for i in range(len(PROMPTS))])
     return {s: [g.token for g in outs] for s, outs in got.items()}
+
+
+def run_tokens(cfg, n_devices):
+    return run_on(EngineCore(cfg, jax.devices()[:n_devices]))
 
 
 def test_pp2_matches_pp1():
@@ -105,25 +108,22 @@ def test_pp_yaml_config_reaches_engine():
     assert cfg.pp == 2 and cfg.tp == 1
 
 
-@pytest.mark.parametrize("pp,nd", [(1, 1), (2, 2)])
-def test_warmup_engine_matches_cold(pp, nd):
+@pytest.mark.parametrize("pp", [1, 2])
+def test_warmup_engine_matches_cold(pp):
     """warmup=True precompiles EVERY bucket program (staged variants when
     pp>1) without disturbing engine state: the program caches are full
     before the first request, no new programs compile while serving, and
     greedy outputs match a cold engine token-for-token."""
     kw = dict(max_batch=2, max_context=128, prefill_chunk=32,
               decode_steps=2, pp=pp)
-    cold = run_tokens(make_cfg(**kw), nd)
+    cold = run_tokens(make_cfg(**kw), pp)
 
-    core = EngineCore(make_cfg(**kw, warmup=True), jax.devices()[:nd])
+    core = EngineCore(make_cfg(**kw, warmup=True), jax.devices()[:pp])
     assert set(core._decode_fns) == set(core.s_buckets)
     n_prefill = (len(core.b_buckets) * len(core.c_buckets)
                  * len(core.s_buckets))
     assert len(core._prefill_batch_fns) == n_prefill
-    for i, (prompt, mt) in enumerate(PROMPTS):
-        core.submit(f"s{i}", req(prompt, max_tokens=mt))
-    got = drain(core, [f"s{i}" for i in range(len(PROMPTS))])
-    warm = {s: [g.token for g in outs] for s, outs in got.items()}
+    warm = run_on(core)
     assert warm == cold
     # serving touched no bucket combination warmup missed
     assert len(core._prefill_batch_fns) == n_prefill

@@ -116,3 +116,18 @@ def test_gguf_card_uses_sp_tokenizer(tmp_path):
     ds = DecodeStream(tok)
     text = "".join(ds.step(t) for t in ids)
     assert text == tok.decode(ids)
+
+
+def test_byte_fallback_streams_without_torn_utf8():
+    """DecodeStream over SP byte-fallback tokens: a multi-byte char split
+    across <0x..> tokens must not emit a torn replacement char mid-stream;
+    the concatenation equals the full decode."""
+    from dynamo_tpu.llm.tokenizer import DecodeStream
+
+    tok = make_tok(add_bos=False)
+    ids = tok.encode("Hello é!")     # é -> two byte tokens
+    ds = DecodeStream(tok)
+    chunks = [ds.step(t) for t in ids]
+    assert "".join(chunks) == tok.decode(ids) == " Hello é!"
+    # no chunk ever contained a replacement character
+    assert all("�" not in c for c in chunks), chunks
