@@ -151,6 +151,22 @@ async def test_full_graph_jax_worker_kv_routing():
                 assert r.status == 200
                 d2 = await r.json()
             assert d2["choices"][0]["text"] == d1["choices"][0]["text"]
+
+        # the router's index must actually HOLD the prefix: a direct route
+        # query for the served tokens reports nonzero overlap. (Regression
+        # guard for block-size drift between the engine's kv-event pages
+        # and the router index — a mismatch silently zeroes every overlap
+        # and degrades routing to load-only.)
+        rcl = await hdrt.namespace("dyn").component("router") \
+            .endpoint("route").client().start()
+        overlap = 0
+        for _ in range(40):        # kv events propagate asynchronously
+            async for item in rcl.generate({"token_ids": body["prompt"]}):
+                overlap = item["overlap_blocks"]
+            if overlap > 0:
+                break
+            await asyncio.sleep(0.1)
+        assert overlap > 0, "router index never matched the served prefix"
         await svc.stop()
     finally:
         for t in tasks:
