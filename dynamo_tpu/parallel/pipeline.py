@@ -8,10 +8,11 @@ stage-to-stage with ``lax.ppermute`` (neighbor ICI links), and after
 ``M + pp - 1`` steps every microbatch has traversed every stage. Steady-
 state utilization is M/(M+pp-1); the bubble shrinks as microbatches grow.
 
-The engine currently serves tp/sp/ep meshes; wiring pp into the serving
-step (stage-assigned KV pools + per-stage page tables) is the planned
-follow-up, the same staging ring attention went through — implemented and
-validated here first, then engine-reachable.
+pp is engine-served: ``JaxEngineConfig.pp`` builds the pp(×tp) mesh and
+the serving prefill/decode programs run the staged path with params AND
+paged KV pools sharded on the layer dim (``models/llama.py`` forward_pp /
+forward_decode_pp; docs/pipeline_parallel.md). This module holds the
+standalone staged-matmul pipeline primitive and its schedule tests.
 
 Reference capability: pipeline parallelism the reference delegates to vLLM
 multinode (SURVEY §2.5: pipeline_parallel_size = num_nodes, vllm_inc.py:38),
