@@ -46,3 +46,22 @@ def test_incremental_append_matches_bulk():
     inc = TokenSequence(block_size=4)
     sealed = [inc.append(t) for t in range(8)]
     assert [b for b in sealed if b] == bulk.blocks
+
+
+def test_apply_penalties_formula():
+    """Unit pin of the OpenAI penalty formula: logits - freq*count -
+    pres*(count>0), exact no-op at zero penalties."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine.sampling import apply_penalties
+
+    logits = jnp.asarray([[1.0, 2.0, 3.0, 4.0]], jnp.float32)
+    counts = jnp.asarray([[0, 1, 3, 0]], jnp.int32)
+    out = apply_penalties(logits, counts,
+                          jnp.asarray([0.5]), jnp.asarray([2.0]))
+    np.testing.assert_allclose(
+        np.asarray(out), [[1.0, 2.0 - 0.5 - 2.0, 3.0 - 1.5 - 2.0, 4.0]])
+    noop = apply_penalties(logits, counts,
+                           jnp.asarray([0.0]), jnp.asarray([0.0]))
+    assert (np.asarray(noop) == np.asarray(logits)).all()
