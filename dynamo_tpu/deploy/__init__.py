@@ -5,14 +5,22 @@ Reference capability: deploy/dynamo/operator (Go CRDs + controllers),
 deploy/dynamo/api-store (FastAPI artifact store), deploy/dynamo/helm and
 deploy/Kubernetes (charts). Re-designed for this stack: desired state lives
 in dynstore (the discovery plane we already run), the operator reconciles it
-into local worker processes or renders k8s manifests for a real cluster, and
-the artifact store is an aiohttp service over a content directory.
+into local worker processes, and :mod:`kube` reconciles rendered manifests
+against a Kubernetes API (server-side apply, owner-ref GC, conditions). The
+artifact store is an aiohttp service over pluggable object storage
+(:mod:`object_store`: local filesystem or S3-compatible); :mod:`imagebuild`
+packages graph sources into OCI build contexts.
 """
 
 from .crd import Condition, Deployment, DeploymentSpec, DeploymentStatus, ServiceSpec
+from .kube import FakeKubeApi, KubeReconciler
+from .object_store import LocalFsStore, MinioStub, ObjectStore, S3Store, open_object_store
 from .operator import FakeRunner, LocalRunner, Operator
 
 __all__ = [
     "Condition", "Deployment", "DeploymentSpec", "DeploymentStatus",
     "ServiceSpec", "Operator", "LocalRunner", "FakeRunner",
+    "KubeReconciler", "FakeKubeApi",
+    "ObjectStore", "LocalFsStore", "S3Store", "MinioStub",
+    "open_object_store",
 ]
