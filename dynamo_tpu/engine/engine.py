@@ -106,6 +106,16 @@ class JaxEngineConfig:
             mcfg = llama.LlamaConfig.from_hf_config(card.model_config)
         elif extra.get("preset"):
             mcfg = llama.preset(extra["preset"])
+        elif card.path and (gpath := _gguf_file(card.path)):
+            # GGUF cards carry no HF config dict — the model shape lives in
+            # the container metadata; sizing from a preset here would build
+            # sampler state (penalty counts) at the wrong vocab width
+            from ..llm.gguf import read_gguf
+            g = read_gguf(gpath)
+            try:
+                mcfg = g.llama_config()
+            finally:
+                g.close()
         else:
             mcfg = llama.preset("tiny-byte")
         kw = dict(

@@ -147,13 +147,18 @@ def _special_ids_from_config(path: str, tok: "HfTokenizer") -> Tuple[List[int], 
 def load_tokenizer(path_or_kind: str) -> Tokenizer:
     """``"byte"`` → ByteTokenizer; ``"gguf-sp:<file.gguf>"`` → the native
     SentencePiece tokenizer built from the GGUF's embedded SPM vocab;
-    otherwise a local HF tokenizer directory."""
+    ``"gguf-bpe:<file.gguf>"`` → the native byte-level BPE tokenizer from
+    the GGUF's tokens+merges; otherwise a local HF tokenizer directory."""
     if path_or_kind == "byte":
         return ByteTokenizer()
     if path_or_kind.startswith("gguf-sp:"):
         from .sp_tokenizer import SpTokenizer
 
         return SpTokenizer.from_gguf(path_or_kind[len("gguf-sp:"):])
+    if path_or_kind.startswith("gguf-bpe:"):
+        from .bpe_tokenizer import BpeTokenizer
+
+        return BpeTokenizer.from_gguf(path_or_kind[len("gguf-bpe:"):])
     return HfTokenizer(path_or_kind)
 
 

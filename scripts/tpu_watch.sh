@@ -35,14 +35,21 @@ while true; do
             tail -c 1000 /tmp/bench_tpu_err.log
             failed=1
         else
+            # deposit in the repo so the window's result survives as a
+            # round artifact even if nobody is watching the log
+            cp /tmp/bench_tpu_out.json TPU_BENCH.json
             tail -c 2000 /tmp/bench_tpu_out.json
             echo
         fi
 
         echo "$(date -u +%H:%M:%S) running perf_probe..."
-        timeout 900 python scripts/perf_probe.py 2>&1 | tail -30
+        timeout 900 python scripts/perf_probe.py 2>&1 | tee /tmp/perf_probe.log | tail -30
         rc=${PIPESTATUS[0]}
-        [ "$rc" -ne 0 ] && { echo "perf_probe FAILED (rc=$rc)"; failed=1; }
+        if [ "$rc" -ne 0 ]; then
+            echo "perf_probe FAILED (rc=$rc)"; failed=1
+        else
+            cp /tmp/perf_probe.log TPU_PERF.log
+        fi
 
         if [ "$failed" -ne 0 ]; then
             # disambiguate: if the tunnel is GONE the failure was the drop

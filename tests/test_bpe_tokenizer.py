@@ -1,0 +1,172 @@
+"""Native byte-level BPE tokenizer from GGUF metadata (VERDICT r3 missing #2).
+
+Reference capability: lib/llm/src/gguf/gguf_tokenizer.rs:121-125,234-283 —
+``tokenizer.ggml.model = "gpt2"`` builds an HF byte-level BPE from the
+embedded tokens+merges.  These tests pin the native implementation token-
+for-token against the HF ``tokenizers`` library building the SAME model
+from the SAME vocab/merges (exactly what the reference constructs), and
+pin the hard-error path for unrecognized tokenizer models.
+"""
+
+import json
+
+import pytest
+
+from dynamo_tpu.llm.bpe_tokenizer import (BpeTokenizer, _TYPE_CONTROL,
+                                          _TYPE_NORMAL, _bytes_to_unicode)
+from dynamo_tpu.llm.gguf import write_gguf
+
+
+def _train_vocab_merges(corpus):
+    """Train a small byte-level BPE with the HF tokenizers library and
+    return (tokens, merges) in GGUF metadata form (id order / rank order)."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders
+    from tokenizers.trainers import BpeTrainer
+
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = BpeTrainer(vocab_size=400, special_tokens=["<|endoftext|>"],
+                         initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+                         show_progress=False)
+    tok.train_from_iterator(corpus, trainer)
+    blob = json.loads(tok.to_str())
+    vocab = blob["model"]["vocab"]
+    merges = blob["model"]["merges"]
+    tokens = [None] * len(vocab)
+    for t, i in vocab.items():
+        tokens[i] = t
+    merges = [m if isinstance(m, str) else " ".join(m) for m in merges]
+    return tok, tokens, merges
+
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "import numpy as np\nprint(np.zeros(3))",
+    "Hello, world! Tokenizers are fun; don't they think so?",
+    "2048 tokens × 4 layers = plenty",
+    "   leading spaces and\ttabs\nand newlines",
+]
+
+TEXTS = CORPUS + [
+    "unseen: zebra quartz vex 42!",
+    "don't stop",
+    "  spaced   out  ",
+    "mixed 314 numbers42x",
+    "newline\n\n\ndense",
+    "unicode: héllo wörld — ☃",
+    "",
+]
+
+
+def test_matches_hf_byte_level_bpe_token_for_token():
+    hf, tokens, merges = _train_vocab_merges(CORPUS)
+    types = [_TYPE_CONTROL] + [_TYPE_NORMAL] * (len(tokens) - 1)
+    nat = BpeTokenizer(tokens, merges, types=types, eos_id=0)
+    for text in TEXTS:
+        want = hf.encode(text).ids
+        got = nat.encode(text)
+        assert got == want, (text, got, want)
+        assert nat.decode(got) == hf.decode(want, skip_special_tokens=True)
+
+
+def test_roundtrip_exact():
+    _, tokens, merges = _train_vocab_merges(CORPUS)
+    nat = BpeTokenizer(tokens, merges, eos_id=0)
+    for text in TEXTS:
+        assert nat.decode(nat.encode(text)) == text
+
+
+def test_special_tokens_encode_to_single_id():
+    _, tokens, merges = _train_vocab_merges(CORPUS)
+    types = [_TYPE_CONTROL] + [_TYPE_NORMAL] * (len(tokens) - 1)
+    nat = BpeTokenizer(tokens, merges, types=types, eos_id=0)
+    ids = nat.encode("foo<|endoftext|>bar")
+    assert 0 in ids  # the control token id, not its character split
+    # control tokens render empty on decode
+    assert nat.decode([0]) == ""
+
+
+def test_qwen2_pre_pattern_splits_numbers_per_digit():
+    _, tokens, merges = _train_vocab_merges(CORPUS)
+    gpt2 = BpeTokenizer(tokens, merges, pre="default")
+    qwen = BpeTokenizer(tokens, merges, pre="qwen2")
+    # qwen2 pattern tokenizes digit-by-digit; gpt2 groups runs of digits
+    assert len(qwen.encode("31415926")) >= len(gpt2.encode("31415926"))
+    # both round-trip
+    assert qwen.decode(qwen.encode("pi is 3.14159")) == "pi is 3.14159"
+
+
+def test_from_gguf_and_card_wiring(tmp_path):
+    import numpy as np
+
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    _, tokens, merges = _train_vocab_merges(CORPUS)
+    meta = {
+        "general.architecture": "qwen2",
+        "qwen2.context_length": 2048,
+        "tokenizer.ggml.model": "gpt2",
+        "tokenizer.ggml.tokens": tokens,
+        "tokenizer.ggml.merges": merges,
+        "tokenizer.ggml.token_type": (
+            [_TYPE_CONTROL] + [_TYPE_NORMAL] * (len(tokens) - 1)),
+        "tokenizer.ggml.eos_token_id": 0,
+        "tokenizer.ggml.bos_token_id": 0,
+    }
+    p = tmp_path / "m.gguf"
+    write_gguf(str(p), meta, {"tok": np.zeros((4,), np.float32)})
+    card = ModelDeploymentCard.from_gguf(str(p))
+    assert card.tokenizer == f"gguf-bpe:{p}"
+    assert card.eos_token_ids == [0]
+
+    from dynamo_tpu.llm.tokenizer import load_tokenizer
+
+    tok = load_tokenizer(card.tokenizer)
+    assert isinstance(tok, BpeTokenizer)
+    assert tok.decode(tok.encode("the quick fox")) == "the quick fox"
+
+
+def test_unknown_tokenizer_model_is_hard_error(tmp_path):
+    import numpy as np
+
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    meta = {
+        "general.architecture": "qwen2",
+        "tokenizer.ggml.model": "wordpiece-nonsense",
+        "tokenizer.ggml.tokens": ["a", "b"],
+    }
+    p = tmp_path / "bad.gguf"
+    write_gguf(str(p), meta, {"tok": np.zeros((4,), np.float32)})
+    with pytest.raises(ValueError, match="wordpiece-nonsense"):
+        ModelDeploymentCard.from_gguf(str(p))
+
+
+def test_tokens_without_model_is_hard_error(tmp_path):
+    import numpy as np
+
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    meta = {
+        "general.architecture": "llama",
+        "tokenizer.ggml.tokens": ["a", "b"],   # vocab but no model decl
+    }
+    p = tmp_path / "nomodel.gguf"
+    write_gguf(str(p), meta, {"tok": np.zeros((4,), np.float32)})
+    with pytest.raises(ValueError, match="tokenizer.ggml.model"):
+        ModelDeploymentCard.from_gguf(str(p))
+
+
+def test_missing_merges_is_hard_error():
+    with pytest.raises(ValueError, match="merges"):
+        BpeTokenizer.from_gguf_metadata({
+            "tokenizer.ggml.model": "gpt2",
+            "tokenizer.ggml.tokens": ["a", "b"],
+        })
+
+
+def test_byte_table_is_reversible():
+    t = _bytes_to_unicode()
+    assert len(t) == 256
+    assert len(set(t.values())) == 256

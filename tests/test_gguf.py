@@ -54,6 +54,9 @@ def tiny_gguf(path, cfg):
         "llama.context_length": cfg.max_position,
         "llama.vocab_size": cfg.vocab_size,
         "tokenizer.ggml.tokens": [f"tok{i}" for i in range(cfg.vocab_size)],
+        # explicit byte-vocab declaration: tokens-without-model is now a
+        # hard error in from_gguf (no silent byte-tokenizer degradation)
+        "tokenizer.ggml.model": "dynamo-byte",
     }
     write_gguf(str(path), meta, tensors)
     return params
