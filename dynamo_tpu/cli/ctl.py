@@ -21,7 +21,7 @@ import asyncio
 import json
 
 from ..llm.model_card import ModelDeploymentCard
-from ..llm.remote import list_models, model_key, register_model, unregister_model
+from ..llm.remote import list_models, register_model, unregister_model
 from ..runtime.store_client import StoreClient
 
 

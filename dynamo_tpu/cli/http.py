@@ -23,7 +23,7 @@ from typing import Dict, Optional
 from ..llm.http_service import HttpService, ModelManager, ServedModel
 from ..llm.model_card import ModelDeploymentCard
 from ..llm.pipeline import OpenAIChatEngine, OpenAICompletionEngine
-from ..llm.remote import MODEL_PREFIX, RemoteCoreEngine
+from ..llm.remote import MODEL_PREFIX, RemoteCoreEngine, split_model_key
 from ..runtime.component import Client, DistributedRuntime
 
 log = logging.getLogger("dynamo_tpu.http")
@@ -37,7 +37,10 @@ class DiscoveryFrontend:
         self.router_component = router_component
         self._clients: Dict[str, Client] = {}       # endpoint path -> client
         self._router_clients: Dict[str, Client] = {}
-        self._model_types: Dict[str, set] = {}
+        # (name, mtype) -> live registration store-keys. A model serves as
+        # long as ANY registrant lives (replicas register under per-lease
+        # keys; one replica dying must not unserve the others).
+        self._registrations: Dict[tuple, set] = {}
 
     async def start(self) -> None:
         await self.drt.store.watch_prefix(MODEL_PREFIX, self._on_change)
@@ -66,16 +69,28 @@ class DiscoveryFrontend:
     async def _on_change(self, key: str, value: Optional[bytes],
                          deleted: bool) -> None:
         try:
-            parts = key[len(MODEL_PREFIX):].split("/", 1)
-            if len(parts) != 2:
+            mt_name = split_model_key(key)
+            if mt_name is None:
                 return
-            mtype, name = parts
+            mtype, name = mt_name
             if deleted:
-                types = self._model_types.get(name, set())
-                types.discard(mtype)
-                if not types:
-                    self.manager.remove(name)
-                    self._model_types.pop(name, None)
+                regs = self._registrations.get((name, mtype))
+                if regs is not None:
+                    regs.discard(key)
+                    if regs:
+                        return      # surviving registrants keep serving
+                    self._registrations.pop((name, mtype), None)
+                served = self.manager.get(name)
+                if served is not None:
+                    if mtype == "chat":
+                        served.chat_engine = None
+                    else:
+                        served.completion_engine = None
+                    if (served.chat_engine is None
+                            and served.completion_engine is None):
+                        self.manager.remove(name)
+                        log.info("model %s removed (no registrants left)",
+                                 name)
                 return
             d = json.loads(value.decode())
             card = ModelDeploymentCard.from_dict(d["card"])
@@ -89,7 +104,7 @@ class DiscoveryFrontend:
                 served.completion_engine = OpenAICompletionEngine(card, core)
             served.card = card
             self.manager.add(served)
-            self._model_types.setdefault(name, set()).add(mtype)
+            self._registrations.setdefault((name, mtype), set()).add(key)
             log.info("model %s (%s) -> %s", name, mtype, d["endpoint"])
         except Exception:
             log.exception("model discovery update failed for %s", key)

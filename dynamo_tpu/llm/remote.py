@@ -13,7 +13,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import AsyncIterator, Optional
+import re
+from typing import AsyncIterator, Optional, Tuple
 
 from ..runtime.component import Client, Endpoint
 from ..runtime.engine import AsyncEngine, Context, EngineError
@@ -22,11 +23,33 @@ from .model_card import ModelDeploymentCard
 
 log = logging.getLogger("dynamo_tpu.remote")
 
-MODEL_PREFIX = "models/"  # store keys: models/{chat|completion}/{name}
+# store keys: models/{chat|completion}/{name}[:i-{lease_hex}]
+# Lease-bound registrations are per-instance (suffixed with the worker's
+# lease id, ref endpoint.rs `{key}:{lease_id_hex}`): replicas of one model
+# must not overwrite each other's liveness binding, or the model drops for
+# everyone when the LAST registrant dies — not when ALL of them have.
+# The ``:i-`` marker keeps the suffix parse unambiguous for model names
+# that themselves contain ':' (e.g. ollama-style "llama3:8b").
+MODEL_PREFIX = "models/"
+
+_LEASE_SUFFIX = re.compile(r":i-[0-9a-f]+$")
 
 
-def model_key(model_type: str, name: str) -> str:
-    return f"{MODEL_PREFIX}{model_type}/{name}"
+def model_key(model_type: str, name: str,
+              lease: Optional[int] = None) -> str:
+    base = f"{MODEL_PREFIX}{model_type}/{name}"
+    return f"{base}:i-{lease:x}" if lease is not None else base
+
+
+def split_model_key(key: str) -> Optional[Tuple[str, str]]:
+    """``models/chat/m:i-1f`` → ("chat", "m"); None for foreign keys."""
+    if not key.startswith(MODEL_PREFIX):
+        return None
+    parts = key[len(MODEL_PREFIX):].split("/", 1)
+    if len(parts) != 2:
+        return None
+    mtype, rest = parts
+    return mtype, _LEASE_SUFFIX.sub("", rest)
 
 
 class RemoteCoreEngine(AsyncEngine[BackendInput, EngineOutput]):
@@ -79,11 +102,17 @@ async def register_model(store, card: ModelDeploymentCard,
 
     payload = json.dumps({"card": card.to_dict(),
                           "endpoint": endpoint_path}).encode()
-    await store.put(model_key(model_type, card.name), payload, lease=lease)
+    await store.put(model_key(model_type, card.name, lease=lease),
+                    payload, lease=lease)
 
 
 async def unregister_model(store, name: str, model_type: str = "chat") -> None:
-    await store.delete(model_key(model_type, name))
+    """llmctl remove: drop the manual entry and every per-instance one."""
+    base = model_key(model_type, name)
+    await store.delete(base)
+    for key, _ in await store.get_prefix(base + ":i-"):
+        if _LEASE_SUFFIX.search(key):   # never sweep a ':'-containing name
+            await store.delete(key)
 
 
 async def list_models(store):
@@ -91,9 +120,11 @@ async def list_models(store):
 
     out = []
     for key, value in await store.get_prefix(MODEL_PREFIX):
+        mt_name = split_model_key(key)
+        if mt_name is None:
+            continue
         d = json.loads(value.decode())
-        _, mtype, name = key.split("/", 2)
-        out.append({"name": name, "type": mtype,
+        out.append({"name": mt_name[1], "type": mt_name[0],
                     "endpoint": d["endpoint"],
                     "card": d.get("card")})
     return out

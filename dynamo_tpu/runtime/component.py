@@ -594,12 +594,23 @@ class Client:
                 live["writer"] = writer
 
                 req_control = {**base_control, "endpoint": info.endpoint}
-                # first exchange: on a pooled connection the server may have
-                # closed it while idle — reopen fresh and resend once. (If
-                # the server instead died MID-request, the resend could
-                # double-execute; the server's duplicate-context guard turns
-                # that rare race into a clean error.)
-                attempts = 2 if pooled is not None else 1
+                # First exchange (request out, first frame back). Failures
+                # here — before ANY response frame was consumed — get one
+                # same-instance retry on a fresh connection: a pooled socket
+                # the server closed while idle resends harmlessly, and a
+                # server that died mid-request is de-duped by its
+                # duplicate-context guard (409) if it is in fact alive.
+                # If the retry's CONNECT is refused, the process is gone —
+                # a dead process cannot double-execute, and no frame was
+                # yielded to the caller — so re-dispatching to another
+                # instance is provably safe, mirroring the connect-refused
+                # failover above. (Churn soak failure class: without this,
+                # every request whose first frame raced a worker death
+                # surfaced as a 503 even though another worker could serve
+                # it.) parts-streaming requests can't replay a partially
+                # consumed body: no retry, no failover.
+                attempts = 2 if parts is None else 1
+                refused_mid_exchange = False
                 for attempt in range(attempts):
                     try:
                         await write_frame(writer, [req_control, req_payload])
@@ -619,24 +630,40 @@ class Client:
                             raise EngineError(
                                 f"connection to {info.host}:{info.port} "
                                 f"failed: {e}", 503) from e
-                        # stale pooled socket (server closed it while idle):
-                        # same-instance retry on a fresh connection — the
-                        # server's duplicate-context guard de-dupes the rare
-                        # died-mid-request case
                         try:
                             reader, writer = await asyncio.open_connection(
                                 info.host, info.port)
+                        except ConnectionRefusedError as e2:
+                            # REFUSED specifically proves the process is
+                            # gone (closed listening port) — other OSErrors
+                            # (fd exhaustion, transient routing) are
+                            # client-side and the worker may still be
+                            # executing the delivered request, where a
+                            # cross-instance re-dispatch could double-
+                            # execute. Drop its pooled sockets and — unless
+                            # the caller pinned this instance — fail over
+                            # like a refused first connect.
+                            _fail()
+                            if mode == "direct":
+                                raise EngineError(
+                                    f"instance {iid:x} at {info.host}:"
+                                    f"{info.port} unreachable: {e2}",
+                                    503) from e2
+                            log.debug("failover: instance %x died mid-"
+                                      "exchange (reconnect refused), "
+                                      "re-dispatching ctx %s", iid, ctx.id)
+                            refused_mid_exchange = True
+                            break
                         except OSError as e2:
-                            # process gone: every remaining pooled socket to
-                            # it is equally dead — drop them so the NEXT
-                            # request takes the connect-refused failover
-                            # path instead of another stale-pool 503
                             _fail()
                             raise EngineError(
                                 f"instance {iid:x} at {info.host}:"
-                                f"{info.port} unreachable: {e2}", 503) from e2
+                                f"{info.port} unreachable: {e2}",
+                                503) from e2
                         fr = FrameReader(reader)
                         live["writer"] = writer
+                if refused_mid_exchange:
+                    continue
                 break
         except BaseException:
             stopper.cancel()

@@ -102,6 +102,83 @@ async def test_full_graph_echo_workers():
         await store.stop()
 
 
+async def test_replica_death_keeps_model_served():
+    """Two replicas register one model; killing either must NOT unserve it.
+
+    Regression: replicas used to share one ``models/{type}/{name}`` store
+    key, each rebinding it to their own lease — whichever registered LAST
+    owned the key, so that worker's death dropped the model for everyone
+    (404) while a live replica kept serving. Registrations are now
+    per-instance (``:{lease_hex}``, ref endpoint.rs key shape) and the
+    frontend refcounts them."""
+    store = StoreServer()
+    port = await store.start()
+    tasks, drts = [], []
+    try:
+        for i in range(2):
+            drt = await DistributedRuntime(
+                store_port=port, advertise_host="127.0.0.1").connect()
+            drts.append(drt)
+            tasks.append(await spawn(run_worker, worker_args(port), drt))
+        hdrt = await DistributedRuntime(store_port=port).connect()
+        drts.append(hdrt)
+        hargs = argparse.Namespace(store=f"127.0.0.1:{port}",
+                                   host="127.0.0.1", port=0,
+                                   router_component=None)
+        svc = await run_http(hargs, drt=hdrt)
+        base = f"http://127.0.0.1:{svc.port}"
+
+        async with aiohttp.ClientSession() as s:
+            for _ in range(50):
+                async with s.get(f"{base}/v1/models") as r:
+                    models = await r.json()
+                if models["data"]:
+                    break
+                await asyncio.sleep(0.1)
+            assert models["data"], "model never discovered"
+
+            # kill each replica in turn — registration order must not
+            # matter (the old bug only fired for the LAST registrant)
+            for victim_idx in (1, 0):
+                await drts[victim_idx].close()
+                tasks[victim_idx].cancel()
+                if victim_idx == 1:
+                    # one replica still alive: model stays served and
+                    # requests still complete
+                    await asyncio.sleep(0.3)
+                    async with s.get(f"{base}/v1/models") as r:
+                        models = await r.json()
+                    assert models["data"], \
+                        "model dropped while a replica is still alive"
+                    body = {"model": "m1",
+                            "messages": [{"role": "user",
+                                          "content": "still here"}],
+                            "ext": {"use_raw_prompt": True}}
+                    async with s.post(f"{base}/v1/chat/completions",
+                                      json=body) as r:
+                        assert r.status == 200, await r.text()
+                        data = await r.json()
+                    assert (data["choices"][0]["message"]["content"]
+                            == "still here")
+                else:
+                    # last registrant gone: the model must now disappear
+                    for _ in range(50):
+                        async with s.get(f"{base}/v1/models") as r:
+                            models = await r.json()
+                        if not models["data"]:
+                            break
+                        await asyncio.sleep(0.1)
+                    assert not models["data"], \
+                        "model still served with zero registrants"
+        await svc.stop()
+    finally:
+        for t in tasks:
+            t.cancel()
+        for d in drts:
+            await d.close()
+        await store.stop()
+
+
 async def test_full_graph_jax_worker_kv_routing():
     """JAX worker publishes KV events; the router index fills; routing pins
     repeat prefixes to the same worker."""

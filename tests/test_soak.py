@@ -74,7 +74,21 @@ async def test_churn_soak():
                 next_id += 1
             await asyncio.wait_for(asyncio.gather(*burst), 30)
 
-        # every request terminated, most succeeded
+        # Every request terminated; the ≥45/60 bound is derived, not tuned:
+        # only MID-STREAM victim deaths may fail (a request that already
+        # consumed ≥1 frame from the killed worker cannot be re-dispatched
+        # without replaying a partially-yielded stream — ref semantics:
+        # "stream just errors", lib/runtime/src/component/client.rs).
+        # Everything earlier fails over: connect refused, stale pooled
+        # socket, and (since round 4) a first exchange whose same-instance
+        # reconnect probe is refused — a dead process can't double-execute,
+        # so re-dispatch is provably safe. Per kill round ~10 requests are
+        # in flight, routed uniformly over 3 workers: victim hits ~
+        # Binomial(10, 1/3), mean 3.33, σ 1.49; mid-stream deaths are a
+        # subset. Three kill rounds: mean ≤ 10 failures, σ ≤ 2.58, so 15
+        # failures is ≥ +1.9σ above the worst-case mean (P < ~3%), and the
+        # slack only grows under load because contention widens the
+        # PRE-first-frame window, which now fails over instead of failing.
         total = stats["ok"] + stats["failed"]
         assert total == 60
         assert stats["ok"] >= 45, stats
