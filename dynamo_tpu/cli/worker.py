@@ -202,7 +202,8 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
             # order) without adding a lock.
             host = core.tiered
             prefix_hit = core.pool.probe_prefix(
-                bi.token_ids, (lambda h: h in host) if host else None)
+                bi.token_ids, (lambda h: h in host) if host else None,
+                lora_id=bi.lora_id)
             remote = False
             if drouter.length_exceeds_local(len(bi.token_ids), prefix_hit):
                 # only candidates pay the queue-depth RPC

@@ -12,15 +12,20 @@ artifact store is an aiohttp service over pluggable object storage
 packages graph sources into OCI build contexts.
 """
 
-from .crd import Condition, Deployment, DeploymentSpec, DeploymentStatus, ServiceSpec
+from .crd import (Condition, Deployment, DeploymentSpec, DeploymentStatus,
+                  IngressSpec, ServiceSpec)
 from .kube import FakeKubeApi, KubeReconciler
+from .manifests import render_envoy_config, render_ingress, render_manifests
 from .object_store import LocalFsStore, MinioStub, ObjectStore, S3Store, open_object_store
 from .operator import FakeRunner, LocalRunner, Operator
+from .rest_api import KubeApiError, RestKubeApi, register_kind
 
 __all__ = [
     "Condition", "Deployment", "DeploymentSpec", "DeploymentStatus",
-    "ServiceSpec", "Operator", "LocalRunner", "FakeRunner",
+    "ServiceSpec", "IngressSpec", "Operator", "LocalRunner", "FakeRunner",
     "KubeReconciler", "FakeKubeApi",
+    "RestKubeApi", "KubeApiError", "register_kind",
+    "render_manifests", "render_ingress", "render_envoy_config",
     "ObjectStore", "LocalFsStore", "S3Store", "MinioStub",
     "open_object_store",
 ]

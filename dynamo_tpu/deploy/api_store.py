@@ -129,8 +129,24 @@ class ApiStore:
             except ValueError:
                 pass
         version = floor + 1
-        await self.objects.put(counter_key, str(version + 1).encode())
-        await self.objects.put(f"{name}/{version}", data)
+        # The asyncio lock serializes allocation within ONE api-store
+        # process; a shared backend (S3) with multiple replicas has no CAS
+        # in the ObjectStore interface, so cross-replica races are detected
+        # opportunistically instead: write, re-read, and if another replica
+        # overwrote our version slot (digest mismatch) move to the next
+        # number. Both racers converge — the overwritten one retries, the
+        # surviving one verifies its own digest. Deploy one api-store per
+        # bucket to avoid even this window.
+        for _ in range(8):
+            await self.objects.put(counter_key, str(version + 1).encode())
+            await self.objects.put(f"{name}/{version}", data)
+            echo = await self.objects.get(f"{name}/{version}")
+            if echo is not None \
+                    and hashlib.sha256(echo).hexdigest() == digest:
+                break
+            version += 1
+        else:
+            raise web.HTTPConflict(text="version allocation kept racing")
         meta = {"version": version, "sha256": digest, "size": len(data),
                 "uploaded": time.time()}
         await self.objects.put(f"{name}/{version}.json",

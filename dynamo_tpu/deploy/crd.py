@@ -55,16 +55,66 @@ class ServiceSpec:
 
 
 @dataclass
+class IngressSpec:
+    """External traffic for the graph's HTTP frontend (reference renders
+    Ingress + an Envoy header-routed debug/production split,
+    deploy/dynamo/operator/internal/envoy/envoy.go)."""
+
+    enabled: bool = False
+    host: Optional[str] = None          # None => match-all virtual host
+    service: str = "Frontend"           # graph service that serves HTTP
+    port: int = 8080
+    path: str = "/"
+    tls_secret: Optional[str] = None
+    annotations: Dict[str, str] = field(default_factory=dict)
+    # Envoy sidecar: requests carrying ``debug_header: debug_value`` route
+    # to the debug backend; everything else to the frontend service
+    envoy: bool = False
+    debug_header: str = "x-dynamo-debug"
+    debug_value: str = "1"
+    debug_service: Optional[str] = None  # None => same service
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"enabled": self.enabled, "host": self.host,
+                "service": self.service, "port": self.port,
+                "path": self.path, "tls_secret": self.tls_secret,
+                "annotations": self.annotations, "envoy": self.envoy,
+                "debug_header": self.debug_header,
+                "debug_value": self.debug_value,
+                "debug_service": self.debug_service}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "IngressSpec":
+        port = int(d.get("port", 8080))
+        if not (0 < port < 65536):
+            raise SpecError(f"ingress.port invalid: {port}")
+        return cls(enabled=bool(d.get("enabled", False)),
+                   host=d.get("host"),
+                   service=str(d.get("service", "Frontend")),
+                   port=port,
+                   path=str(d.get("path", "/")),
+                   tls_secret=d.get("tls_secret"),
+                   annotations={str(k): str(v) for k, v in
+                                (d.get("annotations", {}) or {}).items()},
+                   envoy=bool(d.get("envoy", False)),
+                   debug_header=str(d.get("debug_header", "x-dynamo-debug")),
+                   debug_value=str(d.get("debug_value", "1")),
+                   debug_service=d.get("debug_service"))
+
+
+@dataclass
 class DeploymentSpec:
     graph: str                          # "pkg.module:EntryService" or artifact
     services: Dict[str, ServiceSpec] = field(default_factory=dict)
     store: Optional[str] = None         # host:port of shared dynstore
     platform: str = "auto"              # auto | tpu | cpu
+    ingress: Optional[IngressSpec] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {"graph": self.graph,
                 "services": {k: v.to_dict() for k, v in self.services.items()},
-                "store": self.store, "platform": self.platform}
+                "store": self.store, "platform": self.platform,
+                "ingress": self.ingress.to_dict() if self.ingress else None}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "DeploymentSpec":
@@ -80,6 +130,8 @@ class DeploymentSpec:
                       for k, v in (d.get("services", {}) or {}).items()},
             store=d.get("store"),
             platform=platform,
+            ingress=(IngressSpec.from_dict(d["ingress"])
+                     if d.get("ingress") else None),
         )
 
 

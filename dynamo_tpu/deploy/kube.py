@@ -204,7 +204,7 @@ class KubeReconciler:
                               m["metadata"]["name"]))
 
         # GC: anything owned by this CR that is no longer desired
-        for kind in ("Deployment", "Service", "ConfigMap"):
+        for kind in ("Deployment", "Service", "ConfigMap", "Ingress"):
             for obj in self.api.list(kind, ns):
                 md = obj["metadata"]
                 if not any(r.get("uid") == cr["metadata"]["uid"]
@@ -213,7 +213,11 @@ class KubeReconciler:
                 if (kind, ns, md["name"]) not in desired_keys:
                     self.api.delete(kind, ns, md["name"])
 
-        self.api.resync()
+        # pump the fake's controller sims; a real apiserver's controllers
+        # run on their own, so the adapter has no resync
+        resync = getattr(self.api, "resync", None)
+        if resync is not None:
+            resync()
         return self._update_status(dep, cr)
 
     # ------------------------------------------------------------------

@@ -63,12 +63,13 @@ class LocalFsStore(ObjectStore):
         os.makedirs(root, exist_ok=True)
 
     def _path(self, key: str) -> str:
-        p = os.path.normpath(os.path.join(self.root, key))
-        if not p.startswith(os.path.abspath(self.root) + os.sep) \
-                and p != os.path.abspath(self.root):
-            p2 = os.path.abspath(p)
-            if not p2.startswith(os.path.abspath(self.root)):
-                raise ValueError(f"key escapes root: {key!r}")
+        root = os.path.abspath(self.root)
+        p = os.path.abspath(os.path.normpath(os.path.join(self.root, key)))
+        # commonpath, not startswith: a sibling dir whose name has the
+        # root as a prefix (root=/data/artifacts, key=../artifacts-x/f)
+        # must not pass the escape guard
+        if p != root and os.path.commonpath([root, p]) != root:
+            raise ValueError(f"key escapes root: {key!r}")
         return p
 
     async def put(self, key: str, data: bytes) -> None:

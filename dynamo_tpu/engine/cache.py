@@ -27,7 +27,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..llm.kvbm.pool import DeviceBlockPool, OutOfBlocks
-from ..llm.tokens import TokenSequence, chain_hash, hash_tokens
+from ..llm.tokens import (TokenSequence, chain_hash, hash_tokens,
+                          lora_chain_root)
 
 
 class OutOfPages(RuntimeError):
@@ -58,8 +59,9 @@ class PagePool:
         self.blocks = DeviceBlockPool(num_pages)
         self.blocks.on_evict = self._evicted
         self.seqs: Dict[str, SeqCache] = {}
-        # hook: (seq_id, sealed TokenBlock, page) when a page fills — feeds
-        # the KV event publisher ("stored") for the router index
+        # hook: (seq_id, sealed TokenBlock, page, lora_id) when a page
+        # fills — feeds the KV event publisher ("stored") for the router
+        # index; lora_id is the adapter the sequence was created under
         self.on_block_sealed: Optional[Callable] = None
         # hook: (seq_hashes: List[int]) when sealed blocks leave the device
         # pool — the router "removed" event
@@ -104,11 +106,15 @@ class PagePool:
         return self.free_pages - reserve_pages >= self.pages_needed(prompt_tokens)
 
     # ------------------------------------------------------------------
-    def create(self, seq_id: str, block_hashing: bool = True) -> SeqCache:
+    def create(self, seq_id: str, block_hashing: bool = True,
+               lora_id: int = 0) -> SeqCache:
+        """``lora_id`` salts the block-hash chain so blocks computed under
+        different adapters never alias in reuse or in the router index."""
         if seq_id in self.seqs:
             raise ValueError(f"sequence {seq_id} already exists")
         sc = SeqCache(seq_id,
-                      hashes=TokenSequence(self.page_size) if block_hashing else None)
+                      hashes=(TokenSequence(self.page_size, lora_id=lora_id)
+                              if block_hashing else None))
         self.seqs[seq_id] = sc
         return sc
 
@@ -139,7 +145,8 @@ class PagePool:
                     # router's per-worker refcount balances the single
                     # removed event fired at eviction
                     if registered and self.on_block_sealed:
-                        self.on_block_sealed(sc.seq_id, sealed, page)
+                        self.on_block_sealed(sc.seq_id, sealed, page,
+                                             sc.hashes.lora_id)
         sc.num_tokens += len(tokens)
 
     def extend(self, seq_id: str, tokens: Sequence[int]) -> None:
@@ -178,7 +185,11 @@ class PagePool:
         sc = self.seqs[seq_id]
         assert sc.num_tokens == 0, "match_prefix on a non-empty sequence"
         page_sz = self.page_size
-        parent: Optional[int] = None
+        # the query chain MUST carry the sequence's lora salt: an unsalted
+        # walk would adopt base-model blocks for adapter requests (and
+        # never re-match the adapter's own salted blocks)
+        parent: Optional[int] = lora_chain_root(
+            sc.hashes.lora_id if sc.hashes is not None else 0)
         matched = 0
         uploads: List[Tuple[int, int]] = []
         limit = min(max_tokens, len(prompt))
@@ -205,13 +216,13 @@ class PagePool:
         return matched, uploads
 
     def probe_prefix(self, prompt: Sequence[int],
-                     host_lookup: Optional[Callable[[int], bool]] = None
-                     ) -> int:
+                     host_lookup: Optional[Callable[[int], bool]] = None,
+                     lora_id: int = 0) -> int:
         """Non-claiming prefix probe: how many leading prompt tokens could be
         served from cache right now (device blocks + host tier). Feeds the
         disagg router's prefix_hit input without touching block states."""
         page_sz = self.page_size
-        parent: Optional[int] = None
+        parent: Optional[int] = lora_chain_root(lora_id)
         n = 0
         for start in range(0, len(prompt) - page_sz + 1, page_sz):
             sh = chain_hash(parent,
@@ -236,7 +247,8 @@ class PagePool:
                 sealed = sc.hashes.append(int(t))
         sc.num_tokens += len(tokens)
         if fire_stored and sealed is not None and self.on_block_sealed:
-            self.on_block_sealed(sc.seq_id, sealed, page)
+            self.on_block_sealed(sc.seq_id, sealed, page,
+                                 sc.hashes.lora_id)
 
     # ------------------------------------------------------------------
     # index computation for the jitted forward

@@ -217,14 +217,19 @@ class EngineCore:
         if impl == "auto":
             import os
             impl = os.environ.get("DYNAMO_TPU_ATTN", "auto")
-        if cfg.pp > 1:
-            # the staged loop computes attention inside shard_map (manual
-            # SPMD over pp×tp) — the pallas/ring wrappers don't apply there
+        if m.attn_logit_softcap or m.sliding_window is not None:
+            # Gemma2: score softcapping + alternating sliding windows are
+            # implemented on the XLA attention path only (the Pallas
+            # kernels would silently skip the cap — wrong logits)
             if impl not in ("auto", "xla"):
                 raise ValueError(
-                    f"pp > 1 serves attention in-stage (xla); "
-                    f"attn_impl={impl!r} is not supported with pp")
+                    f"attn_impl={impl!r} does not support softcapping/"
+                    "sliding-window models (Gemma2); use attn_impl='xla'")
             impl = "xla"
+        if cfg.pp > 1 and impl == "ring":
+            # ring rides the sp axis; pp stages the layer stack — the two
+            # prefill shardings don't compose
+            raise ValueError("attn_impl='ring' is not supported with pp")
         if impl == "auto":
             # Pallas kernels on TPU (shard_map-wrapped per tp shard); XLA
             # dense elsewhere or when the model's GQA grouping can't split
@@ -462,9 +467,14 @@ class EngineCore:
                 def one(carry, _):
                     tokens, lengths, k_pool, v_pool, key, counts = carry
                     if cfg.pp > 1:
+                        # in-stage kernels: flash per pp×tp shard (the
+                        # paged kernel would need page tables threaded
+                        # into the stage loop — flash covers T=1 decode)
                         logits, k_pool, v_pool = llama.forward_decode_pp(
                             params, cfg.model, tokens, k_pool, v_pool,
-                            page_tables, lengths, mesh=mesh)
+                            page_tables, lengths, mesh=mesh,
+                            attn_impl=("flash" if impl == "pallas"
+                                       else "xla"))
                     else:
                         logits, k_pool, v_pool = llama.forward_decode(
                             params, cfg.model, tokens, k_pool, v_pool,
@@ -519,7 +529,8 @@ class EngineCore:
                         params, cfg.model, mb(tokens), mb(positions),
                         k_pool, v_pool, mb(write_idx), mb(read_idx),
                         mb(read_pos), mb(read_valid), mesh,
-                        logits_idx=mb(last_i))
+                        logits_idx=mb(last_i),
+                        attn_impl=("flash" if impl == "flash" else "xla"))
                     logits = logits.reshape(Bp, 1, -1)
                 else:
                     logits, k_pool, v_pool = llama.forward(
@@ -636,7 +647,7 @@ class EngineCore:
         slot = _Slot(seq_id, req, prompt)
         self.slots[slot_idx] = slot
         self.by_seq[seq_id] = slot
-        self.pool.create(seq_id)
+        self.pool.create(seq_id, lora_id=getattr(req, "lora_id", 0))
         self._load_sampling(slot_idx, req)
         out: List[StepOutput] = []
         try:
@@ -663,7 +674,7 @@ class EngineCore:
         T = k.shape[1]
         if T != len(prompt):
             raise ValueError(f"KV covers {T} tokens, prompt is {len(prompt)}")
-        self.pool.create(seq_id)
+        self.pool.create(seq_id, lora_id=getattr(request, "lora_id", 0))
         self.pool.extend(seq_id, prompt)
         self._flush_evictions()
         slots = jnp.asarray(self.pool.write_slots(seq_id, 0, T))
@@ -861,7 +872,7 @@ class EngineCore:
         slot = _Slot(seq_id, req, prompt)
         self.slots[slot_idx] = slot
         self.by_seq[seq_id] = slot
-        self.pool.create(seq_id)
+        self.pool.create(seq_id, lora_id=getattr(req, "lora_id", 0))
         matched = 0
         if self.cfg.enable_prefix_reuse:
             matched = self._restore_prefix(seq_id, prompt)

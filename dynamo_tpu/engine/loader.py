@@ -56,13 +56,18 @@ def load_llama_params(path: str, cfg: LlamaConfig,
         return np.stack([transform(lay(i, name)) for i in range(L)])
 
     dt = cfg.dtype
+    # HF Llama calls the PRE-FFN norm "post_attention_layernorm"; Gemma2's
+    # sandwich layout has four norms and names the pre-FFN one
+    # "pre_feedforward_layernorm" instead
+    ln2_name = ("pre_feedforward_layernorm" if cfg.sandwich_norms
+                else "post_attention_layernorm")
     # HF Linear stores [out, in]; our layout is [in, ...out...]
     params: Dict[str, Any] = {
         "embed": _get(tensors, f"{pfx}embed_tokens.weight").astype(dt),
         "layers": {
             "ln1": stack("input_layernorm",
                          lambda w: w.astype(np.float32)).reshape(L, D),
-            "ln2": stack("post_attention_layernorm",
+            "ln2": stack(ln2_name,
                          lambda w: w.astype(np.float32)).reshape(L, D),
             "wq": stack("self_attn.q_proj",
                         lambda w: w.astype(dt).T.reshape(D, Hq, Dh)),
@@ -78,6 +83,13 @@ def load_llama_params(path: str, cfg: LlamaConfig,
         },
         "final_norm": _get(tensors, f"{pfx}norm.weight").astype(np.float32),
     }
+    if cfg.sandwich_norms:
+        params["layers"]["ln1_post"] = stack(
+            "post_attention_layernorm",
+            lambda w: w.astype(np.float32)).reshape(L, D)
+        params["layers"]["ln2_post"] = stack(
+            "post_feedforward_layernorm",
+            lambda w: w.astype(np.float32)).reshape(L, D)
     if cfg.attention_bias:
         def bias(i, name, h):
             return _get(tensors, f"{pfx}layers.{i}.{name}.bias") \
@@ -114,10 +126,22 @@ def save_llama_params(path: str, params: Dict[str, Any], cfg: LlamaConfig) -> No
         "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
         "model.norm.weight": np.asarray(params["final_norm"], np.float32),
     }
+    sandwich = "ln1_post" in lp
     for i in range(L):
         p = f"model.layers.{i}."
         out[p + "input_layernorm.weight"] = np.asarray(lp["ln1"][i], np.float32)
-        out[p + "post_attention_layernorm.weight"] = np.asarray(lp["ln2"][i], np.float32)
+        if sandwich:
+            # Gemma2 naming: ln2 is the PRE-ffw norm; post_attention is
+            # the attn-branch output norm (see load_llama_params)
+            out[p + "pre_feedforward_layernorm.weight"] = np.asarray(
+                lp["ln2"][i], np.float32)
+            out[p + "post_attention_layernorm.weight"] = np.asarray(
+                lp["ln1_post"][i], np.float32)
+            out[p + "post_feedforward_layernorm.weight"] = np.asarray(
+                lp["ln2_post"][i], np.float32)
+        else:
+            out[p + "post_attention_layernorm.weight"] = np.asarray(
+                lp["ln2"][i], np.float32)
         out[p + "self_attn.q_proj.weight"] = C(np.asarray(
             lp["wq"][i], np.float32).reshape(D, Hq * Dh).T)
         out[p + "self_attn.k_proj.weight"] = C(np.asarray(

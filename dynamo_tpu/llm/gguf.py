@@ -217,7 +217,7 @@ class GGUFFile:
 
         md = self.metadata
         arch = self.architecture()
-        if arch not in ("llama", "mistral", "qwen2", "gemma"):
+        if arch not in ("llama", "mistral", "qwen2", "gemma", "gemma2"):
             raise ValueError(f"not a llama-family GGUF: {arch!r}")
 
         def g(key, default=None):
@@ -229,15 +229,35 @@ class GGUFFile:
         vocab_size = (int(md[f"{arch}.vocab_size"])
                       if f"{arch}.vocab_size" in md
                       else len(vocab) if vocab else 32000)
+        gemma2 = arch == "gemma2"
         return LlamaConfig(
             tie_embeddings="output.weight" not in self.tensors,
             attention_bias="blk.0.attn_q.bias" in self.tensors,
-            hidden_act="gelu_tanh" if arch == "gemma" else "silu",
+            hidden_act="gelu_tanh" if arch in ("gemma", "gemma2") else "silu",
             # llama.cpp's gemma converter bakes the +1 into norm weights at
             # export, so GGUF files store the EFFECTIVE scale — applying the
             # offset again would compute 2+w
             norm_offset=False,
-            embed_scale=arch == "gemma",
+            embed_scale=arch in ("gemma", "gemma2"),
+            sandwich_norms=gemma2,
+            attn_logit_softcap=(float(g("attn_logit_softcapping", 50.0))
+                                if gemma2 else None),
+            final_logit_softcap=(float(g("final_logit_softcapping", 30.0))
+                                 if gemma2 else None),
+            sliding_window=(int(g("attention.sliding_window", 4096))
+                            if gemma2 else None),
+            # attention scale: rsqrt(head_dim) for gemma2 2b/9b, but 27b
+            # uses rsqrt(hidden/heads)=rsqrt(144). GGUF metadata carries no
+            # scale key, so mirror llama.cpp's rule: the 27b variant (its
+            # unique 46-layer stack) gets hidden/heads; honor an explicit
+            # key when an exporter provides one. Serving 27b at the 2b/9b
+            # scale would be ~6% off on every attention score — silently.
+            query_pre_attn_scalar=(
+                float(md["gemma2.attention.query_pre_attn_scalar"])
+                if "gemma2.attention.query_pre_attn_scalar" in md
+                else float(emb) / n_heads
+                if gemma2 and int(g("block_count")) == 46
+                else None),
             vocab_size=vocab_size,
             hidden_size=emb,
             num_layers=int(g("block_count")),
@@ -401,6 +421,14 @@ def load_llama_params_gguf(path: str, cfg=None,
         },
         "final_norm": t("output_norm.weight").astype(np.float32),
     }
+    if cfg.sandwich_norms:
+        # gemma2 GGUF tensor names: post_attention_norm / post_ffw_norm
+        # (ffn_norm above is the PRE-ffw norm in this layout)
+        params["layers"]["ln1_post"] = stack(
+            "blk.{}.post_attention_norm.weight",
+            lambda w: w.astype(np.float32))
+        params["layers"]["ln2_post"] = stack(
+            "blk.{}.post_ffw_norm.weight", lambda w: w.astype(np.float32))
     if cfg.attention_bias:
         params["layers"]["bq"] = stack(
             "blk.{}.attn_q.bias", lambda w: w.astype(dt).reshape(Hq, Dh))

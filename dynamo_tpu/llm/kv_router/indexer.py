@@ -206,8 +206,12 @@ class KvIndexer:
     def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
         return self.tree.find_matches(seq_hashes)
 
-    def find_matches_for_tokens(self, tokens: Sequence[int]) -> OverlapScores:
-        return self.find_matches(compute_seq_hashes(tokens, self.block_size))
+    def find_matches_for_tokens(self, tokens: Sequence[int],
+                                lora_id: int = 0) -> OverlapScores:
+        """Match under an adapter: the query chain is salted exactly like
+        the publishers' (same tokens + different lora_id → zero overlap)."""
+        return self.find_matches(
+            compute_seq_hashes(tokens, self.block_size, lora_id=lora_id))
 
 
 class KvIndexerSharded:
@@ -234,5 +238,7 @@ class KvIndexerSharded:
             out.scores.update(part.scores)
         return out
 
-    def find_matches_for_tokens(self, tokens: Sequence[int]) -> OverlapScores:
-        return self.find_matches(compute_seq_hashes(tokens, self.block_size))
+    def find_matches_for_tokens(self, tokens: Sequence[int],
+                                lora_id: int = 0) -> OverlapScores:
+        return self.find_matches(
+            compute_seq_hashes(tokens, self.block_size, lora_id=lora_id))

@@ -31,6 +31,11 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t, ctypes.c_int,
         ctypes.c_uint64]
     lib.dynamo_kv_event_publish_stored.restype = ctypes.c_int
+    lib.dynamo_kv_event_publish_stored_v2.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_uint64]
+    lib.dynamo_kv_event_publish_stored_v2.restype = ctypes.c_int
     lib.dynamo_kv_event_publish_removed.argtypes = [
         ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t]
     lib.dynamo_kv_event_publish_removed.restype = ctypes.c_int
@@ -63,14 +68,20 @@ class NativeKvPublisher:
         return self._event_id
 
     def publish_stored(self, blocks: Sequence[Tuple[int, int]],
-                       parent_hash: Optional[int] = None) -> int:
-        """blocks = [(block_hash a.k.a. sequence hash, tokens_hash), ...]."""
+                       parent_hash: Optional[int] = None,
+                       lora_id: int = 0) -> int:
+        """blocks = [(block_hash a.k.a. sequence hash, tokens_hash), ...].
+
+        The hashes must already be lora-salted at the chain root (see
+        tokens.lora_chain_root); ``lora_id`` rides the wire for parity with
+        the reference C ABI and consumer-side auditing."""
         n = len(blocks)
         bh = (ctypes.c_uint64 * n)(*[b for b, _ in blocks])
         th = (ctypes.c_uint64 * n)(*[t for _, t in blocks])
         eid = self._next_id()
-        rc = self._lib.dynamo_kv_event_publish_stored(
-            eid, bh, th, n, int(parent_hash is not None), parent_hash or 0)
+        rc = self._lib.dynamo_kv_event_publish_stored_v2(
+            eid, bh, th, n, int(parent_hash is not None), parent_hash or 0,
+            lora_id)
         if rc != 0:
             raise RuntimeError("publisher not initialized")
         return eid

@@ -28,6 +28,12 @@ class StoredBlock:
 class KvStoredEvent:
     blocks: List[StoredBlock]
     parent_hash: Optional[int] = None  # sequence hash of the preceding block
+    # Adapter the blocks were computed under (0 = base model). The hash
+    # chain itself is already lora-salted at its root (tokens.py
+    # lora_chain_root) so same-tokens/different-adapter cannot alias; the
+    # wire field preserves C-ABI parity (ref lib/bindings/c lib.rs:253-283)
+    # and lets consumers audit or partition by adapter.
+    lora_id: int = 0
 
 
 @dataclass
@@ -48,6 +54,8 @@ class KvCacheEvent:
                 "parent_hash": self.stored.parent_hash,
                 "blocks": [asdict(b) for b in self.stored.blocks],
             }
+            if self.stored.lora_id:
+                d["stored"]["lora_id"] = self.stored.lora_id
         if self.removed is not None:
             d["removed"] = {"block_hashes": self.removed.block_hashes}
         return d
@@ -60,6 +68,7 @@ class KvCacheEvent:
             stored = KvStoredEvent(
                 blocks=[StoredBlock(**b) for b in d["stored"]["blocks"]],
                 parent_hash=d["stored"].get("parent_hash"),
+                lora_id=int(d["stored"].get("lora_id", 0)),
             )
         if "removed" in d and d["removed"] is not None:
             removed = KvRemovedEvent(block_hashes=list(d["removed"]["block_hashes"]))

@@ -49,13 +49,15 @@ class KvEventPublisher:
         self.published = 0
 
     # -- engine-thread side (hooks for PagePool) ------------------------
-    def block_stored(self, seq_id: str, block: TokenBlock, page: int) -> None:
+    def block_stored(self, seq_id: str, block: TokenBlock, page: int,
+                     lora_id: int = 0) -> None:
         ev = KvCacheEvent(
             event_id=self._next_id(),
             stored=KvStoredEvent(
                 blocks=[StoredBlock(block_hash=block.sequence_hash,
                                     tokens_hash=block.block_hash)],
                 parent_hash=block.parent_sequence_hash,
+                lora_id=lora_id,
             ))
         self._push(ev)
 

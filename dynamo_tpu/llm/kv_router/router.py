@@ -100,8 +100,9 @@ class KvRouterService:
             await asyncio.sleep(self.scrape_interval)
 
     # ------------------------------------------------------------------
-    async def route(self, token_ids) -> Dict:
-        overlaps = self.indexer.find_matches_for_tokens(token_ids)
+    async def route(self, token_ids, lora_id: int = 0) -> Dict:
+        overlaps = self.indexer.find_matches_for_tokens(token_ids,
+                                                        lora_id=lora_id)
         wid = await self.scheduler.schedule_or_wait(token_ids, overlaps)
         return {"worker_id": wid,
                 "overlap_blocks": overlaps.scores.get(wid, 0)}
@@ -109,6 +110,7 @@ class KvRouterService:
     async def serve(self, component: Component,
                     endpoint_name: str = "route") -> None:
         async def handler(request, ctx):
-            yield await self.route(request["token_ids"])
+            yield await self.route(request["token_ids"],
+                                   int(request.get("lora_id", 0)))
 
         await component.endpoint(endpoint_name).serve(handler)

@@ -71,6 +71,10 @@ class BackendInput:
     model: Optional[str] = None
     mdc_sum: Optional[str] = None  # model deployment card checksum
     annotations: Dict[str, Any] = field(default_factory=dict)
+    # LoRA adapter the request targets (0 = base model). Salts the KV
+    # block-hash chain so adapter KV can never alias base/other-adapter KV
+    # in prefix reuse or the router index (ref C ABI lib.rs:253-283).
+    lora_id: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)
@@ -86,6 +90,7 @@ class BackendInput:
             model=d.get("model"),
             mdc_sum=d.get("mdc_sum"),
             annotations=dict(d.get("annotations", {})),
+            lora_id=int(d.get("lora_id", 0)),
         )
 
 

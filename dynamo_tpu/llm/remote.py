@@ -69,7 +69,8 @@ class RemoteCoreEngine(AsyncEngine[BackendInput, EngineOutput]):
         if self.router_client is not None and self.router_client.instances:
             try:
                 async for resp in self.router_client.generate(
-                        {"token_ids": request.token_ids}, context.child()):
+                        {"token_ids": request.token_ids,
+                         "lora_id": request.lora_id}, context.child()):
                     wid = resp.get("worker_id")
                     if wid is not None and wid in self.worker_client.instances:
                         mode, instance_id = "direct", wid

@@ -42,6 +42,22 @@ def chain_hash(parent_sequence_hash: Optional[int], block_hash: int) -> int:
     return xxhash.xxh3_64_intdigest(struct.pack("<QQ", parent, block_hash), seed=_HASH_SEED)
 
 
+def lora_chain_root(lora_id: int) -> Optional[int]:
+    """Root of the sequence-hash chain for an adapter.
+
+    ``lora_id`` salts the chain at its ROOT, so every sequence hash
+    downstream is adapter-distinct: identical tokens under different LoRA
+    adapters can never alias in the radix index (ref carries lora_id
+    through the C ABI, lib/bindings/c/src/lib.rs:253-283; folding it into
+    the hash is the indexer-side half it left as a TODO,
+    kv_router/indexer.rs:104-110). ``lora_id == 0`` (base model) keeps
+    chains bit-identical to the unsalted protocol."""
+    if not lora_id:
+        return None
+    return xxhash.xxh3_64_intdigest(
+        struct.pack("<Q", lora_id & 0xFFFFFFFFFFFFFFFF), seed=_HASH_SEED ^ 0x10AA)
+
+
 @dataclass(frozen=True)
 class TokenBlock:
     """A full block of ``block_size`` tokens with its two hashes."""
@@ -66,10 +82,12 @@ class TokenSequence:
     block_size: int
     blocks: List[TokenBlock] = field(default_factory=list)
     partial: List[int] = field(default_factory=list)
+    lora_id: int = 0            # salts the chain root (adapter-distinct)
 
     @classmethod
-    def from_tokens(cls, tokens: Iterable[int], block_size: int) -> "TokenSequence":
-        seq = cls(block_size=block_size)
+    def from_tokens(cls, tokens: Iterable[int], block_size: int,
+                    lora_id: int = 0) -> "TokenSequence":
+        seq = cls(block_size=block_size, lora_id=lora_id)
         seq.extend(tokens)
         return seq
 
@@ -82,7 +100,8 @@ class TokenSequence:
         self.partial.append(token)
         if len(self.partial) < self.block_size:
             return None
-        parent = self.blocks[-1].sequence_hash if self.blocks else None
+        parent = (self.blocks[-1].sequence_hash if self.blocks
+                  else lora_chain_root(self.lora_id))
         bh = hash_tokens(self.partial)
         block = TokenBlock(
             tokens=tuple(self.partial),
@@ -121,10 +140,12 @@ def compute_block_hashes(tokens: Sequence[int], block_size: int) -> List[int]:
     ]
 
 
-def compute_seq_hashes(tokens: Sequence[int], block_size: int) -> List[int]:
-    """Chained sequence hashes for the full blocks of ``tokens``."""
+def compute_seq_hashes(tokens: Sequence[int], block_size: int,
+                       lora_id: int = 0) -> List[int]:
+    """Chained sequence hashes for the full blocks of ``tokens``; the chain
+    root is salted by ``lora_id`` (0 = base model, unsalted)."""
     out: List[int] = []
-    parent: Optional[int] = None
+    parent: Optional[int] = lora_chain_root(lora_id)
     for i in range(0, len(tokens) - block_size + 1, block_size):
         h = chain_hash(parent, hash_tokens(tokens[i : i + block_size]))
         out.append(h)

@@ -179,6 +179,8 @@ class DispatchPublisher:
         import time as _time
 
         while True:
+            if self._closing:
+                return     # orderly teardown: never escalate to exit(13)
             with self._lock:
                 socks = list(self._socks)
             if not socks:
@@ -194,6 +196,9 @@ class DispatchPublisher:
             if readable or errored:
                 # EOF/reset — or a protocol violation (followers are
                 # silent): the slice can no longer stay in lockstep
+                if self._closing:
+                    return   # a follower closing first during teardown is
+                             # not a failure — re-check right at the brink
                 log.critical("dispatch channel lost (follower died); "
                              "terminating the multi-host worker")
                 import os as _os

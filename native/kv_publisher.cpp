@@ -184,11 +184,16 @@ int dynamo_llm_init(const char* host, int port, const char* ns,
 
 // Publish a "stored" event: n blocks, each (block_hash=sequence hash,
 // tokens_hash=content hash), chained under parent_hash (has_parent=0 for a
-// root block). Returns 0 on success, -1 if not initialized.
-int dynamo_kv_event_publish_stored(int64_t event_id,
-                                   const uint64_t* block_hashes,
-                                   const uint64_t* tokens_hashes, size_t n,
-                                   int has_parent, uint64_t parent_hash) {
+// root block), computed under LoRA adapter `lora_id` (0 = base model; the
+// caller must have salted the hash chain root per tokens.py
+// lora_chain_root — the wire field is the audit trail, matching the
+// reference C ABI's end-to-end lora_id, lib/bindings/c/src/lib.rs:253-283).
+// Returns 0 on success, -1 if not initialized.
+int dynamo_kv_event_publish_stored_v2(int64_t event_id,
+                                      const uint64_t* block_hashes,
+                                      const uint64_t* tokens_hashes, size_t n,
+                                      int has_parent, uint64_t parent_hash,
+                                      uint64_t lora_id) {
   std::lock_guard<std::mutex> g(g_mu);
   if (!g_pub) return -1;
   std::string j = "{\"worker_id\": ";
@@ -198,6 +203,10 @@ int dynamo_kv_event_publish_stored(int64_t event_id,
   j += ", \"stored\": {\"parent_hash\": ";
   if (has_parent) append_u64(j, parent_hash);
   else j += "null";
+  if (lora_id != 0) {
+    j += ", \"lora_id\": ";
+    append_u64(j, lora_id);
+  }
   j += ", \"blocks\": [";
   for (size_t i = 0; i < n; ++i) {
     if (i) j += ", ";
@@ -210,6 +219,16 @@ int dynamo_kv_event_publish_stored(int64_t event_id,
   j += "]}}}";
   g_pub->enqueue_publish(j);
   return 0;
+}
+
+// Base-model variant (lora_id = 0); kept for ABI stability.
+int dynamo_kv_event_publish_stored(int64_t event_id,
+                                   const uint64_t* block_hashes,
+                                   const uint64_t* tokens_hashes, size_t n,
+                                   int has_parent, uint64_t parent_hash) {
+  return dynamo_kv_event_publish_stored_v2(event_id, block_hashes,
+                                           tokens_hashes, n, has_parent,
+                                           parent_hash, 0);
 }
 
 // Publish a "removed" event for n evicted blocks (sequence hashes).

