@@ -171,3 +171,74 @@ def test_70b_offload_shape_serves_http():
         assert out2["choices"][0]["text"] == out["choices"][0]["text"]
     finally:
         serve.stop()
+
+
+def test_32k_70b_offload_shape_tiered_restore_and_linear_cost():
+    """BASELINE config 5's correctness half at full context (VERDICT r4
+    item #10): the 70b_offload.yaml engine SHAPE — 32k max_context, 1024
+    prefill chunks, host+disk tiers at the yaml's 1:4 ratio — on a scaled-
+    down model. Asserts: a 32k prompt serves; a second 32k prompt forces
+    the eviction cascade into BOTH tiers; re-running the first prompt
+    restores through the tiers token-for-token; and warm per-chunk prefill
+    cost stays ~linear across all 32 chunks (the TPU window then only has
+    to measure speed, not correctness)."""
+    import yaml
+
+    with open("examples/configs/70b_offload.yaml") as f:
+        config = yaml.safe_load(f)
+    real_ea = json.loads(config["Worker"]["extra_engine_args"])
+    # the REAL deployment shape this test scales down from
+    assert real_ea["max_context"] == 32768
+    assert real_ea["prefill_chunk"] == 1024
+    assert real_ea["disk_cache_blocks"] == 4 * real_ea["host_cache_blocks"]
+
+    ctx = real_ea["max_context"]
+    prompt_a = [(i * 7 + 3) % 251 for i in range(ctx - 767)]   # 32001 toks
+    prompt_b = [(i * 11 + 5) % 251 for i in range(ctx - 767)]
+    core = EngineCore(JaxEngineConfig(
+        model=llama.preset("tiny-byte", max_position=ctx + 1024),
+        max_batch=2, max_context=ctx, page_size=64,
+        prefill_chunk=real_ea["prefill_chunk"], decode_steps=4,
+        attn_impl="xla",
+        # pool fits ~1.3 sequences of 500 pages; host holds a quarter of
+        # an evicted sequence, disk 4x that (the yaml's tier ratio)
+        num_pages=680, host_cache_blocks=128, disk_cache_blocks=512))
+
+    core.submit("a", _req(prompt_a))
+    a_toks = [so.token for so in _drain(core, "a")]
+    assert len(a_toks) == 4
+
+    # B evicts A's blocks down the cascade; time B's chunks (all bucket
+    # programs compiled during A -> warm, so growth is attention cost)
+    core.submit("b", _req(prompt_b, max_tokens=1))
+    chunk_times = []
+    for _ in range(200):
+        slot = core.by_seq.get("b")
+        in_prefill = slot is None or slot.prefill_done < len(prompt_b)
+        t0 = time.monotonic()
+        outs = core.step()
+        dt = time.monotonic() - t0
+        if in_prefill:
+            chunk_times.append(dt)
+        if outs and outs[-1].finish is not None:
+            break
+    n_chunks = -(-len(prompt_b) // core.cfg.prefill_chunk)
+    assert len(chunk_times) >= n_chunks
+    first4 = sum(chunk_times[:4])
+    last4 = sum(chunk_times[n_chunks - 4:n_chunks])
+    # linear attention growth predicts last4/first4 ~ 29/2.5 ≈ 12 at 32
+    # chunks; quadratic (re-prefill / full-context gather per chunk) would
+    # be ~100x+. Generous CI bound:
+    assert last4 < 60 * max(first4, 1e-3), \
+        f"32k prefill not ~linear: first4={first4:.3f}s last4={last4:.3f}s"
+
+    stats = core.tiered.stats()
+    assert stats["host_blocks"] > 0, "host tier never engaged at 32k"
+    assert stats["disk_blocks"] > 0, "disk cascade never engaged at 32k"
+
+    # A again: restored up through the tiers, token-for-token
+    core.submit("a2", _req(prompt_a))
+    a2_toks = [so.token for so in _drain(core, "a2")]
+    assert a2_toks == a_toks
+    assert core.prefix_hit_tokens > 0, "32k tier restore never hit"
+    assert core.tiered.stats()["hits"] > 0
