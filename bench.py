@@ -282,6 +282,12 @@ def main() -> None:
         "best_batch": headline.get("batch") if headline else None,
         "p50_ttft_s": headline.get("p50_ttft_s") if headline else None,
         "mfu": headline.get("mfu") if headline else None,
+        # which decode kernel served the headline number: auto resolves to
+        # pallas[dma] on TPU (DYNAMO_TPU_PAGED_KERNEL=simple the fallback);
+        # off-TPU the interpreted simple kernel ALWAYS runs, whatever the
+        # env var says — label truthfully
+        "paged_kernel": (os.environ.get("DYNAMO_TPU_PAGED_KERNEL", "dma")
+                         if platform == "tpu" else "simple[interpret]"),
         "sweep": sweeps,
         "notes": notes,
         "wall_s": round(time.monotonic() - t_start, 1),

@@ -155,17 +155,46 @@ def main():
     from dynamo_tpu.ops.attention import paged_attention
     q = jnp.ones((B, m.num_heads, m.head_dim), m.dtype)
 
-    @jax.jit
-    def paged_only(q, k_pool, v_pool):
-        def body(acc, _):
-            for l in range(m.num_layers):
-                acc = acc + paged_attention(q, k_pool[l], v_pool[l],
-                                            page_tables, lengths)
-            return acc, ()
-        acc, _ = jax.lax.scan(body, jnp.zeros_like(q), None, length=N)
-        return acc
-    report("paged_attention, all layers", timeit(paged_only, q, k_pool,
-                                                 v_pool), N)
+    # decode attention must stream the whole ATTENDED KV once per step.
+    # The kernels read whole pages, so bytes/op counts the pages actually
+    # touched: ceil(attended/page) * page tokens. Effective GB/s against
+    # that floor localizes the HBM-bandwidth deficit (round-2 probe: ~9%
+    # of the chip's 819 GB/s) per kernel VARIANT.
+    attended = int(lengths[0])
+    touched_tokens = -(-attended // page) * page
+    kv_bytes = (B * touched_tokens * m.num_kv_heads * m.head_dim * 2
+                * k_pool.dtype.itemsize * m.num_layers)
+
+    def attn_report(ms_per_op):
+        if ms_per_op > 0:
+            gbs = kv_bytes / (ms_per_op * 1e-3) / 1e9
+            print(f"{'':44s}  -> effective {gbs:7.1f} GB/s "
+                  f"({kv_bytes/1e6:.1f} MB KV per step, "
+                  f"{attended} of {args.ctx} tokens attended)")
+
+    saved = os.environ.get("DYNAMO_TPU_PAGED_KERNEL")
+    try:
+        for variant in ("dma", "simple"):
+            os.environ["DYNAMO_TPU_PAGED_KERNEL"] = variant
+
+            @jax.jit
+            def paged_only(q, k_pool, v_pool):
+                def body(acc, _):
+                    for l in range(m.num_layers):
+                        acc = acc + paged_attention(q, k_pool[l], v_pool[l],
+                                                    page_tables, lengths)
+                    return acc, ()
+                acc, _ = jax.lax.scan(body, jnp.zeros_like(q), None,
+                                      length=N)
+                return acc
+            per = report(f"paged_attention[{variant}], all layers",
+                         timeit(paged_only, q, k_pool, v_pool), N)
+            attn_report(per)
+    finally:
+        if saved is None:
+            os.environ.pop("DYNAMO_TPU_PAGED_KERNEL", None)
+        else:
+            os.environ["DYNAMO_TPU_PAGED_KERNEL"] = saved
 
     @jax.jit
     def gather_attend_only(q, k_pool, v_pool):
