@@ -424,7 +424,21 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                          f"(expected dma|simple)")
     if not interpret and variant == "dma":
         q4 = q.reshape(B, Hkv, G, Dh)
-        out = _paged_attention_tpu(q4, k_pages, v_pages, page_tables, lengths)
+        # DMA depth knob for on-chip tuning sweeps (perf_probe) — larger
+        # blocks amortize DMA issue latency, smaller ones cut the tail
+        # wasted on the final partial block. Validated like the sibling
+        # DYNAMO_TPU_PAGED_KERNEL knob: a typo must fail loudly, not
+        # surface as a ZeroDivisionError deep in the grid math.
+        raw_ppb = os.environ.get("DYNAMO_TPU_PAGED_PPB", "8")
+        try:
+            ppb = int(raw_ppb)
+        except ValueError:
+            ppb = -1
+        if not 1 <= ppb <= 64:
+            raise ValueError(f"DYNAMO_TPU_PAGED_PPB={raw_ppb!r} "
+                             f"(expected an integer in [1, 64])")
+        out = _paged_attention_tpu(q4, k_pages, v_pages, page_tables,
+                                   lengths, pages_per_block=ppb)
         return out.reshape(B, Hq, Dh)
     scale = 1.0 / math.sqrt(Dh)
 

@@ -172,29 +172,43 @@ def main():
                   f"({kv_bytes/1e6:.1f} MB KV per step, "
                   f"{attended} of {args.ctx} tokens attended)")
 
+    def paged_probe(label):
+        @jax.jit
+        def paged_only(q, k_pool, v_pool):
+            def body(acc, _):
+                for l in range(m.num_layers):
+                    acc = acc + paged_attention(q, k_pool[l], v_pool[l],
+                                                page_tables, lengths)
+                return acc, ()
+            acc, _ = jax.lax.scan(body, jnp.zeros_like(q), None, length=N)
+            return acc
+        per = report(f"paged_attention[{label}], all layers",
+                     timeit(paged_only, q, k_pool, v_pool), N)
+        attn_report(per)
+
     saved = os.environ.get("DYNAMO_TPU_PAGED_KERNEL")
+    saved_ppb = os.environ.get("DYNAMO_TPU_PAGED_PPB")
+    # the baseline runs must use the DEFAULT depth, not an inherited knob
+    os.environ.pop("DYNAMO_TPU_PAGED_PPB", None)
     try:
         for variant in ("dma", "simple"):
             os.environ["DYNAMO_TPU_PAGED_KERNEL"] = variant
-
-            @jax.jit
-            def paged_only(q, k_pool, v_pool):
-                def body(acc, _):
-                    for l in range(m.num_layers):
-                        acc = acc + paged_attention(q, k_pool[l], v_pool[l],
-                                                    page_tables, lengths)
-                    return acc, ()
-                acc, _ = jax.lax.scan(body, jnp.zeros_like(q), None,
-                                      length=N)
-                return acc
-            per = report(f"paged_attention[{variant}], all layers",
-                         timeit(paged_only, q, k_pool, v_pool), N)
-            attn_report(per)
+            paged_probe(variant)
+        if dev.platform == "tpu":
+            # DMA-depth sweep: pages-per-block trades issue-latency
+            # amortization against partial-block waste
+            os.environ["DYNAMO_TPU_PAGED_KERNEL"] = "dma"
+            for ppb in (2, 4, 16):
+                if ppb <= P:
+                    os.environ["DYNAMO_TPU_PAGED_PPB"] = str(ppb)
+                    paged_probe(f"dma ppb={ppb}")
     finally:
-        if saved is None:
-            os.environ.pop("DYNAMO_TPU_PAGED_KERNEL", None)
-        else:
-            os.environ["DYNAMO_TPU_PAGED_KERNEL"] = saved
+        for var, val in (("DYNAMO_TPU_PAGED_KERNEL", saved),
+                         ("DYNAMO_TPU_PAGED_PPB", saved_ppb)):
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
 
     @jax.jit
     def gather_attend_only(q, k_pool, v_pool):

@@ -24,6 +24,20 @@ while true; do
         rc=${PIPESTATUS[0]}
         [ "$rc" -ne 0 ] && { echo "tpu_smoke FAILED (rc=$rc)"; failed=1; }
 
+        # probe BEFORE bench: if the window closes early, the per-kernel
+        # bandwidth diagnostic is the most actionable artifact (the driver
+        # re-runs bench.py itself at round end anyway)
+        echo "$(date -u +%H:%M:%S) running perf_probe..."
+        # 1800: the ppb sweep adds two jit-compile+measure cycles; a slow
+        # probe must not read as a "real failure" that ends the watch
+        timeout 1800 python scripts/perf_probe.py 2>&1 | tee /tmp/perf_probe.log | tail -40
+        rc=${PIPESTATUS[0]}
+        if [ "$rc" -ne 0 ]; then
+            echo "perf_probe FAILED (rc=$rc)"; failed=1
+        else
+            cp /tmp/perf_probe.log TPU_PERF.log
+        fi
+
         echo "$(date -u +%H:%M:%S) running bench.py..."
         # bench budgets 1500s measurement + up to 300s of backend probes,
         # plus compile time — 2700 leaves room for its final JSON line
@@ -40,15 +54,6 @@ while true; do
             cp /tmp/bench_tpu_out.json TPU_BENCH.json
             tail -c 2000 /tmp/bench_tpu_out.json
             echo
-        fi
-
-        echo "$(date -u +%H:%M:%S) running perf_probe..."
-        timeout 900 python scripts/perf_probe.py 2>&1 | tee /tmp/perf_probe.log | tail -30
-        rc=${PIPESTATUS[0]}
-        if [ "$rc" -ne 0 ]; then
-            echo "perf_probe FAILED (rc=$rc)"; failed=1
-        else
-            cp /tmp/perf_probe.log TPU_PERF.log
         fi
 
         if [ "$failed" -ne 0 ]; then
