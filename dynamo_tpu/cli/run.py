@@ -49,6 +49,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--extra-engine-args", default=None,
                    help="extra engine kwargs: a JSON file path, or inline "
                         "JSON if the value starts with '{'")
+    p.add_argument("--store", default="127.0.0.1:4222",
+                   help="dynstore host:port (out=dyn:// remote mode)")
+    p.add_argument("--connect-timeout", type=float, default=30.0,
+                   help="seconds to wait for a live out=dyn:// instance")
     args = p.parse_args(argv)
     args.input, args.output = "text", "echo_core"
     for tok in args.positional:
@@ -103,9 +107,34 @@ def make_engines(args, card: ModelDeploymentCard):
         except PythonEngineError as e:
             raise SystemExit(str(e))
     if out.startswith("dyn://"):
-        raise SystemExit("out=dyn:// (remote endpoint) requires the distributed "
-                         "runtime; use the runtime worker entrypoint instead")
+        # async connect: handled by connect_remote_engines in amain
+        raise AssertionError("dyn:// handled before make_engines")
     raise SystemExit(f"unknown out={out}")
+
+
+async def connect_remote_engines(args, card: ModelDeploymentCard):
+    """``out=dyn://ns.component.endpoint`` — drive a REMOTE worker's core
+    engine over the runtime data plane (ref dynamo-run's remote client
+    mode, launch/dynamo-run/src/lib.rs in=..., out=dyn://)."""
+    from ..llm.remote import RemoteCoreEngine
+    from ..runtime.component import DistributedRuntime
+
+    path = args.output[len("dyn://"):]
+    parts = path.split(".")
+    if len(parts) != 3:
+        raise SystemExit(f"out=dyn://{path}: expected ns.component.endpoint")
+    host, _, port = args.store.partition(":")
+    drt = await DistributedRuntime(store_host=host or "127.0.0.1",
+                                   store_port=int(port or 4222)).connect()
+    client = await (drt.namespace(parts[0]).component(parts[1])
+                    .endpoint(parts[2]).client().start())
+    try:
+        await client.wait_for_instances(1, timeout=args.connect_timeout)
+    except TimeoutError as e:
+        raise SystemExit(f"out={args.output}: {e}")
+    core = RemoteCoreEngine(client)
+    return (build_chat_engine(card, "core", core),
+            build_completion_engine(card, "core", core))
 
 
 # ---------------------------------------------------------------------------
@@ -227,7 +256,11 @@ async def amain(argv: Optional[List[str]] = None) -> None:
     args = parse_args(argv)
     _honor_jax_platforms_env()
     card = make_card(args)
-    chat_engine, completion_engine = make_engines(args, card)
+    if args.output.startswith("dyn://"):
+        chat_engine, completion_engine = await connect_remote_engines(args,
+                                                                      card)
+    else:
+        chat_engine, completion_engine = make_engines(args, card)
     mode = args.input
     if mode == "http":
         await run_http(args, card, chat_engine, completion_engine)

@@ -102,8 +102,10 @@ def test_completion_prompt_variants():
 def test_cli_unknown_out_modes():
     from dynamo_tpu.cli.run import make_card, make_engines, parse_args
 
-    args = parse_args(["out=dyn://ns.comp.ep"])
-    with pytest.raises(SystemExit):
+    # dyn:// is now a REAL mode (remote client, test_run_remote.py) handled
+    # before make_engines; a truly unknown out still exits cleanly
+    args = parse_args(["out=telepathy"])
+    with pytest.raises(SystemExit, match="unknown out"):
         make_engines(args, make_card(args))
 
 

@@ -1,0 +1,64 @@
+"""``dynamo-run out=dyn://ns.comp.ep`` — the remote client mode: the CLI's
+input modes drive a worker that lives in ANOTHER runtime over the data
+plane (ref dynamo-run's out=dyn:// matrix entry, launch/dynamo-run/src/
+lib.rs + input/endpoint.rs)."""
+
+import argparse
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.store_server import StoreServer
+
+
+async def test_batch_mode_against_remote_worker(tmp_path):
+    from dynamo_tpu.cli.run import (connect_remote_engines, make_card,
+                                    parse_args, run_batch)
+    from dynamo_tpu.llm.engines import EchoCoreEngine
+    from dynamo_tpu.llm.remote import serve_core_engine
+
+    store = StoreServer()
+    port = await store.start()
+    wdrt = await DistributedRuntime(store_port=port,
+                                    advertise_host="127.0.0.1").connect()
+    try:
+        ep = wdrt.namespace("dyn").component("backend").endpoint("generate")
+        await serve_core_engine(ep, EchoCoreEngine())
+
+        prompts = tmp_path / "prompts.jsonl"
+        prompts.write_text("\n".join(
+            json.dumps({"text": f"hello {i}"}) for i in range(4)))
+
+        args = parse_args([
+            "in=none", "out=dyn://dyn.backend.generate",
+            "--store", f"127.0.0.1:{port}", "--max-tokens", "8"])
+        card = make_card(args)
+        chat, completion = await connect_remote_engines(args, card)
+        stats = await run_batch(args, card, chat, completion, str(prompts))
+        assert stats["requests"] == 4
+        assert stats["tokens_out"] > 0
+    finally:
+        await wdrt.close()
+        await store.stop()
+
+
+async def test_dyn_out_bad_path_and_no_instances():
+    from dynamo_tpu.cli.run import connect_remote_engines, make_card, parse_args
+
+    store = StoreServer()
+    port = await store.start()
+    try:
+        args = parse_args(["in=none", "out=dyn://not-a-path",
+                           "--store", f"127.0.0.1:{port}"])
+        with pytest.raises(SystemExit, match="ns.component.endpoint"):
+            await connect_remote_engines(args, make_card(args))
+
+        args = parse_args(["in=none", "out=dyn://dyn.ghost.generate",
+                           "--store", f"127.0.0.1:{port}",
+                           "--connect-timeout", "0.5"])
+        with pytest.raises(SystemExit, match="0/1 instances"):
+            await connect_remote_engines(args, make_card(args))
+    finally:
+        await store.stop()
