@@ -90,6 +90,13 @@ def load_llama_params(path: str, cfg: LlamaConfig,
         params["layers"]["ln2_post"] = stack(
             "post_feedforward_layernorm",
             lambda w: w.astype(np.float32)).reshape(L, D)
+    if cfg.qk_norm:
+        params["layers"]["ln_q"] = stack(
+            "self_attn.q_norm", lambda w: w.astype(np.float32)).reshape(
+            L, Dh)
+        params["layers"]["ln_k"] = stack(
+            "self_attn.k_norm", lambda w: w.astype(np.float32)).reshape(
+            L, Dh)
     if cfg.attention_bias:
         def bias(i, name, h):
             return _get(tensors, f"{pfx}layers.{i}.{name}.bias") \
@@ -153,6 +160,11 @@ def save_llama_params(path: str, params: Dict[str, Any], cfg: LlamaConfig) -> No
         out[p + "mlp.gate_proj.weight"] = C(np.asarray(lp["wg"][i], np.float32).T)
         out[p + "mlp.up_proj.weight"] = C(np.asarray(lp["wu"][i], np.float32).T)
         out[p + "mlp.down_proj.weight"] = C(np.asarray(lp["wd"][i], np.float32).T)
+        if "ln_q" in lp:
+            out[p + "self_attn.q_norm.weight"] = np.asarray(
+                lp["ln_q"][i], np.float32)
+            out[p + "self_attn.k_norm.weight"] = np.asarray(
+                lp["ln_k"][i], np.float32)
         if "bq" in lp:
             out[p + "self_attn.q_proj.bias"] = C(np.asarray(
                 lp["bq"][i], np.float32).reshape(-1))

@@ -217,7 +217,8 @@ class GGUFFile:
 
         md = self.metadata
         arch = self.architecture()
-        if arch not in ("llama", "mistral", "qwen2", "gemma", "gemma2"):
+        if arch not in ("llama", "mistral", "qwen2", "gemma", "gemma2",
+                        "gemma3"):
             raise ValueError(f"not a llama-family GGUF: {arch!r}")
 
         def g(key, default=None):
@@ -230,33 +231,44 @@ class GGUFFile:
                       if f"{arch}.vocab_size" in md
                       else len(vocab) if vocab else 32000)
         gemma2 = arch == "gemma2"
+        gemma3 = arch == "gemma3"
+        gemma_any = arch in ("gemma", "gemma2", "gemma3")
         return LlamaConfig(
             tie_embeddings="output.weight" not in self.tensors,
             attention_bias="blk.0.attn_q.bias" in self.tensors,
-            hidden_act="gelu_tanh" if arch in ("gemma", "gemma2") else "silu",
+            hidden_act="gelu_tanh" if gemma_any else "silu",
             # llama.cpp's gemma converter bakes the +1 into norm weights at
             # export, so GGUF files store the EFFECTIVE scale — applying the
             # offset again would compute 2+w
             norm_offset=False,
-            embed_scale=arch in ("gemma", "gemma2"),
-            sandwich_norms=gemma2,
+            embed_scale=gemma_any,
+            sandwich_norms=gemma2 or gemma3,
+            qk_norm=gemma3,
+            sliding_pattern=(6 if gemma3 else 2),
+            rope_local_theta=(float(g("rope.local.freq_base", 10000.0))
+                              if gemma3 else None),
             attn_logit_softcap=(float(g("attn_logit_softcapping", 50.0))
                                 if gemma2 else None),
             final_logit_softcap=(float(g("final_logit_softcapping", 30.0))
                                  if gemma2 else None),
-            sliding_window=(int(g("attention.sliding_window", 4096))
-                            if gemma2 else None),
+            sliding_window=(int(g("attention.sliding_window",
+                                  1024 if gemma3 else 4096))
+                            if gemma2 or gemma3 else None),
             # attention scale: rsqrt(head_dim) for gemma2 2b/9b, but 27b
             # uses rsqrt(hidden/heads)=rsqrt(144). GGUF metadata carries no
             # scale key, so mirror llama.cpp's rule: the 27b variant (its
             # unique 46-layer stack) gets hidden/heads; honor an explicit
             # key when an exporter provides one. Serving 27b at the 2b/9b
             # scale would be ~6% off on every attention score — silently.
+            # the 27B variants scale by rsqrt(hidden/heads), not
+            # rsqrt(head_dim): gemma2-27b = 46 layers, gemma3-27b = 62
+            # (llama.cpp hardcodes the same rule; GGUF carries no key)
             query_pre_attn_scalar=(
-                float(md["gemma2.attention.query_pre_attn_scalar"])
-                if "gemma2.attention.query_pre_attn_scalar" in md
+                float(md[f"{arch}.attention.query_pre_attn_scalar"])
+                if f"{arch}.attention.query_pre_attn_scalar" in md
                 else float(emb) / n_heads
-                if gemma2 and int(g("block_count")) == 46
+                if ((gemma2 and int(g("block_count")) == 46)
+                    or (gemma3 and int(g("block_count")) == 62))
                 else None),
             vocab_size=vocab_size,
             hidden_size=emb,
@@ -422,13 +434,18 @@ def load_llama_params_gguf(path: str, cfg=None,
         "final_norm": t("output_norm.weight").astype(np.float32),
     }
     if cfg.sandwich_norms:
-        # gemma2 GGUF tensor names: post_attention_norm / post_ffw_norm
+        # gemma2/3 GGUF tensor names: post_attention_norm / post_ffw_norm
         # (ffn_norm above is the PRE-ffw norm in this layout)
         params["layers"]["ln1_post"] = stack(
             "blk.{}.post_attention_norm.weight",
             lambda w: w.astype(np.float32))
         params["layers"]["ln2_post"] = stack(
             "blk.{}.post_ffw_norm.weight", lambda w: w.astype(np.float32))
+    if cfg.qk_norm:
+        params["layers"]["ln_q"] = stack(
+            "blk.{}.attn_q_norm.weight", lambda w: w.astype(np.float32))
+        params["layers"]["ln_k"] = stack(
+            "blk.{}.attn_k_norm.weight", lambda w: w.astype(np.float32))
     if cfg.attention_bias:
         params["layers"]["bq"] = stack(
             "blk.{}.attn_q.bias", lambda w: w.astype(dt).reshape(Hq, Dh))

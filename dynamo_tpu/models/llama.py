@@ -38,22 +38,28 @@ from ..parallel.mesh import AXIS_TP
 # Config
 # ---------------------------------------------------------------------------
 
+_GEMMA_ARCHS = ("GemmaForCausalLM", "Gemma2ForCausalLM",
+                "Gemma3ForCausalLM")
+
+
 def _is_gemma(cfg: Dict[str, Any]) -> bool:
     archs = cfg.get("architectures", []) or []
-    # Gemma3 needs per-layer rope bases + QK-norm this model does not
-    # implement — refuse rather than serve wrong logits
+    # multimodal Gemma3 (vision tower) is not a text LM we can serve;
+    # refuse rather than serve wrong logits
     unsupported = [a for a in archs
-                   if "Gemma" in a
-                   and a not in ("GemmaForCausalLM", "Gemma2ForCausalLM")]
+                   if "Gemma" in a and a not in _GEMMA_ARCHS]
     if unsupported:
         raise ValueError(f"unsupported architecture {unsupported[0]!r} "
-                         f"(Gemma v1/v2 are supported; Gemma3 is not)")
-    return any(a in ("GemmaForCausalLM", "Gemma2ForCausalLM")
-               for a in archs)
+                         f"(text Gemma v1/v2/v3 are supported)")
+    return any(a in _GEMMA_ARCHS for a in archs)
 
 
 def _is_gemma2(cfg: Dict[str, Any]) -> bool:
     return "Gemma2ForCausalLM" in (cfg.get("architectures", []) or [])
+
+
+def _is_gemma3(cfg: Dict[str, Any]) -> bool:
+    return "Gemma3ForCausalLM" in (cfg.get("architectures", []) or [])
 
 
 def _map_act(cfg: Dict[str, Any]) -> str:
@@ -103,14 +109,23 @@ class LlamaConfig:
     final_logit_softcap: Optional[float] = None
     sliding_window: Optional[int] = None
     query_pre_attn_scalar: Optional[float] = None
+    # Gemma3-style knobs: every Nth layer is FULL attention, the rest
+    # sliding (gemma2: 2 — alternating; gemma3: 6 — 5:1); sliding layers
+    # rope at their own base frequency; per-head RMSNorm on q/k
+    sliding_pattern: int = 2
+    rope_local_theta: Optional[float] = None
+    qk_norm: bool = False
     dtype: Any = jnp.bfloat16
     # MoE (0 experts = dense FFN). Experts shard over the ep mesh axis.
     num_experts: int = 0
     experts_per_token: int = 2
 
     def layer_sliding(self, layer: int) -> bool:
-        """Gemma2 alternates: even layers sliding-window, odd layers full."""
-        return self.sliding_window is not None and layer % 2 == 0
+        """Every ``sliding_pattern``-th layer is full attention, the rest
+        sliding (gemma2: 2 — alternating, even layers slide; gemma3: 6 —
+        five sliding then one full)."""
+        return (self.sliding_window is not None
+                and (layer + 1) % self.sliding_pattern != 0)
 
     @property
     def attn_scale(self) -> float:
@@ -143,17 +158,48 @@ class LlamaConfig:
             hidden_act=_map_act(cfg),
             norm_offset=_is_gemma(cfg),
             embed_scale=_is_gemma(cfg),
-            sandwich_norms=_is_gemma2(cfg),
+            sandwich_norms=_is_gemma2(cfg) or _is_gemma3(cfg),
             attn_logit_softcap=(cfg.get("attn_logit_softcapping")
                                 if _is_gemma2(cfg) else None),
             final_logit_softcap=(cfg.get("final_logit_softcapping")
                                  if _is_gemma2(cfg) else None),
             sliding_window=(cfg.get("sliding_window")
-                            if _is_gemma2(cfg) else None),
+                            if _is_gemma2(cfg) or _is_gemma3(cfg) else None),
             query_pre_attn_scalar=(cfg.get("query_pre_attn_scalar")
-                                   if _is_gemma2(cfg) else None),
+                                   if _is_gemma2(cfg) or _is_gemma3(cfg)
+                                   else None),
+            sliding_pattern=_sliding_pattern(cfg),
+            rope_local_theta=(cfg.get("rope_local_base_freq", 10000.0)
+                              if _is_gemma3(cfg) else None),
+            qk_norm=_is_gemma3(cfg),
             dtype=dtype,
         )
+
+
+def _sliding_pattern(cfg: Dict[str, Any]) -> int:
+    """Period of the full-attention layers: from ``layer_types`` when the
+    config carries it (position of the first 'full_attention' + 1), else
+    the family default (gemma2: 2, gemma3: 6)."""
+    lt = cfg.get("layer_types")
+    if lt:
+        period = None
+        for i, t in enumerate(lt):
+            if t == "full_attention":
+                period = i + 1
+                break
+        if period is None:
+            return len(lt) + 1   # all sliding
+        # refuse rather than mis-serve: the whole list must actually
+        # follow the "(period-1) sliding, then full" repetition
+        for i, t in enumerate(lt):
+            want = ("full_attention" if (i + 1) % period == 0
+                    else "sliding_attention")
+            if t != want:
+                raise ValueError(
+                    f"layer_types is not periodic with full every "
+                    f"{period} layers (index {i} is {t!r})")
+        return period
+    return 6 if _is_gemma3(cfg) else 2
 
 
 # test/bench presets (shapes only; weights are random or loaded)
@@ -233,6 +279,36 @@ PRESETS: Dict[str, Dict[str, Any]] = {
                        sandwich_norms=True, attn_logit_softcap=50.0,
                        final_logit_softcap=30.0, sliding_window=4096,
                        query_pre_attn_scalar=144.0),
+    # tiny Gemma3-style model: qk-norm, dual-base rope, 5:1 sliding
+    "tiny-gemma3": dict(vocab_size=259, hidden_size=64, num_layers=6,
+                        num_heads=4, num_kv_heads=2, head_dim=16,
+                        intermediate_size=128, rope_theta=1000000.0,
+                        max_position=1024, tie_embeddings=True,
+                        hidden_act="gelu_tanh", norm_offset=True,
+                        embed_scale=True, rms_eps=1e-6,
+                        sandwich_norms=True, sliding_window=8,
+                        sliding_pattern=3, rope_local_theta=10000.0,
+                        qk_norm=True, query_pre_attn_scalar=24.0),
+    "gemma3-4b": dict(vocab_size=262208, hidden_size=2560, num_layers=34,
+                      num_heads=8, num_kv_heads=4, head_dim=256,
+                      intermediate_size=10240, rope_theta=1000000.0,
+                      rope_scaling={"rope_type": "linear", "factor": 8.0},
+                      max_position=131072, tie_embeddings=True,
+                      hidden_act="gelu_tanh", norm_offset=True,
+                      embed_scale=True, rms_eps=1e-6, sandwich_norms=True,
+                      sliding_window=1024, sliding_pattern=6,
+                      rope_local_theta=10000.0, qk_norm=True,
+                      query_pre_attn_scalar=256.0),
+    "gemma3-12b": dict(vocab_size=262208, hidden_size=3840, num_layers=48,
+                       num_heads=16, num_kv_heads=8, head_dim=256,
+                       intermediate_size=15360, rope_theta=1000000.0,
+                       rope_scaling={"rope_type": "linear", "factor": 8.0},
+                       max_position=131072, tie_embeddings=True,
+                       hidden_act="gelu_tanh", norm_offset=True,
+                       embed_scale=True, rms_eps=1e-6, sandwich_norms=True,
+                       sliding_window=1024, sliding_pattern=6,
+                       rope_local_theta=10000.0, qk_norm=True,
+                       query_pre_attn_scalar=256.0),
     "gemma-2b": dict(vocab_size=256000, hidden_size=2048, num_layers=18,
                      num_heads=8, num_kv_heads=1, head_dim=256,
                      intermediate_size=16384, rope_theta=10000.0,
@@ -301,6 +377,10 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
         kn = jax.random.split(ks[8], 2)
         params["layers"]["ln1_post"] = norm(kn[0], L, D).astype(jnp.float32)
         params["layers"]["ln2_post"] = norm(kn[1], L, D).astype(jnp.float32)
+    if cfg.qk_norm:
+        kq = jax.random.split(ks[6], 2)
+        params["layers"]["ln_q"] = norm(kq[0], L, Dh).astype(jnp.float32)
+        params["layers"]["ln_k"] = norm(kq[1], L, Dh).astype(jnp.float32)
     if cfg.attention_bias:
         kb = jax.random.split(ks[9], 3)
         # non-zero random biases so parity tests would catch a dropped bias
@@ -358,6 +438,9 @@ def param_specs(cfg: LlamaConfig, tp_size: int = 1,
     if cfg.sandwich_norms:
         specs["layers"]["ln1_post"] = P(st, None)
         specs["layers"]["ln2_post"] = P(st, None)
+    if cfg.qk_norm:
+        specs["layers"]["ln_q"] = P(st, None)
+        specs["layers"]["ln_k"] = P(st, None)
     if cfg.attention_bias:
         specs["layers"]["bq"] = P(st, tp, None)
         specs["layers"]["bk"] = P(st, kv, None)
@@ -445,10 +528,20 @@ def _embed(params: Dict[str, Any], cfg: "LlamaConfig",
     return x
 
 
-def _rope_inv_freq(cfg: LlamaConfig) -> np.ndarray:
+def _rope_inv_freq(cfg: LlamaConfig, local: bool = False) -> np.ndarray:
     Dh = cfg.head_dim
+    if local:
+        # gemma3 sliding layers: own base frequency, NO scaling (HF builds
+        # the local rotary with default rope_type regardless of
+        # config.rope_scaling)
+        theta = cfg.rope_local_theta or cfg.rope_theta
+        return (1.0 / (theta ** (np.arange(0, Dh, 2, dtype=np.float64) / Dh))
+                ).astype(np.float32)
     inv = 1.0 / (cfg.rope_theta ** (np.arange(0, Dh, 2, dtype=np.float64) / Dh))
     rs = cfg.rope_scaling or {}
+    if rs.get("rope_type") == "linear" or rs.get("type") == "linear":
+        # linear position scaling (gemma3 4b+): frequencies divide by factor
+        inv = inv / rs.get("factor", 1.0)
     if rs.get("rope_type") == "llama3" or rs.get("type") == "llama3":
         # llama3 frequency-dependent NTK-style scaling
         factor = rs.get("factor", 8.0)
@@ -465,9 +558,11 @@ def _rope_inv_freq(cfg: LlamaConfig) -> np.ndarray:
     return inv.astype(np.float32)
 
 
-def rope_tables(cfg: LlamaConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """cos/sin tables for given integer positions [...]: -> [..., Dh/2]."""
-    inv = jnp.asarray(_rope_inv_freq(cfg))
+def rope_tables(cfg: LlamaConfig, positions: jax.Array,
+                local: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables for given integer positions [...]: -> [..., Dh/2].
+    ``local=True`` = the sliding layers' table (gemma3 dual-base rope)."""
+    inv = jnp.asarray(_rope_inv_freq(cfg, local=local))
     ang = positions[..., None].astype(jnp.float32) * inv
     return jnp.cos(ang), jnp.sin(ang)
 
@@ -590,6 +685,8 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
     lp = params["layers"]
     x = _embed(params, cfg, tokens)  # [B,T,D] bf16
     cos, sin = rope_tables(cfg, positions)
+    if cfg.rope_local_theta is not None:
+        cos_l, sin_l = rope_tables(cfg, positions, local=True)
     flat_w = write_idx.reshape(-1)
     wp, wo = flat_w // page, flat_w % page
     rp, ro = read_idx // page, read_idx % page
@@ -639,8 +736,16 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
             q = q + lp["bq"][l]
             k = k + lp["bk"][l]
             v = v + lp["bv"][l]
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        if cfg.qk_norm:
+            # gemma3: per-head RMSNorm on q/k AFTER projection, BEFORE rope
+            q = rms_norm(q, lp["ln_q"][l], cfg.rms_eps, cfg.norm_offset)
+            k = rms_norm(k, lp["ln_k"][l], cfg.rms_eps, cfg.norm_offset)
+        if cfg.rope_local_theta is not None and cfg.layer_sliding(l):
+            q = apply_rope(q, cos_l, sin_l)
+            k = apply_rope(k, cos_l, sin_l)
+        else:
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
         # scatter chunk KV into the pool (write-then-gather). The scalar
         # layer index is itself an "advanced" index, so the batched dims of
         # [l, :, wp, wo] land in FRONT of the Hkv slice: shape [n, Hkv, Dh]
@@ -744,11 +849,15 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
     # rope_tables handles arbitrary leading dims
     x0 = _embed(params, cfg, tokens)                   # [M, Bm, T, D]
     cos, sin = rope_tables(cfg, positions)             # [M, Bm, T, Dh/2]
+    if cfg.rope_local_theta is not None:
+        cos_sl, sin_sl = rope_tables(cfg, positions, local=True)
+    else:
+        cos_sl, sin_sl = cos, sin   # unused; keeps the shard_map arity fixed
 
     perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
 
-    def local(lp_loc, kp_loc, vp_loc, x0, cos, sin, positions, widx, ridx,
-              rpos, rvalid):
+    def local(lp_loc, kp_loc, vp_loc, x0, cos, sin, cos_sl, sin_sl,
+              positions, widx, ridx, rpos, rvalid):
         idx = jax.lax.axis_index(AXIS_PP)
         Lloc = L // pp
         cur = jnp.zeros_like(x0[0])
@@ -758,6 +867,8 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
             cur, kp, vp = carry
             c_m = jax.lax.dynamic_index_in_dim(cos, mb, keepdims=False)
             s_m = jax.lax.dynamic_index_in_dim(sin, mb, keepdims=False)
+            cl_m = jax.lax.dynamic_index_in_dim(cos_sl, mb, keepdims=False)
+            sl_m = jax.lax.dynamic_index_in_dim(sin_sl, mb, keepdims=False)
             widx_m = jax.lax.dynamic_index_in_dim(widx, mb, keepdims=False)
             ridx_m = jax.lax.dynamic_index_in_dim(ridx, mb, keepdims=False)
             rpos_m = jax.lax.dynamic_index_in_dim(rpos, mb, keepdims=False)
@@ -791,8 +902,24 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
                     q = q + lp_loc["bq"][l]
                     k = k + lp_loc["bk"][l]
                     v = v + lp_loc["bv"][l]
-                q = apply_rope(q, c_m, s_m)
-                k = apply_rope(k, c_m, s_m)
+                if cfg.qk_norm:
+                    q = rms_norm(q, lp_loc["ln_q"][l], cfg.rms_eps,
+                                 cfg.norm_offset)
+                    k = rms_norm(k, lp_loc["ln_k"][l], cfg.rms_eps,
+                                 cfg.norm_offset)
+                if (cfg.rope_local_theta is not None
+                        and cfg.sliding_window is not None):
+                    # gemma3 dual-base rope: the GLOBAL layer index (traced
+                    # stage offset) picks local vs global tables — same
+                    # guard as cfg.layer_sliding so pp stays exact vs the
+                    # sequential forward when sliding_window is unset
+                    sl = (idx * Lloc + l + 1) % cfg.sliding_pattern != 0
+                    c_sel = jnp.where(sl, cl_m, c_m)
+                    s_sel = jnp.where(sl, sl_m, s_m)
+                else:
+                    c_sel, s_sel = c_m, s_m
+                q = apply_rope(q, c_sel, s_sel)
+                k = apply_rope(k, c_sel, s_sel)
                 kp = kp.at[l, :, wp, wo].set(
                     k.reshape(-1, *k.shape[2:]), mode="drop")
                 vp = vp.at[l, :, wp, wo].set(
@@ -811,8 +938,9 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
                 elif cfg.sliding_window is not None:
                     # the GLOBAL layer index (stage offset + local index)
                     # decides sliding vs full — idx is traced, so select
-                    m_l = jnp.where((idx * Lloc + l) % 2 == 0,
-                                    sliding_mask, mask)
+                    m_l = jnp.where(
+                        (idx * Lloc + l + 1) % cfg.sliding_pattern != 0,
+                        sliding_mask, mask)
                     attn = attend(q, k_ctx, v_ctx, m_l,
                                   scale=cfg.attn_scale,
                                   softcap=cfg.attn_logit_softcap)
@@ -867,11 +995,11 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
     xs, k_pool, v_pool = jax.shard_map(
         local, mesh=mesh,
         in_specs=(pspec, pool_spec, pool_spec, rep, rep, rep, rep, rep,
-                  rep, rep, rep),
+                  rep, rep, rep, rep, rep),
         out_specs=(rep, pool_spec, pool_spec),
         check_vma=False,
-    )(lp, k_pool, v_pool, x0, cos, sin, positions, write_idx, read_idx,
-      read_pos, read_valid)
+    )(lp, k_pool, v_pool, x0, cos, sin, cos_sl, sin_sl, positions,
+      write_idx, read_idx, read_pos, read_valid)
 
     if logits_idx is not None:
         xs = jnp.take_along_axis(
@@ -997,6 +1125,8 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
     pos = lengths - 1                                  # [B]
     x = _embed(params, cfg, tokens)[:, None]           # [B,1,D]
     cos, sin = rope_tables(cfg, pos[:, None])
+    if cfg.rope_local_theta is not None:
+        cos_l, sin_l = rope_tables(cfg, pos[:, None], local=True)
     w_page = jnp.take_along_axis(page_tables, (pos // page)[:, None],
                                  axis=1)[:, 0]
     w_off = pos % page
@@ -1038,8 +1168,15 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
             q = q + lp["bq"][l]
             k = k + lp["bk"][l]
             v = v + lp["bv"][l]
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        if cfg.qk_norm:
+            q = rms_norm(q, lp["ln_q"][l], cfg.rms_eps, cfg.norm_offset)
+            k = rms_norm(k, lp["ln_k"][l], cfg.rms_eps, cfg.norm_offset)
+        if cfg.rope_local_theta is not None and cfg.layer_sliding(l):
+            q = apply_rope(q, cos_l, sin_l)
+            k = apply_rope(k, cos_l, sin_l)
+        else:
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
         # [l, :, w_page, w_off] batches over the scalar l too, so the
         # indexed shape is [B, Hkv, Dh] — exactly k[:, 0]
         k_pool = k_pool.at[l, :, w_page, w_off].set(k[:, 0])

@@ -171,3 +171,55 @@ def test_forward_pp_flash_rejected_for_gemma2():
                          jnp.zeros((1, 1, 4), jnp.int32), None, None,
                          None, None, None, None, _mesh(1),
                          attn_impl="flash")
+
+
+@pytest.mark.parametrize("pp", [2])
+def test_forward_pp_gemma3_matches_sequential(pp):
+    """Gemma3 stage body: QK-norm + the traced global-layer dual-base rope
+    selection (local for sliding layers, global for full) must be exact vs
+    the sequential forward. 6 layers / pp=2 -> 3 per stage with pattern 3:
+    stage 0's full layer is l=2, stage 1's is l=5 — both the rope table
+    choice and the mask choice depend on the traced stage index."""
+    cfg = llama.LlamaConfig(
+        vocab_size=97, hidden_size=32, num_layers=6, num_heads=4,
+        num_kv_heads=2, head_dim=8, intermediate_size=48,
+        rope_theta=1000000.0, max_position=256, tie_embeddings=False,
+        sandwich_norms=True, qk_norm=True, sliding_window=5,
+        sliding_pattern=3, rope_local_theta=10000.0,
+        query_pre_attn_scalar=12.0, hidden_act="gelu_tanh",
+        norm_offset=True, embed_scale=True, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(4))
+    M, Bm, T, page, P = 2, 2, 8, 8, 2
+    S = P * page
+    n_pages = M * Bm * P + 1
+
+    rng = np.random.RandomState(3)
+    tokens = jnp.asarray(rng.randint(1, 97, (M, Bm, T)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (M, Bm, T))
+    lane = (jnp.arange(M * Bm).reshape(M, Bm) * P)[..., None]
+    pt = lane + jnp.arange(P, dtype=jnp.int32) + 1
+    slot = (pt[..., None] * page
+            + jnp.arange(page, dtype=jnp.int32)).reshape(M, Bm, S)
+    widx, ridx = slot[..., :T], slot
+    rpos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (M, Bm, S))
+    rvalid = rpos < T
+
+    z = jnp.zeros((cfg.num_layers, cfg.num_kv_heads, n_pages, page,
+                   cfg.head_dim), jnp.float32)
+    k_ref, v_ref = z, jnp.zeros_like(z)
+    logits_ref = []
+    for m in range(M):
+        lg, k_ref, v_ref = llama.forward(
+            params, cfg, tokens[m], positions[m], k_ref, v_ref,
+            widx[m], ridx[m], rpos[m], rvalid[m])
+        logits_ref.append(lg)
+    logits_ref = jnp.stack(logits_ref)
+
+    logits_pp, k_pp, _ = llama.forward_pp(
+        params, cfg, tokens, positions, z, jnp.zeros_like(z), widx, ridx,
+        rpos, rvalid, _mesh(pp))
+    np.testing.assert_allclose(np.asarray(logits_pp),
+                               np.asarray(logits_ref),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(k_pp), np.asarray(k_ref),
+                               atol=1e-5)

@@ -82,6 +82,9 @@ def _load_ours_into_hf(model, cfg, params, bias: bool):
             sd[p + "post_feedforward_layernorm.weight"] = T(lp["ln2_post"][i])
         else:
             sd[p + "post_attention_layernorm.weight"] = T(lp["ln2"][i])
+        if cfg.qk_norm:
+            sd[p + "self_attn.q_norm.weight"] = T(lp["ln_q"][i])
+            sd[p + "self_attn.k_norm.weight"] = T(lp["ln_k"][i])
         sd[p + "self_attn.q_proj.weight"] = T(
             np.asarray(lp["wq"][i], np.float32).reshape(D, Hq * Dh).T)
         sd[p + "self_attn.k_proj.weight"] = T(
@@ -485,13 +488,8 @@ def test_gemma2_safetensors_roundtrip(tmp_path):
                                atol=5e-3, rtol=5e-3)
 
 
-def test_gemma3_rejected_not_mis_served():
-    with pytest.raises(ValueError, match="Gemma3"):
-        llama.LlamaConfig.from_hf_config({
-            "architectures": ["Gemma3ForCausalLM"],
-            "vocab_size": 256, "hidden_size": 64,
-            "num_hidden_layers": 2, "num_attention_heads": 4,
-            "intermediate_size": 128})
+# (Gemma3 text is now SUPPORTED — see test_gemma3_* below; only the
+# multimodal variant remains rejected.)
 
 
 def test_gemma2_gguf_roundtrip(tmp_path):
@@ -556,3 +554,176 @@ def test_gemma2_gguf_roundtrip(tmp_path):
     np.testing.assert_allclose(_our_logits(cfg, params, tokens),
                                _our_logits(cfg2, loaded, tokens),
                                atol=5e-3, rtol=5e-3)
+
+
+def _hf_logits_gemma3(cfg, params, tokens):
+    hf_cfg = transformers.Gemma3TextConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        intermediate_size=cfg.intermediate_size,
+        rope_theta=cfg.rope_theta,
+        rope_local_base_freq=cfg.rope_local_theta,
+        rms_norm_eps=cfg.rms_eps,
+        max_position_embeddings=cfg.max_position,
+        tie_word_embeddings=cfg.tie_embeddings,
+        hidden_activation="gelu_pytorch_tanh",
+        attention_dropout=0.0,
+        attention_bias=False,
+        query_pre_attn_scalar=cfg.query_pre_attn_scalar,
+        sliding_window=cfg.sliding_window,
+        layer_types=[("full_attention"
+                      if not cfg.layer_sliding(l) else "sliding_attention")
+                     for l in range(cfg.num_layers)],
+        rope_scaling=cfg.rope_scaling,
+        attn_implementation="eager",
+    )
+    model = transformers.Gemma3ForCausalLM(hf_cfg).eval()
+    _load_ours_into_hf(model, cfg, params, bias=False)
+    with torch.no_grad():
+        out = model(torch.tensor(tokens, dtype=torch.long))
+    return out.logits.float().numpy()
+
+
+def test_gemma3_matches_hf():
+    """Gemma3: QK-norm, dual-base rope (local for sliding layers, global +
+    linear scaling for full layers), 5:1-style sliding pattern, sandwich
+    norms — logits parity vs HF transformers. The tiny preset's pattern is
+    3 (layers 2 and 5 full) with a window (8) shorter than the prompt so
+    both rope bases AND the pattern actually bind."""
+    cfg, params = _f32_params(llama.preset(
+        "tiny-gemma3",
+        rope_scaling={"rope_type": "linear", "factor": 4.0}))
+    assert not cfg.layer_sliding(2) and cfg.layer_sliding(1)
+    rng = np.random.RandomState(8)
+    tokens = rng.randint(0, cfg.vocab_size, (2, 12))
+    ours = _our_logits(cfg, params, tokens)
+    hf = _hf_logits_gemma3(cfg, params, tokens)
+    np.testing.assert_allclose(ours, hf, atol=2e-3, rtol=2e-3)
+
+
+def test_gemma3_hf_config_mapping():
+    cfg = llama.LlamaConfig.from_hf_config({
+        "architectures": ["Gemma3ForCausalLM"],
+        "vocab_size": 262208, "hidden_size": 2560,
+        "num_hidden_layers": 34, "num_attention_heads": 8,
+        "num_key_value_heads": 4, "head_dim": 256,
+        "intermediate_size": 10240, "rope_theta": 1000000.0,
+        "rope_local_base_freq": 10000.0,
+        "rms_norm_eps": 1e-6, "max_position_embeddings": 131072,
+        "tie_word_embeddings": True,
+        "hidden_activation": "gelu_pytorch_tanh",
+        "query_pre_attn_scalar": 256,
+        "sliding_window": 1024,
+        "layer_types": (["sliding_attention"] * 5
+                        + ["full_attention"]) * 5 + ["sliding_attention"] * 4,
+        "rope_scaling": {"rope_type": "linear", "factor": 8.0},
+    })
+    assert cfg.qk_norm and cfg.sandwich_norms
+    assert cfg.rope_local_theta == 10000.0
+    assert cfg.sliding_pattern == 6
+    assert cfg.attn_logit_softcap is None      # gone in v3
+    assert cfg.layer_sliding(0) and not cfg.layer_sliding(5)
+
+
+def test_gemma3_serves_through_engine():
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+    from dynamo_tpu.llm.protocols.common import BackendInput, StopConditions
+
+    core = EngineCore(JaxEngineConfig(
+        model=llama.preset("tiny-gemma3"), max_batch=2, max_context=128,
+        page_size=8, prefill_chunk=32, attn_impl="auto"))
+    assert core.attn_impl == "xla"   # sliding windows force the xla path
+
+    def run(seq):
+        core.submit(seq, BackendInput(token_ids=[5, 6, 7],
+                                      stop=StopConditions(max_tokens=5,
+                                                          ignore_eos=True)))
+        toks = []
+        for _ in range(200):
+            for so in core.step():
+                assert so.error is None
+                toks.append(so.token)
+            if not core.has_work:
+                break
+        return toks
+
+    a = run("a")
+    assert len(a) == 5 and a == run("b")
+
+
+def test_gemma3_gguf_roundtrip(tmp_path):
+    """gemma3-arch GGUF (qk-norm tensors, dual rope bases) loads and
+    reproduces the source model's logits (norms stored EFFECTIVE, +1
+    baked, llama.cpp convention)."""
+    from dynamo_tpu.llm.gguf import load_llama_params_gguf, write_gguf
+
+    cfg, params = _f32_params(llama.preset("tiny-gemma3"))
+    D, Hq, Hkv, Dh = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                      cfg.head_dim)
+    lp = params["layers"]
+    A = lambda a: np.asarray(a, np.float32)
+    tensors = {"token_embd.weight": A(params["embed"]),
+               "output_norm.weight": A(params["final_norm"]) + 1.0}
+    for i in range(cfg.num_layers):
+        tensors[f"blk.{i}.attn_norm.weight"] = A(lp["ln1"][i]) + 1.0
+        tensors[f"blk.{i}.post_attention_norm.weight"] = \
+            A(lp["ln1_post"][i]) + 1.0
+        tensors[f"blk.{i}.ffn_norm.weight"] = A(lp["ln2"][i]) + 1.0
+        tensors[f"blk.{i}.post_ffw_norm.weight"] = A(lp["ln2_post"][i]) + 1.0
+        tensors[f"blk.{i}.attn_q_norm.weight"] = A(lp["ln_q"][i]) + 1.0
+        tensors[f"blk.{i}.attn_k_norm.weight"] = A(lp["ln_k"][i]) + 1.0
+        tensors[f"blk.{i}.attn_q.weight"] = A(lp["wq"][i]).reshape(
+            D, Hq * Dh).T
+        tensors[f"blk.{i}.attn_k.weight"] = A(lp["wk"][i]).reshape(
+            D, Hkv * Dh).T
+        tensors[f"blk.{i}.attn_v.weight"] = A(lp["wv"][i]).reshape(
+            D, Hkv * Dh).T
+        tensors[f"blk.{i}.attn_output.weight"] = A(lp["wo"][i]).reshape(
+            Hq * Dh, D).T
+        tensors[f"blk.{i}.ffn_gate.weight"] = A(lp["wg"][i]).T
+        tensors[f"blk.{i}.ffn_up.weight"] = A(lp["wu"][i]).T
+        tensors[f"blk.{i}.ffn_down.weight"] = A(lp["wd"][i]).T
+    meta = {
+        "general.architecture": "gemma3",
+        "gemma3.embedding_length": cfg.hidden_size,
+        "gemma3.block_count": cfg.num_layers,
+        "gemma3.attention.head_count": cfg.num_heads,
+        "gemma3.attention.head_count_kv": cfg.num_kv_heads,
+        "gemma3.attention.key_length": cfg.head_dim,
+        "gemma3.feed_forward_length": cfg.intermediate_size,
+        "gemma3.rope.freq_base": cfg.rope_theta,
+        "gemma3.rope.local.freq_base": cfg.rope_local_theta,
+        "gemma3.attention.layer_norm_rms_epsilon": cfg.rms_eps,
+        "gemma3.context_length": cfg.max_position,
+        "gemma3.vocab_size": cfg.vocab_size,
+        "gemma3.attention.sliding_window": cfg.sliding_window,
+        "gemma3.attention.query_pre_attn_scalar": cfg.query_pre_attn_scalar,
+    }
+    p = tmp_path / "g3.gguf"
+    write_gguf(str(p), meta, tensors)
+    cfg2, loaded = load_llama_params_gguf(str(p), dtype=np.float32)
+    assert cfg2.qk_norm and cfg2.sandwich_norms and not cfg2.norm_offset
+    assert cfg2.rope_local_theta == cfg.rope_local_theta
+    assert cfg2.sliding_window == cfg.sliding_window
+    # GGUF default pattern is 6; the tiny preset uses 3 — override to
+    # compare apples to apples (llama.cpp gemma3 is always 6)
+    cfg2 = llama.LlamaConfig(**{**cfg2.__dict__,
+                                "sliding_pattern": cfg.sliding_pattern})
+    rng = np.random.RandomState(9)
+    tokens = rng.randint(0, cfg.vocab_size, (1, 12))
+    np.testing.assert_allclose(_our_logits(cfg, params, tokens),
+                               _our_logits(cfg2, loaded, tokens),
+                               atol=5e-3, rtol=5e-3)
+
+
+def test_gemma3_multimodal_rejected():
+    with pytest.raises(ValueError, match="Gemma3ForConditionalGeneration"):
+        llama.LlamaConfig.from_hf_config({
+            "architectures": ["Gemma3ForConditionalGeneration"],
+            "vocab_size": 256, "hidden_size": 64,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "intermediate_size": 128})
