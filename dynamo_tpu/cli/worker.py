@@ -107,6 +107,14 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
     own_drt = drt is None
     if own_drt:
         drt = await _connect_drt(args)
+    if token is not None:
+        # reference semantics (etcd.rs:55-76): losing the liveness lease
+        # cancels the worker — shut down cleanly so the orchestrator
+        # restarts us with a fresh lease, instead of serving unroutably
+        def _lease_lost(lease: int) -> None:
+            log.critical("liveness lease %x lost; shutting down", lease)
+            token.cancel()
+        drt.store.on_lease_lost = _lease_lost
     ns = drt.namespace(args.namespace)
     component = ns.component(args.component)
 
@@ -264,6 +272,9 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
             while True:
                 await asyncio.sleep(3600)
     finally:
+        # never leave the lease-lost closure pointing at a token the
+        # caller may repurpose after this worker exits (shared-drt case)
+        drt.store.on_lease_lost = None
         mtask.cancel()
         await pub.stop()
         if own_drt:

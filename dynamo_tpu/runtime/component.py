@@ -136,11 +136,29 @@ class DistributedRuntime:
 
     async def connect(self) -> "DistributedRuntime":
         await self.store.connect()
-        self.lease = await self.store.lease_grant(ttl=5.0)
+        # Liveness TTL (DYN_LEASE_TTL): keepalives fire every ttl/3 from
+        # the asyncio loop, so the margin must absorb loop starvation
+        # (compile storms, loaded CI boxes). 10s = etcd-typical default;
+        # worker-death detection latency is bounded by the same number.
+        import math
+        import os
+        raw_ttl = os.environ.get("DYN_LEASE_TTL", "10.0")
+        try:
+            ttl = float(raw_ttl)
+        except ValueError:
+            ttl = -1.0
+        if not (math.isfinite(ttl) and ttl > 0):
+            raise ValueError(f"DYN_LEASE_TTL={raw_ttl!r} (expected a "
+                             "positive number of seconds)")
+        self.lease = await self.store.lease_grant(ttl=ttl)
         self.worker_id = self.lease
         return self
 
     async def close(self) -> None:
+        # orderly shutdown: the revoke below would otherwise read as a
+        # lease LOSS at the next keepalive beat and fire a spurious
+        # shutdown callback
+        self.store.on_lease_lost = None
         if self.lease is not None:
             try:
                 await self.store.lease_revoke(self.lease)

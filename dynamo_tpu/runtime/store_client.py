@@ -39,6 +39,9 @@ class StoreClient:
         self._push_q: "asyncio.Queue[Dict[str, Any]]" = asyncio.Queue()
         self._push_task: Optional[asyncio.Task] = None
         self._keepalive_tasks: List[asyncio.Task] = []
+        # fired (sync, on the loop) when a kept-alive lease is discovered
+        # lost — liveness is gone, the owner should shut down/restart
+        self.on_lease_lost: Optional[Callable[[int], None]] = None
         self._send_lock = asyncio.Lock()
         self.closed = asyncio.Event()
 
@@ -150,12 +153,46 @@ class StoreClient:
                 self._keepalive_loop(lease, ttl), name=f"lease-{lease}"))
         return lease
 
+    def _fire_lease_lost(self, lease: int, why: str) -> None:
+        # liveness is gone: registrations expire(d) server-side, so a
+        # worker that kept serving would be an unroutable zombie. Mirror
+        # the reference (etcd.rs:55-76 — lease loss cancels the worker's
+        # token): notify so the shell can shut down for a clean restart.
+        log.warning("lease %x lost (%s); keepalive stopping", lease, why)
+        if self.on_lease_lost is not None:
+            try:
+                self.on_lease_lost(lease)
+            except Exception:
+                log.exception("on_lease_lost callback")
+
     async def _keepalive_loop(self, lease: int, ttl: float) -> None:
         try:
             while True:
                 await asyncio.sleep(ttl / 3)
-                await self._call("lease_keepalive", lease=lease)
-        except (asyncio.CancelledError, StoreError):
+                try:
+                    await self._call("lease_keepalive", lease=lease)
+                except StoreError as e:
+                    if "lease not found" in str(e):
+                        # expired server-side (e.g. after loop starvation)
+                        self._fire_lease_lost(lease, str(e))
+                        return
+                    if "connection" in str(e).lower():
+                        # this client has ONE connection and no reconnect:
+                        # once it is gone every renewal will fail and the
+                        # lease WILL expire — that is a lease loss
+                        self._fire_lease_lost(lease, str(e))
+                        return
+                    # other server hiccup (version skew, transient): the
+                    # lease may still be alive — keep trying rather than
+                    # orphaning a healthy lease
+                    log.debug("lease %x keepalive error (retrying): %s",
+                              lease, e)
+                except (ConnectionError, OSError,
+                        asyncio.IncompleteReadError) as e:
+                    # transport died mid-call — same terminal state
+                    self._fire_lease_lost(lease, f"{type(e).__name__}: {e}")
+                    return
+        except asyncio.CancelledError:
             pass
 
     async def lease_revoke(self, lease: int) -> None:

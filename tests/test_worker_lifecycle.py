@@ -220,3 +220,37 @@ def test_worker_shutdown_runs_when_app_returns_at_cancel():
 
     run()
     assert drt.closed and not drt._active
+
+
+async def test_lease_loss_fires_callback_and_cancels_worker():
+    """Reference semantics (etcd.rs:55-76): losing the liveness lease must
+    not leave a serving-but-unroutable zombie — the keepalive loop fires
+    on_lease_lost and the worker shell's token cancels (round-4: the
+    keepalive also survives TRANSIENT store errors instead of silently
+    dying and orphaning a healthy lease)."""
+    import asyncio
+
+    from dynamo_tpu.runtime.store_client import StoreClient
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    store = StoreServer()
+    port = await store.start()
+    c = await StoreClient(port=port).connect()
+    try:
+        lost = asyncio.Event()
+        c.on_lease_lost = lambda lease: lost.set()
+        # generous ttl: the healthy-half assertion must not depend on CI
+        # scheduling (keepalive every 2s, expiry headroom 6s)
+        lease = await c.lease_grant(ttl=6.0)
+        await asyncio.sleep(2.5)                      # ≥1 keepalive beat
+        assert not lost.is_set(), "healthy lease reported lost"
+
+        # revoke server-side (what expiry does): next keepalive discovers
+        # the loss and fires the callback
+        other = await StoreClient(port=port).connect()
+        await other.lease_revoke(lease)
+        await other.close()
+        await asyncio.wait_for(lost.wait(), 5)
+    finally:
+        await c.close()
+        await store.stop()
