@@ -42,6 +42,12 @@ import time
 TARGET_TOK_S = 4000.0
 PROBE_TIMEOUT_S = float(os.environ.get("DYNAMO_BENCH_PROBE_TIMEOUT", "150"))
 BUDGET_S = float(os.environ.get("DYNAMO_BENCH_BUDGET", "1500"))
+# Every (model, batch) measurement is flushed here the moment it lands: a
+# tunnel wedge mid-sweep must leave the points already measured as a real
+# artifact (round-4 lost its only on-chip window to end-of-run-only writing)
+PARTIAL_PATH = os.environ.get(
+    "DYNAMO_BENCH_PARTIAL", os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "BENCH_PARTIAL.json"))
 
 _PEAK_BF16 = (  # device_kind substring -> peak dense bf16 FLOP/s per chip
     ("v6", 918e12),
@@ -81,8 +87,20 @@ def _probe_backend(timeout_s: float):
     return None
 
 
+def _flush_partial(payload: dict) -> None:
+    """Atomically write the in-progress result. Never allowed to fail the
+    bench: a read-only FS just loses the hedge, not the run."""
+    try:
+        tmp = PARTIAL_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, PARTIAL_PATH)
+    except Exception:
+        pass
+
+
 def _run_model(model_cfg, batches, prompt_len, gen_tokens, max_context,
-               on_tpu, peak_flops, deadline):
+               on_tpu, peak_flops, deadline, flush=None):
     """For each batch size, build an EngineCore sized max_batch=b (decode
     dispatches always run at full engine width, so measuring batch b inside a
     max-sized engine would measure padding, not batch-b performance), run a
@@ -141,9 +159,15 @@ def _run_model(model_cfg, batches, prompt_len, gen_tokens, max_context,
                 t_first, post_tokens)
 
     sweep = []
+
+    def _record(entry):
+        sweep.append(entry)
+        if flush is not None:
+            flush(n_params, sweep)
+
     for b in batches:
         if time.monotonic() > deadline:
-            sweep.append({"batch": b, "skipped": "time budget"})
+            _record({"batch": b, "skipped": "time budget"})
             continue
         try:
             core = None  # drop the previous core BEFORE building the next
@@ -159,7 +183,7 @@ def _run_model(model_cfg, batches, prompt_len, gen_tokens, max_context,
         except Exception as e:
             # one batch failing (e.g. OOM at the largest size) must not
             # discard the batches already measured for this model
-            sweep.append({"batch": b, "error": f"{type(e).__name__}: {e}"})
+            _record({"batch": b, "error": f"{type(e).__name__}: {e}"})
             continue
         # steady-state decode rate: tokens from dispatches strictly after the
         # one that produced the last first-token, over the time after it —
@@ -189,13 +213,17 @@ def _run_model(model_cfg, batches, prompt_len, gen_tokens, max_context,
                     warm_ttfts[len(warm_ttfts) // 2], 4)
         except Exception:  # noqa: BLE001 - warm pass is optional
             pass
-        sweep.append(entry)
+        _record(entry)
     return n_params, sweep
 
 
 def main() -> None:
     t_start = time.monotonic()
     deadline = t_start + BUDGET_S
+    try:  # a stale partial from a previous run must never be mistaken for
+        os.remove(PARTIAL_PATH)  # this run's artifact by the salvage path
+    except OSError:
+        pass
 
     probe = _probe_backend(PROBE_TIMEOUT_S)
     if probe is None:
@@ -246,52 +274,64 @@ def main() -> None:
         runs = [("tiny-byte", llama.preset("tiny-byte"), [1, 4], 32, 32, 256)]
 
     sweeps = []
-    headline = None
+
+    def assemble(partial: bool):
+        best = None
+        for sw in sweeps:
+            if sw.get("model") == runs[0][0]:
+                done = [e for e in sw.get("results", []) if "decode_tok_s" in e]
+                if done:
+                    best = max(done, key=lambda e: e["decode_tok_s"])
+        return {
+            "metric": "decode_tok_s_per_chip",
+            "value": best["decode_tok_s"] if best else 0.0,
+            "unit": "tok/s",
+            "vs_baseline": (round(best["decode_tok_s"] / TARGET_TOK_S, 3)
+                            if best else 0.0),
+            "platform": platform,
+            "device_kind": dev.device_kind,
+            "tpu": tpu_status,
+            "model": runs[0][0],
+            "best_batch": best.get("batch") if best else None,
+            "p50_ttft_s": best.get("p50_ttft_s") if best else None,
+            "mfu": best.get("mfu") if best else None,
+            "paged_kernel": (os.environ.get("DYNAMO_TPU_PAGED_KERNEL", "dma")
+                             if platform == "tpu" else "simple[interpret]"),
+            "sweep": sweeps,
+            "notes": notes,
+            "partial": partial,
+            "wall_s": round(time.monotonic() - t_start, 1),
+        }
+
     for name, mcfg, batches, plen, gen, ctx in runs:
         if time.monotonic() > deadline:
             sweeps.append({"model": name, "skipped": "time budget"})
             continue
+        live = {"model": name, "prompt_len": plen, "gen_tokens": gen,
+                "results": []}
+        sweeps.append(live)
+
+        def flush(n_params, sweep, live=live):
+            live["n_params"] = n_params
+            live["results"] = sweep
+            _flush_partial(assemble(partial=True))
+
         try:
             n_params, sweep = _run_model(mcfg, batches, plen, gen, ctx,
-                                         on_tpu, peak, deadline)
+                                         on_tpu, peak, deadline, flush=flush)
         except Exception as e:
             # a later run (e.g. the conditional 8B sweep) must never zero an
             # already-measured headline — record and keep going
-            sweeps.append({"model": name, "error": f"{type(e).__name__}: {e}"})
+            live["error"] = f"{type(e).__name__}: {e}"
             continue
-        sweeps.append({"model": name, "n_params": n_params,
-                       "prompt_len": plen, "gen_tokens": gen,
-                       "results": sweep})
-        # the headline (and vs_baseline, a 1B-class target) is strictly the
-        # first model's sweep — a later model must never stand in for it
-        if name == runs[0][0] and headline is None:
-            best = [e for e in sweep if "decode_tok_s" in e]
-            if best:
-                headline = max(best, key=lambda e: e["decode_tok_s"])
+        live["n_params"] = n_params
+        live["results"] = sweep
 
-    result = {
-        "metric": "decode_tok_s_per_chip",
-        "value": headline["decode_tok_s"] if headline else 0.0,
-        "unit": "tok/s",
-        "vs_baseline": (round(headline["decode_tok_s"] / TARGET_TOK_S, 3)
-                        if headline else 0.0),
-        "platform": platform,
-        "device_kind": dev.device_kind,
-        "tpu": tpu_status,
-        "model": runs[0][0],
-        "best_batch": headline.get("batch") if headline else None,
-        "p50_ttft_s": headline.get("p50_ttft_s") if headline else None,
-        "mfu": headline.get("mfu") if headline else None,
-        # which decode kernel served the headline number: auto resolves to
-        # pallas[dma] on TPU (DYNAMO_TPU_PAGED_KERNEL=simple the fallback);
-        # off-TPU the interpreted simple kernel ALWAYS runs, whatever the
-        # env var says — label truthfully
-        "paged_kernel": (os.environ.get("DYNAMO_TPU_PAGED_KERNEL", "dma")
-                         if platform == "tpu" else "simple[interpret]"),
-        "sweep": sweeps,
-        "notes": notes,
-        "wall_s": round(time.monotonic() - t_start, 1),
-    }
+    # the headline (and vs_baseline, a 1B-class target) is strictly the
+    # first model's sweep — a later model must never stand in for it;
+    # assemble() enforces that by matching runs[0][0]
+    result = assemble(partial=False)
+    _flush_partial(result)
     print(json.dumps(result), flush=True)
 
 
