@@ -98,7 +98,10 @@ async def run(args) -> int:
             print(f"removed {args.name}")
         elif args.action == "list":
             for m in await list_models(store):
-                print(f"{m['type']:<11} {m['name']:<30} {m['endpoint']}")
+                inst = (f"  x{m['instances']}"
+                        if m.get("instances", 1) > 1 else "")
+                print(f"{m['type']:<11} {m['name']:<30} {m['endpoint']}"
+                      f"{inst}")
         return 0
     finally:
         await store.close()

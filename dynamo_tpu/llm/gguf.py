@@ -233,6 +233,40 @@ class GGUFFile:
         gemma2 = arch == "gemma2"
         gemma3 = arch == "gemma3"
         gemma_any = arch in ("gemma", "gemma2", "gemma3")
+        # rope scaling: gemma3 4b/12b/27b and linear-scaled llamas carry
+        # {arch}.rope.scaling.{type,factor}; ignoring them would run rope at
+        # unscaled (e.g. 8x-too-fast) frequencies — silently wrong logits at
+        # every position. llama3-style NTK scaling is exported by llama.cpp
+        # as a rope_freqs.weight tensor of per-frequency divisors instead.
+        rope_scaling = None
+        scale_type = g("rope.scaling.type")
+        if scale_type in (None, "", "none"):
+            pass
+        elif scale_type == "linear":
+            rope_scaling = {"rope_type": "linear",
+                            "factor": float(g("rope.scaling.factor", 1.0))}
+        else:
+            # yarn etc.: refusing beats serving wrong positions for every
+            # token (ref lib/llm/src/gguf/* takes the same bail-hard stance
+            # on unknown tokenizer models)
+            raise NotImplementedError(
+                f"GGUF rope scaling type {scale_type!r} is not supported "
+                f"(linear and llama3-style rope_freqs factors are); "
+                f"serving without it would be silently wrong")
+        for tname in ("rope_freqs.weight", "rope_factors_long.weight"):
+            if tname in self.tensors:
+                if tname != "rope_freqs.weight":
+                    raise NotImplementedError(
+                        f"GGUF per-position rope factor tensor {tname!r} "
+                        f"(longrope) is not supported")
+                factors = self.load_tensor(tname).astype(float).ravel()
+                if rope_scaling is not None:
+                    # ggml applies freq_scale (linear) AND freq_factors
+                    # together (ggml_rope_ext); fold the linear factor into
+                    # the per-frequency divisors rather than dropping it
+                    factors = factors * rope_scaling["factor"]
+                rope_scaling = {"rope_type": "ggml_factors",
+                                "factors": factors.tolist()}
         return LlamaConfig(
             tie_embeddings="output.weight" not in self.tensors,
             attention_bias="blk.0.attn_q.bias" in self.tensors,
@@ -270,6 +304,7 @@ class GGUFFile:
                 if ((gemma2 and int(g("block_count")) == 46)
                     or (gemma3 and int(g("block_count")) == 62))
                 else None),
+            rope_scaling=rope_scaling,
             vocab_size=vocab_size,
             hidden_size=emb,
             num_layers=int(g("block_count")),

@@ -31,11 +31,16 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t, ctypes.c_int,
         ctypes.c_uint64]
     lib.dynamo_kv_event_publish_stored.restype = ctypes.c_int
-    lib.dynamo_kv_event_publish_stored_v2.argtypes = [
-        ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
-        ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t, ctypes.c_int,
-        ctypes.c_uint64, ctypes.c_uint64]
-    lib.dynamo_kv_event_publish_stored_v2.restype = ctypes.c_int
+    # the v2 symbol (adds lora_id) may be absent from a prebuilt library
+    # built before it existed — probe instead of binding unconditionally so
+    # init doesn't die on a raw ctypes AttributeError (ADVICE r4); callers
+    # fall back to v1 when lora_id==0 and get a clear rebuild error otherwise
+    if hasattr(lib, "dynamo_kv_event_publish_stored_v2"):
+        lib.dynamo_kv_event_publish_stored_v2.argtypes = [
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_uint64]
+        lib.dynamo_kv_event_publish_stored_v2.restype = ctypes.c_int
     lib.dynamo_kv_event_publish_removed.argtypes = [
         ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t]
     lib.dynamo_kv_event_publish_removed.restype = ctypes.c_int
@@ -54,6 +59,9 @@ class NativeKvPublisher:
     def __init__(self, host: str, port: int, namespace: str, component: str,
                  worker_id: int):
         self._lib = _load_lib()
+        # probe once: ctypes does not cache symbol MISSES, so a per-call
+        # hasattr on the hot path would dlsym+raise on every publish
+        self._has_v2 = hasattr(self._lib, "dynamo_kv_event_publish_stored_v2")
         rc = self._lib.dynamo_llm_init(
             host.encode(), port, namespace.encode(), component.encode(),
             worker_id)
@@ -79,9 +87,21 @@ class NativeKvPublisher:
         bh = (ctypes.c_uint64 * n)(*[b for b, _ in blocks])
         th = (ctypes.c_uint64 * n)(*[t for _, t in blocks])
         eid = self._next_id()
-        rc = self._lib.dynamo_kv_event_publish_stored_v2(
-            eid, bh, th, n, int(parent_hash is not None), parent_hash or 0,
-            lora_id)
+        if self._has_v2:
+            rc = self._lib.dynamo_kv_event_publish_stored_v2(
+                eid, bh, th, n, int(parent_hash is not None),
+                parent_hash or 0, lora_id)
+        elif lora_id == 0:
+            # v1 carries no lora_id field; 0 (= base model) is its implied
+            # value, so the fallback is lossless
+            rc = self._lib.dynamo_kv_event_publish_stored(
+                eid, bh, th, n, int(parent_hash is not None),
+                parent_hash or 0)
+        else:
+            raise RuntimeError(
+                "this build of libdynamo_kv.so predates lora_id support; "
+                "rebuild it (make -C native build/libdynamo_kv.so) to "
+                "publish lora-tagged KV events")
         if rc != 0:
             raise RuntimeError("publisher not initialized")
         return eid

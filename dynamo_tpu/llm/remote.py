@@ -117,15 +117,33 @@ async def unregister_model(store, name: str, model_type: str = "chat") -> None:
 
 
 async def list_models(store):
+    """One entry per (type, name): N replicas register N lease-suffixed keys
+    for the same model — surface them as ``instances: N``, not N duplicate
+    rows in llmctl output (ADVICE r4). A manual (lease-less) ``llmctl add``
+    entry is not a replica, so it never inflates the count; registrations
+    that disagree on the endpoint are surfaced, not silently collapsed."""
     import json
 
-    out = []
+    by_model: dict = {}
     for key, value in await store.get_prefix(MODEL_PREFIX):
         mt_name = split_model_key(key)
         if mt_name is None:
             continue
         d = json.loads(value.decode())
-        out.append({"name": mt_name[1], "type": mt_name[0],
-                    "endpoint": d["endpoint"],
-                    "card": d.get("card")})
-    return out
+        is_instance = _LEASE_SUFFIX.search(key) is not None
+        entry = by_model.get(mt_name)
+        if entry is None:
+            entry = by_model[mt_name] = {
+                "name": mt_name[1], "type": mt_name[0],
+                "endpoint": d["endpoint"], "card": d.get("card"),
+                "instances": 1 if is_instance else 0}
+        else:
+            if is_instance:
+                entry["instances"] += 1
+        if d["endpoint"] != entry["endpoint"]:
+            entry.setdefault("conflicting_endpoints", []).append(
+                d["endpoint"])
+    # a model present only via a manual entry still serves: show 1
+    for entry in by_model.values():
+        entry["instances"] = entry["instances"] or 1
+    return list(by_model.values())

@@ -542,6 +542,15 @@ def _rope_inv_freq(cfg: LlamaConfig, local: bool = False) -> np.ndarray:
     if rs.get("rope_type") == "linear" or rs.get("type") == "linear":
         # linear position scaling (gemma3 4b+): frequencies divide by factor
         inv = inv / rs.get("factor", 1.0)
+    if rs.get("rope_type") == "ggml_factors":
+        # llama.cpp exports llama3-style scaling as a rope_freqs tensor of
+        # per-frequency divisors (ggml applies inv_freq / factor[i])
+        factors = np.asarray(rs["factors"], dtype=np.float64)
+        if factors.shape != inv.shape:
+            raise ValueError(
+                f"rope_freqs tensor has {factors.shape[0]} factors but "
+                f"head_dim {Dh} needs {inv.shape[0]}")
+        inv = inv / factors
     if rs.get("rope_type") == "llama3" or rs.get("type") == "llama3":
         # llama3 frequency-dependent NTK-style scaling
         factor = rs.get("factor", 8.0)

@@ -23,7 +23,24 @@ MsgCallback = Callable[[str, bytes], Awaitable[None]]
 
 
 class StoreError(RuntimeError):
-    pass
+    """Error reply from the store (or transport loss).
+
+    ``code`` is the machine-readable classification ("lease_not_found",
+    "conn_lost", or "" for anything else). Branch on it, never on the
+    human-readable text — a reworded server message must not silently flip
+    terminal-vs-transient handling (ADVICE r4). Servers predating the
+    ``code`` wire field get a legacy substring fallback at construction.
+    """
+
+    def __init__(self, msg: str, code: str = ""):
+        super().__init__(msg)
+        if not code:  # prebuilt/old server: classify by the known phrases
+            low = msg.lower()
+            if "lease not found" in low:
+                code = "lease_not_found"
+            elif "connection" in low:
+                code = "conn_lost"
+        self.code = code
 
 
 class StoreClient:
@@ -83,7 +100,8 @@ class StoreClient:
                 asyncio.CancelledError):
             for fut in self._pending.values():
                 if not fut.done():
-                    fut.set_exception(StoreError("connection lost"))
+                    fut.set_exception(
+                        StoreError("connection lost", code="conn_lost"))
             self._pending.clear()
             self.closed.set()
 
@@ -116,7 +134,8 @@ class StoreClient:
             await write_frame(self._writer, {"op": op, "id": rid, **kw})
         reply = await fut
         if not reply.get("ok", False):
-            raise StoreError(reply.get("error", "store error"))
+            raise StoreError(reply.get("error", "store error"),
+                             code=reply.get("code", ""))
         return reply
 
     # -- KV -------------------------------------------------------------
@@ -172,11 +191,11 @@ class StoreClient:
                 try:
                     await self._call("lease_keepalive", lease=lease)
                 except StoreError as e:
-                    if "lease not found" in str(e):
+                    if e.code == "lease_not_found":
                         # expired server-side (e.g. after loop starvation)
                         self._fire_lease_lost(lease, str(e))
                         return
-                    if "connection" in str(e).lower():
+                    if e.code == "conn_lost":
                         # this client has ONE connection and no reconnect:
                         # once it is gone every renewal will fail and the
                         # lease WILL expire — that is a lease loss

@@ -229,7 +229,8 @@ class StoreServer:
         key, value = m["key"], m["value"]
         lease = m.get("lease")
         if lease is not None and lease not in self._leases:
-            return {"ok": False, "error": "lease not found"}
+            return {"ok": False, "error": "lease not found",
+                    "code": "lease_not_found"}
         self._kv[key] = _KeyVal(value, lease)
         if lease is not None:
             self._leases[lease].keys.add(key)
@@ -284,7 +285,8 @@ class StoreServer:
     async def _op_lease_keepalive(self, conn, m):
         lease = self._leases.get(m["lease"])
         if lease is None:
-            return {"ok": False, "error": "lease not found"}
+            return {"ok": False, "error": "lease not found",
+                    "code": "lease_not_found"}
         lease.expires = time.monotonic() + lease.ttl
         return {}
 

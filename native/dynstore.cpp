@@ -371,10 +371,14 @@ class Server {
     send(c, reply);
   }
 
-  static Value err_body(const std::string& msg) {
+  // `code` is the machine-readable classification clients branch on
+  // (lease-loss terminal vs transient retry); the text is for humans and
+  // may be reworded freely.
+  static Value err_body(const std::string& msg, const std::string& code = "") {
     Value r = Value::map();
     r.set("ok", Value::boolean(false));
     r.set("error", Value::str(msg));
+    if (!code.empty()) r.set("code", Value::str(code));
     return r;
   }
   static Value err_reply(const Value& rid, const std::string& msg) {
@@ -408,7 +412,7 @@ class Server {
     const std::string& value = want_data(m, "value");
     const Value* lv = m.get("lease");
     int64_t lease = (lv && lv->t == Value::T::Int) ? lv->i : -1;
-    if (lease >= 0 && !leases_.count(lease)) return err_body("lease not found");
+    if (lease >= 0 && !leases_.count(lease)) return err_body("lease not found", "lease_not_found");
     kv_[key] = KeyVal{value, lease};
     if (lease >= 0) leases_[lease].keys.insert(key);
     notify_watchers(key, &value);
@@ -509,7 +513,7 @@ class Server {
 
   Value op_lease_keepalive(const Value& m) {
     auto it = leases_.find(want_int(m, "lease"));
-    if (it == leases_.end()) return err_body("lease not found");
+    if (it == leases_.end()) return err_body("lease not found", "lease_not_found");
     it->second.expires = now_s() + it->second.ttl;
     return Value::map();
   }

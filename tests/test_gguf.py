@@ -434,3 +434,90 @@ def test_q5_0_loads_from_file(tmp_path):
     got = g2.load_tensor("blk.0.ffn_up.weight")
     want = G._dequant_q5_0(bytes(raw), count).reshape(info.shape)
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_rope_scaling_linear_metadata(tmp_path):
+    """{arch}.rope.scaling.type=linear must land in cfg.rope_scaling —
+    ignoring it serves factor-x-too-fast rope frequencies (ADVICE r4 high;
+    ref gguf converters export gemma3 4b+ with linear factor 8)."""
+    cfg = llama.preset("tiny-byte", tie_embeddings=False)
+    tiny_gguf(tmp_path / "m.gguf", cfg)
+    g = read_gguf(str(tmp_path / "m.gguf"))
+    g.metadata["llama.rope.scaling.type"] = "linear"
+    g.metadata["llama.rope.scaling.factor"] = 8.0
+    got = g.llama_config()
+    assert got.rope_scaling == {"rope_type": "linear", "factor": 8.0}
+    # and the frequencies actually divide by the factor
+    from dynamo_tpu.models.llama import _rope_inv_freq
+    unscaled = _rope_inv_freq(
+        got.__class__(**{**got.__dict__, "rope_scaling": None}))
+    np.testing.assert_allclose(_rope_inv_freq(got), unscaled / 8.0,
+                               rtol=1e-6)
+
+
+def test_rope_scaling_unsupported_type_hard_errors(tmp_path):
+    cfg = llama.preset("tiny-byte", tie_embeddings=False)
+    tiny_gguf(tmp_path / "m.gguf", cfg)
+    g = read_gguf(str(tmp_path / "m.gguf"))
+    g.metadata["llama.rope.scaling.type"] = "yarn"
+    with pytest.raises(NotImplementedError, match="yarn"):
+        g.llama_config()
+
+
+def test_rope_freqs_tensor_applied(tmp_path):
+    """llama.cpp exports llama3-style scaling as a rope_freqs.weight tensor
+    of per-frequency divisors; it must scale inv_freq, not be ignored."""
+    from dynamo_tpu.models.llama import _rope_inv_freq
+
+    cfg = llama.preset("tiny-byte", tie_embeddings=False)
+    tiny_gguf(tmp_path / "m.gguf", cfg)
+    g = read_gguf(str(tmp_path / "m.gguf"))
+    n_freq = cfg.head_dim // 2
+    factors = np.linspace(1.0, 8.0, n_freq).astype(np.float32)
+    # re-write with the factor tensor included
+    meta = dict(g.metadata)
+    tensors = {name: g.load_tensor(name) for name in g.tensors}
+    tensors["rope_freqs.weight"] = factors
+    write_gguf(str(tmp_path / "m2.gguf"), meta, tensors)
+    g2 = read_gguf(str(tmp_path / "m2.gguf"))
+    got = g2.llama_config()
+    assert got.rope_scaling["rope_type"] == "ggml_factors"
+    base = got.__class__(**{**got.__dict__, "rope_scaling": None})
+    np.testing.assert_allclose(_rope_inv_freq(got),
+                               _rope_inv_freq(base) / factors, rtol=1e-5)
+
+
+def test_rope_freqs_wrong_length_rejected(tmp_path):
+    from dynamo_tpu.models.llama import _rope_inv_freq
+
+    cfg = llama.preset("tiny-byte", tie_embeddings=False)
+    got = llama.preset("tiny-byte", tie_embeddings=False,
+                       rope_scaling={"rope_type": "ggml_factors",
+                                     "factors": [1.0, 2.0, 3.0]})
+    assert cfg.head_dim // 2 != 3
+    with pytest.raises(ValueError, match="factors"):
+        _rope_inv_freq(got)
+
+
+def test_rope_freqs_combined_with_linear(tmp_path):
+    """ggml applies freq_scale (linear) AND freq_factors together; a GGUF
+    carrying both must fold the linear factor into the divisors, not drop
+    it."""
+    from dynamo_tpu.models.llama import _rope_inv_freq
+
+    cfg = llama.preset("tiny-byte", tie_embeddings=False)
+    tiny_gguf(tmp_path / "m.gguf", cfg)
+    g = read_gguf(str(tmp_path / "m.gguf"))
+    n_freq = cfg.head_dim // 2
+    factors = np.linspace(1.0, 4.0, n_freq).astype(np.float32)
+    meta = dict(g.metadata)
+    meta["llama.rope.scaling.type"] = "linear"
+    meta["llama.rope.scaling.factor"] = 8.0
+    tensors = {name: g.load_tensor(name) for name in g.tensors}
+    tensors["rope_freqs.weight"] = factors
+    write_gguf(str(tmp_path / "m2.gguf"), meta, tensors)
+    got = read_gguf(str(tmp_path / "m2.gguf")).llama_config()
+    base = got.__class__(**{**got.__dict__, "rope_scaling": None})
+    np.testing.assert_allclose(
+        _rope_inv_freq(got), _rope_inv_freq(base) / (factors * 8.0),
+        rtol=1e-5)

@@ -443,3 +443,78 @@ async def test_client_fails_over_dead_instance():
         await w.close()
     finally:
         await srv.stop()
+
+
+async def test_store_error_codes_structured():
+    """Lease-loss classification rides a machine-readable ``code`` field,
+    not error-text substrings (ADVICE r4: a reworded message must not flip
+    terminal-vs-transient handling)."""
+    server, port = await start_store()
+    try:
+        c = await client(port)
+        with pytest.raises(StoreError) as ei:
+            await c.put("k", b"v", lease=999999)  # nonexistent lease
+        assert ei.value.code == "lease_not_found"
+        # transport loss surfaces as conn_lost on pending futures
+        fut_err = StoreError("connection lost", code="conn_lost")
+        assert fut_err.code == "conn_lost"
+        # legacy server without the code field: constructor fallback still
+        # classifies the two known phrases
+        assert StoreError("lease not found").code == "lease_not_found"
+        assert StoreError("Connection reset by peer").code == "conn_lost"
+        assert StoreError("version skew").code == ""
+        await c.close()
+    finally:
+        await server.stop()
+
+
+async def test_list_models_dedupes_instances():
+    """N per-instance registrations of one model = ONE list entry with
+    instances=N (ADVICE r4)."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.remote import list_models, register_model
+
+    server, port = await start_store()
+    try:
+        c = await client(port)
+        card = ModelDeploymentCard.synthetic(name="m1")
+        l1 = await c.lease_grant(ttl=5.0, auto_keepalive=False)
+        l2 = await c.lease_grant(ttl=5.0, auto_keepalive=False)
+        await register_model(c, card, "dyn://ns.comp.ep", lease=l1)
+        await register_model(c, card, "dyn://ns.comp.ep", lease=l2)
+        card2 = ModelDeploymentCard.synthetic(name="m2")
+        await register_model(c, card2, "dyn://ns.comp.ep2", lease=l1)
+        models = await list_models(c)
+        by_name = {m["name"]: m for m in models}
+        assert len(models) == 2
+        assert by_name["m1"]["instances"] == 2
+        assert by_name["m2"]["instances"] == 1
+        await c.close()
+    finally:
+        await server.stop()
+
+
+async def test_list_models_manual_entry_not_counted_as_replica():
+    """A lease-less llmctl-add entry is not a replica: it must not inflate
+    instances, and a divergent endpoint must be surfaced."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.remote import list_models, register_model
+
+    server, port = await start_store()
+    try:
+        c = await client(port)
+        card = ModelDeploymentCard.synthetic(name="m1")
+        await register_model(c, card, "dyn://ns.comp.manual")  # no lease
+        l1 = await c.lease_grant(ttl=5.0, auto_keepalive=False)
+        await register_model(c, card, "dyn://ns.comp.worker", lease=l1)
+        (m,) = await list_models(c)
+        assert m["instances"] == 1           # the worker, not manual+worker
+        assert m["conflicting_endpoints"]    # divergence is visible
+        # manual-only model still shows as servable
+        card2 = ModelDeploymentCard.synthetic(name="m2")
+        await register_model(c, card2, "dyn://ns.comp.manual2")
+        by_name = {x["name"]: x for x in await list_models(c)}
+        assert by_name["m2"]["instances"] == 1
+        await c.close()
+    finally:
+        await server.stop()
