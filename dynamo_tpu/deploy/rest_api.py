@@ -60,10 +60,15 @@ class RestKubeApi:
                  ca_file: Optional[str] = None,
                  insecure_skip_verify: bool = False,
                  field_manager: str = "dynamo-tpu",
+                 force: bool = True,
                  timeout: float = 30.0):
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.field_manager = field_manager
+        # force=True (default) is the controller stance: this manager owns
+        # what it renders. force=False surfaces SSA conflicts as
+        # KubeApiError(409) instead — for co-managed objects.
+        self.force = force
         self.timeout = timeout
         if base_url.startswith("https"):
             if insecure_skip_verify:
@@ -160,7 +165,8 @@ class RestKubeApi:
         status, obj = self._request(
             "PATCH", path, body=manifest,
             content_type="application/apply-patch+yaml",
-            query={"fieldManager": self.field_manager, "force": "true"})
+            query={"fieldManager": self.field_manager,
+                   "force": "true" if self.force else "false"})
         if status == 404 or not isinstance(obj, dict):
             raise KubeApiError(status, str(obj))
         return obj

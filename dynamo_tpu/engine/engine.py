@@ -218,14 +218,15 @@ class EngineCore:
             import os
             impl = os.environ.get("DYNAMO_TPU_ATTN", "auto")
         if m.attn_logit_softcap or m.sliding_window is not None:
-            # Gemma2: score softcapping + alternating sliding windows are
-            # implemented on the XLA attention path only (the Pallas
-            # kernels would silently skip the cap — wrong logits)
-            if impl not in ("auto", "xla"):
+            # Gemma2/3: the Pallas flash/paged kernels take softcap +
+            # sliding windows natively (round 5); only ring attention
+            # still lacks them (cross-shard windows don't compose with
+            # the ring schedule)
+            if impl == "ring":
                 raise ValueError(
-                    f"attn_impl={impl!r} does not support softcapping/"
-                    "sliding-window models (Gemma2); use attn_impl='xla'")
-            impl = "xla"
+                    "attn_impl='ring' does not support softcapping/"
+                    "sliding-window models (Gemma2/3); use 'pallas' or "
+                    "'xla'")
         if cfg.pp > 1 and impl == "ring":
             # ring rides the sp axis; pp stages the layer stack — the two
             # prefill shardings don't compose
@@ -1281,14 +1282,22 @@ def _pallas_probe_ok(m, cfg) -> bool:
         kp = jnp.zeros((Hkv, 3, page, Dh), m.dtype)
         pt = jnp.zeros((2, 1), jnp.int32)
         ln = jnp.ones((2,), jnp.int32)
-        paged_attention(q, kp, kp, pt, ln, interpret=False
-                        ).block_until_ready()
+        # probe the exact kernel variants this model will run: softcap and
+        # (on sliding models) the windowed variant are distinct Mosaic
+        # lowerings from the plain causal one
+        kw = dict(scale=m.attn_scale, softcap=m.attn_logit_softcap)
+        windows = ([None, m.sliding_window] if m.sliding_window is not None
+                   else [None])
+        for w in windows:
+            paged_attention(q, kp, kp, pt, ln, interpret=False,
+                            window=w, **kw).block_until_ready()
         T = max(8, min(128, cfg.prefill_chunk))
         qf = jnp.zeros((2, T, Hq, Dh), m.dtype)
         kf = jnp.zeros((2, T, Hkv, Dh), m.dtype)
         pos = jnp.zeros((2, T), jnp.int32)
-        flash_attention(qf, kf, kf, pos, pos, pos < 1, interpret=False
-                        ).block_until_ready()
+        for w in windows:
+            flash_attention(qf, kf, kf, pos, pos, pos < 1, interpret=False,
+                            window=w, **kw).block_until_ready()
         return True
     except Exception:  # noqa: BLE001 - any lowering failure means fall back
         log.exception("pallas probe failure detail")

@@ -649,11 +649,15 @@ def _lm_head(x: jax.Array, params: Dict[str, Any],
 
 
 def _require_xla_attn(cfg: LlamaConfig, attn_impl: str) -> None:
-    if attn_impl != "xla" and (cfg.attn_logit_softcap
-                               or cfg.sliding_window is not None):
+    """Ring attention is the one path left without softcap/sliding support
+    (cross-shard windows don't compose with the ring schedule); the Pallas
+    flash/paged kernels take window+softcap+scale natively (round 5 —
+    Gemma2/3 no longer forfeit the fast path)."""
+    if attn_impl == "ring" and (cfg.attn_logit_softcap
+                                or cfg.sliding_window is not None):
         raise ValueError(
-            f"attn_impl={attn_impl!r} does not support score softcapping / "
-            "sliding windows (Gemma2); use attn_impl='xla'")
+            "attn_impl='ring' does not support score softcapping / sliding "
+            "windows (Gemma2/3); use attn_impl='pallas' or 'xla'")
 
 
 # ---------------------------------------------------------------------------
@@ -709,19 +713,34 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
             and cfg.num_kv_heads % mesh.shape[_TP] == 0) else None
     elif attn_impl == "flash":
         tp_sz = _tp_size(mesh)
-        if tp_sz > 1:
-            # per-shard flash kernel: heads sharded over tp, kv heads when
-            # divisible (replicated otherwise); sequence dims replicated
-            from ..ops.attention import flash_attention as _flash
-            kv_spec = (P(None, None, AXIS_TP, None)
-                       if cfg.num_kv_heads % tp_sz == 0
-                       else P(None, None, None, None))
-            sharded_flash = jax.shard_map(
-                _flash, mesh=mesh,
-                in_specs=(P(None, None, AXIS_TP, None), kv_spec, kv_spec,
-                          P(None, None), P(None, None), P(None, None)),
-                out_specs=P(None, None, AXIS_TP, None),
-                check_vma=False)   # pallas_call can't declare vma
+        from ..ops.attention import flash_attention as _flash
+        _flash_cache: Dict[Optional[int], Any] = {}
+
+        def flash_for(layer: int):
+            """Kernel variant for this layer (softcap/scale always, window
+            on sliding layers) — window is a static kernel param, so the
+            two layer classes get two compiled variants, built once."""
+            w = cfg.sliding_window if cfg.layer_sliding(layer) else None
+            if w not in _flash_cache:
+                fn = partial(
+                    _flash, scale=cfg.attn_scale,
+                    softcap=cfg.attn_logit_softcap, window=w)
+                if tp_sz > 1:
+                    # per-shard flash kernel: heads sharded over tp, kv
+                    # heads when divisible (replicated otherwise);
+                    # sequence dims replicated
+                    kv_spec = (P(None, None, AXIS_TP, None)
+                               if cfg.num_kv_heads % tp_sz == 0
+                               else P(None, None, None, None))
+                    fn = jax.shard_map(
+                        fn, mesh=mesh,
+                        in_specs=(P(None, None, AXIS_TP, None), kv_spec,
+                                  kv_spec, P(None, None), P(None, None),
+                                  P(None, None)),
+                        out_specs=P(None, None, AXIS_TP, None),
+                        check_vma=False)   # pallas_call can't declare vma
+                _flash_cache[w] = fn
+            return _flash_cache[w]
     else:
         # causal/validity mask [B,T,S]
         mask = (read_valid[:, None, :]
@@ -764,17 +783,13 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
         k_ctx = k_pool[l, :, rp, ro]
         v_ctx = v_pool[l, :, rp, ro]
         if attn_impl == "flash":
-            from ..ops.attention import flash_attention
-            if tp_sz > 1:
-                attn = sharded_flash(q, k_ctx, v_ctx, positions, read_pos,
-                                     read_valid)
-            else:
-                attn = flash_attention(q, k_ctx, v_ctx, positions, read_pos,
-                                       read_valid)
+            attn = flash_for(l)(q, k_ctx, v_ctx, positions, read_pos,
+                                read_valid)
         elif attn_impl == "ring":
             attn = ring_attention(q, k_ctx, v_ctx, positions, read_pos,
                                   read_valid, mesh=mesh,
-                                  head_axis=head_axis)
+                                  head_axis=head_axis,
+                                  scale=cfg.attn_scale)
         else:
             attn = attend(q, k_ctx, v_ctx,
                           sliding_mask if cfg.layer_sliding(l) else mask,
@@ -942,8 +957,20 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
                     # call shape as forward()'s tp path (removes the
                     # pp-forfeits-kernels restriction, VERDICT r3 weak #5)
                     from ..ops.attention import flash_attention
-                    attn = flash_attention(q, k_ctx, v_ctx, pos_m, rpos_m,
-                                           rval_m)
+                    fl = partial(flash_attention, scale=cfg.attn_scale,
+                                 softcap=cfg.attn_logit_softcap)
+                    if cfg.sliding_window is not None:
+                        # sliding-vs-full depends on the GLOBAL layer index
+                        # (traced stage offset); window is a static kernel
+                        # param — cond picks between the two compiled
+                        # variants at run time
+                        sl = (idx * Lloc + l + 1) % cfg.sliding_pattern != 0
+                        attn = jax.lax.cond(
+                            sl,
+                            partial(fl, window=cfg.sliding_window),
+                            fl, q, k_ctx, v_ctx, pos_m, rpos_m, rval_m)
+                    else:
+                        attn = fl(q, k_ctx, v_ctx, pos_m, rpos_m, rval_m)
                 elif cfg.sliding_window is not None:
                     # the GLOBAL layer index (stage offset + local index)
                     # decides sliding vs full — idx is traced, so select
@@ -1140,20 +1167,33 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
                                  axis=1)[:, 0]
     w_off = pos % page
     tp_sz = _tp_size(mesh) if attn_impl == "pallas" else 1
-    if tp_sz > 1:
-        # run the paged kernel per tp shard: q sharded over heads, pools
-        # over kv heads when divisible (replicated otherwise). Axes the
-        # specs don't mention (sp/dp/...) stay replicated.
+    if attn_impl == "pallas":
         from ..ops.attention import paged_attention as _paged
-        kv_spec = (P(AXIS_TP, None, None, None)
-                   if cfg.num_kv_heads % tp_sz == 0
-                   else P(None, None, None, None))
-        sharded_paged = jax.shard_map(
-            _paged, mesh=mesh,
-            in_specs=(P(None, AXIS_TP, None), kv_spec, kv_spec,
-                      P(None, None), P(None)),
-            out_specs=P(None, AXIS_TP, None),
-            check_vma=False)       # pallas_call can't declare vma
+        _paged_cache: Dict[Optional[int], Any] = {}
+
+        def paged_for(layer: int):
+            """Per-layer kernel variant (window on sliding layers; softcap/
+            scale always) — static kernel params, so the two layer classes
+            compile two variants, built once. At tp>1 the kernel runs per
+            tp shard: q sharded over heads, pools over kv heads when
+            divisible (replicated otherwise); axes the specs don't mention
+            (sp/dp/...) stay replicated."""
+            w = cfg.sliding_window if cfg.layer_sliding(layer) else None
+            if w not in _paged_cache:
+                fn = partial(_paged, scale=cfg.attn_scale,
+                             softcap=cfg.attn_logit_softcap, window=w)
+                if tp_sz > 1:
+                    kv_spec = (P(AXIS_TP, None, None, None)
+                               if cfg.num_kv_heads % tp_sz == 0
+                               else P(None, None, None, None))
+                    fn = jax.shard_map(
+                        fn, mesh=mesh,
+                        in_specs=(P(None, AXIS_TP, None), kv_spec, kv_spec,
+                                  P(None, None), P(None)),
+                        out_specs=P(None, AXIS_TP, None),
+                        check_vma=False)   # pallas_call can't declare vma
+                _paged_cache[w] = fn
+            return _paged_cache[w]
     _require_xla_attn(cfg, attn_impl)
     if attn_impl != "pallas":
         S = page_tables.shape[1] * page
@@ -1191,13 +1231,8 @@ def forward_decode(params: Dict[str, Any], cfg: LlamaConfig,
         k_pool = k_pool.at[l, :, w_page, w_off].set(k[:, 0])
         v_pool = v_pool.at[l, :, w_page, w_off].set(v[:, 0])
         if attn_impl == "pallas":
-            from ..ops.attention import paged_attention
-            if tp_sz > 1:
-                attn = sharded_paged(q[:, 0], k_pool[l], v_pool[l],
-                                     page_tables, lengths)[:, None]
-            else:
-                attn = paged_attention(q[:, 0], k_pool[l], v_pool[l],
-                                       page_tables, lengths)[:, None]
+            attn = paged_for(l)(q[:, 0], k_pool[l], v_pool[l],
+                                page_tables, lengths)[:, None]
         else:
             k_ctx = k_pool[l, :, rp, ro]               # [B,S,Hkv,Dh]
             v_ctx = v_pool[l, :, rp, ro]

@@ -58,7 +58,8 @@ def _pick_block(n: int, cap: int = 128) -> int:
 # ---------------------------------------------------------------------------
 
 def _flash_kernel(qpos_ref, kpos_ref, kval_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, scale: float, G: int):
+                  m_scr, l_scr, acc_scr, *, scale: float, G: int,
+                  softcap: Optional[float], window: Optional[int]):
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -67,31 +68,48 @@ def _flash_kernel(qpos_ref, kpos_ref, kval_ref, q_ref, k_ref, v_ref, o_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0]                                           # [G, BT, Dh] bf16
-    BS, Dh = k_ref.shape[-2], k_ref.shape[-1]
-    k = jnp.broadcast_to(k_ref[0][None], (G, BS, Dh))      # [G, BS, Dh]
-    v = jnp.broadcast_to(v_ref[0][None], (G, BS, Dh))
-    s = jax.lax.dot_general(
-        q, k, (((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32) * scale        # [G, BT, BS]
-
     qp = qpos_ref[0]                                       # [BT, 1]
     kp = kpos_ref[0]                                       # [1, BS]
     kv = kval_ref[0]
-    mask = ((kp <= qp) & (kv > 0))[None]                   # [1, BT, BS]
+    # dead-block skip: a key block entirely in the causal future — or, on
+    # sliding layers, entirely below every query's window — contributes
+    # nothing; skip its matmuls (positions are dynamic, so this is a
+    # run-time guard; the BlockSpec copies still happen)
+    live = jnp.min(kp) <= jnp.max(qp)
+    if window is not None:
+        live = live & (jnp.max(kp) > jnp.min(qp) - window)
 
-    m_prev = m_scr[:]
-    m_cur = jnp.max(jnp.where(mask, s, NEG_INF), axis=-1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    # mask p explicitly: with a finite NEG_INF sentinel, exp(s - m) of a fully
-    # masked row would otherwise be exp(0) = 1
-    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)           # [G, BT, BS] f32
-    alpha = jnp.exp(m_prev - m_new)
-    l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32)                # [G, BT, Dh]
-    m_scr[:] = m_new
+    @pl.when(live)
+    def _():
+        q = q_ref[0]                                       # [G, BT, Dh] bf16
+        BS, Dh = k_ref.shape[-2], k_ref.shape[-1]
+        k = jnp.broadcast_to(k_ref[0][None], (G, BS, Dh))  # [G, BS, Dh]
+        v = jnp.broadcast_to(v_ref[0][None], (G, BS, Dh))
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale    # [G, BT, BS]
+        if softcap is not None:
+            # Gemma2 attention-score softcapping, BEFORE masking (tanh of
+            # the NEG_INF sentinel would turn masked slots into finite ±cap)
+            s = jnp.tanh(s / softcap) * softcap
+
+        mask = ((kp <= qp) & (kv > 0))[None]               # [1, BT, BS]
+        if window is not None:
+            # sliding layers: keys within the last `window` positions
+            mask = mask & (kp > qp - window)[None]
+
+        m_prev = m_scr[:]
+        m_cur = jnp.max(jnp.where(mask, s, NEG_INF), axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # mask p explicitly: with a finite NEG_INF sentinel, exp(s - m) of a
+        # fully masked row would otherwise be exp(0) = 1
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)       # [G, BT, BS] f32
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)            # [G, BT, Dh]
+        m_scr[:] = m_new
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _():
@@ -102,12 +120,18 @@ def _flash_kernel(qpos_ref, kpos_ref, kval_ref, q_ref, k_ref, v_ref, o_ref,
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     q_pos: jax.Array, k_pos: jax.Array, k_valid: jax.Array,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    scale: Optional[float] = None,
+                    softcap: Optional[float] = None,
+                    window: Optional[int] = None) -> jax.Array:
     """Blockwise attention with explicit positions.
 
     q: [B, T, Hq, Dh] ; k, v: [B, S, Hkv, Dh] (gathered context, GQA)
     q_pos: [B, T] int32 ; k_pos: [B, S] int32 ; k_valid: [B, S] bool
-    A query at position p attends to context slots with k_pos <= p & valid.
+    A query at position p attends to context slots with k_pos <= p & valid;
+    with ``window`` additionally k_pos > p - window (Gemma2/3 sliding
+    layers). ``softcap`` tanh-caps scores before the online softmax;
+    ``scale`` overrides the rsqrt(Dh) default (query_pre_attn_scalar).
     Returns [B, T, Hq, Dh] in q.dtype.
     """
     B, T, Hq, Dh = q.shape
@@ -117,7 +141,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         interpret = _interpret_default()
     BT = _pick_block(T)
     BS = _pick_block(S)
-    scale = 1.0 / math.sqrt(Dh)
+    if scale is None:
+        scale = 1.0 / math.sqrt(Dh)
 
     # head-major layouts: fold (B, Hkv) into the leading grid axis
     q5 = q.reshape(B, T, Hkv, G, Dh).transpose(0, 2, 3, 1, 4)
@@ -135,7 +160,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     grid = (B * Hkv, T // BT, S // BS)
     out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, G=G),
+        functools.partial(_flash_kernel, scale=scale, G=G,
+                          softcap=softcap, window=window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, BT, 1), lambda bh, i, j: (bh // Hkv, i, 0)),
@@ -182,12 +208,18 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def _paged_dma_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
                       k_buf, v_buf, sem, m_scr, l_scr, acc_scr, state,
                       *, scale: float, page: int, ppb: int, hkv: int,
-                      fold: int, dh: int):
+                      fold: int, dh: int, softcap: Optional[float],
+                      window: Optional[int]):
     """Pools arrive pre-folded to [Hkv, n_pages, page//fold, fold*Dh] so DMA
     rows are 128-lane aligned even for Dh=64; a folded row holds ``fold``
     consecutive tokens, handled as ``fold`` score slices. Buffers are
     head-major ([2, Hkv, ppb, rows, fold*Dh]) so the per-page all-head DMA
-    lands as a contiguous per-head reshape for the batched matmul."""
+    lands as a contiguous per-head reshape for the batched matmul.
+
+    With ``window``, each lane's active block range is clamped at BOTH ends:
+    blocks wholly below ``length - window`` are never DMA'd nor computed
+    (the page-range clamp — sliding decode reads O(window) bytes, not
+    O(context)), and in-block tokens below the window start are masked."""
     b = pl.program_id(0)
     j = pl.program_id(1)
     L2 = ppb * page           # tokens per compute block
@@ -196,6 +228,13 @@ def _paged_dma_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
 
     def nblocks(bb):
         return (len_ref[bb] + L2 - 1) // L2
+
+    def jstart(bb):
+        # first block holding any in-window token. The decode query sits at
+        # length-1, so the window covers [length - window, length).
+        if window is None:
+            return 0
+        return jnp.maximum(len_ref[bb] - window, 0) // L2
 
     def copy_descs(bb, jj, slot):
         descs = []
@@ -213,10 +252,13 @@ def _paged_dma_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
             d.start()
 
     nb = nblocks(b)
-    active = j < nb
+    j0 = jstart(b)
+    active = (j >= j0) & (j < nb)
 
-    # first grid step: prime the pipeline with our own block
-    first = (b == 0) & (j == 0)
+    # first grid step: prime the pipeline with lane 0's first active block.
+    # Steps of lane 0 before its window start are dead, so the prime fires
+    # at (0, jstart(0)) — for full attention that is (0, 0) as before.
+    first = (b == 0) & (j == jstart(0))
 
     @pl.when(first)
     def _():
@@ -227,19 +269,22 @@ def _paged_dma_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
     def _():
         slot = state[0]
 
-        @pl.when(j == 0)
+        @pl.when(j == j0)
         def _():
             m_scr[:] = jnp.full_like(m_scr, NEG_INF)
             l_scr[:] = jnp.zeros_like(l_scr)
             acc_scr[:] = jnp.zeros_like(acc_scr)
 
         # prefetch the next ACTIVE step's block into the other buffer.
-        # flat order: j within b, then b; j beyond a sequence's nblocks is
+        # flat order: j within b, then b; j outside [jstart, nblocks) is
         # dead (never copied, never computed).
         nj, nb_ = j + 1, b
         wrap_b = nj >= nb
-        nj = jnp.where(wrap_b, 0, nj)
         nb_ = jnp.where(wrap_b, b + 1, nb_)
+        # clamp the lookup lane: when nb_ == num_programs there is no next
+        # step (has_next gates the start), but jstart still indexes len_ref
+        nj = jnp.where(wrap_b,
+                       jstart(jnp.minimum(nb_, pl.num_programs(0) - 1)), nj)
         has_next = nb_ < pl.num_programs(0)
 
         @pl.when(has_next)
@@ -265,7 +310,12 @@ def _paged_dma_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
             s = jax.lax.dot_general(
                 q, kslice, (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32) * scale  # [Hkv, G, rows]
+            if softcap is not None:
+                # cap BEFORE masking (tanh(NEG_INF) would be a finite ±cap)
+                s = jnp.tanh(s / softcap) * softcap
             mask = (base + f) < length
+            if window is not None:
+                mask = mask & ((base + f) >= length - window)
             s_parts.append(jnp.where(mask, s, NEG_INF))
             mask_parts.append(mask)
 
@@ -297,8 +347,14 @@ def _paged_dma_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
 
 
 def _paged_attention_tpu(q4, k_pages, v_pages, page_tables, lengths,
-                         *, pages_per_block: int = 8) -> jax.Array:
-    """q4: [B, Hkv, G, Dh]; pools [Hkv, n_pages, page, Dh]. Returns q4-shaped."""
+                         *, pages_per_block: int = 8,
+                         scale: Optional[float] = None,
+                         softcap: Optional[float] = None,
+                         window: Optional[int] = None,
+                         interpret: bool = False) -> jax.Array:
+    """q4: [B, Hkv, G, Dh]; pools [Hkv, n_pages, page, Dh]. Returns q4-shaped.
+    ``interpret`` exists for the CPU test suite only — the serving path
+    always compiles this variant (paged_attention gates it to real TPUs)."""
     B, Hkv, G, Dh = q4.shape
     _, n_pages, page, _ = k_pages.shape
     P = page_tables.shape[1]
@@ -307,7 +363,8 @@ def _paged_attention_tpu(q4, k_pages, v_pages, page_tables, lengths,
         page_tables = jnp.pad(page_tables, ((0, 0), (0, ppb - P % ppb)))
         P = page_tables.shape[1]
     NB = P // ppb
-    scale = 1.0 / math.sqrt(Dh)
+    if scale is None:
+        scale = 1.0 / math.sqrt(Dh)
 
     # fold tokens so DMA rows are 128-lane aligned (free bitcast view)
     fold = max(1, 128 // Dh)
@@ -338,15 +395,18 @@ def _paged_attention_tpu(q4, k_pages, v_pages, page_tables, lengths,
     )
     return pl.pallas_call(
         functools.partial(_paged_dma_kernel, scale=scale, page=page,
-                          ppb=ppb, hkv=Hkv, fold=fold, dh=Dh),
+                          ppb=ppb, hkv=Hkv, fold=fold, dh=Dh,
+                          softcap=softcap, window=window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, Dh), q4.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
     )(page_tables, lengths, q4, kf, vf)
 
 def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, scale: float, page: int):
+                  m_scr, l_scr, acc_scr, *, scale: float, page: int,
+                  softcap: Optional[float], window: Optional[int]):
     b = pl.program_id(0)
     p = pl.program_id(1)
 
@@ -358,8 +418,15 @@ def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
     length = len_ref[b]
     npages = (length + page - 1) // page
+    if window is None:
+        in_range = p < npages
+    else:
+        # page-range clamp: pages wholly below the window start contribute
+        # nothing — skip their compute entirely
+        pstart = jnp.maximum(length - window, 0) // page
+        in_range = (p >= pstart) & (p < npages)
 
-    @pl.when(p < npages)
+    @pl.when(in_range)
     def _():
         q = q_ref[0]                                       # [Hkv, G, Dh]
         k = k_ref[:, 0]                                    # [Hkv, page, Dh]
@@ -367,8 +434,13 @@ def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale    # [Hkv, G, page]
+        if softcap is not None:
+            # cap BEFORE masking (tanh(NEG_INF) would be a finite ±cap)
+            s = jnp.tanh(s / softcap) * softcap
         tok = jax.lax.broadcasted_iota(jnp.int32, (1, 1, page), 2) + p * page
         mask = tok < length
+        if window is not None:
+            mask = mask & (tok >= length - window)
         m_prev = m_scr[:]
         m_cur = jnp.max(jnp.where(mask, s, NEG_INF), axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -389,14 +461,23 @@ def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                     page_tables: jax.Array, lengths: jax.Array,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    scale: Optional[float] = None,
+                    softcap: Optional[float] = None,
+                    window: Optional[int] = None) -> jax.Array:
     """Decode attention straight over the paged KV pool.
 
     q: [B, Hq, Dh] (one new token per sequence, already rope'd)
     k_pages, v_pages: [Hkv, n_pages, page, Dh] — the layer's HBM pool
     page_tables: [B, P] int32 page ids (rows padded with page 0)
     lengths: [B] int32 — tokens to attend per sequence (including current)
-    Returns [B, Hq, Dh]. Sequences attend to tokens [0, length).
+    Returns [B, Hq, Dh]. Sequences attend to tokens [0, length); with
+    ``window`` only [max(0, length - window), length). The DMA kernel
+    clamps its active block range, so out-of-window pages cost neither
+    copies nor compute (sliding decode reads O(window) bytes); the simple
+    kernel skips only their compute — its BlockSpec pipeline still copies
+    every page. ``softcap`` tanh-caps scores pre-softmax (Gemma2);
+    ``scale`` overrides rsqrt(Dh) (query_pre_attn_scalar).
 
     On a real TPU this runs the multi-page double-buffered DMA kernel
     above (``DYNAMO_TPU_PAGED_KERNEL=simple`` falls back to the
@@ -438,9 +519,12 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
             raise ValueError(f"DYNAMO_TPU_PAGED_PPB={raw_ppb!r} "
                              f"(expected an integer in [1, 64])")
         out = _paged_attention_tpu(q4, k_pages, v_pages, page_tables,
-                                   lengths, pages_per_block=ppb)
+                                   lengths, pages_per_block=ppb,
+                                   scale=scale, softcap=softcap,
+                                   window=window)
         return out.reshape(B, Hq, Dh)
-    scale = 1.0 / math.sqrt(Dh)
+    if scale is None:
+        scale = 1.0 / math.sqrt(Dh)
 
     q4 = q.reshape(B, Hkv, G, Dh)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -462,7 +546,8 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_kernel, scale=scale, page=page),
+        functools.partial(_paged_kernel, scale=scale, page=page,
+                          softcap=softcap, window=window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, Dh), q.dtype),
         interpret=interpret,

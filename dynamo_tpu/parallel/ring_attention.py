@@ -61,7 +61,8 @@ def _online_update(qg, k, v, qpos, kpos, kval, scale, m, l, acc):
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    q_pos: jax.Array, k_pos: jax.Array, k_valid: jax.Array,
                    mesh: Mesh, axis: str = AXIS_SP,
-                   head_axis: Optional[str] = None) -> jax.Array:
+                   head_axis: Optional[str] = None,
+                   scale: Optional[float] = None) -> jax.Array:
     """Sequence-parallel attention with explicit positions.
 
     q: [B, T, Hq, Dh] ; k, v: [B, S, Hkv, Dh] ; q_pos: [B, T] int32 ;
@@ -74,7 +75,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     by that axis so GQA groups stay aligned per shard.
     """
     sp = mesh.shape[axis]
-    scale = 1.0 / (math.sqrt(q.shape[-1]))
+    if scale is None:
+        scale = 1.0 / (math.sqrt(q.shape[-1]))
     if head_axis is not None:
         hp = mesh.shape[head_axis]
         if q.shape[2] % hp or k.shape[2] % hp:

@@ -15,17 +15,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def dense_ref(q, k, v, q_pos, k_pos, k_valid):
+def dense_ref(q, k, v, q_pos, k_pos, k_valid, scale=None, softcap=None,
+              window=None):
     import jax.numpy as jnp
 
-    scale = 1.0 / np.sqrt(q.shape[-1])
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
     g = q.shape[2] // k.shape[2]
     k = jnp.repeat(k, g, axis=2)
     v = jnp.repeat(v, g, axis=2)
     s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if softcap is not None:
+        s = jnp.tanh(s / softcap) * softcap
     mask = (k_pos[:, None, None, :] <= q_pos[:, None, :, None]) \
         & k_valid[:, None, None, :]
+    if window is not None:
+        mask = mask & (k_pos[:, None, None, :]
+                       > q_pos[:, None, :, None] - window)
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     p = jnp.where(mask.any(-1, keepdims=True), p, 0.0)
@@ -89,6 +96,39 @@ def _run_queue(jax, jnp, flash_attention, paged_attention) -> int:
                             "error": f"{type(e).__name__}: {str(e)[:200]}"})
             failures += 1
 
+    # Gemma2/3 kernel variants (round 5): sliding window + score softcap +
+    # query_pre_attn_scalar are extra Mosaic lowerings (tanh, window mask,
+    # clamped block ranges) that only surface on-chip
+    gem = dict(scale=1.0 / np.sqrt(24.0), softcap=50.0, window=96)
+    for B in (1, 8):
+        T, S = 128, 256
+        key = jax.random.PRNGKey(40 + B)
+        kq, kk, kv_ = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (B, T, Hq, Dh), jnp.bfloat16)
+        k = jax.random.normal(kk, (B, S, Hkv, Dh), jnp.bfloat16)
+        v = jax.random.normal(kv_, (B, S, Hkv, Dh), jnp.bfloat16)
+        q_pos = jnp.broadcast_to(jnp.arange(T), (B, T)) + 16
+        k_pos = jnp.broadcast_to(jnp.arange(S), (B, S))
+        k_valid = k_pos < (T + 16)
+        case = f"flash[gemma] B={B}"
+        try:
+            out = np.asarray(flash_attention(q, k, v, q_pos, k_pos, k_valid,
+                                             interpret=False, **gem),
+                             np.float32)
+            ref = np.asarray(dense_ref(q, k, v, q_pos, k_pos, k_valid,
+                                       **gem), np.float32)
+            err = np.abs(out - ref).max()
+            ok = bool(err < 0.05)
+            print(f"{case}: max_err={err:.4f} {'OK' if ok else 'FAIL'}")
+            RESULTS.append({"case": case, "ok": ok, "max_err": float(err)})
+            failures += 0 if ok else 1
+        except Exception as e:  # noqa: BLE001
+            print(f"{case}: COMPILE/RUN FAIL: {type(e).__name__}: "
+                  f"{str(e)[:200]}")
+            RESULTS.append({"case": case, "ok": False,
+                            "error": f"{type(e).__name__}: {str(e)[:200]}"})
+            failures += 1
+
     page, P = 64, 8
     for variant in ("dma", "simple"):
         os.environ["DYNAMO_TPU_PAGED_KERNEL"] = variant
@@ -136,6 +176,59 @@ def _run_queue(jax, jnp, flash_attention, paged_attention) -> int:
                 RESULTS.append({"case": case, "ok": False,
                                 "error": f"{type(e).__name__}: {str(e)[:200]}"})
                 failures += 1
+
+    # paged decode with the Gemma variant set: the window clamps the DMA
+    # kernel's active block range at BOTH ends (lanes start mid-table) —
+    # a prefetch-chain shape the causal cases never exercise
+    for variant in ("dma", "simple"):
+        os.environ["DYNAMO_TPU_PAGED_KERNEL"] = variant
+        for B in (1, 8):
+            case = f"paged[{variant}][gemma] B={B}"
+            try:
+                n_pages = B * P + 1
+                key = jax.random.PRNGKey(200 + B)
+                kq, kk, kv_ = jax.random.split(key, 3)
+                q = jax.random.normal(kq, (B, Hq, Dh), jnp.bfloat16)
+                k_pages = jax.random.normal(kk, (Hkv, n_pages, page, Dh),
+                                            jnp.bfloat16)
+                v_pages = jax.random.normal(kv_, (Hkv, n_pages, page, Dh),
+                                            jnp.bfloat16)
+                pt = (np.arange(P)[None]
+                      + np.arange(B)[:, None] * P + 1).astype(np.int32)
+                page_tables = jnp.asarray(pt)
+                # lengths straddle the window: some lanes shorter than 96,
+                # some spanning several out-of-window pages
+                lengths = jnp.asarray(
+                    np.random.RandomState(B).randint(1, P * page, B),
+                    jnp.int32)
+                out = np.asarray(
+                    paged_attention(q, k_pages, v_pages, page_tables,
+                                    lengths, interpret=False, **gem),
+                    np.float32)
+                kg = np.asarray(k_pages, np.float32)[:, pt] \
+                    .transpose(1, 2, 3, 0, 4).reshape(B, P * page, Hkv, Dh)
+                vg = np.asarray(v_pages, np.float32)[:, pt] \
+                    .transpose(1, 2, 3, 0, 4).reshape(B, P * page, Hkv, Dh)
+                kp = jnp.broadcast_to(jnp.arange(P * page), (B, P * page))
+                valid = kp < np.asarray(lengths)[:, None]
+                ref = np.asarray(dense_ref(
+                    jnp.asarray(q)[:, None],
+                    jnp.asarray(kg, jnp.bfloat16),
+                    jnp.asarray(vg, jnp.bfloat16),
+                    (lengths - 1)[:, None], kp, valid, **gem),
+                    np.float32)[:, 0]
+                err = np.abs(out - ref.reshape(out.shape)).max()
+                ok = bool(err < 0.05)
+                print(f"{case}: max_err={err:.4f} {'OK' if ok else 'FAIL'}")
+                RESULTS.append({"case": case, "ok": ok,
+                                "max_err": float(err)})
+                failures += 0 if ok else 1
+            except Exception as e:  # noqa: BLE001
+                print(f"{case}: COMPILE/RUN FAIL: {type(e).__name__}: "
+                      f"{str(e)[:200]}")
+                RESULTS.append({"case": case, "ok": False,
+                                "error": f"{type(e).__name__}: {str(e)[:200]}"})
+                failures += 1
     return failures
 
 
@@ -152,7 +245,8 @@ def _record(device_kind: str, failures: int) -> None:
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "TPU_SMOKE.json")
     with open(path, "w") as f:
-        complete = len(RESULTS) >= 10   # 4 flash + 2x3 paged cases
+        # 4 flash + 2 flash[gemma] + 2x3 paged + 2x2 paged[gemma] cases
+        complete = len(RESULTS) >= 16
         json.dump({"device": device_kind, "failures": failures,
                    "pass": failures == 0 and complete,
                    "complete": complete, "when": time.time(),

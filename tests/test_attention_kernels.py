@@ -114,3 +114,114 @@ def test_paged_inside_scan_with_donated_pool():
     _, outs = run(q, k_pages, v_pages, pt, lengths)
     assert outs.shape == (3, B, Hq, Dh)
     assert np.isfinite(np.asarray(outs, np.float32)).all()
+
+
+def _dense_ref_full(q, k, v, q_pos, k_pos, k_valid, scale=None,
+                    softcap=None, window=None):
+    mask = k_valid[:, None, :] & (k_pos[:, None, :] <= q_pos[:, :, None])
+    if window is not None:
+        mask = mask & (k_pos[:, None, :] > q_pos[:, :, None] - window)
+    return attend(q, k, v, mask, scale=scale, softcap=softcap)
+
+
+@pytest.mark.parametrize("window,softcap,scale", [
+    (24, None, None),            # gemma3-style sliding
+    (None, 50.0, None),          # gemma2 softcap
+    (24, 30.0, 1.0 / math.sqrt(24.0)),   # all three (gemma2 27b-style)
+])
+def test_flash_window_softcap_scale(window, softcap, scale):
+    B, T, S, Hq, Hkv, Dh = 2, 32, 128, 4, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (B, T, Hq, Dh), jnp.float32).astype(jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, Hkv, Dh), jnp.float32).astype(jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, Hkv, Dh), jnp.float32).astype(jnp.bfloat16)
+    ctx = S // 2 - T // 2
+    n = ctx + T
+    q_pos = jnp.broadcast_to(jnp.arange(ctx, ctx + T, dtype=jnp.int32), (B, T))
+    k_pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    k_valid = k_pos < n
+
+    got = flash_attention(q, k, v, q_pos, k_pos, k_valid, interpret=True,
+                          scale=scale, softcap=softcap, window=window)
+    want = _dense_ref_full(q, k, v, q_pos, k_pos, k_valid, scale=scale,
+                           softcap=softcap, window=window)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=3e-2, rtol=3e-2)
+
+
+@pytest.mark.parametrize("window,softcap,scale", [
+    (12, None, None),
+    (None, 50.0, None),
+    (12, 30.0, 1.0 / math.sqrt(24.0)),
+    (1000, 50.0, None),          # window wider than any context: == causal
+])
+def test_paged_window_softcap_scale(window, softcap, scale):
+    B, Hq, Hkv, Dh, page, P = 3, 4, 2, 16, 8, 4
+    n_pages = B * P + 1
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(ks[0], (B, Hq, Dh), jnp.float32).astype(jnp.bfloat16)
+    k_pages = jax.random.normal(
+        ks[1], (Hkv, n_pages, page, Dh), jnp.float32).astype(jnp.bfloat16)
+    v_pages = jax.random.normal(
+        ks[2], (Hkv, n_pages, page, Dh), jnp.float32).astype(jnp.bfloat16)
+    page_tables = (jnp.arange(P, dtype=jnp.int32)[None]
+                   + jnp.arange(B, dtype=jnp.int32)[:, None] * P + 1)
+    # lengths straddle window boundaries: shorter, equal, and longer than
+    # the window (the page-range clamp only engages in the last case)
+    lengths = jnp.asarray([5, 12, page * P], jnp.int32)
+
+    got = paged_attention(q, k_pages, v_pages, page_tables, lengths,
+                          interpret=True, scale=scale, softcap=softcap,
+                          window=window)
+    S = P * page
+    for b in range(B):
+        ctx_k = (k_pages[:, page_tables[b]].transpose(1, 2, 0, 3)
+                 .reshape(S, Hkv, Dh))
+        ctx_v = (v_pages[:, page_tables[b]].transpose(1, 2, 0, 3)
+                 .reshape(S, Hkv, Dh))
+        qb = q[b][None, None]
+        k_pos = jnp.arange(S, dtype=jnp.int32)[None]
+        valid = k_pos < lengths[b]
+        q_pos = jnp.full((1, 1), lengths[b] - 1, jnp.int32)
+        want = _dense_ref_full(qb, ctx_k[None], ctx_v[None], q_pos, k_pos,
+                               valid, scale=scale, softcap=softcap,
+                               window=window)
+        np.testing.assert_allclose(
+            np.asarray(got[b], np.float32),
+            np.asarray(want[0, 0], np.float32), atol=3e-2, rtol=3e-2)
+
+
+@pytest.mark.parametrize("window,softcap,ppb", [
+    (None, None, 2),             # baseline: full causal through the DMA path
+    (12, None, 2),
+    (12, 30.0, 3),               # ppb=3 forces a padded page table too
+    (1000, 50.0, 2),             # window wider than any context
+])
+def test_paged_dma_variant_window_softcap(window, softcap, ppb):
+    """The double-buffered DMA kernel (the TPU serving path) in interpret
+    mode: window clamps the active block range at both ends — the prefetch
+    chain must stay correctly linked when lanes start mid-table."""
+    from dynamo_tpu.ops.attention import _paged_attention_tpu
+
+    B, Hq, Hkv, Dh, page, P = 3, 4, 2, 16, 8, 4
+    n_pages = B * P + 1
+    ks = jax.random.split(jax.random.PRNGKey(13), 3)
+    q = jax.random.normal(ks[0], (B, Hq, Dh), jnp.float32).astype(jnp.bfloat16)
+    k_pages = jax.random.normal(
+        ks[1], (Hkv, n_pages, page, Dh), jnp.float32).astype(jnp.bfloat16)
+    v_pages = jax.random.normal(
+        ks[2], (Hkv, n_pages, page, Dh), jnp.float32).astype(jnp.bfloat16)
+    page_tables = (jnp.arange(P, dtype=jnp.int32)[None]
+                   + jnp.arange(B, dtype=jnp.int32)[:, None] * P + 1)
+    lengths = jnp.asarray([5, 12, page * P], jnp.int32)
+
+    got = _paged_attention_tpu(
+        q.reshape(B, Hkv, Hq // Hkv, Dh), k_pages, v_pages, page_tables,
+        lengths, pages_per_block=ppb, softcap=softcap, window=window,
+        interpret=True).reshape(B, Hq, Dh)
+    want = paged_attention(q, k_pages, v_pages, page_tables, lengths,
+                           interpret=True, softcap=softcap, window=window)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=3e-2, rtol=3e-2)

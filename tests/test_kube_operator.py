@@ -8,8 +8,11 @@ dynamodeployment_controller.go:68.
 
 import copy
 
+import pytest
+
 from dynamo_tpu.deploy.crd import Deployment, DeploymentSpec, ServiceSpec
-from dynamo_tpu.deploy.kube import CR_KIND, FakeKubeApi, KubeReconciler
+from dynamo_tpu.deploy.kube import (CR_KIND, FakeKubeApi, KubeConflict,
+                                    KubeReconciler)
 
 SERVICES = {
     "Frontend": ("examples.llm_graphs:Frontend", 1, 0),
@@ -152,3 +155,82 @@ def test_build_context_and_builder_dispatch(tmp_path):
     assert (tmp_path / "args.txt").read_text().split() == ["-t", "graph:1", "-"]
     assert int((tmp_path / "stdin_bytes.txt").read_text()) == \
         os.path.getsize(ctx)
+
+
+# ---------------------------------------------------------------------------
+# real-apiserver semantics the mock must generate (VERDICT r4 item #6:
+# envtest-class conflict + race + finalizer paths)
+# ---------------------------------------------------------------------------
+
+def _cm(name="cm", data=None, **md):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": "prod", **md},
+            "data": data or {"k": "v"}}
+
+
+def test_ssa_field_manager_conflict():
+    """A second manager changing an owned field without force gets 409;
+    with force it takes ownership (after which the FIRST manager conflicts)."""
+    api = FakeKubeApi()
+    api.apply(_cm(), field_manager="alpha")
+    with pytest.raises(KubeConflict) as ei:
+        api.apply(_cm(data={"k": "other"}), field_manager="beta",
+                  force=False)
+    assert "data" in ei.value.conflicts
+    # unchanged fields never conflict
+    api.apply(_cm(), field_manager="beta", force=False)
+    # force takes ownership...
+    out = api.apply(_cm(data={"k": "other"}), field_manager="beta")
+    assert out["data"] == {"k": "other"}
+    # ...so now the original manager is the one that conflicts
+    with pytest.raises(KubeConflict):
+        api.apply(_cm(data={"k": "v3"}), field_manager="alpha",
+                  force=False)
+
+
+def test_resource_version_race():
+    """Optimistic concurrency: an apply carrying a stale resourceVersion
+    fails even with force (the race is about staleness, not ownership)."""
+    api = FakeKubeApi()
+    v1 = api.apply(_cm())
+    stale_rv = v1["metadata"]["resourceVersion"]
+    api.apply(_cm(data={"k": "newer"}))          # bumps rv
+    with pytest.raises(KubeConflict, match="modified"):
+        api.apply(_cm(data={"k": "mine"}, resourceVersion=stale_rv))
+    # the current rv is accepted
+    cur = api.get("ConfigMap", "prod", "cm")["metadata"]["resourceVersion"]
+    api.apply(_cm(data={"k": "mine"}, resourceVersion=cur))
+    assert api.get("ConfigMap", "prod", "cm")["data"] == {"k": "mine"}
+
+
+def test_finalizer_blocks_delete_until_cleared():
+    api = FakeKubeApi()
+    api.apply(_cm(finalizers=["dynamo.tpu/cleanup"]))
+    assert api.delete("ConfigMap", "prod", "cm") is True
+    obj = api.get("ConfigMap", "prod", "cm")
+    assert obj is not None                      # still there, marked
+    assert obj["metadata"]["deletionTimestamp"]
+    # clearing the finalizer completes the pending delete
+    api.apply(_cm(finalizers=[],
+                  resourceVersion=obj["metadata"]["resourceVersion"]))
+    assert api.get("ConfigMap", "prod", "cm") is None
+
+
+def test_reconciler_unaffected_by_conflict_semantics():
+    """The operator's own loop (force SSA, no rv pinning) reconciles
+    exactly as before even when another manager co-owns objects."""
+    api = FakeKubeApi()
+    rec = KubeReconciler(api, SERVICES)
+    dep = make_dep(Worker={"replicas": 1})
+    rec.reconcile(dep)
+    # an outside manager force-adopts a child's spec...
+    child = api.list("Deployment", "prod")[0]
+    api.apply({"apiVersion": "apps/v1", "kind": "Deployment",
+               "metadata": {"name": child["metadata"]["name"],
+                            "namespace": "prod"},
+               "spec": {**child["spec"], "replicas": 7}},
+              field_manager="outsider")
+    # ...and the reconciler (force) takes it straight back
+    rec.reconcile(dep)
+    child = api.list("Deployment", "prod")[0]
+    assert int(child["spec"]["replicas"]) == 1

@@ -453,13 +453,37 @@ def test_gemma2_serves_through_engine():
     assert len(a) == 5 and a == b
 
 
-def test_gemma2_rejects_pallas_attn():
+def _engine_greedy(model_cfg, attn_impl, seq, n=6, prompt=(5, 6, 7, 8, 9)):
     from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+    from dynamo_tpu.llm.protocols.common import BackendInput, StopConditions
 
-    with pytest.raises(ValueError, match="softcap"):
-        EngineCore(JaxEngineConfig(
-            model=llama.preset("tiny-gemma2"), max_batch=2,
-            max_context=128, page_size=8, attn_impl="pallas"))
+    core = EngineCore(JaxEngineConfig(
+        model=model_cfg, max_batch=2, max_context=128, page_size=8,
+        prefill_chunk=32, attn_impl=attn_impl))
+    core.submit(seq, BackendInput(token_ids=list(prompt),
+                                  stop=StopConditions(max_tokens=n,
+                                                      ignore_eos=True)))
+    toks = []
+    for _ in range(200):
+        for so in core.step():
+            assert so.error is None
+            toks.append(so.token)
+        if not core.has_work:
+            break
+    return toks
+
+
+@pytest.mark.parametrize("preset", ["tiny-gemma2", "tiny-gemma3"])
+def test_gemma_pallas_matches_xla(preset):
+    """Gemma2/3 on the Pallas kernels (round 5 — the newest families no
+    longer forfeit the fast path): window + softcap + query_pre_attn_scalar
+    flow into flash (prefill) and paged (decode) kernels, token-for-token
+    vs the XLA path. The tiny presets' windows are shorter than
+    prompt+generation, so the sliding mask actually binds."""
+    cfg = llama.preset(preset)
+    a = _engine_greedy(cfg, "pallas", "p")
+    b = _engine_greedy(cfg, "xla", "x")
+    assert len(a) == 6 and a == b
 
 
 def test_gemma2_safetensors_roundtrip(tmp_path):
@@ -636,7 +660,7 @@ def test_gemma3_serves_through_engine():
     core = EngineCore(JaxEngineConfig(
         model=llama.preset("tiny-gemma3"), max_batch=2, max_context=128,
         page_size=8, prefill_chunk=32, attn_impl="auto"))
-    assert core.attn_impl == "xla"   # sliding windows force the xla path
+    assert core.attn_impl == "xla"   # auto resolves to xla off-TPU
 
     def run(seq):
         core.submit(seq, BackendInput(token_ids=[5, 6, 7],

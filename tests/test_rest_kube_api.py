@@ -16,7 +16,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import pytest
 
 from dynamo_tpu.deploy.crd import Deployment, DeploymentSpec, ServiceSpec
-from dynamo_tpu.deploy.kube import CR_KIND, FakeKubeApi, KubeReconciler
+from dynamo_tpu.deploy.kube import (CR_KIND, FakeKubeApi, KubeConflict,
+                                    KubeReconciler)
 from dynamo_tpu.deploy.rest_api import _KINDS, RestKubeApi
 
 _PLURALS = {plural: kind for kind, (_, plural) in _KINDS.items()}
@@ -86,7 +87,14 @@ class _ApiServerShim(BaseHTTPRequestHandler):
         body = json.loads(
             self.rfile.read(int(self.headers["Content-Length"])))
         assert body["kind"] == kind and body["metadata"]["name"] == name
-        return self._send(200, self.api.apply(body))
+        try:
+            out = self.api.apply(body, field_manager=q["fieldManager"],
+                                 force=q.get("force") == "true")
+        except KubeConflict as e:
+            return self._send(409, {"kind": "Status", "code": 409,
+                                    "reason": "Conflict",
+                                    "message": str(e)})
+        return self._send(200, out)
 
     def do_DELETE(self):
         kind, ns, name, _ = self._parse()
@@ -204,3 +212,23 @@ users:
     api = RestKubeApi.from_kubeconfig(str(cfgfile))
     assert api.base_url == "https://1.2.3.4:6443"
     assert api.token == "sekrit-token"
+
+
+def test_ssa_conflict_surfaces_as_409_over_rest(rest_api):
+    """A non-force manager hitting an owned field gets KubeApiError(409)
+    through the real HTTP path (the error class a live apiserver returns
+    under envtest, VERDICT r4 item #6)."""
+    from dynamo_tpu.deploy.rest_api import KubeApiError, RestKubeApi
+
+    api, fake = rest_api
+    cm = {"apiVersion": "v1", "kind": "ConfigMap",
+          "metadata": {"name": "co", "namespace": "prod"},
+          "data": {"k": "v"}}
+    api.apply(cm)                                # manager: dynamo-tpu
+    rival = RestKubeApi(api.base_url, field_manager="rival", force=False)
+    with pytest.raises(KubeApiError) as ei:
+        rival.apply({**cm, "data": {"k": "other"}})
+    assert ei.value.status == 409
+    assert "conflict" in ei.value.body.lower()
+    # the object is untouched by the failed apply
+    assert fake.get("ConfigMap", "prod", "co")["data"] == {"k": "v"}
