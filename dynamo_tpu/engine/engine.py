@@ -178,8 +178,12 @@ class EngineCore:
         m = cfg.model
         llama.validate_tp(m, cfg.tp, cfg.ep)
         llama.validate_pp(m, cfg.pp, cfg.tp)
-        if cfg.pp > 1 and (cfg.sp > 1 or cfg.ep > 1):
-            raise ValueError("pp > 1 composes with tp only (sp/ep must be 1)")
+        if cfg.pp > 1 and cfg.sp > 1:
+            # ring prefill shards the sequence axis the pp stage loop
+            # microbatches — the two prefill schedules don't compose (the
+            # reference's vLLM pp has the same envelope); pp x tp x ep all
+            # compose (round 5)
+            raise ValueError("pp > 1 composes with tp/ep (sp must be 1)")
         self.mesh = serving_mesh(cfg.tp, cfg.sp, cfg.ep, cfg.pp, devices)
         self.page_size = cfg.page_size
         # every sequence may overshoot up to 2*decode_steps speculative

@@ -474,8 +474,6 @@ def validate_pp(cfg: LlamaConfig, pp: int, tp: int = 1) -> None:
     if cfg.num_layers % pp:
         raise ValueError(
             f"num_layers {cfg.num_layers} not divisible by pp={pp}")
-    if cfg.num_experts:
-        raise ValueError("pp > 1 with MoE staging is not supported yet")
     if tp > 1 and cfg.num_kv_heads % tp:
         raise ValueError(
             f"pp > 1 with tp={tp} needs kv heads divisible by tp "
@@ -841,7 +839,7 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
     delegates to vLLM `pipeline_parallel_size`); here the model compute
     path itself is pp-partitioned and engine-served (JaxEngineConfig.pp).
     """
-    from ..parallel.mesh import AXIS_PP
+    from ..parallel.mesh import AXIS_EP, AXIS_PP
 
     M, Bm, T = tokens.shape
     L = cfg.num_layers
@@ -860,12 +858,21 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
             outs.append(lg)
         return jnp.stack(outs), k_pool, v_pool
     assert L % pp == 0, f"layers {L} must divide pp {pp}"
-    assert not cfg.num_experts, "pp + MoE staging is a follow-up"
     tp_sz = _tp_size(mesh)
     # per-shard GQA grouping must stay integral: with kv heads replicated a
     # shard would silently pair its local q heads with the wrong kv heads
     assert cfg.num_kv_heads % tp_sz == 0, \
         f"pp with tp={tp_sz} needs kv heads divisible (got {cfg.num_kv_heads})"
+    # pp x ep (round 5): the stage body computes its LOCAL experts' dense
+    # dispatch for the full token set and psums over ep — same math as
+    # moe_ffn's sharded formulation, inlined because we're already inside
+    # the pp(+tp) shard_map and shard_maps don't nest
+    ep_sz = (mesh.shape[AXIS_EP]
+             if mesh is not None and AXIS_EP in mesh.axis_names else 1)
+    E = cfg.num_experts
+    El = E // ep_sz if E else 0
+    moe_tp = (tp_sz if E and tp_sz > 1
+              and cfg.intermediate_size % tp_sz == 0 else 1)
     page = k_pool.shape[3]
     lp = params["layers"]
 
@@ -989,12 +996,35 @@ def forward_pp(params: Dict[str, Any], cfg: LlamaConfig,
                     o = jax.lax.psum(o, AXIS_TP)
                 x = _attn_residual(x, o, lp_loc, l, cfg)
                 h2 = rms_norm(x, lp_loc["ln2"][l], cfg.rms_eps, cfg.norm_offset)
-                g = jnp.einsum("btd,df->btf", h2, lp_loc["wg"][l])
-                u = jnp.einsum("btd,df->btf", h2, lp_loc["wu"][l])
-                f = jnp.einsum("btf,fd->btd", _act(cfg)(g) * u,
-                               lp_loc["wd"][l])
-                if tp_sz > 1:
-                    f = jax.lax.psum(f, AXIS_TP)
+                if E:
+                    # routed MoE: router replicated, experts sharded over
+                    # ep (and F over tp when divisible). Dense dispatch —
+                    # every local expert sees every token; non-local gate
+                    # weights are zero, so the ep psum is exact. Gating and
+                    # expert math are moe.py's shared helpers: the pp path
+                    # cannot silently diverge from the pp=1 moe_ffn policy.
+                    from .moe import dense_gates, expert_ffn, route_topk
+                    vals, topi = route_topk(h2, lp_loc["wr"][l],
+                                            cfg.experts_per_token)
+                    gates = dense_gates(vals, topi, E)     # [B, T, E]
+                    if ep_sz > 1:
+                        eidx = jax.lax.axis_index(AXIS_EP)
+                        gates = jax.lax.dynamic_slice_in_dim(
+                            gates, eidx * El, El, axis=2)  # local slice
+                    f = expert_ffn(h2, lp_loc["wg"][l], lp_loc["wu"][l],
+                                   lp_loc["wd"][l], gates)
+                    axes = tuple(ax for ax, n in ((AXIS_EP, ep_sz),
+                                                  (AXIS_TP, moe_tp))
+                                 if n > 1)
+                    if axes:
+                        f = jax.lax.psum(f, axes)
+                else:
+                    g = jnp.einsum("btd,df->btf", h2, lp_loc["wg"][l])
+                    u = jnp.einsum("btd,df->btf", h2, lp_loc["wu"][l])
+                    f = jnp.einsum("btf,fd->btd", _act(cfg)(g) * u,
+                                   lp_loc["wd"][l])
+                    if tp_sz > 1:
+                        f = jax.lax.psum(f, AXIS_TP)
                 if cfg.sandwich_norms:
                     f = rms_norm(f, lp_loc["ln2_post"][l], cfg.rms_eps,
                                  cfg.norm_offset)

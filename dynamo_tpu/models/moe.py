@@ -76,6 +76,34 @@ def _sorted_dispatch(x: jax.Array,            # [B, T, D]
     return out.reshape(B, T, D).astype(x.dtype)
 
 
+def route_topk(x: jax.Array, wr: jax.Array, top_k: int):
+    """Router: renormalized top-k gate values + expert ids ([B,T,K] each).
+    Shared by every dispatch formulation (incl. forward_pp's in-stage MoE)
+    so the gating policy has exactly one implementation."""
+    logits = jnp.einsum("btd,de->bte", x, wr.astype(x.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    vals, idx = jax.lax.top_k(probs, top_k)               # [B,T,K]
+    return vals / jnp.sum(vals, axis=-1, keepdims=True), idx
+
+
+def dense_gates(vals: jax.Array, idx: jax.Array, n_experts: int) -> jax.Array:
+    """One-hot gate matrix [B,T,E] for dense dispatch."""
+    return jnp.sum(jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)
+                   * vals[..., None], axis=-2)
+
+
+def expert_ffn(x: jax.Array, wg: jax.Array, wu: jax.Array, wd: jax.Array,
+               gates: jax.Array) -> jax.Array:
+    """Dense-dispatch expert compute for ONE shard's local experts.
+    Shapes per shard: wg/wu [El, D, F], wd [El, F, D], gates [B,T,El].
+    Pure per-shard math — safe inside any enclosing shard_map (forward_pp's
+    pp x ep stage body psums the result over ep/tp itself)."""
+    g = jnp.einsum("btd,edf->btef", x, wg)
+    u = jnp.einsum("btd,edf->btef", x, wu)
+    a = jax.nn.silu(g) * u
+    return jnp.einsum("btef,efd,bte->btd", a, wd, gates.astype(x.dtype))
+
+
 def moe_ffn(x: jax.Array,           # [B, T, D]
             wr: jax.Array,          # [D, E] router
             wg: jax.Array,          # [E, D, F] expert gate projections
@@ -85,10 +113,7 @@ def moe_ffn(x: jax.Array,           # [B, T, D]
             mesh=None) -> jax.Array:
     """Routed MoE feed-forward. Returns [B, T, D] in x.dtype."""
     E = wr.shape[1]
-    logits = jnp.einsum("btd,de->bte", x, wr.astype(x.dtype))
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    vals, idx = jax.lax.top_k(probs, top_k)               # [B,T,K]
-    vals = vals / jnp.sum(vals, axis=-1, keepdims=True)   # renormalize
+    vals, idx = route_topk(x, wr, top_k)
 
     ep = _ep_size(mesh)
     tp = _tp_size(mesh)
@@ -103,16 +128,8 @@ def moe_ffn(x: jax.Array,           # [B, T, D]
 
     # dense dispatch (tiny decode batches / sharded meshes) consumes the
     # one-hot gates tensor; only built where used
-    gates = jnp.sum(jax.nn.one_hot(idx, E, dtype=jnp.float32)
-                    * vals[..., None], axis=-2)           # [B,T,E]
-
-    def experts(x, wg, wu, wd, gates):
-        # shapes per shard: wg/wu [El, D, F], wd [El, F, D], gates [B,T,El]
-        g = jnp.einsum("btd,edf->btef", x, wg)
-        u = jnp.einsum("btd,edf->btef", x, wu)
-        a = jax.nn.silu(g) * u
-        return jnp.einsum("btef,efd,bte->btd", a, wd,
-                          gates.astype(x.dtype))
+    gates = dense_gates(vals, idx, E)                     # [B,T,E]
+    experts = expert_ffn
 
     if ep <= 1 and tp_ffn <= 1:
         return experts(x, wg, wu, wd, gates)

@@ -103,7 +103,7 @@ class LocalServe:
             for w in range(workers):
                 env = dict(os.environ)
                 env[SERVICE_CONFIG_ENV] = json.dumps(self.config)
-                env.update(alloc.allocate(chips))
+                env.update(alloc.allocate(chips, service=spec.name))
                 p = subprocess.Popen(
                     [sys.executable, "-m", "dynamo_tpu.sdk.serve_child",
                      f"{mod}:{cls.__name__}", "--store", store],

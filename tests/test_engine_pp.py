@@ -136,8 +136,28 @@ def test_pp_rejects_bad_combos():
                             pp=2), jax.devices()[:2])
     with pytest.raises(ValueError, match="ring"):
         EngineCore(make_cfg(pp=2, attn_impl="ring"), jax.devices()[:2])
-    with pytest.raises(ValueError, match="sp/ep"):
+    with pytest.raises(ValueError, match="sp must be 1"):
         EngineCore(make_cfg(pp=2, sp=2), jax.devices()[:4])
+
+
+def test_pp2_ep2_moe_matches_pp1():
+    """pp x ep composition (VERDICT r4 item #7): a MoE model staged over
+    pp=2 with experts sharded over ep=2 serves token-for-token vs the
+    single-device engine (expert psums cross the ep axis inside every
+    stage)."""
+    mcfg = llama.preset("tiny-moe")
+    ref = run_tokens(make_cfg(model=mcfg, max_batch=4), 1)
+    out = run_tokens(make_cfg(model=mcfg, max_batch=4, pp=2, ep=2), 4)
+    assert out == ref
+
+
+def test_pp2_ep2_tp2_moe_matches_pp1():
+    """The full pp x ep x tp stack (8 virtual devices): stage loop + local
+    experts + F-sharded expert matmuls + attention-head sharding."""
+    mcfg = llama.preset("tiny-moe")   # intermediate 96 % tp=2 == 0
+    ref = run_tokens(make_cfg(model=mcfg, max_batch=4), 1)
+    out = run_tokens(make_cfg(model=mcfg, max_batch=4, pp=2, ep=2, tp=2), 8)
+    assert out == ref
 
 
 def test_pp_with_pallas_serves_exactly():

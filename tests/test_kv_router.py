@@ -293,3 +293,98 @@ def test_local_prefix_reuse_respects_lora():
     pool.create("lora2", lora_id=7)
     matched, _ = pool.match_prefix("lora2", tokens, 8)
     assert matched == 8
+
+
+async def test_recorder_pause_filter_bounds_and_indexer_feed(tmp_path):
+    """Recorder depth (VERDICT r4 item #8, ref recorder.rs:38-291):
+    pause/resume gates the stream, predicate filtering drops without
+    breaking the tap, max_events auto-stops, and a capture replays
+    STRAIGHT into a KvIndexer (worker-filtered) — a recorded production
+    stream drives router state bit-for-bit."""
+    from dynamo_tpu.llm.recorder import KvRecorder
+
+    def mk(worker_id, eid, tokens_base):
+        h = compute_seq_hashes(list(range(tokens_base, tokens_base + 4)), 4)
+        return stored(worker_id, h).to_dict()
+
+    rec = KvRecorder(str(tmp_path / "cap.jsonl"),
+                     filter_fn=lambda e: e["payload"]["worker_id"] != 99,
+                     max_events=3)
+    assert rec.record({"subject": "kv_events", "payload": mk(1, 1, 0)})
+    rec.pause()
+    assert not rec.record({"subject": "kv_events", "payload": mk(1, 2, 4)})
+    rec.resume()
+    # filtered out (worker 99), counted as skipped
+    assert not rec.record({"subject": "kv_events", "payload": mk(99, 3, 8)})
+    assert rec.record({"subject": "kv_events", "payload": mk(2, 4, 12)})
+    assert rec.record({"subject": "kv_events", "payload": mk(2, 5, 16)})
+    assert rec.stopped                      # max_events reached
+    assert not rec.record({"subject": "kv_events", "payload": mk(1, 6, 20)})
+    assert rec.count == 3 and rec.skipped == 3
+    rec.flush()
+
+    # full replay into an indexer
+    idx = KvIndexer(block_size=4)
+    assert rec.replay_into_indexer(idx) == 3
+    assert set(idx.tree.workers()) == {1, 2}
+    # worker-filtered replay
+    idx2 = KvIndexer(block_size=4)
+    assert rec.replay_into_indexer(idx2, worker_ids=[2]) == 2
+    assert set(idx2.tree.workers()) == {2}
+    rec.close()
+
+
+async def test_recorder_attach_taps_live_event_plane(tmp_path):
+    """KvRecorder.attach subscribes the component's kv_events subject: the
+    real publisher->event-plane->recorder path, then replay into an indexer
+    reproduces the live router's view."""
+    from dynamo_tpu.llm.recorder import KvRecorder
+    from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    srv = StoreServer(port=0)
+    port = await srv.start()
+    try:
+        drt = await DistributedRuntime(store_port=port).connect()
+        comp = drt.namespace("dynamo").component("backend")
+        rec = await KvRecorder(str(tmp_path / "tap.jsonl")).attach(comp)
+
+        async def transport(subject, payload):
+            await comp.publish(subject, payload)
+
+        from dynamo_tpu.engine.cache import PagePool
+
+        pub = KvEventPublisher(worker_id=7, publish=transport)
+        await pub.start()
+        pool = PagePool(num_pages=8, page_size=4)
+        pool.on_block_sealed = pub.block_stored
+        pool.create("s1")
+        pool.extend("s1", list(range(9)))    # seals 2 blocks -> 2 events
+        await pub.flush()
+        await pub.stop()
+        for _ in range(50):
+            if rec.count >= 2:
+                break
+            await asyncio.sleep(0.05)
+        assert rec.count == 2
+        rec.flush()
+        idx = KvIndexer(block_size=4)
+        assert rec.replay_into_indexer(idx) == 2
+        assert idx.find_matches_for_tokens(list(range(8))).scores == {7: 2}
+        rec.close()
+        await drt.close()
+    finally:
+        await srv.stop()
+
+
+async def test_recorder_close_gates_live_tap(tmp_path):
+    """close() on a recorder with a live attach tap must gate later events
+    (no unsubscribe surface exists) instead of raising on a closed file."""
+    from dynamo_tpu.llm.recorder import KvRecorder
+
+    rec = KvRecorder(str(tmp_path / "t.jsonl"))
+    assert rec.record({"payload": {"x": 1}})
+    rec.close()
+    assert not rec.record({"payload": {"x": 2}})   # gated, no ValueError
+    assert rec.count == 1 and rec.skipped == 1
