@@ -1,0 +1,124 @@
+"""Serve a REAL pretrained checkpoint end-to-end and verify a completion.
+
+BASELINE config 1/2's correctness half (VERDICT r4 item #5): sharded
+safetensors (or GGUF) -> sharded device pytrees -> the in-tree engine ->
+OpenAI HTTP -> a pinned greedy completion. This box ships no real
+checkpoints (zero egress), so the script is the recorded, runnable recipe
+for any host that has one (the TPU VM's HF cache, a mounted model dir):
+
+    python scripts/serve_real_checkpoint.py /path/to/Llama-3.2-1B \
+        [--prompt "The capital of France is"] [--expect " Paris"] \
+        [--tp 1] [--attn auto] [--max-tokens 16]
+
+Path may be an HF-layout directory (config.json + *.safetensors +
+tokenizer.json) or a .gguf file. Exit 0 = loaded, served over HTTP,
+completion streamed, and (with --expect) the pinned text matched.
+Ref: lib/llm/src/model_card/create.rs:41-143 (from_local_path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("model_path")
+    ap.add_argument("--prompt", default="The capital of France is")
+    ap.add_argument("--expect", default=None,
+                    help="substring the completion must contain")
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--attn", default="auto")
+    ap.add_argument("--max-tokens", type=int, default=16)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--max-context", type=int, default=2048)
+    ap.add_argument("--timeout", type=float, default=900.0,
+                    help="server-up timeout (weight load + first compile)")
+    args = ap.parse_args()
+    # the server subprocess runs with cwd=REPO: a relative model path must
+    # resolve against the CALLER's cwd, not the repo
+    args.model_path = os.path.abspath(args.model_path)
+
+    port = _free_port()
+    ea = {"tp": args.tp, "max_batch": args.max_batch,
+          "max_context": args.max_context, "attn_impl": args.attn,
+          "decode_steps": 8}
+    # loopback only: this is a verification drive, not a deployment — the
+    # model must not be reachable from the network for the run's duration
+    cmd = [sys.executable, "-m", "dynamo_tpu.cli.run", "in=http", "out=jax",
+           "--http-host", "127.0.0.1", "--http-port", str(port),
+           "--model-path", args.model_path,
+           "--extra-engine-args", json.dumps(ea)]
+    print("+", " ".join(cmd), flush=True)
+    t0 = time.monotonic()
+    proc = subprocess.Popen(cmd, cwd=REPO)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        while True:
+            if proc.poll() is not None:
+                print(f"FAIL: server exited rc={proc.returncode}")
+                return 1
+            if time.monotonic() - t0 > args.timeout:
+                print("FAIL: server not up within timeout")
+                return 1
+            try:
+                with urllib.request.urlopen(base + "/v1/models",
+                                            timeout=2) as r:
+                    models = json.load(r)["data"]
+                    break
+            except Exception:
+                time.sleep(2)
+        model_id = models[0]["id"]
+        load_s = time.monotonic() - t0
+        print(f"up in {load_s:.1f}s; model={model_id}")
+
+        body = json.dumps({"model": model_id, "prompt": args.prompt,
+                           "max_tokens": args.max_tokens,
+                           "temperature": 0}).encode()
+        t1 = time.monotonic()
+        req = urllib.request.Request(
+            base + "/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=600) as r:
+            out = json.load(r)
+        dt = time.monotonic() - t1
+        text = out["choices"][0]["text"]
+        usage = out.get("usage", {})
+        print(json.dumps({
+            "model": model_id, "prompt": args.prompt, "completion": text,
+            "usage": usage, "load_s": round(load_s, 1),
+            "gen_s": round(dt, 2),
+            "tok_s": (round(usage.get("completion_tokens", 0) / dt, 1)
+                      if dt > 0 else None)}, ensure_ascii=False))
+        if args.expect is not None and args.expect not in text:
+            print(f"FAIL: expected {args.expect!r} in completion")
+            return 1
+        print("PASS")
+        return 0
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
