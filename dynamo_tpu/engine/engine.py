@@ -154,6 +154,10 @@ class _Slot:
     # physical tokens written after every ENQUEUED decode dispatch executes
     # (runs ahead of `generated`, which advances when results are fetched)
     sched_len: int = 0
+    # VLM: per-position image-group ids + projected soft tokens
+    # [n_images, mm_tokens, D] (None for text-only requests)
+    mm_spans: Optional[np.ndarray] = None
+    mm_soft: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -215,6 +219,59 @@ class EngineCore:
             params = llama.init_params(m, jax.random.PRNGKey(cfg.seed))
             self.params = jax.tree.map(
                 lambda a, s: global_put(a, s), params, shardings)
+
+        # --- vision tower (Gemma3 VLM) --------------------------------
+        # replicated params (the tower is tiny next to the LM; sharding it
+        # would only add collectives to a once-per-request encode)
+        self.vision_cfg = None
+        if m.vision is not None:
+            from ..models import siglip as _siglip
+
+            self.vision_cfg = _siglip.SiglipVisionConfig.from_hf_config(
+                m.vision, dtype=m.dtype)
+            vt = None
+            if cfg.params_path and _has_safetensors(cfg.params_path):
+                from .loader import _get, _open_all
+
+                tensors = _open_all(cfg.params_path)
+                vnames = [k for k in tensors
+                          if "vision_tower" in k
+                          or "multi_modal_projector" in k]
+                if vnames:
+                    strip = ("model." if any(
+                        k.startswith("model.vision_tower") for k in vnames)
+                        else "")
+                    vt = {k[len(strip):]: _get(tensors, k) for k in vnames}
+            if vt is not None:
+                self.vision_params = _siglip.params_from_hf(
+                    vt, self.vision_cfg)
+                self.proj_params = _siglip.projector_from_hf(
+                    vt, self.vision_cfg)
+            elif cfg.params_path and _has_safetensors(cfg.params_path):
+                # a real checkpoint WITHOUT vision tensors must not fall
+                # back to random tower weights: images would get
+                # confidently wrong completions
+                raise ValueError(
+                    f"model config declares a vision tower but "
+                    f"{cfg.params_path} has no vision_tower/"
+                    f"multi_modal_projector tensors; serve the text-only "
+                    f"config instead")
+            else:
+                # no checkpoint at all: random init (tests/benching)
+                kv1, kv2 = jax.random.split(jax.random.PRNGKey(cfg.seed + 1))
+                self.vision_params = _siglip.init_params(self.vision_cfg, kv1)
+                self.proj_params = _siglip.init_projector_params(
+                    self.vision_cfg, m.hidden_size, kv2)
+
+            def _encode(px):
+                feats = _siglip.forward(self.vision_params, self.vision_cfg,
+                                        px)
+                return _siglip.project(self.proj_params, self.vision_cfg,
+                                       feats, m.mm_tokens_per_image)
+
+            # jit caches per image-count; image requests are rare relative
+            # to decode steps, so lazy compile is fine
+            self._encode_images = jax.jit(_encode)
 
         # --- attention backend ---------------------------------------
         impl = cfg.attn_impl
@@ -505,14 +562,14 @@ class EngineCore:
             self._decode_fns[S] = step
         return self._decode_fns[S]
 
-    def _prefill_fn(self, Bp: int, C: int, S: int):
+    def _prefill_fn(self, Bp: int, C: int, S: int, mm: bool = False):
         """Batched prefill: Bp sequence chunks advance in ONE dispatch (the
         whole admission wave prefills together instead of one dispatch — and
         one host round-trip — per sequence). Every lane computes the LM head
         only at its own last chunk position (``logits_idx``) and samples; the
         host keeps results only for lanes whose prompt completed. Padded
         lanes write to scratch page 0 with nothing valid to read."""
-        if (Bp, C, S) not in self._prefill_batch_fns:
+        if (Bp, C, S, mm) not in self._prefill_batch_fns:
             cfg = self.cfg
             impl = {"pallas": "flash", "ring": "ring"}.get(
                 self.attn_impl, "xla")
@@ -526,7 +583,8 @@ class EngineCore:
                      out_shardings=(rep, rep, rep, kv, kv))
             def fn(params, tokens, positions, k_pool, v_pool, write_idx,
                    read_idx, read_pos, read_valid, last_i, temp, top_p,
-                   top_k, keys):
+                   top_k, keys, ov_vals=None, ov_mask=None, q_span=None,
+                   read_span=None):
                 if cfg.pp > 1:
                     def mb(a):
                         return a.reshape(M, Bp // M, *a.shape[1:])
@@ -538,17 +596,23 @@ class EngineCore:
                         attn_impl=("flash" if impl == "flash" else "xla"))
                     logits = logits.reshape(Bp, 1, -1)
                 else:
+                    # image waves run the xla attention path: the span
+                    # or-mask has no Pallas kernel input (text waves keep
+                    # the fast path — mm programs compile separately)
                     logits, k_pool, v_pool = llama.forward(
                         params, cfg.model, tokens, positions, k_pool, v_pool,
                         write_idx, read_idx, read_pos, read_valid,
-                        attn_impl=impl, mesh=mesh, logits_idx=last_i)
+                        attn_impl="xla" if mm else impl, mesh=mesh,
+                        logits_idx=last_i,
+                        embed_override=((ov_vals, ov_mask) if mm else None),
+                        attn_spans=((q_span, read_span) if mm else None))
                 tok, logp, new_keys = sample(
                     logits[:, 0], temp, top_p, top_k, keys)
                 packed = jnp.stack([tok.astype(jnp.float32), logp], -1)
                 return packed, tok, new_keys, k_pool, v_pool
 
-            self._prefill_batch_fns[(Bp, C, S)] = fn
-        return self._prefill_batch_fns[(Bp, C, S)]
+            self._prefill_batch_fns[(Bp, C, S, mm)] = fn
+        return self._prefill_batch_fns[(Bp, C, S, mm)]
 
     @staticmethod
     def _bucket(n: int, buckets: List[int]) -> int:
@@ -641,6 +705,9 @@ class EngineCore:
         prompt = list(request.token_ids)
         if len(prompt) + 1 >= self.cfg.max_context:
             raise ValueError(f"prompt of {len(prompt)} exceeds max_context")
+        if request.images:
+            raise ValueError("disaggregated prefill does not take image "
+                             "requests yet; serve VLM prompts aggregated")
         if None not in self.slots:
             raise RuntimeError("no free slot for prefill job")
         # the first sampled token must never finish the slot (we need the KV
@@ -849,6 +916,39 @@ class EngineCore:
                 self.k_pool, self.v_pool, pages, ks, vs)
         return matched
 
+    def _prepare_mm(self, req: BackendInput, prompt: List[int]):
+        """Validate + encode a VLM request. Returns (spans, soft, digest)
+        or an error string. Vision encode happens here (admission, engine
+        thread) so the prefill dispatch itself stays token-shaped."""
+        import hashlib
+
+        from . import multimodal as mm
+
+        m = self.cfg.model
+        if self.vision_cfg is None:
+            return ("this model has no vision tower; images are not "
+                    "servable (text-only deployment)")
+        if self.cfg.pp > 1:
+            return ("image requests are not supported on pipeline-parallel "
+                    "engines yet (the staged prefill takes no span inputs)")
+        if m.image_token_id is None:
+            return "model config has no image_token_id"
+        spans = mm.image_spans(prompt, m.image_token_id)
+        err = mm.validate_mm_prompt(spans, len(req.images),
+                                    m.mm_tokens_per_image,
+                                    self.cfg.prefill_chunk)
+        if err:
+            return err
+        try:
+            px = np.stack([mm.normalize_image(im, self.vision_cfg.image_size)
+                           for im in req.images])
+        except ValueError as e:
+            return str(e)
+        digest = int.from_bytes(
+            hashlib.blake2b(px.tobytes(), digest_size=8).digest(), "little")
+        soft = np.asarray(self._encode_images(jnp.asarray(px)))
+        return spans, soft, digest
+
     def _admit_one(self, out: List[StepOutput]):
         """Admit the head-of-line request into a free slot (no prefill yet).
         Returns (slot_idx, slot), "rejected" (popped with an error emitted),
@@ -872,12 +972,28 @@ class EngineCore:
             return "rejected"
         if not self.pool.can_admit(len(prompt) + 1):
             return "blocked"  # decode will free KV space eventually
+        mm_spans = mm_soft = None
+        chain_salt = getattr(req, "lora_id", 0)
+        if req.images:
+            err = self._prepare_mm(req, prompt)
+            if isinstance(err, str):
+                self.waiting.popleft()
+                out.append(StepOutput(seq_id, 0, 0.0, FinishReason.ERROR,
+                                      error=err))
+                return "rejected"
+            mm_spans, mm_soft, img_digest = err
+            # salt the block-hash chain with the image content: identical
+            # (prompt, images) requests still prefix-match, but the same
+            # placeholder ids with DIFFERENT images can never alias — in
+            # local reuse or the router index
+            chain_salt = (chain_salt ^ img_digest) & ((1 << 63) - 1)
         self.waiting.popleft()
         slot_idx = self.slots.index(None)
         slot = _Slot(seq_id, req, prompt)
+        slot.mm_spans, slot.mm_soft = mm_spans, mm_soft
         self.slots[slot_idx] = slot
         self.by_seq[seq_id] = slot
-        self.pool.create(seq_id, lora_id=getattr(req, "lora_id", 0))
+        self.pool.create(seq_id, lora_id=chain_salt)
         matched = 0
         if self.cfg.enable_prefix_reuse:
             matched = self._restore_prefix(seq_id, prompt)
@@ -936,17 +1052,26 @@ class EngineCore:
 
     def _run_prefill_program(self, Bp, C, S, tokens, positions, write_idx,
                              read_idx, read_pos, read_valid, last_i, temp,
-                             top_p, top_k, idxs, last_lanes):
+                             top_p, top_k, idxs, last_lanes,
+                             mm_arrays=None):
         """Execute the batched prefill program + key bookkeeping. The SAME
         code path runs on the leader (from _prefill_dispatch) and on
         followers (from mirror_dispatch) so device state stays in lockstep."""
         s = self.sampling
         keys = s.key[jnp.asarray(idxs)]
-        fn = self._prefill_fn(Bp, C, S)
-        packed, _tok, new_keys, self.k_pool, self.v_pool = fn(
-            self.params, tokens, positions, self.k_pool, self.v_pool,
-            write_idx, read_idx, read_pos, read_valid, last_i,
-            temp, top_p, top_k, keys)
+        fn = self._prefill_fn(Bp, C, S, mm=mm_arrays is not None)
+        if mm_arrays is not None:
+            packed, _tok, new_keys, self.k_pool, self.v_pool = fn(
+                self.params, tokens, positions, self.k_pool, self.v_pool,
+                write_idx, read_idx, read_pos, read_valid, last_i,
+                temp, top_p, top_k, keys, mm_arrays["ov_vals"],
+                mm_arrays["ov_mask"], mm_arrays["q_span"],
+                mm_arrays["read_span"])
+        else:
+            packed, _tok, new_keys, self.k_pool, self.v_pool = fn(
+                self.params, tokens, positions, self.k_pool, self.v_pool,
+                write_idx, read_idx, read_pos, read_valid, last_i,
+                temp, top_p, top_k, keys)
         # persist advanced PRNG keys only for lanes that really sampled
         if last_lanes:
             la = jnp.asarray([int(idxs[l]) for l in last_lanes])
@@ -964,7 +1089,13 @@ class EngineCore:
         for i, slot in chunks:
             prompt = slot.prompt
             start = slot.prefill_done
-            count = min(len(prompt) - start, cfg.prefill_chunk)
+            if slot.mm_spans is not None:
+                # never split an image span across chunks: its queries need
+                # every span key written in the same dispatch
+                from .multimodal import chunk_end
+                count = chunk_end(slot.mm_spans, start, cfg.prefill_chunk)
+            else:
+                count = min(len(prompt) - start, cfg.prefill_chunk)
             try:
                 self.pool.extend(slot.seq_id, prompt[start:start + count])
             except OutOfPages:
@@ -994,6 +1125,15 @@ class EngineCore:
         top_p = np.ones(Bp, np.float32)
         top_k = np.zeros(Bp, np.int32)
         idxs = np.zeros(Bp, np.int32)
+        mm = any(w[1].mm_spans is not None for w in work)
+        mm_arrays = None
+        if mm:
+            from .multimodal import soft_token_rows
+            D = cfg.model.hidden_size
+            ov_vals = np.zeros((Bp, C, D), np.float32)
+            ov_mask = np.zeros((Bp, C), bool)
+            q_span = np.zeros((Bp, C), np.int32)
+            read_span = np.zeros((Bp, S), np.int32)
         for lane, (i, slot, start, count, _) in enumerate(work):
             tokens[lane, :count] = slot.prompt[start:start + count]
             positions[lane, :count] = np.arange(start, start + count)
@@ -1007,20 +1147,39 @@ class EngineCore:
             top_p[lane] = s.top_p[i]
             top_k[lane] = s.top_k[i]
             idxs[lane] = i
+            if mm and slot.mm_spans is not None:
+                vals, maskv = soft_token_rows(slot.mm_spans, slot.mm_soft,
+                                              start, count)
+                ov_vals[lane, :count] = vals
+                ov_mask[lane, :count] = maskv
+                q_span[lane, :count] = slot.mm_spans[start:start + count]
+                # context slots map position -> image group (0 past prompt)
+                sp = np.zeros(S, np.int32)
+                n = min(len(slot.mm_spans), S)
+                sp[:n] = slot.mm_spans[:n]
+                read_span[lane] = np.where(r_v, sp[np.minimum(r_p, S - 1)],
+                                           0)
+        if mm:
+            mm_arrays = {"ov_vals": ov_vals, "ov_mask": ov_mask,
+                         "q_span": q_span, "read_span": read_span}
         seeds = self._apply_pending_seeds()
         last_lanes = [lane for lane, w in enumerate(work) if w[4]]
         if self.dispatch_hook is not None:
+            arrays = {"tokens": tokens, "positions": positions,
+                      "write_idx": write_idx, "read_idx": read_idx,
+                      "read_pos": read_pos, "read_valid": read_valid,
+                      "last_i": last_i, "temp": temp, "top_p": top_p,
+                      "top_k": top_k, "idxs": idxs}
+            if mm_arrays:
+                arrays.update(mm_arrays)
             self.dispatch_hook("prefill", {
                 "Bp": Bp, "C": C, "S": S, "seeds": seeds,
-                "last_lanes": last_lanes,
-            }, {"tokens": tokens, "positions": positions,
-                "write_idx": write_idx, "read_idx": read_idx,
-                "read_pos": read_pos, "read_valid": read_valid,
-                "last_i": last_i, "temp": temp, "top_p": top_p,
-                "top_k": top_k, "idxs": idxs})
+                "last_lanes": last_lanes, "mm": bool(mm_arrays),
+            }, arrays)
         packed = self._run_prefill_program(
             Bp, C, S, tokens, positions, write_idx, read_idx, read_pos,
-            read_valid, last_i, temp, top_p, top_k, idxs, last_lanes)
+            read_valid, last_i, temp, top_p, top_k, idxs, last_lanes,
+            mm_arrays=mm_arrays)
 
         packed_np = np.asarray(packed)            # ONE host fetch
         for lane, (i, slot, start, count, is_last) in enumerate(work):
@@ -1209,12 +1368,16 @@ class EngineCore:
             for slot_idx, seed in meta.get("seeds", []):
                 self._pending_seeds.append((int(slot_idx), int(seed)))
             self._apply_pending_seeds()
+            mm_arrays = ({k: arrs[k] for k in ("ov_vals", "ov_mask",
+                                               "q_span", "read_span")}
+                         if meta.get("mm") else None)
             self._run_prefill_program(
                 meta["Bp"], meta["C"], meta["S"], arrs["tokens"],
                 arrs["positions"], arrs["write_idx"], arrs["read_idx"],
                 arrs["read_pos"], arrs["read_valid"], arrs["last_i"],
                 arrs["temp"], arrs["top_p"], arrs["top_k"], arrs["idxs"],
-                [int(x) for x in meta.get("last_lanes", [])])
+                [int(x) for x in meta.get("last_lanes", [])],
+                mm_arrays=mm_arrays)
         elif kind == "decode":
             s = self.sampling
             s.temperature = arrs["temp"]

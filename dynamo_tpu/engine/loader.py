@@ -47,7 +47,12 @@ def load_llama_params(path: str, cfg: LlamaConfig,
     tensors = _open_all(path)
     L, D, Hq, Hkv, Dh = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
                          cfg.num_kv_heads, cfg.head_dim)
-    pfx = "model." if any(k.startswith("model.") for k in tensors) else ""
+    # Gemma3 VLM checkpoints nest the text model under language_model
+    pfx = ""
+    for cand in ("model.language_model.", "language_model.", "model."):
+        if any(k.startswith(cand + "layers.") for k in tensors):
+            pfx = cand
+            break
 
     def lay(i: int, name: str) -> np.ndarray:
         return _get(tensors, f"{pfx}layers.{i}.{name}.weight")

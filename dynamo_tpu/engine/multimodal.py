@@ -1,0 +1,119 @@
+"""Host-side multimodal prompt assembly for Gemma3 VLM serving.
+
+The engine keeps its compiled prefill programs token-shaped; images enter
+as (a) an embedding override (projected soft tokens replacing the
+``<image_soft_token>`` placeholder embeddings) and (b) per-position image
+GROUP ids driving the same-image bidirectional attention mask. This module
+computes both from the prompt's token ids — pure numpy, no device work.
+
+Reference capability: the VLM prompt merge the reference inherits from its
+engines (HF masked_scatter + token_type_ids mask,
+transformers modeling_gemma3.py:729-953).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def image_spans(prompt: List[int], image_token_id: int) -> np.ndarray:
+    """Per-position image-group ids: 0 for text, k>=1 for the k-th
+    contiguous run of ``image_token_id`` placeholders."""
+    ids = np.asarray(prompt, np.int64)
+    is_img = ids == image_token_id
+    starts = is_img & ~np.concatenate(([False], is_img[:-1]))
+    groups = np.cumsum(starts)
+    return np.where(is_img, groups, 0).astype(np.int32)
+
+
+def validate_mm_prompt(spans: np.ndarray, n_images: int,
+                       mm_tokens_per_image: int,
+                       prefill_chunk: int) -> Optional[str]:
+    """Returns an error string when the prompt's image layout can't be
+    served, None when fine. Checks: placeholder-run count/length matches
+    the attached images, and every image fits inside one prefill chunk
+    (bidirectional attention must see the whole image in a single
+    dispatch — the chunker aligns boundaries, it cannot split a span)."""
+    groups = int(spans.max()) if spans.size else 0
+    if groups != n_images:
+        return (f"prompt has {groups} image placeholder run(s) but "
+                f"{n_images} image(s) attached")
+    for g in range(1, groups + 1):
+        n = int((spans == g).sum())
+        if n != mm_tokens_per_image:
+            return (f"image {g} placeholder run is {n} tokens; the model "
+                    f"expects exactly {mm_tokens_per_image} "
+                    f"<image_soft_token>s per image")
+        if mm_tokens_per_image > prefill_chunk:
+            return (f"mm_tokens_per_image {mm_tokens_per_image} exceeds "
+                    f"prefill_chunk {prefill_chunk}: an image span cannot "
+                    f"fit one prefill dispatch")
+    return None
+
+
+def chunk_end(spans: np.ndarray, start: int, max_count: int) -> int:
+    """Largest count <= max_count such that [start, start+count) does not
+    split an image span: bidirectional attention needs every image wholly
+    inside one prefill dispatch. The boundary moves BACK to the span start
+    (validate_mm_prompt guarantees a span fits a full chunk, so count
+    stays > 0)."""
+    count = min(len(spans) - start, max_count)
+    end = start + count
+    if end < len(spans) and spans[end] != 0 and spans[end] == spans[end - 1]:
+        g = spans[end]
+        span_start = int(np.argmax(spans == g))
+        if span_start > start:
+            return span_start - start
+        # span starts at (or before) this chunk's start and doesn't fit
+        # max_count — validate_mm_prompt rejects this layout up front
+        raise ValueError("image span longer than the prefill chunk")
+    return count
+
+
+def soft_token_rows(spans: np.ndarray, soft: np.ndarray,
+                    start: int, count: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(vals [count, D], mask [count]) for prompt window [start,
+    start+count): each image position takes its row of that image's
+    projected soft tokens, in order (HF masked_scatter semantics —
+    flattened image features fill flattened placeholder positions).
+    ``soft``: [n_images, mm_tokens, D]."""
+    D = soft.shape[-1]
+    window = spans[start:start + count]
+    vals = np.zeros((count, D), soft.dtype)
+    mask = window > 0
+    for g in np.unique(window[mask]):
+        pos = np.nonzero(spans == g)[0]          # absolute positions
+        rows = soft[g - 1]                       # [mm_tokens, D]
+        sel = (pos >= start) & (pos < start + count)
+        vals[pos[sel] - start] = rows[np.nonzero(sel)[0]]
+    return vals, mask
+
+
+def normalize_image(pixels: np.ndarray, image_size: int) -> np.ndarray:
+    """uint8 HWC (or float CHW already normalized) -> float32 CHW in
+    [-1, 1], resized to (image_size, image_size). SigLIP preprocessing:
+    rescale 1/255 then normalize mean=std=0.5 (HF SiglipImageProcessor
+    defaults)."""
+    a = np.asarray(pixels)
+    # integer HWC (uint8, or int lists off the wire — BackendInput
+    # serializes pixels as nested lists, which round-trip as int64)
+    if a.ndim == 3 and a.shape[-1] in (1, 3) and a.dtype.kind in "iu":
+        from PIL import Image
+
+        a = np.clip(a, 0, 255).astype(np.uint8)
+        img = Image.fromarray(a if a.shape[-1] == 3
+                              else np.repeat(a, 3, axis=-1))
+        img = img.resize((image_size, image_size), Image.BILINEAR)
+        a = np.asarray(img, np.float32) / 255.0
+        a = (a - 0.5) / 0.5
+        return a.transpose(2, 0, 1)
+    a = a.astype(np.float32)
+    if a.ndim != 3 or a.shape[0] != 3:
+        raise ValueError(f"image must be uint8 HWC or float CHW, "
+                         f"got shape {a.shape}")
+    if a.shape[1] != image_size or a.shape[2] != image_size:
+        raise ValueError(f"float CHW image must already be "
+                         f"{image_size}x{image_size}, got {a.shape[1:]}")
+    return a

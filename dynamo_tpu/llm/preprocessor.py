@@ -10,6 +10,7 @@ prompt templating, stop-condition assembly, annotations).
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple, Union
 
@@ -44,6 +45,64 @@ def content_text(content: Any) -> str:
         return "".join(p.get("text", "") for p in content
                        if isinstance(p, dict))
     return "" if content is None else str(content)
+
+
+# VLM: image parts are replaced by a sentinel in the rendered prompt, then
+# spliced back as placeholder TOKEN ids after segmented tokenization (the
+# byte-level sentinel survives any template; token-level splicing is what
+# HF processors do too — boi + N soft tokens + eoi per image)
+_IMG_SENTINEL = "\x00<dynimg:{k}>\x00"
+_IMG_SPLIT = re.compile("\x00<dynimg:(\\d+)>\x00")
+
+
+def _decode_data_url(url: str):
+    """data:image/...;base64,... -> uint8 HWC numpy array."""
+    import base64
+    import io
+
+    import numpy as np
+
+    if not url.startswith("data:"):
+        raise ProtocolError(
+            "only data: image URLs are supported (no egress from the "
+            "serving host); send base64-embedded images")
+    try:
+        payload = url.split(",", 1)[1]
+        raw = base64.b64decode(payload)
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(raw)).convert("RGB")
+        return np.asarray(img, np.uint8)
+    except ProtocolError:
+        raise
+    except Exception as e:
+        raise ProtocolError(f"could not decode image: {e}") from e
+
+
+def extract_images(messages: List[Dict[str, Any]]
+                   ) -> Tuple[List[Any], List[Dict[str, Any]]]:
+    """Pull image_url parts out of OpenAI multipart messages; each becomes
+    a decoded pixel array plus an in-text sentinel marking its position."""
+    images: List[Any] = []
+    out = []
+    for m in messages:
+        c = m.get("content")
+        if isinstance(c, list) and any(
+                isinstance(p, dict) and p.get("type") == "image_url"
+                for p in c):
+            parts = []
+            for p in c:
+                if isinstance(p, dict) and p.get("type") == "image_url":
+                    url = (p.get("image_url") or {}).get("url", "")
+                    images.append(_decode_data_url(url))
+                    parts.append({"type": "text",
+                                  "text": _IMG_SENTINEL.format(
+                                      k=len(images) - 1)})
+                else:
+                    parts.append(p)
+            m = {**m, "content": parts}
+        out.append(m)
+    return images, out
 
 
 @dataclass
@@ -83,6 +142,10 @@ class Preprocessor:
 
     # ------------------------------------------------------------------
     def preprocess_chat(self, req: ChatCompletionRequest) -> PreprocessedRequest:
+        images: List[Any] = []
+        messages = req.messages
+        if not bool(req.ext.get("use_raw_prompt")):
+            images, messages = extract_images(messages)
         if bool(req.ext.get("use_raw_prompt")) and req.messages:
             # raw-prompt escape hatch: single user message passed through untemplated
             prompt = "".join(str(m.get("content", "")) for m in req.messages)
@@ -91,8 +154,11 @@ class Preprocessor:
             # stay out of the prompt too — otherwise the template invites
             # tool-call JSON that would stream back as plain content
             tools = None if req.tool_choice == "none" else req.tools
-            prompt = self.render_chat(req.messages, tools)
-        token_ids = self.tokenizer.encode(prompt)
+            prompt = self.render_chat(messages, tools)
+        if images:
+            token_ids = self._encode_with_images(prompt, len(images))
+        else:
+            token_ids = self.tokenizer.encode(prompt)
         bi = self._assemble(
             token_ids,
             model=req.model,
@@ -110,9 +176,40 @@ class Preprocessor:
             logprobs=(req.top_logprobs if req.top_logprobs is not None else 0)
             if req.logprobs else None,
         )
+        if images:
+            bi.images = images
         annotations = self._annotations(req.ext, prompt, token_ids)
         bi.annotations = annotations
         return PreprocessedRequest(bi, prompt, annotations)
+
+    def _encode_with_images(self, prompt: str, n_images: int) -> List[int]:
+        """Segmented tokenization around image sentinels: text segments
+        encode normally; each sentinel becomes [boi] + mm_tokens x
+        [image_token_id] + [eoi] from the card's model config."""
+        mc = self.card.model_config or {}
+        img_id = mc.get("image_token_id")
+        if img_id is None:
+            raise ProtocolError(
+                "this model takes no image input (no image_token_id in "
+                "its config)")
+        mm_tokens = int(mc.get("mm_tokens_per_image", 256))
+        boi, eoi = mc.get("boi_token_id"), mc.get("eoi_token_id")
+        ids: List[int] = []
+        pieces = _IMG_SPLIT.split(prompt)
+        # split() yields [text, idx, text, idx, ..., text]
+        for i, piece in enumerate(pieces):
+            if i % 2 == 0:
+                if piece:
+                    ids.extend(self.tokenizer.encode(piece))
+            else:
+                if int(piece) >= n_images:
+                    raise ProtocolError("image sentinel out of range")
+                if boi is not None:
+                    ids.append(int(boi))
+                ids.extend([int(img_id)] * mm_tokens)
+                if eoi is not None:
+                    ids.append(int(eoi))
+        return ids
 
     def preprocess_completion(self, req: CompletionRequest) -> PreprocessedRequest:
         prompt: Optional[str]

@@ -75,9 +75,21 @@ class BackendInput:
     # block-hash chain so adapter KV can never alias base/other-adapter KV
     # in prefix reuse or the router index (ref C ABI lib.rs:253-283).
     lora_id: int = 0
+    # VLM: normalized pixel arrays ([3, H, W] nested float lists — wire-
+    # serializable; the engine's vision tower encodes them at prefill).
+    # Image k fills the k-th ``image_token_id`` placeholder run.
+    images: Optional[List[Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return asdict(self)
+        if self.images is None:
+            return asdict(self)
+        import numpy as np
+        from dataclasses import replace
+
+        # exclude the pixel arrays from asdict's deep copy; convert once
+        d = asdict(replace(self, images=None))
+        d["images"] = [np.asarray(im).tolist() for im in self.images]
+        return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "BackendInput":
@@ -91,6 +103,7 @@ class BackendInput:
             mdc_sum=d.get("mdc_sum"),
             annotations=dict(d.get("annotations", {})),
             lora_id=int(d.get("lora_id", 0)),
+            images=d.get("images"),
         )
 
 

@@ -42,15 +42,20 @@ _GEMMA_ARCHS = ("GemmaForCausalLM", "Gemma2ForCausalLM",
                 "Gemma3ForCausalLM")
 
 
+_GEMMA_VLM_ARCH = "Gemma3ForConditionalGeneration"
+
+
 def _is_gemma(cfg: Dict[str, Any]) -> bool:
     archs = cfg.get("architectures", []) or []
-    # multimodal Gemma3 (vision tower) is not a text LM we can serve;
-    # refuse rather than serve wrong logits
+    # VLM Gemma3 configs are nested (text_config/vision_config) and handled
+    # by from_hf_config before this runs on the flat text config
     unsupported = [a for a in archs
-                   if "Gemma" in a and a not in _GEMMA_ARCHS]
+                   if "Gemma" in a and a not in _GEMMA_ARCHS
+                   and a != _GEMMA_VLM_ARCH]
     if unsupported:
         raise ValueError(f"unsupported architecture {unsupported[0]!r} "
-                         f"(text Gemma v1/v2/v3 are supported)")
+                         f"(text Gemma v1/v2/v3 and Gemma3 VLM are "
+                         f"supported)")
     return any(a in _GEMMA_ARCHS for a in archs)
 
 
@@ -119,6 +124,12 @@ class LlamaConfig:
     # MoE (0 experts = dense FFN). Experts shard over the ep mesh axis.
     num_experts: int = 0
     experts_per_token: int = 2
+    # Gemma3 VLM: a SigLIP vision tower rides alongside the text stack
+    # (HF vision_config dict; models/siglip.py builds from it). Image soft
+    # tokens replace ``image_token_id`` placeholder embeddings at prefill.
+    vision: Optional[Dict[str, Any]] = None
+    mm_tokens_per_image: int = 256
+    image_token_id: Optional[int] = None
 
     def layer_sliding(self, layer: int) -> bool:
         """Every ``sliding_pattern``-th layer is full attention, the rest
@@ -135,7 +146,27 @@ class LlamaConfig:
 
     @classmethod
     def from_hf_config(cls, cfg: Dict[str, Any], dtype=jnp.bfloat16) -> "LlamaConfig":
-        """Map a HF ``config.json`` (LlamaForCausalLM family) onto ours."""
+        """Map a HF ``config.json`` (LlamaForCausalLM family) onto ours.
+        Gemma3 VLM configs nest the text model under ``text_config``: the
+        text half maps recursively; the vision tower + mm wiring land on
+        the vision fields."""
+        if _GEMMA_VLM_ARCH in (cfg.get("architectures", []) or []):
+            if "text_config" not in cfg or "vision_config" not in cfg:
+                raise ValueError(
+                    f"{_GEMMA_VLM_ARCH} config must nest text_config and "
+                    f"vision_config; refusing to guess a flat layout")
+            text = dict(cfg["text_config"])
+            # the nested text config usually omits architectures — restore
+            # the family marker so the gemma3 mapping rules fire
+            text.setdefault("architectures", ["Gemma3ForCausalLM"])
+            base = cls.from_hf_config(text, dtype=dtype)
+            return cls(**{
+                **base.__dict__,
+                "vision": dict(cfg["vision_config"]),
+                "mm_tokens_per_image": int(cfg.get("mm_tokens_per_image",
+                                                   256)),
+                "image_token_id": int(cfg.get("image_token_id", 262144)),
+            })
         return cls(
             vocab_size=cfg["vocab_size"],
             hidden_size=cfg["hidden_size"],
@@ -309,6 +340,22 @@ PRESETS: Dict[str, Dict[str, Any]] = {
                        sliding_window=1024, sliding_pattern=6,
                        rope_local_theta=10000.0, qk_norm=True,
                        query_pre_attn_scalar=256.0),
+    # tiny Gemma3 VLM: text stack of tiny-gemma3 + a 2-layer SigLIP tower
+    # (56x56 images, 14px patches -> 16 patches -> 4 soft tokens/image)
+    "tiny-gemma3-vlm": dict(vocab_size=259, hidden_size=64, num_layers=6,
+                            num_heads=4, num_kv_heads=2, head_dim=16,
+                            intermediate_size=128, rope_theta=1000000.0,
+                            max_position=1024, tie_embeddings=True,
+                            hidden_act="gelu_tanh", norm_offset=True,
+                            embed_scale=True, rms_eps=1e-6,
+                            sandwich_norms=True, sliding_window=8,
+                            sliding_pattern=3, rope_local_theta=10000.0,
+                            qk_norm=True, query_pre_attn_scalar=24.0,
+                            mm_tokens_per_image=4, image_token_id=250,
+                            vision=dict(hidden_size=32, num_hidden_layers=2,
+                                        num_attention_heads=4,
+                                        intermediate_size=48, image_size=56,
+                                        patch_size=14)),
     "gemma-2b": dict(vocab_size=256000, hidden_size=2048, num_layers=18,
                      num_heads=8, num_kv_heads=1, head_dim=256,
                      intermediate_size=16384, rope_theta=10000.0,
@@ -674,6 +721,8 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
             attn_impl: str = "xla",      # "xla" | "flash" Pallas | "ring" sp
             mesh=None,                   # required for attn_impl="ring"
             logits_idx: Optional[jax.Array] = None,  # [B] per-lane position
+            embed_override: Optional[Tuple[jax.Array, jax.Array]] = None,
+            attn_spans: Optional[Tuple[jax.Array, jax.Array]] = None,
             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One forward pass over a token chunk against the paged KV pool.
 
@@ -690,11 +739,25 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
     ([B] int32), the LM head runs only on each lane's hidden state at that
     chunk position and logits are [B, 1, vocab] — the prefill fast path,
     which never materializes the [B, T, vocab] tensor.
+
+    Multimodal (Gemma3 VLM, xla attention only):
+
+    - ``embed_override`` = (vals [B,T,D], mask [B,T] bool) replaces the
+      masked positions' embeddings AFTER the embed scale — projected image
+      soft tokens are injected raw, exactly HF's masked_scatter
+      (modeling_gemma3.py:908-914).
+    - ``attn_spans`` = (q_span [B,T], read_span [B,S]) int32 image-group
+      ids (0 = text): tokens of the SAME image attend bidirectionally —
+      the or-mask applies to full and sliding layers alike
+      (modeling_gemma3.py:936-953).
     """
     B, T = tokens.shape
     page = k_pool.shape[3]
     lp = params["layers"]
     x = _embed(params, cfg, tokens)  # [B,T,D] bf16
+    if embed_override is not None:
+        ov_vals, ov_mask = embed_override
+        x = jnp.where(ov_mask[..., None], ov_vals.astype(x.dtype), x)
     cos, sin = rope_tables(cfg, positions)
     if cfg.rope_local_theta is not None:
         cos_l, sin_l = rope_tables(cfg, positions, local=True)
@@ -748,6 +811,19 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
             sliding_mask = mask & (
                 read_pos[:, None, :]
                 > positions[:, :, None] - cfg.sliding_window)
+        if attn_spans is not None:
+            # same-image bidirectional attention ORs into BOTH masks
+            q_span, read_span = attn_spans
+            bidir = ((q_span[:, :, None] > 0)
+                     & (q_span[:, :, None] == read_span[:, None, :])
+                     & read_valid[:, None, :])
+            mask = mask | bidir
+            if cfg.sliding_window is not None:
+                sliding_mask = sliding_mask | bidir
+    if attn_spans is not None and attn_impl != "xla":
+        raise ValueError(
+            "image-span bidirectional attention (Gemma3 VLM) runs on "
+            "attn_impl='xla' only; flash/ring kernels take no span inputs")
     _require_xla_attn(cfg, attn_impl)
 
     # NOTE: forward_pp.apply_stage mirrors this layer body for the

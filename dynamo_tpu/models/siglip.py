@@ -1,0 +1,264 @@
+"""SigLIP vision tower + Gemma3 multimodal projector (JAX/TPU-native).
+
+The vision half of Gemma3 VLM serving: images -> patch embeddings -> ViT
+encoder -> avg-pooled, RMS-normed, projected soft tokens the language model
+consumes in place of ``<image_soft_token>`` embeddings. Pure functions over
+a params pytree, bf16-friendly, everything jittable — the tower is one
+more XLA program on the serving device, not a separate runtime.
+
+Layout notes (TPU-first): the patch conv is expressed as an unfold+matmul
+(patches are non-overlapping, stride == kernel), which lowers onto the MXU
+as a single [N*P², 3*ps²] x [3*ps², D] matmul instead of a conv; attention
+is full bidirectional over P² patches (no masking, no KV cache — images
+are encoded once per request at prefill).
+
+Reference capability: the reference serves Gemma3 VLM through its engine
+zoo (support_matrix.md); HF parity target:
+transformers Gemma3 vision_tower (SiglipVisionModel) +
+Gemma3MultiModalProjector (modeling_gemma3.py:693-726).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class SiglipVisionConfig:
+    hidden_size: int = 1152          # SigLIP-400M defaults (Gemma3's tower)
+    num_layers: int = 27
+    num_heads: int = 16
+    intermediate_size: int = 4304
+    image_size: int = 896
+    patch_size: int = 14
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def patches_per_side(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def num_patches(self) -> int:
+        return self.patches_per_side ** 2
+
+    @classmethod
+    def from_hf_config(cls, cfg: Dict[str, Any],
+                       dtype=jnp.bfloat16) -> "SiglipVisionConfig":
+        return cls(
+            hidden_size=cfg["hidden_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=cfg["num_attention_heads"],
+            intermediate_size=cfg["intermediate_size"],
+            image_size=cfg["image_size"],
+            patch_size=cfg["patch_size"],
+            num_channels=cfg.get("num_channels", 3),
+            layer_norm_eps=cfg.get("layer_norm_eps", 1e-6),
+            dtype=dtype,
+        )
+
+
+def init_params(cfg: SiglipVisionConfig, key: jax.Array) -> Dict[str, Any]:
+    """Random-init tower params (tests / benching without checkpoints).
+    Patch embedding is stored PRE-UNFOLDED: [ps*ps*3, D] (HWIO flattened),
+    ready for the matmul formulation."""
+    D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    Dh = D // cfg.num_heads
+    ps, C = cfg.patch_size, cfg.num_channels
+    ks = jax.random.split(key, 12)
+    dt = cfg.dtype
+
+    def norm(k, *shape):
+        return (jax.random.normal(k, shape, jnp.float32)
+                / math.sqrt(shape[0])).astype(dt)
+
+    return {
+        "patch_w": norm(ks[0], ps * ps * C, D),
+        "patch_b": jnp.zeros((D,), dt),
+        "pos_embed": norm(ks[1], cfg.num_patches, D),
+        "layers": {
+            "ln1_w": jnp.ones((L, D), jnp.float32),
+            "ln1_b": jnp.zeros((L, D), jnp.float32),
+            "ln2_w": jnp.ones((L, D), jnp.float32),
+            "ln2_b": jnp.zeros((L, D), jnp.float32),
+            "wq": norm(ks[2], L, D, D), "bq": jnp.zeros((L, D), dt),
+            "wk": norm(ks[3], L, D, D), "bk": jnp.zeros((L, D), dt),
+            "wv": norm(ks[4], L, D, D), "bv": jnp.zeros((L, D), dt),
+            "wo": norm(ks[5], L, D, D), "bo": jnp.zeros((L, D), dt),
+            "fc1": norm(ks[6], L, D, F), "fb1": jnp.zeros((L, F), dt),
+            "fc2": norm(ks[7], L, F, D), "fb2": jnp.zeros((L, D), dt),
+        },
+        "post_ln_w": jnp.ones((D,), jnp.float32),
+        "post_ln_b": jnp.zeros((D,), jnp.float32),
+    }
+
+
+def _layer_norm(x: jax.Array, w: jax.Array, b: jax.Array,
+                eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def patchify(cfg: SiglipVisionConfig, pixels: jax.Array) -> jax.Array:
+    """[N, C, H, W] -> [N, P², ps*ps*C] non-overlapping patch unfold, rows
+    ordered row-major over the patch grid (matching Conv2d stride=kernel).
+    Inner layout per row is (ph, pw, C) — HWIO — so one matmul against the
+    pre-flattened conv kernel reproduces the convolution exactly."""
+    N, C, H, W = pixels.shape
+    ps = cfg.patch_size
+    gh, gw = H // ps, W // ps
+    x = pixels.reshape(N, C, gh, ps, gw, ps)
+    #            N  gh  gw  ps  ps  C   -> rows (gh*gw), inner (ps, ps, C)
+    x = x.transpose(0, 2, 4, 3, 5, 1)
+    return x.reshape(N, gh * gw, ps * ps * C)
+
+
+def forward(params: Dict[str, Any], cfg: SiglipVisionConfig,
+            pixels: jax.Array) -> jax.Array:
+    """Vision tower: [N, C, H, W] (normalized pixels) -> [N, P², D]."""
+    lp = params["layers"]
+    D = cfg.hidden_size
+    H = cfg.num_heads
+    Dh = D // H
+    x = patchify(cfg, pixels.astype(cfg.dtype)) @ params["patch_w"] \
+        + params["patch_b"]
+    x = x + params["pos_embed"][None]
+    N, P, _ = x.shape
+
+    scale = 1.0 / math.sqrt(Dh)
+    for l in range(cfg.num_layers):
+        h = _layer_norm(x, lp["ln1_w"][l], lp["ln1_b"][l],
+                        cfg.layer_norm_eps)
+        q = (h @ lp["wq"][l] + lp["bq"][l]).reshape(N, P, H, Dh)
+        k = (h @ lp["wk"][l] + lp["bk"][l]).reshape(N, P, H, Dh)
+        v = (h @ lp["wv"][l] + lp["bv"][l]).reshape(N, P, H, Dh)
+        s = jnp.einsum("nqhd,nkhd->nhqk", q, k).astype(jnp.float32) * scale
+        a = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        o = jnp.einsum("nhqk,nkhd->nqhd", a, v).reshape(N, P, D)
+        x = x + (o @ lp["wo"][l] + lp["bo"][l])
+        h2 = _layer_norm(x, lp["ln2_w"][l], lp["ln2_b"][l],
+                         cfg.layer_norm_eps)
+        f = jax.nn.gelu(h2 @ lp["fc1"][l] + lp["fb1"][l], approximate=True)
+        x = x + (f @ lp["fc2"][l] + lp["fb2"][l])
+    return _layer_norm(x, params["post_ln_w"], params["post_ln_b"],
+                       cfg.layer_norm_eps)
+
+
+# ---------------------------------------------------------------------------
+# Gemma3 multimodal projector
+# ---------------------------------------------------------------------------
+
+def init_projector_params(cfg: SiglipVisionConfig, text_hidden: int,
+                          key: jax.Array) -> Dict[str, Any]:
+    return {
+        # Gemma RMS convention: stored weight is the OFFSET from 1 (HF
+        # Gemma3RMSNorm initializes to zeros; effective scale is 1+w)
+        "norm": jnp.zeros((cfg.hidden_size,), jnp.float32),
+        "proj": (jax.random.normal(key, (cfg.hidden_size, text_hidden),
+                                   jnp.float32)
+                 / math.sqrt(cfg.hidden_size)).astype(cfg.dtype),
+    }
+
+
+def project(params: Dict[str, Any], cfg: SiglipVisionConfig,
+            vision_out: jax.Array, mm_tokens_per_image: int,
+            rms_eps: float = None) -> jax.Array:
+    """[N, P², Dv] -> [N, mm_tokens, Dtext]: avg-pool the patch grid down
+    to tokens_per_side², Gemma-RMSNorm with the stored weight as a +1
+    offset (HF Gemma3RMSNorm semantics), project. Mirrors
+    Gemma3MultiModalProjector (modeling_gemma3.py:693-726)."""
+    N, P2, Dv = vision_out.shape
+    pps = cfg.patches_per_side
+    tps = int(math.isqrt(mm_tokens_per_image))
+    assert tps * tps == mm_tokens_per_image, \
+        f"mm_tokens_per_image {mm_tokens_per_image} must be a square"
+    kern = pps // tps
+    x = vision_out.reshape(N, pps, pps, Dv)
+    x = x.reshape(N, tps, kern, tps, kern, Dv).mean(axis=(2, 4))  # avgpool
+    x = x.reshape(N, tps * tps, Dv)
+    # Gemma3RMSNorm: output = x * rsqrt(mean(x²)+eps) * (1 + weight)
+    xf = x.astype(jnp.float32)
+    nrm = xf * jax.lax.rsqrt(
+        jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        + (cfg.layer_norm_eps if rms_eps is None else rms_eps))
+    nrm = nrm * (1.0 + params["norm"])
+    return (nrm @ params["proj"].astype(jnp.float32)).astype(vision_out.dtype)
+
+
+# ---------------------------------------------------------------------------
+# HF weight loading (numpy dict of tensors, names as in Gemma3 checkpoints)
+# ---------------------------------------------------------------------------
+
+def params_from_hf(tensors: Dict[str, np.ndarray], cfg: SiglipVisionConfig,
+                   prefix: str = "vision_tower.vision_model."
+                   ) -> Dict[str, Any]:
+    """Map HF SiglipVisionModel tensors onto our pytree. ``tensors`` maps
+    full names -> numpy arrays (the loader's safetensors accessor)."""
+    D, L = cfg.hidden_size, cfg.num_layers
+    ps, C = cfg.patch_size, cfg.num_channels
+    dt = cfg.dtype
+
+    def g(name):
+        return np.asarray(tensors[prefix + name])
+
+    # Conv2d weight [D, C, ph, pw] -> unfold layout [(ph pw C), D]
+    conv = g("embeddings.patch_embedding.weight")
+    patch_w = conv.transpose(2, 3, 1, 0).reshape(ps * ps * C, D)
+
+    def lay(i, name):
+        return np.asarray(tensors[f"{prefix}encoder.layers.{i}.{name}"])
+
+    def stack(name, t=False):
+        ws = [lay(i, name) for i in range(L)]
+        return np.stack([w.T if t else w for w in ws])
+
+    return {
+        "patch_w": jnp.asarray(patch_w, dt),
+        "patch_b": jnp.asarray(g("embeddings.patch_embedding.bias"), dt),
+        "pos_embed": jnp.asarray(g("embeddings.position_embedding.weight"),
+                                 dt),
+        "layers": {
+            "ln1_w": jnp.asarray(stack("layer_norm1.weight"), jnp.float32),
+            "ln1_b": jnp.asarray(stack("layer_norm1.bias"), jnp.float32),
+            "ln2_w": jnp.asarray(stack("layer_norm2.weight"), jnp.float32),
+            "ln2_b": jnp.asarray(stack("layer_norm2.bias"), jnp.float32),
+            # HF Linear stores [out, in]; ours is [in, out]
+            "wq": jnp.asarray(stack("self_attn.q_proj.weight", t=True), dt),
+            "bq": jnp.asarray(stack("self_attn.q_proj.bias"), dt),
+            "wk": jnp.asarray(stack("self_attn.k_proj.weight", t=True), dt),
+            "bk": jnp.asarray(stack("self_attn.k_proj.bias"), dt),
+            "wv": jnp.asarray(stack("self_attn.v_proj.weight", t=True), dt),
+            "bv": jnp.asarray(stack("self_attn.v_proj.bias"), dt),
+            "wo": jnp.asarray(stack("self_attn.out_proj.weight", t=True), dt),
+            "bo": jnp.asarray(stack("self_attn.out_proj.bias"), dt),
+            "fc1": jnp.asarray(stack("mlp.fc1.weight", t=True), dt),
+            "fb1": jnp.asarray(stack("mlp.fc1.bias"), dt),
+            "fc2": jnp.asarray(stack("mlp.fc2.weight", t=True), dt),
+            "fb2": jnp.asarray(stack("mlp.fc2.bias"), dt),
+        },
+        "post_ln_w": jnp.asarray(g("post_layernorm.weight"), jnp.float32),
+        "post_ln_b": jnp.asarray(g("post_layernorm.bias"), jnp.float32),
+    }
+
+
+def projector_from_hf(tensors: Dict[str, np.ndarray],
+                      cfg: SiglipVisionConfig,
+                      prefix: str = "multi_modal_projector."
+                      ) -> Dict[str, Any]:
+    return {
+        "norm": jnp.asarray(
+            np.asarray(tensors[prefix + "mm_soft_emb_norm.weight"]),
+            jnp.float32),
+        "proj": jnp.asarray(
+            np.asarray(tensors[prefix + "mm_input_projection_weight"]),
+            cfg.dtype),
+    }
