@@ -117,6 +117,9 @@ def parse_args(argv=None):
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--router-component", default=None,
                    help="component name of a KV router to consult")
+    p.add_argument("--namespace", default=None,
+                   help="scope the /metrics stage scrape to one namespace "
+                        "(default: all namespaces in the store)")
     return p.parse_args(argv)
 
 
@@ -131,7 +134,13 @@ async def run_http(args, *, ready_event=None,
     manager = ModelManager()
     frontend = DiscoveryFrontend(drt, manager, args.router_component)
     await frontend.start()
-    svc = HttpService(manager, host=args.host, port=args.port)
+    # store-wired service: /v1/traces stitches spans published by workers,
+    # /metrics merges their per-stage histograms
+    from ..utils.tracing import configure as configure_tracing
+    configure_tracing(component="http")
+    svc = HttpService(manager, host=args.host, port=args.port,
+                      store=drt.store,
+                      namespace=getattr(args, "namespace", None))
     actual = await svc.start()
     print(f"dynamo_tpu http frontend on :{actual} (discovery mode)",
           flush=True)

@@ -26,8 +26,10 @@ from ..llm.model_card import ModelDeploymentCard
 from ..llm.protocols.common import BackendInput
 from ..runtime.component import DistributedRuntime
 from ..runtime.engine import Context
+from ..utils import tracing
 
 MAX_ATTEMPTS = 3
+PREFILL_COMPONENT = "prefill"   # stage-metrics component tag
 
 log = logging.getLogger("dynamo_tpu.prefill_worker")
 
@@ -61,6 +63,24 @@ async def run_prefill_worker(args, *,
     kv_client = await ns.component(args.decode_component) \
         .endpoint(KV_RECEIVE_ENDPOINT).client().start()
 
+    # tracing + stage metrics: spans flush to the store (the frontend's
+    # /v1/traces stitches them); histogram dumps refresh under our lease
+    tracing.configure(component="prefill_worker")
+    span_sink = await tracing.StoreSpanSink(drt.store).start()
+    from ..llm.metrics_aggregator import publish_stage_metrics
+
+    async def stage_metrics_loop():
+        while True:
+            try:
+                await publish_stage_metrics(
+                    drt.store, args.namespace, PREFILL_COMPONENT,
+                    drt.worker_id, drt.lease)
+            except Exception:
+                log.exception("stage metrics publish failed")
+            await asyncio.sleep(1.0)
+
+    stage_task = asyncio.create_task(stage_metrics_loop())
+
     log.info("prefill worker up, pulling %s", queue.queue)
     print(f"prefill worker pulling {queue.queue}", flush=True)
     if ready_event is not None:
@@ -74,10 +94,17 @@ async def run_prefill_worker(args, *,
                 log.info("dropping cancelled prefill job %s", job.request_id)
                 done += 1
                 continue
+            # all spans of this job parent under the decode worker's span
+            # (carried in job.trace); fallback: stitch by request id
+            job_parent = tracing.extract_wire(job.trace, job.request_id)
             try:
                 bi = BackendInput.from_dict(job.request)
                 ctx = Context(job.request_id)
-                k, v, tok, logp = await engine.prefill_extract(bi, ctx)
+                async with tracing.get_tracer().span(
+                        "prefill.compute", parent=job_parent,
+                        request_id=job.request_id,
+                        prompt_tokens=len(bi.token_ids)) as csp:
+                    k, v, tok, logp = await engine.prefill_extract(bi, ctx)
                 if await queue.consume_cancelled(job.request_id):
                     # submitter gave up mid-compute: skip the (large) push
                     await queue.ack(msg_id)
@@ -85,8 +112,10 @@ async def run_prefill_worker(args, *,
                              job.request_id)
                     done += 1
                     continue
-                await push_kv(kv_client, job.decode_worker_id,
-                              job.request_id, tok, logp, k, v)
+                with tracing.current_span_var_scope(
+                        csp.context() if csp is not None else job_parent):
+                    await push_kv(kv_client, job.decode_worker_id,
+                                  job.request_id, tok, logp, k, v)
                 await queue.ack(msg_id)
                 log.info("prefilled %s (%d tokens) -> worker %x",
                          job.request_id, len(bi.token_ids),
@@ -101,6 +130,9 @@ async def run_prefill_worker(args, *,
                 job.attempts += 1
                 await queue.ack(msg_id)
                 if job.attempts < MAX_ATTEMPTS:
+                    # restamp so queue-wait measures THIS attempt's wait,
+                    # not wait + failed compute + backoff since the first
+                    job.enqueued_at = 0.0
                     await queue.enqueue(job)
                 else:
                     try:
@@ -112,6 +144,11 @@ async def run_prefill_worker(args, *,
                 await asyncio.sleep(0.2)
             done += 1
     finally:
+        stage_task.cancel()
+        try:
+            await span_sink.stop()   # final flush: short-lived runs
+        except Exception:            # (max_jobs) must not lose spans
+            pass
         engine.shutdown()
         if own_drt:
             await drt.close()

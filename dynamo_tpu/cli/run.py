@@ -142,6 +142,9 @@ async def connect_remote_engines(args, card: ModelDeploymentCard):
 # ---------------------------------------------------------------------------
 
 async def run_http(args, card, chat_engine, completion_engine) -> None:
+    from ..utils.tracing import configure as configure_tracing
+
+    configure_tracing(component="http")
     manager = ModelManager()
     manager.add(ServedModel(card, chat_engine, completion_engine))
     svc = HttpService(manager, host=args.http_host, port=args.http_port)

@@ -1,0 +1,136 @@
+"""tracectl: fetch a request's span timeline from the HTTP frontend and
+pretty-print it as an ASCII waterfall (or save Chrome trace-event JSON).
+
+    python -m dynamo_tpu.cli.tracectl <request_id> \
+        [--url http://127.0.0.1:8080] [--chrome out.json] [--json]
+    python -m dynamo_tpu.cli.tracectl --list [--url ...]
+
+The request id is the ``x-request-id`` response header every frontend
+response carries. ``--chrome`` writes Perfetto-loadable trace-event JSON
+(open at https://ui.perfetto.dev or chrome://tracing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List
+
+from ..utils.dynconfig import EnvDefaultsParser
+
+BAR_WIDTH = 40
+
+
+def _fetch_json(url: str) -> Any:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _fmt_dur(seconds: float) -> str:
+    if seconds >= 1.0:
+        return f"{seconds:8.3f}s "
+    if seconds >= 1e-3:
+        return f"{seconds * 1e3:8.2f}ms"
+    return f"{seconds * 1e6:8.1f}us"
+
+
+def render_timeline(spans: List[Dict[str, Any]], width: int = BAR_WIDTH
+                    ) -> str:
+    """ASCII waterfall of one trace's spans (pure function; unit-tested).
+
+    Spans are drawn in start order, indented by parent depth, with a
+    proportional ``[###]`` bar positioned on the trace's wall-clock extent
+    and per-span component/duration/status columns."""
+    if not spans:
+        return "(no spans)"
+    spans = sorted(spans, key=lambda s: (s.get("start") or 0.0,
+                                         s.get("end") or 0.0))
+    t0 = min(s.get("start") or 0.0 for s in spans)
+    t1 = max(s.get("end") or 0.0 for s in spans)
+    total = max(t1 - t0, 1e-9)
+    by_id = {s.get("span_id"): s for s in spans}
+
+    def depth(s, guard=0) -> int:
+        p = s.get("parent_id")
+        if p is None or p not in by_id or guard > 16:
+            return 0
+        return 1 + depth(by_id[p], guard + 1)
+
+    name_w = max(len("  " * depth(s) + s.get("name", "?")) for s in spans)
+    name_w = min(max(name_w, 12), 48)
+    comp_w = max((len(f"{s.get('component', '?')}:{s.get('pid', 0)}")
+                  for s in spans), default=8)
+    lines = [f"trace {spans[0].get('trace_id', '?')} — {len(spans)} spans, "
+             f"{_fmt_dur(total).strip()} total"]
+    for s in spans:
+        start = (s.get("start") or 0.0) - t0
+        dur = max(0.0, (s.get("end") or 0.0) - (s.get("start") or 0.0))
+        lo = int(round(start / total * width))
+        hi = int(round((start + dur) / total * width))
+        hi = min(max(hi, lo + 1), width)
+        bar = " " * lo + "#" * (hi - lo) + " " * (width - hi)
+        label = ("  " * depth(s) + s.get("name", "?"))[:name_w]
+        comp = f"{s.get('component', '?')}:{s.get('pid', 0)}"
+        err = "  !ERROR" if s.get("status") not in (None, "ok") else ""
+        lines.append(f"{label:<{name_w}} |{bar}| {_fmt_dur(dur)} "
+                     f"{comp:<{comp_w}}{err}")
+    return "\n".join(lines)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = EnvDefaultsParser(prog="dynamo-tracectl")
+    p.add_argument("request_id", nargs="?", default=None,
+                   help="trace/request id (x-request-id response header)")
+    p.add_argument("--url", default="http://127.0.0.1:8080",
+                   help="frontend base URL")
+    p.add_argument("--list", action="store_true",
+                   help="list recent trace ids instead")
+    p.add_argument("--json", action="store_true",
+                   help="dump the raw span JSON instead of the waterfall")
+    p.add_argument("--chrome", default=None, metavar="FILE",
+                   help="write Chrome trace-event JSON to FILE")
+    return p.parse_args(argv)
+
+
+def run(args) -> int:
+    base = args.url.rstrip("/")
+    try:
+        if args.list:
+            data = _fetch_json(f"{base}/v1/traces")
+            for tid in data.get("traces", []):
+                print(tid)
+            return 0
+        if not args.request_id:
+            print("error: request_id required (or --list)", file=sys.stderr)
+            return 2
+        if args.chrome:
+            chrome = _fetch_json(
+                f"{base}/v1/traces/{args.request_id}?format=chrome")
+            with open(args.chrome, "w") as f:
+                json.dump(chrome, f)
+            print(f"wrote {len(chrome.get('traceEvents', []))} events to "
+                  f"{args.chrome} (load in https://ui.perfetto.dev)")
+            return 0
+        data = _fetch_json(f"{base}/v1/traces/{args.request_id}")
+        if args.json:
+            print(json.dumps(data, indent=2))
+        else:
+            print(render_timeline(data.get("spans", [])))
+        return 0
+    except urllib.error.HTTPError as e:
+        print(f"error: {e.code} {e.reason} for {e.url}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+def main() -> None:
+    raise SystemExit(run(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,7 @@ from ..llm.model_card import ModelDeploymentCard
 from ..llm.protocols.common import BackendInput
 from ..llm.remote import register_model, serve_core_engine
 from ..runtime.component import DistributedRuntime
+from ..utils import tracing
 
 log = logging.getLogger("dynamo_tpu.worker")
 
@@ -117,6 +118,12 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
         drt.store.on_lease_lost = _lease_lost
     ns = drt.namespace(args.namespace)
     component = ns.component(args.component)
+
+    # tracing: span context arrives over the wire (rpc spans) and via the
+    # prefill queue; finished spans flush to the store so the frontend's
+    # /v1/traces endpoint can stitch the cross-process timeline
+    tracing.configure(component="decode_worker")
+    span_sink = await tracing.StoreSpanSink(drt.store).start()
 
     # --- engine -------------------------------------------------------
     card = _build_card(args)
@@ -218,26 +225,37 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                 qsize = await queue.size()
                 remote = drouter.should_prefill_remote(
                     len(bi.token_ids), prefix_hit, qsize)
+            tracer = tracing.get_tracer()
             if remote:
                 # register interest BEFORE enqueueing: a fast prefill worker
                 # may push the KV back before we'd otherwise start listening
                 fut = receiver.expect(ctx.id)
-                await queue.enqueue(RemotePrefillRequest(
-                    ctx.id, drt.worker_id, request))
-                try:
-                    kv = await await_remote_kv(ctx, fut)
-                except RemotePrefillError as e:
-                    log.warning("remote prefill for %s dead-lettered (%s); "
-                                "prefilling locally", ctx.id, e)
-                    kv = None
+                async with tracer.span("prefill.remote_wait",
+                                       trace_id=ctx.id,
+                                       prompt_tokens=len(bi.token_ids),
+                                       prefix_hit_tokens=prefix_hit) as wsp:
+                    await queue.enqueue(RemotePrefillRequest(
+                        ctx.id, drt.worker_id, request))
+                    try:
+                        kv = await await_remote_kv(ctx, fut)
+                    except RemotePrefillError as e:
+                        log.warning("remote prefill for %s dead-lettered "
+                                    "(%s); prefilling locally", ctx.id, e)
+                        kv = None
+                    if wsp is not None:
+                        wsp.attrs["fallback_local"] = kv is None
                 if kv is not None:
                     k, v, tok, logp = kv
-                    async for out in engine.generate_prefilled(
-                            bi, ctx, k, v, tok, logp):
-                        yield out.to_dict()
+                    async with tracer.span("decode.stream",
+                                           trace_id=ctx.id, injected=True):
+                        async for out in engine.generate_prefilled(
+                                bi, ctx, k, v, tok, logp):
+                            yield out.to_dict()
                     return
-            async for out in engine.generate(bi, ctx):
-                yield out.to_dict()
+            async with tracer.span("decode.stream", trace_id=ctx.id,
+                                   injected=False):
+                async for out in engine.generate(bi, ctx):
+                    yield out.to_dict()
 
         await endpoint.serve(generate_handler)
     else:
@@ -249,6 +267,8 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                              model_type="completion", lease=drt.lease)
 
     # --- metrics loop -------------------------------------------------
+    from ..llm.metrics_aggregator import publish_stage_metrics
+
     async def metrics_loop():
         key = metrics_key(args.namespace, args.component, drt.worker_id)
         while True:
@@ -258,6 +278,12 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                 m = ForwardPassMetrics(request_total_slots=64)
             await drt.store.put(key, json.dumps(m.to_dict()).encode(),
                                 lease=drt.lease)
+            try:
+                await publish_stage_metrics(
+                    drt.store, args.namespace, args.component,
+                    drt.worker_id, drt.lease)
+            except Exception:
+                log.exception("stage metrics publish failed")
             await asyncio.sleep(args.metrics_interval)
 
     mtask = asyncio.create_task(metrics_loop())
@@ -276,6 +302,10 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
         # caller may repurpose after this worker exits (shared-drt case)
         drt.store.on_lease_lost = None
         mtask.cancel()
+        try:
+            await span_sink.stop()
+        except Exception:
+            pass
         await pub.stop()
         if own_drt:
             await drt.close()

@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import contextlib
 import logging
+import os
 import queue as thread_queue
 import threading
 import time
@@ -43,6 +45,16 @@ from .cache import OutOfPages, PagePool
 from .sampling import STATIC_K, SamplingState, apply_penalties, sample
 
 log = logging.getLogger("dynamo_tpu.engine")
+
+
+def _trace_annotation(name: str):
+    """Named ``jax.profiler`` scope around a device dispatch (no-op when the
+    profiler is unavailable) — lines the XLA timeline up with the host-side
+    request spans in captured profiles."""
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
 
 
 def global_put(host_array, sharding) -> jax.Array:
@@ -189,6 +201,9 @@ class EngineCore:
             # compose (round 5)
             raise ValueError("pp > 1 composes with tp/ep (sp must be 1)")
         self.mesh = serving_mesh(cfg.tp, cfg.sp, cfg.ep, cfg.pp, devices)
+        from ..utils.prometheus import stage_metrics
+
+        self.stage = stage_metrics()   # cached: observe() runs per harvest
         self.page_size = cfg.page_size
         # every sequence may overshoot up to 2*decode_steps speculative
         # tokens: one dispatch in flight plus one chained behind it
@@ -1060,18 +1075,19 @@ class EngineCore:
         s = self.sampling
         keys = s.key[jnp.asarray(idxs)]
         fn = self._prefill_fn(Bp, C, S, mm=mm_arrays is not None)
-        if mm_arrays is not None:
-            packed, _tok, new_keys, self.k_pool, self.v_pool = fn(
-                self.params, tokens, positions, self.k_pool, self.v_pool,
-                write_idx, read_idx, read_pos, read_valid, last_i,
-                temp, top_p, top_k, keys, mm_arrays["ov_vals"],
-                mm_arrays["ov_mask"], mm_arrays["q_span"],
-                mm_arrays["read_span"])
-        else:
-            packed, _tok, new_keys, self.k_pool, self.v_pool = fn(
-                self.params, tokens, positions, self.k_pool, self.v_pool,
-                write_idx, read_idx, read_pos, read_valid, last_i,
-                temp, top_p, top_k, keys)
+        with _trace_annotation(f"dynamo.prefill[B{Bp},C{C},S{S}]"):
+            if mm_arrays is not None:
+                packed, _tok, new_keys, self.k_pool, self.v_pool = fn(
+                    self.params, tokens, positions, self.k_pool, self.v_pool,
+                    write_idx, read_idx, read_pos, read_valid, last_i,
+                    temp, top_p, top_k, keys, mm_arrays["ov_vals"],
+                    mm_arrays["ov_mask"], mm_arrays["q_span"],
+                    mm_arrays["read_span"])
+            else:
+                packed, _tok, new_keys, self.k_pool, self.v_pool = fn(
+                    self.params, tokens, positions, self.k_pool, self.v_pool,
+                    write_idx, read_idx, read_pos, read_valid, last_i,
+                    temp, top_p, top_k, keys)
         # persist advanced PRNG keys only for lanes that really sampled
         if last_lanes:
             la = jnp.asarray([int(idxs[l]) for l in last_lanes])
@@ -1338,7 +1354,8 @@ class EngineCore:
         packed, final_tok = self._run_decode_program(
             S, tokens, page_tables, lengths, fresh, active_mask)
         self._inflight.append({"packed": packed, "final_tok": final_tok,
-                               "active": active})
+                               "active": active,
+                               "dispatched_at": time.perf_counter()})
 
     def _run_decode_program(self, S: int, tokens, page_tables, lengths,
                             fresh, active_mask):
@@ -1349,11 +1366,12 @@ class EngineCore:
             tokens = self._last_final_tok
         s = self.sampling
         fn = self._decode_fn(S)
-        (packed, final_tok, new_key, self.k_pool, self.v_pool,
-         self.gen_counts) = fn(
-            self.params, tokens, self.k_pool, self.v_pool,
-            page_tables, lengths, s.temperature, s.top_p, s.top_k, s.key,
-            self.gen_counts, fresh, active_mask, s.freq_pen, s.pres_pen)
+        with _trace_annotation(f"dynamo.decode[S{S}]"):
+            (packed, final_tok, new_key, self.k_pool, self.v_pool,
+             self.gen_counts) = fn(
+                self.params, tokens, self.k_pool, self.v_pool,
+                page_tables, lengths, s.temperature, s.top_p, s.top_k, s.key,
+                self.gen_counts, fresh, active_mask, s.freq_pen, s.pres_pen)
         s.key = new_key
         self._last_final_tok = final_tok
         return packed, final_tok
@@ -1396,6 +1414,12 @@ class EngineCore:
         rec = self._inflight.popleft()
         packed_np = np.asarray(rec["packed"])     # [N, B, 2] — ONE fetch
         N = packed_np.shape[0]
+        if N and "dispatched_at" in rec:
+            # effective per-token decode latency: dispatch -> results on
+            # host, amortized over the dispatch's N steps (pipelined
+            # dispatches overlap compute, which this deliberately reflects)
+            self.stage.decode_step.observe(
+                value=(time.perf_counter() - rec["dispatched_at"]) / N)
         outs: List[StepOutput] = []
         for i, slot, _ in rec["active"]:
             if self.slots[i] is not slot:
@@ -1511,6 +1535,22 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
 
     # ------------------------------------------------------------------
     def _run(self) -> None:
+        from ..utils.prometheus import stage_metrics
+
+        stage = stage_metrics()
+        # DYN_PROFILE_DIR: capture an XLA profile of the first
+        # DYN_PROFILE_STEPS (default 32) working engine iterations — the
+        # TraceAnnotation scopes around prefill/decode dispatches name the
+        # device timeline so it lines up with host-side request spans.
+        profile_dir = os.environ.get("DYN_PROFILE_DIR")
+        try:
+            profile_steps = int(os.environ.get("DYN_PROFILE_STEPS", "32"))
+        except ValueError:
+            # a typo'd env var must not kill the engine thread
+            log.warning("invalid DYN_PROFILE_STEPS=%r; using 32",
+                        os.environ.get("DYN_PROFILE_STEPS"))
+            profile_steps = 32
+        profiling = False
         while self._running:
             moved = False
             while True:
@@ -1543,6 +1583,15 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
+            if profile_dir and not profiling and profile_steps > 0:
+                try:
+                    jax.profiler.start_trace(profile_dir)
+                    profiling = True
+                    log.info("XLA profile capture started -> %s",
+                             profile_dir)
+                except Exception:
+                    log.exception("DYN_PROFILE_DIR capture failed to start")
+                    profile_dir = None
             try:
                 outs = self.core.step()
             except Exception as e:  # engine must never die silently
@@ -1553,6 +1602,19 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                 for sid in list(self.core.by_seq):
                     self.core.cancel(sid)
                 self.core._reap_cancelled()
+            stage.batch_occupancy.set(str(os.getpid()),
+                                      value=self.core.active)
+            if profiling:
+                profile_steps -= 1
+                if profile_steps <= 0:
+                    try:
+                        jax.profiler.stop_trace()
+                        log.info("XLA profile capture written to %s",
+                                 profile_dir)
+                    except Exception:
+                        log.exception("stopping XLA profile failed")
+                    profiling = False
+                    profile_dir = None
             for so in outs:
                 try:
                     self._deliver(so)
@@ -1562,6 +1624,14 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                 # waiting requests that can't be admitted yet: don't busy-spin
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()
+        if profiling:
+            # shutdown before DYN_PROFILE_STEPS working iterations: JAX only
+            # writes trace files on stop_trace, so finalize the short capture
+            try:
+                jax.profiler.stop_trace()
+                log.info("XLA profile capture written to %s", profile_dir)
+            except Exception:
+                log.exception("stopping XLA profile failed")
 
     def _deliver(self, so: StepOutput) -> None:
         loop = self._loop

@@ -21,8 +21,12 @@ from __future__ import annotations
 
 import json
 import logging
+import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
+
+from ..utils.prometheus import stage_metrics
+from ..utils.tracing import extract_wire, get_tracer, wire_context
 
 log = logging.getLogger("dynamo_tpu.disagg")
 
@@ -51,6 +55,11 @@ class RemotePrefillRequest:
     request: Dict[str, Any]
     prefix_hit_tokens: int = 0
     attempts: int = 0
+    # span context ([trace_id, parent_span_id]) + enqueue wall-clock: the
+    # prefill worker parents its spans under the decode worker's and turns
+    # the enqueue->dequeue gap into the queue-wait span/histogram
+    trace: Optional[List[Optional[str]]] = None
+    enqueued_at: float = 0.0
 
     def to_bytes(self) -> bytes:
         return json.dumps(self.__dict__).encode()
@@ -70,13 +79,30 @@ class PrefillQueue:
         self.queue = prefill_queue_name(namespace)
 
     async def enqueue(self, req: RemotePrefillRequest) -> int:
+        if req.trace is None:
+            req.trace = wire_context()
+        if not req.enqueued_at:
+            req.enqueued_at = time.time()
         return await self.store.q_push(self.queue, req.to_bytes())
 
     async def dequeue(self) -> tuple:
         """Blocks until work is available. Returns (msg_id, request);
         the caller MUST ack(msg_id) after the KV has been delivered."""
         msg_id, payload = await self.store.q_pull(self.queue)
-        return msg_id, RemotePrefillRequest.from_bytes(payload)
+        req = RemotePrefillRequest.from_bytes(payload)
+        if req.enqueued_at:
+            # queue wait, measured across processes on wall clocks (skew
+            # bounds accuracy; clamp so a skewed clock never goes negative)
+            now = time.time()
+            wait = max(0.0, now - req.enqueued_at)
+            stage_metrics().queue_wait.observe(value=wait)
+            get_tracer().record(
+                "prefill.queue_wait", start=min(req.enqueued_at, now),
+                end=now,
+                parent=extract_wire(req.trace,
+                                    default_trace_id=req.request_id),
+                request_id=req.request_id, attempts=req.attempts)
+        return msg_id, req
 
     async def ack(self, msg_id: int) -> None:
         await self.store.q_ack(self.queue, msg_id)

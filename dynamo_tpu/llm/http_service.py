@@ -1,9 +1,16 @@
 """OpenAI-compatible HTTP frontend (aiohttp).
 
 Routes: POST /v1/chat/completions, POST /v1/completions, GET /v1/models,
-GET /health, GET /metrics (Prometheus). SSE streaming with client-disconnect
-propagation into engine cancellation; a ModelManager maps model name → engines
-and supports live add/remove (used by etcd-style discovery later).
+GET /health, GET /metrics (Prometheus), GET /v1/traces[/{request_id}]
+(request span timelines; ``?format=chrome`` exports Perfetto-loadable
+trace-event JSON). SSE streaming with client-disconnect propagation into
+engine cancellation; a ModelManager maps model name → engines and supports
+live add/remove (used by etcd-style discovery later).
+
+Every request opens a root span whose trace id is the request id (echoed
+back as the ``x-request-id`` response header); per-stage latencies (TTFT,
+inter-token) land in the process StageMetrics and /metrics additionally
+merges the stage histograms workers publish to the store.
 
 Reference capability: lib/llm/src/http/service/{service_v2,openai,metrics,
 discovery}.rs — axum server, ModelManager, disconnect monitor, Prometheus.
@@ -13,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
@@ -20,7 +28,10 @@ from typing import Any, Dict, Optional
 from aiohttp import web
 
 from ..runtime.engine import AsyncEngine, Context, EngineError
-from ..utils.prometheus import Registry
+from ..utils import tracing
+from ..utils.prometheus import Registry, render_states, stage_metrics
+
+log = logging.getLogger("dynamo_tpu.http_service")
 from .model_card import ModelDeploymentCard
 from .protocols.openai import (
     ChatCompletionRequest,
@@ -61,10 +72,18 @@ class ModelManager:
 
 class HttpService:
     def __init__(self, manager: Optional[ModelManager] = None,
-                 host: str = "0.0.0.0", port: int = 8080):
+                 host: str = "0.0.0.0", port: int = 8080, store=None,
+                 namespace: Optional[str] = None):
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
+        # optional dynstore client: lets /v1/traces fetch spans published by
+        # worker processes and /metrics merge their stage histograms —
+        # scoped to ``namespace`` when set (a shared store may carry other
+        # deployments' dumps, which must not pollute this scrape)
+        self.store = store
+        self.namespace = namespace
+        self.stage = stage_metrics()
         self.registry = Registry()
         m = self.registry
         self.m_requests = m.counter(
@@ -89,6 +108,8 @@ class HttpService:
         app.router.add_post("/v1/chat/completions", self._chat)
         app.router.add_post("/v1/completions", self._completions)
         app.router.add_get("/v1/models", self._models)
+        app.router.add_get("/v1/traces", self._list_traces)
+        app.router.add_get("/v1/traces/{request_id}", self._get_trace)
         app.router.add_get("/health", self._health)
         app.router.add_get("/metrics", self._metrics)
         return app
@@ -120,8 +141,47 @@ class HttpService:
         )
 
     async def _metrics(self, _req: web.Request) -> web.Response:
-        return web.Response(text=self.registry.render(),
-                            content_type="text/plain")
+        text = self.registry.render()
+        # per-stage histograms: this process's, plus — in discovery mode —
+        # the dumps every worker publishes under metrics_stage/ (component-
+        # labelled, merged across replicas)
+        states = [("http", self.stage.registry.state_dump())]
+        if self.store is not None:
+            try:
+                from .metrics_aggregator import fetch_stage_states
+
+                states += await fetch_stage_states(self.store,
+                                                   self.namespace)
+            except Exception:
+                log.exception("stage metrics scrape failed")
+        text += render_states(states)
+        return web.Response(text=text, content_type="text/plain")
+
+    # ------------------------------------------------------------------
+    async def _list_traces(self, req: web.Request) -> web.Response:
+        try:
+            limit = int(req.query.get("limit", "50"))
+        except ValueError:
+            return _err(400, "limit must be an integer")
+        ids = tracing.get_tracer().recent_trace_ids(limit)
+        return web.json_response({"traces": ids})
+
+    async def _get_trace(self, req: web.Request) -> web.Response:
+        rid = req.match_info["request_id"]
+        local = tracing.get_tracer().spans_for(rid)
+        remote = []
+        if self.store is not None:
+            try:
+                remote = await tracing.fetch_trace_spans(self.store, rid)
+            except Exception:
+                log.exception("trace fetch from store failed")
+        spans = tracing.merge_spans(local, remote)
+        if not spans:
+            return _err(404, f"no trace recorded for request {rid!r}")
+        if req.query.get("format") == "chrome":
+            return web.json_response(tracing.to_chrome_trace(spans))
+        return web.json_response(
+            {"trace_id": rid, "spans": [s.to_dict() for s in spans]})
 
     async def _models(self, _req: web.Request) -> web.Response:
         now = int(time.time())
@@ -180,13 +240,32 @@ class HttpService:
         # remote workers via the wire context_id) carries ctx.id
         from ..utils.logging_ext import request_id_var
         request_id_var.set(ctx.id)
+        # root span: trace id IS the request id; every downstream span —
+        # local pipeline stages and remote workers via the wire trace
+        # field — stitches under it. GET /v1/traces/{ctx.id} replays it.
+        tracer = tracing.get_tracer()
+        root = tracer.start_span(f"http:{endpoint}", trace_id=ctx.id,
+                                 model=model_name)
+        root_token = tracing.current_span_var.set(root.context()) \
+            if root is not None else None
         self.m_inflight.inc(model_name)
         status = "200"
         try:
             if oai_req.stream:
-                return await self._stream(req, engine, oai_req, ctx,
-                                          model_name, endpoint, started)
+                try:
+                    resp = await self._stream(req, engine, oai_req, ctx,
+                                              model_name, endpoint, started)
+                except (ConnectionResetError, asyncio.CancelledError):
+                    status = "499"   # client closed mid-stream
+                    raise
+                # mid-stream failures can't change the committed 200, but
+                # the root span / request counter must reflect them; a
+                # pre-commit failure returns a plain 4xx/5xx response
+                status = getattr(resp, "_dyn_error_status",
+                                 str(resp.status))
+                return resp
             chunks = []
+            first = True
             try:
                 async for ch in engine.generate(oai_req, ctx):
                     if "event" in ch:
@@ -196,7 +275,11 @@ class HttpService:
                         # failures in-stream; here nothing is committed yet
                         # so it can still be a clean 4xx
                         status = "400"
-                        return _err(400, ch["error"]["message"])
+                        return _err(400, ch["error"]["message"], ctx.id)
+                    if first:
+                        self.stage.ttft.observe(
+                            model_name, value=time.monotonic() - started)
+                        first = False
                     chunks.append(ch)
                     u = ch.get("usage")
                     if u:
@@ -204,14 +287,18 @@ class HttpService:
                                           amount=u["completion_tokens"])
             except ProtocolError as e:
                 status = "400"
-                return _err(400, str(e))
+                return _err(400, str(e), ctx.id)
             except EngineError as e:
                 status = str(e.code)
-                return _err(e.code, str(e))
+                return _err(e.code, str(e), ctx.id)
             agg = (aggregate_chat_chunks(chunks) if endpoint == "chat"
                    else aggregate_completion_chunks(chunks))
-            return web.json_response(agg)
+            return web.json_response(agg,
+                                     headers={"x-request-id": ctx.id})
         finally:
+            if root_token is not None:
+                tracing.current_span_var.reset(root_token)
+            tracer.finish(root, status="ok" if status == "200" else "error")
             self.m_inflight.dec(model_name)
             self.m_requests.inc(model_name, endpoint, status)
             self.m_duration.observe(model_name, endpoint,
@@ -229,22 +316,28 @@ class HttpService:
         except StopAsyncIteration:
             first_item = None
         except ProtocolError as e:
-            return _err(400, str(e))
+            return _err(400, str(e), ctx.id)
         except EngineError as e:
-            return _err(e.code, str(e))
+            return _err(e.code, str(e), ctx.id)
         if isinstance(first_item, dict) and "error" in first_item:
             # a pipeline that reports failures in-stream (tool matcher) may
             # fail before any content chunk; nothing is committed yet so it
             # can still be a proper 4xx
-            return _err(400, first_item["error"]["message"])
+            return _err(400, first_item["error"]["message"], ctx.id)
 
         resp = web.StreamResponse(
             status=200,
             headers={"Content-Type": "text/event-stream",
-                     "Cache-Control": "no-cache"},
+                     "Cache-Control": "no-cache",
+                     "x-request-id": ctx.id},
         )
         await resp.prepare(req)
         first = True
+        last_chunk_at: Optional[float] = None
+        stage = self.stage
+        tracer = tracing.get_tracer()
+        sse_span = tracer.start_span("sse.egress", model=model)
+        chunks_out = 0
 
         async def chain():
             if first_item is not None:
@@ -259,37 +352,66 @@ class HttpService:
                                f"data: {json.dumps(ch['data'])}\n\n").encode()
                     await resp.write(payload)
                     continue
+                if "error" in ch:
+                    # in-band error after chunks were committed: the HTTP
+                    # status is already 200, but traces/metrics must not
+                    # call this request ok
+                    resp._dyn_error_status = "500"
+                    await resp.write(sse_encode(json.dumps(ch)))
+                    continue
+                now = time.monotonic()
                 if first:
-                    self.m_ttft.observe(model, value=time.monotonic() - started)
+                    ttft = now - started
+                    self.m_ttft.observe(model, value=ttft)
+                    stage.ttft.observe(model, value=ttft)
                     first = False
+                elif last_chunk_at is not None:
+                    stage.inter_token.observe(model,
+                                              value=now - last_chunk_at)
+                last_chunk_at = now
+                chunks_out += 1
                 u = ch.get("usage")
                 if u:
                     self.m_tokens.inc(model, amount=u["completion_tokens"])
                 await resp.write(sse_encode(json.dumps(ch)))
             await resp.write(sse_encode(SSE_DONE))
         except (ConnectionResetError, asyncio.CancelledError):
-            # client went away: propagate cancellation into the engine
+            # client went away: propagate cancellation into the engine.
+            # 499 (nginx's client-closed-request): aborted streams are the
+            # requests operators trace — they must not read as clean 200s
+            resp._dyn_error_status = "499"
             ctx.stop_generating()
             raise
         except ProtocolError as e:
+            resp._dyn_error_status = "400"
             await resp.write(sse_encode(json.dumps({"error": {
                 "message": str(e), "type": "invalid_request_error"}})))
             await resp.write(sse_encode(SSE_DONE))
         except EngineError as e:
+            resp._dyn_error_status = str(e.code)
             await resp.write(sse_encode(json.dumps({"error": {
                 "message": str(e), "type": "engine_error", "code": e.code}})))
             await resp.write(sse_encode(SSE_DONE))
         finally:
+            if sse_span is not None:
+                sse_span.attrs["chunks"] = chunks_out
+            tracer.finish(sse_span,
+                          status="ok" if getattr(resp, "_dyn_error_status",
+                                                 "200") == "200" else "error")
             ctx.stop_generating()
         await resp.write_eof()
         return resp
 
 
-def _err(code: int, message: str) -> web.Response:
+def _err(code: int, message: str,
+         request_id: Optional[str] = None) -> web.Response:
+    # error responses for requests that got far enough to have an id carry
+    # x-request-id too — failed requests are the ones operators trace
     return web.json_response(
         {"error": {"message": message,
                    "type": "invalid_request_error" if code == 400 else "not_found_error"
                    if code == 404 else "internal_error",
                    "code": code}},
         status=code,
+        headers={"x-request-id": request_id} if request_id else None,
     )

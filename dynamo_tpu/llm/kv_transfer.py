@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import AsyncIterator, Dict, Optional, Tuple
 
 import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
@@ -25,6 +26,8 @@ import numpy as np
 
 from ..runtime.component import Client, StreamingRequest
 from ..runtime.engine import Context
+from ..utils.prometheus import stage_metrics
+from ..utils.tracing import extract_wire, get_tracer, wire_context
 
 log = logging.getLogger("dynamo_tpu.kv_transfer")
 
@@ -41,6 +44,9 @@ def _meta(request_id: str, first_token: int, first_logprob: float,
         "layers": int(L), "tokens": int(T),
         "kv_heads": int(H), "head_dim": int(D),
         "dtype": str(k.dtype),
+        # span context rides the meta header (not just the wire control) so
+        # the receive side stitches even on planes that drop control fields
+        "trace": wire_context(),
     }
 
 
@@ -51,17 +57,28 @@ async def push_kv(client: Client, decode_worker_id: int, request_id: str,
     """Stream a sequence's prompt KV ([L,T,Hkv,Dh] each) to the decode
     worker that owns ``request_id``. Returns the receiver's ack."""
     meta = _meta(request_id, first_token, first_logprob, k)
+    nbytes = k.nbytes + v.nbytes
 
     async def parts() -> AsyncIterator[bytes]:
         for layer in range(k.shape[0]):
             yield k[layer].tobytes()
             yield v[layer].tobytes()
 
+    stage = stage_metrics()
     ack = None
-    async for resp in client.generate(meta, context, mode="direct",
-                                      instance_id=decode_worker_id,
-                                      parts=parts()):
-        ack = resp
+    async with get_tracer().span("kv.push", trace_id=request_id,
+                                 bytes=nbytes, tokens=meta["tokens"],
+                                 layers=meta["layers"]):
+        # restamp inside the scope so the receiver's kv.receive span
+        # parents under kv.push, not under this function's caller
+        meta["trace"] = wire_context()
+        t0 = time.monotonic()
+        async for resp in client.generate(meta, context, mode="direct",
+                                          instance_id=decode_worker_id,
+                                          parts=parts()):
+            ack = resp
+        stage.kv_transfer.observe("send", value=time.monotonic() - t0)
+        stage.kv_transfer_bytes.inc("send", amount=nbytes)
     return ack or {}
 
 
@@ -121,15 +138,32 @@ class KvReceiver:
         k = np.empty((L, T, H, D), dtype)
         v = np.empty((L, T, H, D), dtype)
         i = 0
-        async for part in request.parts:
-            layer, is_v = divmod(i, 2)
-            if layer >= L:
-                raise ValueError(f"kv stream for {rid}: too many parts")
-            arr = np.frombuffer(part, dtype).reshape(T, H, D)
-            (v if is_v else k)[layer] = arr
-            i += 1
-        if i != 2 * L:
-            raise ValueError(f"kv stream for {rid}: got {i}/{2 * L} parts")
+        nbytes = 0
+        t0 = time.monotonic()
+        recv_span = get_tracer().start_span(
+            "kv.receive", parent=extract_wire(meta.get("trace"), rid),
+            request_id=rid, tokens=T, layers=L)
+        try:
+            async for part in request.parts:
+                layer, is_v = divmod(i, 2)
+                if layer >= L:
+                    raise ValueError(f"kv stream for {rid}: too many parts")
+                arr = np.frombuffer(part, dtype).reshape(T, H, D)
+                (v if is_v else k)[layer] = arr
+                i += 1
+                nbytes += len(part)
+            if i != 2 * L:
+                raise ValueError(
+                    f"kv stream for {rid}: got {i}/{2 * L} parts")
+        except BaseException:
+            get_tracer().finish(recv_span, status="error")
+            raise
+        if recv_span is not None:
+            recv_span.attrs["bytes"] = nbytes
+        get_tracer().finish(recv_span)
+        stage = stage_metrics()
+        stage.kv_transfer.observe("recv", value=time.monotonic() - t0)
+        stage.kv_transfer_bytes.inc("recv", amount=nbytes)
         fut = self._pending.pop(rid, None)
         if fut is None or fut.done():
             log.warning("unexpected KV for request %s (client gone?)", rid)

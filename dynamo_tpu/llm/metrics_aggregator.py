@@ -25,18 +25,56 @@ import math
 from typing import Dict, List, Optional, Sequence
 
 from ..runtime.component import DistributedRuntime
-from ..utils.prometheus import Registry
+from ..utils.prometheus import Registry, render_states
 from .kv_router.protocols import ForwardPassMetrics, KVHitRateEvent
 
 log = logging.getLogger("dynamo_tpu.metrics")
 
 METRICS_PREFIX = "metrics/"
+STAGE_PREFIX = "metrics_stage/"
 
 
 def metrics_key(namespace: str, component: str, worker_id: int) -> str:
     """Store key a worker refreshes its ForwardPassMetrics under (lease-
     bound, so dead workers' snapshots vanish with their lease)."""
     return f"{METRICS_PREFIX}{namespace}/{component}/{worker_id:x}"
+
+
+def stage_key(namespace: str, component: str, worker_id: int) -> str:
+    """Store key a worker refreshes its per-stage latency histogram dump
+    under (utils.prometheus.StageMetrics state; lease-bound like above)."""
+    return f"{STAGE_PREFIX}{namespace}/{component}/{worker_id:x}"
+
+
+async def publish_stage_metrics(store, namespace: str, component: str,
+                                worker_id: int, lease: int) -> None:
+    """One refresh of this process's stage-histogram dump (workers call
+    this from their metrics loop)."""
+    from ..utils.prometheus import stage_metrics
+
+    payload = json.dumps({
+        "component": component,
+        "metrics": stage_metrics().registry.state_dump(),
+    }).encode()
+    await store.put(stage_key(namespace, component, worker_id), payload,
+                    lease=lease)
+
+
+async def fetch_stage_states(store, namespace: Optional[str] = None
+                             ) -> List[tuple]:
+    """All published stage dumps as ``(component, state_dump)`` pairs, ready
+    for :func:`dynamo_tpu.utils.prometheus.render_states`."""
+    prefix = STAGE_PREFIX + (f"{namespace}/" if namespace else "")
+    states: List[tuple] = []
+    for key, value in await store.get_prefix(prefix):
+        try:
+            d = json.loads(value.decode())
+            states.append((d.get("component")
+                           or key[len(STAGE_PREFIX):].split("/")[1],
+                           d["metrics"]))
+        except Exception:
+            log.warning("malformed stage metrics at %s", key)
+    return states
 
 
 class ClusterMetricsAggregator:
@@ -80,6 +118,9 @@ class ClusterMetricsAggregator:
         self._overlap_blocks = 0
         # last scrape snapshot, for tests/introspection
         self.workers: Dict[str, Dict[int, ForwardPassMetrics]] = {}
+        # last stage-histogram scrape: (component, state_dump) pairs folded
+        # into render() via render_states
+        self.stage_states: List[tuple] = []
 
     # ------------------------------------------------------------------
     async def start(self) -> "ClusterMetricsAggregator":
@@ -116,6 +157,8 @@ class ClusterMetricsAggregator:
                     log.warning("malformed metrics at %s", key)
             self.workers[comp] = workers
             self._export(comp, workers)
+        self.stage_states = await fetch_stage_states(self.drt.store,
+                                                     self.namespace)
 
     def _export(self, comp: str,
                 workers: Dict[int, ForwardPassMetrics]) -> None:
@@ -153,4 +196,4 @@ class ClusterMetricsAggregator:
 
     # ------------------------------------------------------------------
     def render(self) -> str:
-        return self.registry.render()
+        return self.registry.render() + render_states(self.stage_states)

@@ -12,6 +12,7 @@ import contextlib
 from typing import Any, AsyncIterator, Dict, List, Optional
 
 from ..runtime.engine import AsyncEngine, Context
+from ..utils.tracing import get_tracer
 from .backend import Backend
 from .engines import EchoFullEngine
 from .model_card import ModelDeploymentCard
@@ -41,7 +42,10 @@ class OpenAIChatEngine(AsyncEngine[ChatCompletionRequest, Dict[str, Any]]):
                        context: Context) -> AsyncIterator[Dict[str, Any]]:
         from .tools import ToolCallingMatcher, normalize_tool_choice
 
-        pre = self.preprocessor.preprocess_chat(request)
+        with get_tracer().span("preprocess", trace_id=context.id) as psp:
+            pre = self.preprocessor.preprocess_chat(request)
+            if psp is not None:
+                psp.attrs["prompt_tokens"] = len(pre.backend_input.token_ids)
         gen = ChatDeltaGenerator(request.model, request_id=f"chatcmpl-{context.id[:24]}")
         prompt_tokens = len(pre.backend_input.token_ids)
         completion_tokens = 0
@@ -132,7 +136,10 @@ class OpenAICompletionEngine(AsyncEngine[CompletionRequest, Dict[str, Any]]):
 
     async def generate(self, request: CompletionRequest,
                        context: Context) -> AsyncIterator[Dict[str, Any]]:
-        pre = self.preprocessor.preprocess_completion(request)
+        with get_tracer().span("preprocess", trace_id=context.id) as psp:
+            pre = self.preprocessor.preprocess_completion(request)
+            if psp is not None:
+                psp.attrs["prompt_tokens"] = len(pre.backend_input.token_ids)
         gen = CompletionDeltaGenerator(request.model, request_id=f"cmpl-{context.id[:24]}")
         prompt_tokens = len(pre.backend_input.token_ids)
         completion_tokens = 0

@@ -31,19 +31,21 @@ from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
 
 from .engine import AsyncEngine, Context, EngineError
 from .store_client import StoreClient
-from .wire import FrameReader, write_frame
+from .wire import FrameReader, attach_trace, extract_trace, write_frame
 
 log = logging.getLogger("dynamo_tpu.runtime")
 
 Handler = Callable[[Any, Context], AsyncIterator[Any]]
 
 
-async def drive_handler_stream(stream, send) -> None:
+async def drive_handler_stream(stream, send) -> bool:
     """Drive a handler's response stream through ``await send(control,
     payload)`` — the ONE implementation of the response wire protocol
     (error-before-stream prologue, data / bin frames, sentinel, mid-stream
     error frames) shared by the asyncio and native data planes. Connection
-    errors raised by ``send`` propagate to the caller."""
+    errors raised by ``send`` propagate to the caller. Returns True on a
+    clean full stream, False when a handler error became an error frame
+    (the servers mark the request's rpc span accordingly)."""
     try:
         first = await stream.__anext__()
         have_first = True
@@ -51,10 +53,10 @@ async def drive_handler_stream(stream, send) -> None:
         have_first = False
     except EngineError as e:
         await send({"kind": "error", "message": str(e), "code": e.code}, None)
-        return
+        return False
     except Exception as e:  # noqa: BLE001
         await send({"kind": "error", "message": str(e), "code": 500}, None)
-        return
+        return False
     await send({"kind": "prologue"}, None)
 
     def enc(item):
@@ -76,6 +78,8 @@ async def drive_handler_stream(stream, send) -> None:
                        None)
         except Exception:
             pass
+        return False
+    return True
 
 
 @dataclass
@@ -273,7 +277,17 @@ class DistributedRuntime:
         ctx = Context(ctx_id)
         self._active[ctx.id] = ctx
         from ..utils.logging_ext import request_id_var
+        from ..utils.tracing import current_span_var, get_tracer
         rid_token = request_id_var.set(ctx.id)  # span: this request's id
+        # server span: covers the whole handler stream; parented from the
+        # wire trace field when present, else a fresh parentless span on
+        # trace_id == context id (requests keep their id across hops)
+        tracer = get_tracer()
+        srv_span = tracer.start_span(
+            f"rpc:{ep}", parent=extract_trace(control, ctx.id),
+            context_id=ctx.id)
+        span_token = current_span_var.set(srv_span.context()) \
+            if srv_span is not None else None
         leftover: List[Any] = []
 
         async def watch_control():
@@ -317,11 +331,13 @@ class DistributedRuntime:
             request = StreamingRequest(meta=request, parts=parts_gen())
         else:
             watcher = asyncio.create_task(watch_control())
+        srv_status = "error"
         try:
             async def send(control, payload):
                 await write_frame(writer, [control, payload])
 
-            await drive_handler_stream(handler(request, ctx), send)
+            if await drive_handler_stream(handler(request, ctx), send):
+                srv_status = "ok"
         except (ConnectionResetError, BrokenPipeError):
             ctx.stop_generating()
         finally:
@@ -338,6 +354,9 @@ class DistributedRuntime:
                 except Exception:
                     pass
             self._active.pop(ctx.id, None)
+            if span_token is not None:
+                current_span_var.reset(span_token)
+            tracer.finish(srv_span, status=srv_status)
             # reset: a reused (pipelined) connection must not tag later
             # frames/log lines with a finished request's id
             request_id_var.reset(rid_token)
@@ -547,6 +566,21 @@ class Client:
             req_payload = json.dumps(request).encode()
         if parts is not None:
             base_control["streaming"] = True
+        # client span around the whole exchange; its context rides the wire
+        # so the server's rpc span parents under it. No ambient span (bare
+        # client) => the request id becomes the trace id, matching the
+        # server-side fallback.
+        from ..utils.tracing import current_span_var, get_tracer
+        tracer = get_tracer()
+        amb = current_span_var.get()
+        call_span = tracer.start_span(
+            f"call:{self.endpoint.name}",
+            trace_id=None if amb is not None else ctx.id,
+            context_id=ctx.id)
+        if call_span is not None:
+            base_control["trace"] = call_span.context().to_wire()
+        else:
+            attach_trace(base_control)
 
         # a stop/kill issued while we wait for the first frame (mid-prefill)
         # must reach the server immediately: the stopper lives for the whole
@@ -685,6 +719,7 @@ class Client:
                 break
         except BaseException:
             stopper.cancel()
+            tracer.finish(call_span, status="error")
             raise
 
         clean = False
@@ -719,6 +754,7 @@ class Client:
                 except Exception:
                     pass
         finally:
+            tracer.finish(call_span, status="ok" if clean else "error")
             if clean:
                 # full exchange completed: the connection sits at a frame
                 # boundary and is safe to reuse for the next request

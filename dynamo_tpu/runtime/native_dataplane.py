@@ -195,7 +195,18 @@ class NativeDataPlane:
             return
         drt._active[ctx.id] = ctx
         from ..utils.logging_ext import request_id_var
+        from ..utils.tracing import (SpanContext, current_span_var,
+                                     get_tracer)
         rid_token = request_id_var.set(ctx.id)
+        # the C parser drops the control's trace field, so the server span
+        # stitches by trace_id == context id (parent linkage is lost on
+        # this plane; see docs/observability.md)
+        tracer = get_tracer()
+        srv_span = tracer.start_span(f"rpc:{endpoint}",
+                                     parent=SpanContext(ctx.id, None),
+                                     context_id=ctx.id)
+        span_token = current_span_var.set(srv_span.context()) \
+            if srv_span is not None else None
 
         if streaming:
             from .component import StreamingRequest
@@ -211,6 +222,7 @@ class NativeDataPlane:
 
             request = StreamingRequest(meta=request, parts=parts_gen())
 
+        srv_status = "error"
         try:
             from .component import drive_handler_stream
 
@@ -227,7 +239,8 @@ class NativeDataPlane:
                             "stream cancelled while backpressured")
                     await asyncio.sleep(0.005)
 
-            await drive_handler_stream(handler(request, ctx), send)
+            if await drive_handler_stream(handler(request, ctx), send):
+                srv_status = "ok"
         except Exception as e:  # noqa: BLE001 - transport-level failure
             try:
                 self._send(sid, {"kind": "error", "message": str(e),
@@ -238,5 +251,8 @@ class NativeDataPlane:
             drt._active.pop(ctx.id, None)
             self._contexts.pop(sid, None)
             self._part_queues.pop(sid, None)
+            if span_token is not None:
+                current_span_var.reset(span_token)
+            tracer.finish(srv_span, status=srv_status)
             request_id_var.reset(rid_token)
             self._end(sid)

@@ -21,6 +21,30 @@ import msgpack
 
 MAX_FRAME = 256 * 1024 * 1024  # 256 MB: KV block transfers ride this plane
 
+# Optional span-context field on request control headers: [trace_id,
+# parent_span_id]. Rides next to ``context_id`` so one request's spans
+# stitch across processes (utils/tracing.py). Planes that drop unknown
+# control fields (the native C parser) degrade to trace_id == context_id.
+TRACE_KEY = "trace"
+
+
+def attach_trace(control: dict) -> dict:
+    """Stamp the ambient span context onto a request control header."""
+    from ..utils.tracing import wire_context
+
+    tw = wire_context()
+    if tw is not None:
+        control[TRACE_KEY] = tw
+    return control
+
+
+def extract_trace(control: dict, default_trace_id=None):
+    """SpanContext from a control header (see utils.tracing.extract_wire)."""
+    from ..utils.tracing import extract_wire
+
+    return extract_wire(control.get(TRACE_KEY),
+                        default_trace_id=default_trace_id)
+
 
 def pack(obj: Any) -> bytes:
     body = msgpack.packb(obj, use_bin_type=True)

@@ -8,10 +8,23 @@ components/metrics prometheus export.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 _DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0
+)
+
+# Per-metric bucket presets: the shared default starts at 5 ms, which
+# collapses ms-scale signals (inter-token latency, decode step) into the
+# first bucket. FAST resolves 200 µs – 1 s; WIDE resolves 10 ms – 2 min
+# (TTFT, queue wait, KV transfer over DCN).
+LATENCY_BUCKETS_FAST = (
+    0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0,
+)
+LATENCY_BUCKETS_WIDE = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+    120.0,
 )
 
 
@@ -47,7 +60,9 @@ class Counter(_Metric):
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def get(self, *label_values: str) -> float:
-        return self._values.get(tuple(str(v) for v in label_values), 0.0)
+        key = tuple(str(v) for v in label_values)
+        with self._lock:   # a torn read would race concurrent inc()
+            return self._values.get(key, 0.0)
 
     def clear_label(self, pos: int, value: str) -> None:
         """Drop every series whose label at ``pos`` equals ``value`` (e.g.
@@ -59,10 +74,19 @@ class Counter(_Metric):
                 del self._values[key]
 
     def render(self) -> List[str]:
+        with self._lock:   # snapshot: render must not race inc/set
+            items = sorted(self._values.items())
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
-        for key, v in sorted(self._values.items()):
+        for key, v in items:
             out.append(f"{self.name}{_fmt_labels(self.labels, key)} {v}")
         return out
+
+    def state(self) -> Dict[str, Any]:
+        """JSON-serializable snapshot (cross-process metric aggregation)."""
+        with self._lock:
+            series = {"\x1f".join(k): v for k, v in self._values.items()}
+        return {"kind": self.kind, "help": self.help,
+                "labels": list(self.labels), "series": series}
 
 
 class Gauge(Counter):
@@ -91,25 +115,49 @@ class Histogram(_Metric):
         key = tuple(str(v) for v in label_values)
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            # per-bucket (non-cumulative) storage: render() cumulates.
+            # (Incrementing every bucket >= value here double-counted once
+            # render summed again — le= lines used to overshoot.)
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     counts[i] += 1
+                    break
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
 
+    def get_count(self, *label_values: str) -> int:
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            return self._totals.get(key, 0)
+
     def render(self) -> List[str]:
+        with self._lock:   # snapshot: render must not race observe()
+            items = sorted((k, list(c)) for k, c in self._counts.items())
+            sums = dict(self._sums)
+            totals = dict(self._totals)
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
-        for key, counts in sorted(self._counts.items()):
+        for key, counts in items:
             cum = 0
             for b, c in zip(self.buckets, counts):
                 cum += c
                 lbls = _fmt_labels(self.labels + ("le",), key + (repr(b).rstrip("0").rstrip("."),))
                 out.append(f"{self.name}_bucket{lbls} {cum}")
             lbls_inf = _fmt_labels(self.labels + ("le",), key + ("+Inf",))
-            out.append(f"{self.name}_bucket{lbls_inf} {self._totals[key]}")
-            out.append(f"{self.name}_sum{_fmt_labels(self.labels, key)} {self._sums[key]}")
-            out.append(f"{self.name}_count{_fmt_labels(self.labels, key)} {self._totals[key]}")
+            out.append(f"{self.name}_bucket{lbls_inf} {totals[key]}")
+            out.append(f"{self.name}_sum{_fmt_labels(self.labels, key)} {sums[key]}")
+            out.append(f"{self.name}_count{_fmt_labels(self.labels, key)} {totals[key]}")
         return out
+
+    def state(self) -> Dict[str, Any]:
+        with self._lock:
+            series = {
+                "\x1f".join(k): {"counts": list(c),
+                                 "sum": self._sums.get(k, 0.0),
+                                 "total": self._totals.get(k, 0)}
+                for k, c in self._counts.items()}
+        return {"kind": self.kind, "help": self.help,
+                "labels": list(self.labels), "buckets": list(self.buckets),
+                "series": series}
 
 
 class Registry:
@@ -136,3 +184,133 @@ class Registry:
         for m in self._metrics:
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
+
+    def state_dump(self) -> Dict[str, Dict]:
+        """Snapshot every metric's state — the unit workers publish to the
+        store so a cluster scraper can merge histograms across processes."""
+        return {m.name: m.state() for m in self._metrics}
+
+
+# ---------------------------------------------------------------------------
+# cross-process merge + render of state dumps
+# ---------------------------------------------------------------------------
+def render_states(states: Iterable[Tuple[str, Dict[str, Dict]]]) -> str:
+    """Render ``(component, registry.state_dump())`` pairs as one exposition
+    block, each series tagged with a leading ``component`` label. Series from
+    multiple processes of the SAME component merge: counters/histogram counts
+    sum, gauges last-write-wins (per-worker gauges should carry a worker
+    label instead of relying on this)."""
+    # metric name -> (kind, help, labels, buckets, {(component,)+key -> val})
+    merged: Dict[str, Dict[str, Any]] = {}
+    for component, dump in states:
+        for name, st in dump.items():
+            m = merged.setdefault(name, {
+                "kind": st["kind"], "help": st.get("help", ""),
+                "labels": list(st.get("labels", ())),
+                "buckets": st.get("buckets"), "series": {}})
+            if m["kind"] != st["kind"] or m["labels"] != list(
+                    st.get("labels", ())):
+                continue    # incompatible foreign dump: skip, don't corrupt
+            if (st["kind"] == "histogram"
+                    and list(st.get("buckets") or ()) != list(
+                        m["buckets"] or ())):
+                continue    # different bucket layout (mixed-version
+                            # rollout): summing or relabelling would lie
+            for skey, val in st.get("series", {}).items():
+                key = (component,) + tuple(skey.split("\x1f")) \
+                    if skey else (component,)
+                cur = m["series"].get(key)
+                if st["kind"] == "histogram":
+                    if (cur is not None and m["buckets"] is not None
+                            and len(cur["counts"]) == len(val["counts"])):
+                        cur["counts"] = [a + b for a, b in
+                                         zip(cur["counts"], val["counts"])]
+                        cur["sum"] += val["sum"]
+                        cur["total"] += val["total"]
+                    else:
+                        m["series"][key] = {"counts": list(val["counts"]),
+                                            "sum": val["sum"],
+                                            "total": val["total"]}
+                elif st["kind"] == "counter":
+                    m["series"][key] = (cur or 0.0) + val
+                else:   # gauge
+                    m["series"][key] = val
+    lines: List[str] = []
+    for name, m in sorted(merged.items()):
+        labels = ("component",) + tuple(m["labels"])
+        lines.append(f"# HELP {name} {m['help']}")
+        lines.append(f"# TYPE {name} {m['kind']}")
+        for key, val in sorted(m["series"].items()):
+            if m["kind"] == "histogram":
+                cum = 0
+                for b, c in zip(m["buckets"] or (), val["counts"]):
+                    cum += c
+                    lb = _fmt_labels(labels + ("le",),
+                                     key + (repr(b).rstrip("0").rstrip("."),))
+                    lines.append(f"{name}_bucket{lb} {cum}")
+                lines.append(f"{name}_bucket"
+                             f"{_fmt_labels(labels + ('le',), key + ('+Inf',))}"
+                             f" {val['total']}")
+                lines.append(f"{name}_sum{_fmt_labels(labels, key)}"
+                             f" {val['sum']}")
+                lines.append(f"{name}_count{_fmt_labels(labels, key)}"
+                             f" {val['total']}")
+            else:
+                lines.append(f"{name}{_fmt_labels(labels, key)} {val}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ---------------------------------------------------------------------------
+# per-stage LLM latency metrics (one set per process, own registry)
+# ---------------------------------------------------------------------------
+class StageMetrics:
+    """The request-lifecycle flight-recorder histograms every serving
+    process records locally: TTFT, inter-token latency, prefill queue wait,
+    KV-transfer duration/bytes, decode step time, batch occupancy. Workers
+    publish ``registry.state_dump()`` to the store; the metrics aggregator
+    and the HTTP frontend's ``/metrics`` merge them cluster-wide via
+    :func:`render_states`."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        self.ttft = r.histogram(
+            "llm_ttft_seconds", "Time to first token", ("model",),
+            buckets=LATENCY_BUCKETS_WIDE)
+        self.inter_token = r.histogram(
+            "llm_inter_token_seconds", "Gap between streamed tokens",
+            ("model",), buckets=LATENCY_BUCKETS_FAST)
+        self.queue_wait = r.histogram(
+            "llm_prefill_queue_wait_seconds",
+            "Remote prefill job wait in the shared queue", (),
+            buckets=LATENCY_BUCKETS_WIDE)
+        self.kv_transfer = r.histogram(
+            "llm_kv_transfer_seconds",
+            "Prefill->decode KV block transfer duration", ("direction",),
+            # sub-ms on loopback, seconds over DCN: fast floor, coarse tail
+            buckets=LATENCY_BUCKETS_FAST + (2.5, 10.0, 60.0))
+        self.kv_transfer_bytes = r.counter(
+            "llm_kv_transfer_bytes_total",
+            "Bytes of KV moved prefill->decode", ("direction",))
+        self.decode_step = r.histogram(
+            "llm_decode_step_seconds", "One engine decode iteration", (),
+            buckets=LATENCY_BUCKETS_FAST)
+        self.batch_occupancy = r.gauge(
+            "llm_batch_occupancy", "Active sequences in the engine batch",
+            # per-worker label (pid): render_states merges same-component
+            # gauges last-write-wins, which would collapse replicas
+            ("worker",))
+
+
+_stage: Optional[StageMetrics] = None
+_stage_lock = threading.Lock()
+
+
+def stage_metrics() -> StageMetrics:
+    """Process-global :class:`StageMetrics` (lazily created)."""
+    global _stage
+    if _stage is None:
+        with _stage_lock:
+            if _stage is None:
+                _stage = StageMetrics()
+    return _stage

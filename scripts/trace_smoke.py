@@ -1,0 +1,227 @@
+"""Trace smoke: loopback disagg stack, one traced request, full timeline.
+
+Launches the dynstore, a disagg decode worker (remote-prefill forced), a
+prefill worker, and the discovery HTTP frontend as FOUR separate processes
+on 127.0.0.1, sends one streamed chat completion, then asserts:
+
+- ``GET /v1/traces/{x-request-id}`` returns one stitched trace with >= 6
+  spans from >= 2 distinct OS processes covering every hop (http:chat ->
+  preprocess -> rpc:generate -> prefill.remote_wait -> prefill.queue_wait
+  -> prefill.compute -> kv.push -> decode.stream -> sse.egress);
+- cross-process parenting holds (prefill.compute under remote_wait);
+- ``?format=chrome`` yields well-formed Chrome trace-event JSON;
+- the frontend ``/metrics`` merge exposes non-empty ``llm_ttft_seconds``
+  and ``llm_kv_transfer_seconds`` histograms for the request.
+
+    python scripts/trace_smoke.py [--timeout 240]
+
+Exit 0 = complete timeline + metrics; on failure, dumps the tail of every
+process log. CPU-only (synthetic model, JAX_PLATFORMS=cpu): runnable in CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MODEL = "trace-smoke"
+ENGINE_ARGS = json.dumps({"max_batch": 2, "max_context": 256,
+                          "prefill_chunk": 32, "decode_steps": 4, "seed": 3})
+# every hop of the disagg path must appear in the stitched trace
+WANT_SPANS = {"http:chat", "preprocess", "rpc:generate",
+              "prefill.remote_wait", "prefill.queue_wait",
+              "prefill.compute", "kv.push", "decode.stream", "sse.egress"}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url: str, timeout: float = 5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        body = r.read()
+    return json.loads(body) if body[:1] in (b"{", b"[") else body.decode()
+
+
+class Stack:
+    """The four loopback processes, logs tee'd to files for failure dumps."""
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+        self.procs = []         # (name, Popen, log path)
+        self.env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                    "DYNAMO_TPU_DATAPLANE": "python"}
+
+    def spawn(self, name: str, *argv: str) -> None:
+        path = os.path.join(self.logdir, f"{name}.log")
+        with open(path, "wb") as log:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", *argv], cwd=REPO, env=self.env,
+                stdout=log, stderr=subprocess.STDOUT)
+        self.procs.append((name, proc, path))
+
+    def check_alive(self) -> None:
+        for name, proc, _ in self.procs:
+            if proc.poll() is not None:
+                raise RuntimeError(f"{name} exited rc={proc.returncode}")
+
+    def wait_log(self, name: str, needle: str, deadline: float) -> None:
+        path = next(p for n, _, p in self.procs if n == name)
+        while time.monotonic() < deadline:
+            self.check_alive()
+            with open(path, "rb") as f:
+                if needle.encode() in f.read():
+                    return
+            time.sleep(0.25)
+        raise RuntimeError(f"{name}: {needle!r} not seen before timeout")
+
+    def dump(self, tail: int = 3000) -> None:
+        for name, _, path in self.procs:
+            with open(path, "rb") as f:
+                body = f.read()[-tail:].decode(errors="replace")
+            print(f"\n--- {name} (last {tail}B) ---\n{body}", flush=True)
+
+    def stop(self) -> None:
+        for _, proc, _ in reversed(self.procs):
+            if proc.poll() is None:
+                proc.terminate()
+        for _, proc, _ in self.procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def run(stack: Stack, timeout: float) -> int:
+    deadline = time.monotonic() + timeout
+    store_port, http_port = _free_port(), _free_port()
+    store = f"127.0.0.1:{store_port}"
+    base = f"http://127.0.0.1:{http_port}"
+
+    stack.spawn("store", "dynamo_tpu.runtime.store_server",
+                "--host", "127.0.0.1", "--port", str(store_port))
+    stack.wait_log("store", "dynstore listening", deadline)
+
+    # decode worker: max_local_prefill_length=0 forces EVERY prompt through
+    # the remote-prefill queue, so one request exercises the whole path
+    stack.spawn("decode", "dynamo_tpu.cli.worker", "--engine", "jax",
+                "--store", store, "--advertise-host", "127.0.0.1",
+                "--model-name", MODEL, "--register-model",
+                "--enable-disagg", "--max-local-prefill-length", "0",
+                "--max-prefill-queue-size", "4", "--kv-block-size", "8",
+                "--metrics-interval", "0.2",
+                "--extra-engine-args", ENGINE_ARGS)
+    stack.wait_log("decode", "serving", deadline)
+
+    stack.spawn("prefill", "dynamo_tpu.cli.prefill_worker",
+                "--store", store, "--advertise-host", "127.0.0.1",
+                "--model-name", MODEL, "--kv-block-size", "8",
+                "--extra-engine-args", ENGINE_ARGS)
+    stack.wait_log("prefill", "prefill worker pulling", deadline)
+
+    stack.spawn("http", "dynamo_tpu.cli.http", "--store", store,
+                "--host", "127.0.0.1", "--port", str(http_port))
+    stack.wait_log("http", "http frontend", deadline)
+
+    # model discovery
+    while True:
+        stack.check_alive()
+        if time.monotonic() > deadline:
+            raise RuntimeError("model never discovered")
+        try:
+            if any(m["id"] == MODEL
+                   for m in _get(base + "/v1/models")["data"]):
+                break
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.5)
+
+    # one traced streamed request
+    body = json.dumps({
+        "model": MODEL, "stream": True, "max_tokens": 6,
+        "messages": [{"role": "user", "content":
+                      "trace smoke: " + "tell me about latency " * 4}],
+        "ext": {"use_raw_prompt": True}}).encode()
+    req = urllib.request.Request(
+        base + "/v1/chat/completions", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        rid = r.headers["x-request-id"]
+        r.read()                     # drain the SSE stream
+    print(f"request {rid} served", flush=True)
+
+    # spans flush to the store asynchronously: poll for the full timeline
+    spans, names = [], set()
+    while time.monotonic() < deadline:
+        stack.check_alive()
+        data = _get(f"{base}/v1/traces/{rid}")
+        spans = data["spans"]
+        names = {s["name"] for s in spans}
+        if WANT_SPANS <= names:
+            break
+        time.sleep(0.3)
+    missing = WANT_SPANS - names
+    assert not missing, f"incomplete timeline, missing {missing}: {names}"
+    assert len(spans) >= 6, f"only {len(spans)} spans"
+    assert all(s["trace_id"] == rid for s in spans), "foreign trace ids"
+    pids = {(s["component"], s["pid"]) for s in spans}
+    assert len({p for _, p in pids}) >= 2, f"single-process trace: {pids}"
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["prefill.compute"]["parent_id"] == \
+        by_name["prefill.remote_wait"]["span_id"], "broken x-proc parenting"
+
+    chrome = _get(f"{base}/v1/traces/{rid}?format=chrome")
+    events = [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+    assert len(events) >= 6, "chrome export lost spans"
+    json.dumps(chrome)               # must round-trip as JSON
+
+    # merged stage metrics: TTFT (frontend) + KV transfer (both workers)
+    text = ""
+    while time.monotonic() < deadline:
+        text = _get(base + "/metrics")
+        if ("llm_ttft_seconds_count" in text
+                and "llm_kv_transfer_seconds_count" in text):
+            break
+        time.sleep(0.3)
+    assert "llm_ttft_seconds_count" in text, "no TTFT histogram"
+    assert 'llm_kv_transfer_seconds_count{component="prefill",' \
+        'direction="send"}' in text, "no KV-transfer histogram"
+
+    print(f"PASS: {len(spans)} spans across "
+          f"{len({p for _, p in pids})} processes "
+          f"({', '.join(sorted(c for c, _ in pids))}); "
+          f"TTFT + KV-transfer histograms exposed", flush=True)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--timeout", type=float, default=240.0)
+    args = ap.parse_args()
+    stack = Stack(tempfile.mkdtemp(prefix="trace_smoke_"))
+    print(f"logs: {stack.logdir}", flush=True)
+    try:
+        return run(stack, args.timeout)
+    except Exception as e:
+        print(f"FAIL: {e}", flush=True)
+        stack.dump()
+        return 1
+    finally:
+        stack.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,412 @@
+"""End-to-end request tracing + per-stage latency flight recorder.
+
+Covers: span nesting/ring-buffer semantics, wire-envelope trace round-trip
+over the data plane, the disaggregated prefill->decode path producing one
+stitched trace, Chrome trace-event export, per-stage histogram buckets and
+exposition format, the cross-process stage-metrics merge, the frontend
+/v1/traces endpoint, and tracectl's waterfall renderer."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.utils import tracing
+from dynamo_tpu.utils.prometheus import (LATENCY_BUCKETS_FAST,
+                                         LATENCY_BUCKETS_WIDE, Registry,
+                                         render_states, stage_metrics)
+from dynamo_tpu.utils.tracing import (Span, SpanContext, Tracer,
+                                      to_chrome_trace)
+
+
+# ---------------------------------------------------------------------------
+# unit: spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_parenting():
+    t = Tracer(component="test", capacity=64, enabled=True)
+    with t.span("outer", trace_id="trace-1") as outer:
+        with t.span("inner") as inner:
+            pass
+    assert inner.trace_id == "trace-1"
+    assert inner.parent_id == outer.span_id
+    assert outer.parent_id is None
+    spans = t.spans_for("trace-1")
+    assert {s.name for s in spans} == {"outer", "inner"}
+    # inner finished first
+    assert spans[0].name == "inner"
+    assert all(s.end >= s.start for s in spans)
+
+
+def test_span_error_status_and_ring_bound():
+    t = Tracer(component="test", capacity=8, enabled=True)
+    with pytest.raises(ValueError):
+        with t.span("boom", trace_id="x"):
+            raise ValueError("nope")
+    assert t.spans_for("x")[0].status == "error"
+    for i in range(50):
+        t.finish(t.start_span("s", trace_id=f"t{i}"))
+    assert len(t) == 8  # bounded ring
+
+
+def test_tracer_disabled_is_noop():
+    t = Tracer(component="test", enabled=False)
+    with t.span("nothing") as s:
+        assert s is None
+    assert len(t) == 0
+
+
+def test_span_dict_roundtrip_and_wire_context():
+    t = Tracer(component="c", enabled=True)
+    s = t.start_span("n", trace_id="tid", foo=1)
+    t.finish(s)
+    s2 = Span.from_dict(json.loads(json.dumps(s.to_dict())))
+    assert (s2.name, s2.trace_id, s2.span_id, s2.attrs) == \
+        ("n", "tid", s.span_id, {"foo": 1})
+    # wire form
+    ctx = SpanContext.from_wire(s.context().to_wire())
+    assert ctx.trace_id == "tid" and ctx.span_id == s.span_id
+    assert SpanContext.from_wire(None) is None
+    assert SpanContext.from_wire(["a"]) is None
+    # fallback: planes that drop the trace field stitch by request id
+    fb = tracing.extract_wire(None, default_trace_id="req-9")
+    assert fb.trace_id == "req-9" and fb.span_id is None
+
+
+def test_chrome_trace_export():
+    t = Tracer(component="compA", enabled=True)
+    with t.span("root", trace_id="tr") as root:
+        with t.span("child"):
+            pass
+    out = to_chrome_trace(t.spans_for("tr"))
+    s = json.dumps(out)  # must be valid JSON
+    assert "traceEvents" in out
+    evs = [e for e in out["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in out["traceEvents"] if e["ph"] == "M"]
+    assert len(evs) == 2 and len(meta) == 1
+    assert {e["name"] for e in evs} == {"root", "child"}
+    for e in evs:
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert e["args"]["trace_id"] == "tr"
+    assert "compA" in meta[0]["args"]["name"]
+    assert root.span_id in {e["args"]["span_id"] for e in evs}
+
+
+# ---------------------------------------------------------------------------
+# unit: prometheus fixes + stage metrics
+# ---------------------------------------------------------------------------
+
+def test_histogram_bucket_overrides_and_exposition():
+    r = Registry()
+    h = r.histogram("itl_seconds", "itl", ("model",),
+                    buckets=LATENCY_BUCKETS_FAST)
+    # ms-scale observations spread across buckets instead of collapsing
+    h.observe("m", value=0.0003)
+    h.observe("m", value=0.004)
+    h.observe("m", value=0.2)
+    text = r.render()
+    assert 'itl_seconds_bucket{model="m",le="0.0005"} 1' in text
+    assert 'itl_seconds_bucket{model="m",le="0.005"} 2' in text
+    assert 'itl_seconds_bucket{model="m",le="+Inf"} 3' in text
+    assert 'itl_seconds_count{model="m"} 3' in text
+    assert h.get_count("m") == 3
+    # the stage set uses the overrides
+    sm = stage_metrics()
+    assert sm.inter_token.buckets == tuple(sorted(LATENCY_BUCKETS_FAST))
+    assert sm.ttft.buckets == tuple(sorted(LATENCY_BUCKETS_WIDE))
+    assert sm.decode_step.buckets[0] < 0.001
+
+
+def test_counter_get_and_render_locked():
+    # behavioral: get/render take the lock and see consistent values
+    r = Registry()
+    c = r.counter("c_total", "c", ("k",))
+    c.inc("a", amount=2.5)
+    assert c.get("a") == 2.5
+    assert c.get("missing") == 0.0
+    g = r.gauge("g", "g", ())
+    g.set(value=7)
+    assert 'g 7' in "\n".join(g.render())
+
+
+def test_state_dump_and_render_states_merge():
+    def make(n):
+        r = Registry()
+        h = r.histogram("llm_kv_transfer_seconds", "kv", ("direction",),
+                        buckets=(0.1, 1.0))
+        for _ in range(n):
+            h.observe("send", value=0.05)
+        c = r.counter("llm_kv_transfer_bytes_total", "b", ("direction",))
+        c.inc("send", amount=10 * n)
+        return r
+    # two replicas of one component merge; a different component stays apart
+    text = render_states([
+        ("prefill", make(2).state_dump()),
+        ("prefill", make(3).state_dump()),
+        ("http", make(1).state_dump()),
+    ])
+    assert ('llm_kv_transfer_seconds_bucket{component="prefill",'
+            'direction="send",le="0.1"} 5') in text
+    assert ('llm_kv_transfer_seconds_count{component="prefill",'
+            'direction="send"} 5') in text
+    assert ('llm_kv_transfer_bytes_total{component="prefill",'
+            'direction="send"} 50.0') in text
+    assert 'component="http"' in text
+    # one HELP/TYPE block per family despite three sources
+    assert text.count("# TYPE llm_kv_transfer_seconds histogram") == 1
+
+
+# ---------------------------------------------------------------------------
+# wire round-trip over the data plane
+# ---------------------------------------------------------------------------
+
+async def test_trace_propagates_over_dataplane(monkeypatch):
+    """Client span context rides the request envelope: the server-side rpc
+    span shares the trace id and parents under the client's span."""
+    monkeypatch.setenv("DYNAMO_TPU_DATAPLANE", "python")
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    store_srv = StoreServer()
+    port = await store_srv.start()
+    drts = []
+    try:
+        sdrt = await DistributedRuntime(
+            store_port=port, advertise_host="127.0.0.1").connect()
+        drts.append(sdrt)
+
+        async def handler(request, ctx):
+            cur = tracing.current_span_var.get()
+            yield {"trace_id": cur.trace_id if cur else None,
+                   "span_id": cur.span_id if cur else None,
+                   "ctx_id": ctx.id}
+
+        await sdrt.namespace("ns").component("c").endpoint("echo") \
+            .serve(handler)
+        cdrt = await DistributedRuntime(
+            store_port=port, advertise_host="127.0.0.1").connect()
+        drts.append(cdrt)
+        client = await cdrt.namespace("ns").component("c") \
+            .endpoint("echo").client().start()
+
+        t = tracing.get_tracer()
+        with t.span("client.root", trace_id="trace-xyz"):
+            items = []
+            async for item in client.generate({"hi": 1}):
+                items.append(item)
+        assert items[0]["trace_id"] == "trace-xyz"
+        # server rpc span is a child of the client's call span, which is a
+        # child of client.root — all recorded in this (single) process
+        spans = t.spans_for("trace-xyz")
+        names = {s.name for s in spans}
+        assert {"client.root", "call:echo", "rpc:echo"} <= names
+        by_name = {s.name: s for s in spans}
+        assert by_name["call:echo"].parent_id == \
+            by_name["client.root"].span_id
+        assert by_name["rpc:echo"].parent_id == by_name["call:echo"].span_id
+        assert items[0]["span_id"] == by_name["rpc:echo"].span_id
+    finally:
+        for d in drts:
+            await d.close()
+        await store_srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# disagg path: one trace spanning decode + prefill workers, stage metrics
+# ---------------------------------------------------------------------------
+
+async def test_disagg_trace_and_stage_metrics(monkeypatch):
+    """A remote-prefilled request yields >= 6 spans sharing one trace id,
+    published to the store, and non-empty kv-transfer/queue-wait stage
+    histograms land under metrics_stage/."""
+    monkeypatch.setenv("DYNAMO_TPU_DATAPLANE", "python")
+    import argparse
+
+    from dynamo_tpu.cli.prefill_worker import run_prefill_worker
+    from dynamo_tpu.cli.worker import run_worker
+    from dynamo_tpu.llm.metrics_aggregator import fetch_stage_states
+    from dynamo_tpu.llm.protocols.common import (BackendInput,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    store_srv = StoreServer()
+    port = await store_srv.start()
+    tasks, drts = [], []
+    engine_args = json.dumps({"max_batch": 2, "max_context": 128,
+                              "prefill_chunk": 32, "decode_steps": 4,
+                              "seed": 3})
+    try:
+        ddrt = await DistributedRuntime(
+            store_port=port, advertise_host="127.0.0.1").connect()
+        drts.append(ddrt)
+        dargs = argparse.Namespace(
+            engine="jax", namespace="dyn", component="backend",
+            store=f"127.0.0.1:{port}", advertise_host="127.0.0.1",
+            model_path=None, model_name="m1", register_model=False,
+            tp=1, kv_block_size=8, metrics_interval=0.2,
+            extra_engine_args=engine_args,
+            enable_disagg=True, max_local_prefill_length=0,
+            max_prefill_queue_size=4)
+        ready = asyncio.Event()
+        tasks.append(asyncio.create_task(
+            run_worker(dargs, ready_event=ready, drt=ddrt)))
+        await asyncio.wait_for(ready.wait(), 60)
+
+        pdrt = await DistributedRuntime(
+            store_port=port, advertise_host="127.0.0.1").connect()
+        drts.append(pdrt)
+        pargs = argparse.Namespace(
+            namespace="dyn", decode_component="backend",
+            store=f"127.0.0.1:{port}", advertise_host="127.0.0.1",
+            model_path=None, model_name="m1", tp=1, kv_block_size=8,
+            extra_engine_args=engine_args)
+        pready = asyncio.Event()
+        tasks.append(asyncio.create_task(
+            run_prefill_worker(pargs, ready_event=pready, drt=pdrt)))
+        await asyncio.wait_for(pready.wait(), 60)
+
+        cdrt = await DistributedRuntime(
+            store_port=port, advertise_host="127.0.0.1").connect()
+        drts.append(cdrt)
+        client = await cdrt.namespace("dyn").component("backend") \
+            .endpoint("generate").client().start()
+        bi = BackendInput(token_ids=list(range(3, 40)),
+                          sampling=SamplingOptions(),
+                          stop=StopConditions(max_tokens=6))
+        from dynamo_tpu.runtime.engine import Context
+
+        ctx = Context()
+        toks = []
+        async for item in client.generate(bi.to_dict(), ctx):
+            toks.extend(item["token_ids"])
+            assert item.get("finish_reason") != "error"
+        assert len(toks) == 6
+
+        # spans flush asynchronously: poll the store for the full timeline
+        want = {"rpc:generate", "prefill.remote_wait", "prefill.queue_wait",
+                "prefill.compute", "kv.push", "decode.stream"}
+        spans, names = [], set()
+        for _ in range(60):
+            spans = await tracing.fetch_trace_spans(cdrt.store, ctx.id)
+            names = {s.name for s in spans}
+            if want <= names:
+                break
+            await asyncio.sleep(0.1)
+        assert want <= names, f"incomplete timeline: {names}"
+        assert len(spans) >= 6
+        assert all(s.trace_id == ctx.id for s in spans)
+        # parenting across the queue: prefill.compute under remote_wait
+        by_name = {s.name: s for s in spans}
+        assert by_name["prefill.compute"].parent_id == \
+            by_name["prefill.remote_wait"].span_id
+        # chrome export of the merged trace is well-formed
+        chrome = to_chrome_trace(tracing.merge_spans(spans))
+        assert len([e for e in chrome["traceEvents"]
+                    if e["ph"] == "X"]) >= 6
+
+        # stage metrics: kv transfer + queue wait observed and published
+        states = []
+        for _ in range(40):
+            states = await fetch_stage_states(cdrt.store, "dyn")
+            text = render_states(states)
+            if ("llm_kv_transfer_seconds_count" in text
+                    and "llm_prefill_queue_wait_seconds_count" in text):
+                break
+            await asyncio.sleep(0.1)
+        # substring (no exact count): the stage singleton is process-global
+        # and accumulates across tests sharing this pytest process
+        text = render_states(states)
+        assert 'llm_kv_transfer_seconds_count{component="prefill",' \
+            'direction="send"}' in text
+        assert 'llm_prefill_queue_wait_seconds_count{component="prefill"}' \
+            in text
+        assert 'direction="recv"' in text   # decode-side receive
+    finally:
+        for t in tasks:
+            t.cancel()
+        for d in drts:
+            await d.close()
+        await store_srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP frontend: /v1/traces endpoint + x-request-id
+# ---------------------------------------------------------------------------
+
+async def test_http_trace_endpoint():
+    import aiohttp
+
+    from dynamo_tpu.llm.http_service import (HttpService, ModelManager,
+                                             ServedModel)
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.pipeline import (build_chat_engine,
+                                         build_completion_engine)
+
+    card = ModelDeploymentCard.synthetic("echo")
+    manager = ModelManager()
+    manager.add(ServedModel(card, build_chat_engine(card, "echo_core"),
+                            build_completion_engine(card, "echo_core")))
+    svc = HttpService(manager, host="127.0.0.1", port=0)
+    base = f"http://127.0.0.1:{await svc.start()}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "echo", "stream": True,
+                    "messages": [{"role": "user", "content": "hi!"}],
+                    "ext": {"use_raw_prompt": True}}
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                rid = r.headers["x-request-id"]
+                await r.read()
+            async with s.get(f"{base}/v1/traces/{rid}") as r:
+                assert r.status == 200
+                data = await r.json()
+            names = {sp["name"] for sp in data["spans"]}
+            assert {"http:chat", "preprocess", "sse.egress"} <= names
+            assert all(sp["trace_id"] == rid for sp in data["spans"])
+            async with s.get(f"{base}/v1/traces/{rid}?format=chrome") as r:
+                chrome = await r.json()
+                assert any(e["ph"] == "X" and e["name"] == "http:chat"
+                           for e in chrome["traceEvents"])
+            async with s.get(f"{base}/v1/traces") as r:
+                assert rid in (await r.json())["traces"]
+            async with s.get(f"{base}/v1/traces/nonexistent") as r:
+                assert r.status == 404
+            # stage metrics on /metrics: ttft + inter-token observed
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+            assert 'llm_ttft_seconds_count{component="http",model="echo"}' \
+                in text
+            assert "llm_inter_token_seconds" in text
+    finally:
+        await svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# tracectl renderer
+# ---------------------------------------------------------------------------
+
+def test_tracectl_render_timeline():
+    from dynamo_tpu.cli.tracectl import render_timeline
+
+    spans = [
+        {"name": "http:completions", "trace_id": "t1", "span_id": "a",
+         "parent_id": None, "component": "http", "pid": 1,
+         "start": 100.0, "end": 100.5, "status": "ok", "attrs": {}},
+        {"name": "rpc:generate", "trace_id": "t1", "span_id": "b",
+         "parent_id": "a", "component": "decode_worker", "pid": 2,
+         "start": 100.1, "end": 100.45, "status": "ok", "attrs": {}},
+        {"name": "prefill.compute", "trace_id": "t1", "span_id": "c",
+         "parent_id": "b", "component": "prefill_worker", "pid": 3,
+         "start": 100.15, "end": 100.3, "status": "error", "attrs": {}},
+    ]
+    out = render_timeline(spans)
+    lines = out.splitlines()
+    assert "3 spans" in lines[0]
+    assert any("http:completions" in ln and "|" in ln for ln in lines)
+    # nesting indentation and error flag
+    assert any(ln.startswith("    prefill.compute") for ln in lines)
+    assert any("!ERROR" in ln for ln in lines)
+    assert any("decode_worker:2" in ln for ln in lines)
+    assert render_timeline([]) == "(no spans)"
