@@ -37,13 +37,20 @@ log = logging.getLogger("dynamo_tpu.prefill_worker")
 async def run_prefill_worker(args, *,
                              ready_event: Optional[asyncio.Event] = None,
                              drt: Optional[DistributedRuntime] = None,
-                             max_jobs: Optional[int] = None) -> None:
+                             max_jobs: Optional[int] = None,
+                             token=None) -> None:
     host, port = args.store.split(":")
     own_drt = drt is None
     if own_drt:
         drt = await DistributedRuntime(
             store_host=host, store_port=int(port),
             advertise_host=args.advertise_host).connect()
+    if token is not None:
+        def _lease_lost(lease: int) -> None:
+            log.critical("liveness lease %x unrecoverably lost; "
+                         "shutting down", lease)
+            token.cancel()
+        drt.store.on_lease_lost = _lease_lost
     ns = drt.namespace(args.namespace)
 
     from ..engine.engine import JaxEngine, JaxEngineConfig
@@ -88,7 +95,26 @@ async def run_prefill_worker(args, *,
     done = 0
     try:
         while max_jobs is None or done < max_jobs:
-            msg_id, job = await queue.dequeue()
+            # race the (possibly long-parked) queue pull against drain: a
+            # SIGTERM'd prefill worker must stop TAKING jobs immediately —
+            # an abandoned pull's message is requeued when the connection
+            # closes (at-least-once)
+            pull = asyncio.ensure_future(queue.dequeue())
+            if token is not None or drt.draining.is_set():
+                waiters = {pull, asyncio.ensure_future(drt.draining.wait())}
+                if token is not None:
+                    waiters.add(asyncio.ensure_future(token.wait()))
+                # unbounded-ok: drain/cancel always completes this wait
+                await asyncio.wait(waiters,
+                                   return_when=asyncio.FIRST_COMPLETED)
+                for w in waiters:
+                    if w is not pull:
+                        w.cancel()
+                if not pull.done():
+                    pull.cancel()
+                    log.info("draining: queue pull stopped")
+                    break
+            msg_id, job = await pull
             if await queue.consume_cancelled(job.request_id):
                 await queue.ack(msg_id)
                 log.info("dropping cancelled prefill job %s", job.request_id)
@@ -97,9 +123,20 @@ async def run_prefill_worker(args, *,
             # all spans of this job parent under the decode worker's span
             # (carried in job.trace); fallback: stitch by request id
             job_parent = tracing.extract_wire(job.trace, job.request_id)
+            ctx = None
             try:
+                from ..utils import faults
+
+                # chaos hook: a stalled/failed prefill worker — the decode
+                # side's deadline-bounded KV wait must turn this into a 504
+                await faults.fire("prefill.compute")
                 bi = BackendInput.from_dict(job.request)
-                ctx = Context(job.request_id)
+                ctx = Context(job.request_id, deadline=job.deadline)
+                # register with the runtime so the Worker shell's drain
+                # waits for (then stops/kills) the in-flight compute+push
+                # instead of cancelling it mid-job — the job must be acked
+                # or requeued, never silently half-done
+                drt._active[ctx.id] = ctx
                 async with tracing.get_tracer().span(
                         "prefill.compute", parent=job_parent,
                         request_id=job.request_id,
@@ -142,6 +179,9 @@ async def run_prefill_worker(args, *,
                         log.exception("could not dead-letter %s",
                                       job.request_id)
                 await asyncio.sleep(0.2)
+            finally:
+                if ctx is not None:
+                    drt._active.pop(ctx.id, None)
             done += 1
     finally:
         stage_task.cancel()
@@ -172,8 +212,23 @@ def parse_args(argv=None) -> argparse.Namespace:
 def main() -> None:
     from ..utils.logging_ext import init_logging
     init_logging()
+    args = parse_args()
+    # Worker shell: SIGINT/SIGTERM drain gracefully — stop pulling the
+    # queue, finish/ship the in-flight job, revoke the lease, exit
+    from ..runtime.worker import Worker
+
+    shell = Worker()
+
+    async def app(token):
+        host, port = args.store.split(":")
+        drt = await DistributedRuntime(
+            store_host=host, store_port=int(port),
+            advertise_host=args.advertise_host).connect()
+        shell.add_runtime(drt)
+        await run_prefill_worker(args, drt=drt, token=token)
+
     try:
-        asyncio.run(run_prefill_worker(parse_args()))
+        shell.execute(app)
     except KeyboardInterrupt:
         pass
 

@@ -31,6 +31,7 @@ from ..llm.model_card import ModelDeploymentCard
 from ..llm.protocols.common import BackendInput
 from ..llm.remote import register_model, serve_core_engine
 from ..runtime.component import DistributedRuntime
+from ..runtime.store_client import StoreError
 from ..utils import tracing
 
 log = logging.getLogger("dynamo_tpu.worker")
@@ -111,11 +112,22 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
     if token is not None:
         # reference semantics (etcd.rs:55-76): losing the liveness lease
         # cancels the worker — shut down cleanly so the orchestrator
-        # restarts us with a fresh lease, instead of serving unroutably
+        # restarts us with a fresh lease, instead of serving unroutably.
+        # With store reconnect this is now the LAST resort: transient
+        # connection loss re-establishes the session (lease re-granted
+        # under the same id, endpoint keys re-put) and the worker keeps
+        # serving; the callback fires only when the reconnect window is
+        # exhausted or the server could not preserve our identity.
         def _lease_lost(lease: int) -> None:
-            log.critical("liveness lease %x lost; shutting down", lease)
+            log.critical("liveness lease %x unrecoverably lost; "
+                         "shutting down", lease)
             token.cancel()
         drt.store.on_lease_lost = _lease_lost
+
+    def _session_replayed() -> None:
+        log.warning("store session re-established: lease %x re-granted, "
+                    "endpoint/model keys re-registered", drt.worker_id)
+    drt.store.on_session_replayed = _session_replayed
     ns = drt.namespace(args.namespace)
     component = ns.component(args.component)
 
@@ -182,28 +194,11 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
 
         remote_timeout = getattr(args, "remote_prefill_timeout", 120.0)
 
+        from ..llm.kv_transfer import await_remote_kv as _await_kv
+
         async def await_remote_kv(ctx, fut):
-            """Wait for the KV push, racing client-stop and a timeout.
-            Returns the KV tuple, or None => fall back to local prefill."""
-            stop = asyncio.ensure_future(ctx.stopped())
-            try:
-                done, _ = await asyncio.wait(
-                    {fut, stop}, timeout=remote_timeout,
-                    return_when=asyncio.FIRST_COMPLETED)
-                if fut in done:
-                    return fut.result()  # may raise RemotePrefillError
-                if stop in done:
-                    await queue.cancel(ctx.id)
-                    raise asyncio.CancelledError
-                log.warning("remote prefill for %s timed out after %.0fs; "
-                            "prefilling locally", ctx.id, remote_timeout)
-                # tombstone the queued job so a prefill worker doesn't burn
-                # a full prompt prefill on KV nobody will accept
-                await queue.cancel(ctx.id)
-                return None
-            finally:
-                stop.cancel()
-                receiver.abandon(ctx.id)
+            return await _await_kv(ctx, fut, queue, receiver,
+                                   remote_timeout)
 
         async def generate_handler(request, ctx):
             bi = BackendInput.from_dict(request)
@@ -235,7 +230,8 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                                        prompt_tokens=len(bi.token_ids),
                                        prefix_hit_tokens=prefix_hit) as wsp:
                     await queue.enqueue(RemotePrefillRequest(
-                        ctx.id, drt.worker_id, request))
+                        ctx.id, drt.worker_id, request,
+                        deadline=ctx.deadline))
                     try:
                         kv = await await_remote_kv(ctx, fut)
                     except RemotePrefillError as e:
@@ -276,12 +272,16 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                 m = ForwardPassMetrics(**core.utilization())
             else:
                 m = ForwardPassMetrics(request_total_slots=64)
-            await drt.store.put(key, json.dumps(m.to_dict()).encode(),
-                                lease=drt.lease)
             try:
+                await drt.store.put(key, json.dumps(m.to_dict()).encode(),
+                                    lease=drt.lease)
                 await publish_stage_metrics(
                     drt.store, args.namespace, args.component,
                     drt.worker_id, drt.lease)
+            except StoreError:
+                # store mid-outage (reconnect in flight): skip the beat —
+                # the session replay re-puts the last snapshot anyway
+                log.debug("metrics publish skipped (store disconnected)")
             except Exception:
                 log.exception("stage metrics publish failed")
             await asyncio.sleep(args.metrics_interval)

@@ -60,6 +60,13 @@ class RemotePrefillRequest:
     # the enqueue->dequeue gap into the queue-wait span/histogram
     trace: Optional[List[Optional[str]]] = None
     enqueued_at: float = 0.0
+    # end-to-end deadline (absolute time.time()): a job that expires while
+    # queued is acked-and-dropped at dequeue — never computed
+    deadline: Optional[float] = None
+
+    @property
+    def expired(self) -> bool:
+        return self.deadline is not None and time.time() >= self.deadline
 
     def to_bytes(self) -> bytes:
         return json.dumps(self.__dict__).encode()
@@ -87,9 +94,19 @@ class PrefillQueue:
 
     async def dequeue(self) -> tuple:
         """Blocks until work is available. Returns (msg_id, request);
-        the caller MUST ack(msg_id) after the KV has been delivered."""
-        msg_id, payload = await self.store.q_pull(self.queue)
-        req = RemotePrefillRequest.from_bytes(payload)
+        the caller MUST ack(msg_id) after the KV has been delivered.
+        Jobs whose end-to-end deadline expired while queued are acked and
+        dropped here — never handed to the engine (counted per stage in
+        ``dyn_deadline_expiries_total{stage="prefill_dequeue"}``)."""
+        while True:
+            msg_id, payload = await self.store.q_pull(self.queue)
+            req = RemotePrefillRequest.from_bytes(payload)
+            if not req.expired:
+                break
+            await self.ack(msg_id)
+            stage_metrics().deadline_expiries.inc("prefill_dequeue")
+            log.info("dropping expired prefill job %s "
+                     "(deadline passed while queued)", req.request_id)
         if req.enqueued_at:
             # queue wait, measured across processes on wall clocks (skew
             # bounds accuracy; clamp so a skewed clock never goes negative)

@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional
 
 from aiohttp import web
 
+from ..runtime import deadline as dl
 from ..runtime.engine import AsyncEngine, Context, EngineError
 from ..utils import tracing
 from ..utils.prometheus import Registry, render_states, stage_metrics
@@ -225,6 +226,11 @@ class HttpService:
             # any other parse failure is still the client's malformed input
             self.m_requests.inc("unknown", endpoint, "400")
             return _err(400, f"malformed request: {e}")
+        try:
+            timeout = _request_timeout(req)
+        except ValueError as e:
+            self.m_requests.inc("unknown", endpoint, "400")
+            return _err(400, str(e))
         model_name = oai_req.model
         served = self.manager.get(model_name)
         engine = served and (served.chat_engine if endpoint == "chat"
@@ -235,7 +241,10 @@ class HttpService:
             self.m_requests.inc("unknown", endpoint, "404")
             return _err(404, f"model {model_name!r} not found")
 
-        ctx = Context()
+        # end-to-end deadline (x-request-timeout header, DYN_REQUEST_TIMEOUT
+        # default): every downstream hop sees it via the context / wire
+        # envelope; expiry anywhere surfaces as a 504 naming the stage
+        ctx = Context(deadline=dl.from_timeout(timeout))
         # request-id span: every log line in this async call chain (and in
         # remote workers via the wire context_id) carries ctx.id
         from ..utils.logging_ext import request_id_var
@@ -267,7 +276,9 @@ class HttpService:
             chunks = []
             first = True
             try:
-                async for ch in engine.generate(oai_req, ctx):
+                async for ch in dl.guard_stream(
+                        engine.generate(oai_req, ctx), ctx.deadline,
+                        "http_aggregate", slack=0.5):
                     if "event" in ch:
                         continue  # annotations only meaningful when streaming
                     if "error" in ch:
@@ -310,9 +321,11 @@ class HttpService:
         agen = engine.generate(oai_req, ctx)
         # Pull the first item BEFORE committing the 200/SSE response so that
         # preprocessing failures (context overflow, bad template) still map to
-        # a proper 4xx status instead of an error inside a 200 stream.
+        # a proper 4xx status instead of an error inside a 200 stream — and
+        # a pre-first-token deadline expiry to a clean 504.
         try:
-            first_item = await agen.__anext__()
+            first_item = await dl.wait_for(agen.__anext__(), ctx.deadline,
+                                           "http_first_token", slack=0.5)
         except StopAsyncIteration:
             first_item = None
         except ProtocolError as e:
@@ -346,7 +359,8 @@ class HttpService:
                 yield item
 
         try:
-            async for ch in chain():
+            async for ch in dl.guard_stream(chain(), ctx.deadline,
+                                            "http_stream", slack=0.5):
                 if "event" in ch:
                     payload = (f"event: {ch['event']}\n"
                                f"data: {json.dumps(ch['data'])}\n\n").encode()
@@ -403,14 +417,45 @@ class HttpService:
         return resp
 
 
+def _request_timeout(req: web.Request) -> Optional[float]:
+    """Per-request deadline budget in seconds: the ``x-request-timeout``
+    header when present, else the ``DYN_REQUEST_TIMEOUT`` env default, else
+    None (no deadline). A malformed HEADER raises ValueError (the client's
+    fault — 400); a malformed env default is the operator's typo and is
+    logged and ignored, never inflicted on clients."""
+    import os
+
+    raw = req.headers.get("x-request-timeout")
+    if raw:
+        try:
+            t = float(raw)
+        except ValueError:
+            raise ValueError(f"x-request-timeout: {raw!r} is not a number")
+        if not t > 0:
+            raise ValueError(f"x-request-timeout must be > 0, got {t}")
+        return t
+    env = os.environ.get("DYN_REQUEST_TIMEOUT")
+    if not env:
+        return None
+    try:
+        t = float(env)
+    except ValueError:
+        log.warning("ignoring malformed DYN_REQUEST_TIMEOUT=%r", env)
+        return None
+    return t if t > 0 else None
+
+
+_ERR_TYPES = {400: "invalid_request_error", 404: "not_found_error",
+              504: "timeout_error"}
+
+
 def _err(code: int, message: str,
          request_id: Optional[str] = None) -> web.Response:
     # error responses for requests that got far enough to have an id carry
     # x-request-id too — failed requests are the ones operators trace
     return web.json_response(
         {"error": {"message": message,
-                   "type": "invalid_request_error" if code == 400 else "not_found_error"
-                   if code == 404 else "internal_error",
+                   "type": _ERR_TYPES.get(code, "internal_error"),
                    "code": code}},
         status=code,
         headers={"x-request-id": request_id} if request_id else None,

@@ -13,8 +13,11 @@ NATS), and the C-ABI publish path (lib/bindings/c) that engines call.
 from __future__ import annotations
 
 import asyncio
+import logging
 import threading
 from typing import Awaitable, Callable, List, Optional
+
+log = logging.getLogger("dynamo_tpu.kv_events")
 
 from ..tokens import TokenBlock
 from .protocols import (
@@ -107,16 +110,30 @@ class KvEventPublisher:
     async def _drain(self) -> None:
         with self._lock:
             batch, self._buf = self._buf, []
-        for ev in batch:
-            await self._publish(
-                self.subject,
-                RouterEvent(self.worker_id, ev).to_dict())
+        for i, ev in enumerate(batch):
+            try:
+                await self._publish(
+                    self.subject,
+                    RouterEvent(self.worker_id, ev).to_dict())
+            except Exception:
+                # transport outage (e.g. store reconnecting): put the
+                # unsent tail back IN ORDER and retry on a later beat —
+                # the router's index depends on event order per worker
+                with self._lock:
+                    self._buf = batch[i:] + self._buf
+                raise
             self.published += 1
 
     async def _run(self) -> None:
         assert self._wake is not None
         while True:
-            await self._drain()
+            try:
+                await self._drain()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 - keep the pump alive
+                log.debug("kv event publish deferred (%s); retrying",
+                          e)
             try:
                 await asyncio.wait_for(self._wake.wait(), timeout=0.2)
                 self._wake.clear()

@@ -60,7 +60,11 @@ async def push_kv(client: Client, decode_worker_id: int, request_id: str,
     nbytes = k.nbytes + v.nbytes
 
     async def parts() -> AsyncIterator[bytes]:
+        from ..utils import faults
+
         for layer in range(k.shape[0]):
+            # chaos hook: kill/stall the KV stream mid-flight (per part)
+            await faults.fire("kv.push.part")
             yield k[layer].tobytes()
             yield v[layer].tobytes()
 
@@ -84,6 +88,54 @@ async def push_kv(client: Client, decode_worker_id: int, request_id: str,
 
 class RemotePrefillError(RuntimeError):
     pass
+
+
+async def _cancel_quietly(queue, request_id: str) -> None:
+    """Tombstone a queued job, best-effort: a store mid-outage must not
+    mask the caller's own outcome (timeout / client stop)."""
+    try:
+        await queue.cancel(request_id)
+    except Exception:  # noqa: BLE001
+        log.debug("prefill cancel tombstone for %s failed (store down?)",
+                  request_id)
+
+
+async def await_remote_kv(ctx: Context, fut: asyncio.Future, queue,
+                          receiver: "KvReceiver",
+                          remote_timeout: float):
+    """Decode-side wait for the remotely computed KV, racing client-stop,
+    the request's end-to-end deadline, and the fallback timeout. Returns
+    the KV tuple, or None => fall back to local prefill. An expired
+    deadline raises a 504 naming the stage (``decode_kv_wait``) — there is
+    no point prefilling locally for a caller that already timed out."""
+    from ..runtime import deadline as dl
+
+    stop = asyncio.ensure_future(ctx.stopped())
+    try:
+        timeout = remote_timeout
+        rem = dl.remaining(ctx.deadline)
+        deadline_first = rem is not None and rem < timeout
+        if deadline_first:
+            timeout = rem
+        done, _ = await asyncio.wait(
+            {fut, stop}, timeout=timeout,
+            return_when=asyncio.FIRST_COMPLETED)
+        if fut in done:
+            return fut.result()  # may raise RemotePrefillError
+        if stop in done:
+            await _cancel_quietly(queue, ctx.id)
+            raise asyncio.CancelledError
+        # tombstone the queued job so a prefill worker doesn't burn a
+        # full prompt prefill on KV nobody will accept
+        await _cancel_quietly(queue, ctx.id)
+        if deadline_first or dl.expired(ctx.deadline):
+            raise dl.expire("decode_kv_wait", ctx.deadline)
+        log.warning("remote prefill for %s timed out after %.0fs; "
+                    "prefilling locally", ctx.id, remote_timeout)
+        return None
+    finally:
+        stop.cancel()
+        receiver.abandon(ctx.id)
 
 
 async def push_kv_error(client: Client, decode_worker_id: int,

@@ -29,6 +29,9 @@ import socket
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional, Tuple
 
+from ..utils import faults
+from . import deadline as dl
+from .circuit_breaker import InstanceBreaker
 from .engine import AsyncEngine, Context, EngineError
 from .store_client import StoreClient
 from .wire import FrameReader, attach_trace, extract_trace, write_frame
@@ -73,8 +76,11 @@ async def drive_handler_stream(stream, send) -> bool:
     except (ConnectionResetError, BrokenPipeError):
         raise
     except Exception as e:  # noqa: BLE001 - mid-stream failure
+        # typed engine errors (e.g. DeadlineExceeded=504) keep their code
+        # so the far end can map them; everything else is a 500
+        code = e.code if isinstance(e, EngineError) else 500
         try:
-            await send({"kind": "error", "message": str(e), "code": 500},
+            await send({"kind": "error", "message": str(e), "code": code},
                        None)
         except Exception:
             pass
@@ -137,6 +143,9 @@ class DistributedRuntime:
         self._handlers: Dict[str, Handler] = {}
         self._active: Dict[str, Context] = {}
         self._conn_writers: set = set()   # live data-plane connections
+        # graceful drain: set once the process decided to exit — queue-pull
+        # loops and periodic publishers check it to stop taking new work
+        self.draining = asyncio.Event()
 
     async def connect(self) -> "DistributedRuntime":
         await self.store.connect()
@@ -158,10 +167,30 @@ class DistributedRuntime:
         self.worker_id = self.lease
         return self
 
+    async def prepare_drain(self) -> None:
+        """First phase of graceful shutdown: make the worker INVISIBLE
+        before anything stops serving. Revoking the lease expires every
+        lease-bound key (endpoint + model registrations, metrics snapshots)
+        server-side, so watchers route new work elsewhere while in-flight
+        streams keep completing here. Idempotent; store-unreachable is fine
+        (the lease then expires by TTL, which is the same outcome later)."""
+        if self.draining.is_set():
+            return
+        self.draining.set()
+        # the deliberate revoke below must not read as a lease LOSS
+        self.store.on_lease_lost = None
+        if self.lease is not None:
+            try:
+                await self.store.lease_revoke(self.lease)
+            except Exception:  # noqa: BLE001 - store may be mid-outage
+                log.info("drain: lease revoke failed (store unreachable); "
+                         "lease will expire by TTL", exc_info=True)
+
     async def close(self) -> None:
         # orderly shutdown: the revoke below would otherwise read as a
         # lease LOSS at the next keepalive beat and fire a spurious
         # shutdown callback
+        self.draining.set()
         self.store.on_lease_lost = None
         if self.lease is not None:
             try:
@@ -228,6 +257,8 @@ class DistributedRuntime:
         self._conn_writers.add(writer)
         try:
             while True:
+                # unbounded-ok: idle server connection awaiting the next
+                # request; lives exactly as long as the client keeps it
                 frame = pending if pending is not None else await fr.read()
                 pending = None
                 control, payload = frame
@@ -274,7 +305,15 @@ class DistributedRuntime:
                 "message": f"context {ctx_id} is already executing "
                            f"(duplicate delivery)"}, None])
             return None
-        ctx = Context(ctx_id)
+        req_deadline = control.get(dl.DEADLINE_KEY)
+        if dl.expired(req_deadline):
+            # the request died in transit/queueing: refuse to burn compute
+            # on work nobody is waiting for (counted per stage)
+            err = dl.expire(f"worker_ingress:{ep}", req_deadline)
+            await write_frame(writer, [{"kind": "error", "code": err.code,
+                                        "message": str(err)}, None])
+            return None
+        ctx = Context(ctx_id, deadline=req_deadline)
         self._active[ctx.id] = ctx
         from ..utils.logging_ext import request_id_var
         from ..utils.tracing import current_span_var, get_tracer
@@ -296,6 +335,8 @@ class DistributedRuntime:
             stash it for _serve_conn and stop reading."""
             try:
                 while True:
+                    # unbounded-ok: control watcher is cancelled when the
+                    # request finishes; disconnects stop the context below
                     frame = await fr.read()
                     c, _ = frame
                     if c.get("kind") == "stop":
@@ -316,6 +357,8 @@ class DistributedRuntime:
             async def parts_gen():
                 nonlocal watcher
                 while True:
+                    # unbounded-ok: client-streamed body; a disconnect
+                    # raises into the handler, which owns the request
                     c, p = await fr.read()
                     kind = c.get("kind")
                     if kind == "part":
@@ -469,6 +512,10 @@ class Client:
         self._watching = False
         # (host, port) -> idle (reader, FrameReader, writer) connections
         self._pool: Dict[Tuple[str, int], List[Any]] = {}
+        # cross-request per-instance failure accounting (eject / half-open
+        # probe / recover) — the per-call ``failed`` set only ever protected
+        # one request from re-picking a dead instance
+        self.breaker = InstanceBreaker()
         self.on_instances_changed: Optional[Callable[[], None]] = None
 
     def _pool_get(self, key):
@@ -499,9 +546,14 @@ class Client:
         async def on_change(key: str, value: Optional[bytes], deleted: bool):
             lease = int(key.rsplit(":", 1)[1], 16)
             if deleted:
+                # deregistration must evict pooled sockets too: the next
+                # request would otherwise burn its same-instance retry on a
+                # connection to a gone worker — and drop the breaker's
+                # accounting (a re-registered id starts with a clean slate)
                 info = self.instances.pop(lease, None)
                 if info is not None:
                     self._pool_drop((info.host, info.port))
+                self.breaker.forget(lease)
             else:
                 self.instances[lease] = EndpointInfo.from_bytes(value)
             if self.on_instances_changed:
@@ -540,6 +592,10 @@ class Client:
         if not ids:
             raise EngineError(
                 f"all live instances of {self.endpoint.path} unreachable", 503)
+        # circuit breaker: skip instances currently ejected (open). If that
+        # would veto everyone, filter() stands down — the breaker may not
+        # manufacture a total outage the membership plane doesn't see.
+        ids = self.breaker.filter(ids)
         if mode == "round_robin":
             iid = ids[next(self._rr) % len(ids)]
         else:
@@ -555,6 +611,7 @@ class Client:
         With ``parts`` set, streams the binary chunks after the request header
         (server handler receives a :class:`StreamingRequest`)."""
         ctx = context or Context()
+        dl.check(ctx.deadline, f"rpc_dispatch:{self.endpoint.name}")
         # serialize BEFORE any socket exists: a non-serializable request
         # must not leak a freshly opened connection
         if isinstance(request, (bytes, bytearray)):
@@ -564,6 +621,10 @@ class Client:
         else:
             base_control = {"kind": "request", "context_id": ctx.id}
             req_payload = json.dumps(request).encode()
+        if ctx.deadline is not None:
+            # the deadline rides the envelope next to context_id/trace so
+            # every downstream hop can drop work nobody awaits anymore
+            base_control[dl.DEADLINE_KEY] = ctx.deadline
         if parts is not None:
             base_control["streaming"] = True
         # client span around the whole exchange; its context rides the wire
@@ -623,6 +684,7 @@ class Client:
 
                 def _fail(iid=iid, key=key):
                     failed.add(iid)
+                    self.breaker.record_failure(iid)
                     self._pool_drop(key)
 
                 # part-streaming requests can't replay their body on a
@@ -632,8 +694,10 @@ class Client:
                     reader, fr, writer = pooled
                 else:
                     try:
-                        reader, writer = await asyncio.open_connection(
-                            info.host, info.port)
+                        await faults.fire("client.connect")
+                        reader, writer = await dl.wait_for(
+                            asyncio.open_connection(info.host, info.port),
+                            ctx.deadline, f"rpc_connect:{info.endpoint}")
                     except OSError as e:
                         _fail()
                         if mode == "direct":
@@ -673,18 +737,25 @@ class Client:
                                     [{"kind": "part", "ctype": "bin"},
                                      bytes(chunk)])
                             await write_frame(writer, [{"kind": "end"}, None])
-                        first = await fr.read()
+                        first = await dl.wait_for(
+                            fr.read(), ctx.deadline,
+                            f"rpc_first_frame:{info.endpoint}", slack=0.25)
+                        self.breaker.record_success(iid)
                         break
                     except (ConnectionResetError, BrokenPipeError,
                             asyncio.IncompleteReadError) as e:
                         writer.close()
                         if attempt == attempts - 1:
+                            self.breaker.record_failure(iid)
                             raise EngineError(
                                 f"connection to {info.host}:{info.port} "
                                 f"failed: {e}", 503) from e
                         try:
-                            reader, writer = await asyncio.open_connection(
-                                info.host, info.port)
+                            reader, writer = await dl.wait_for(
+                                asyncio.open_connection(
+                                    info.host, info.port),
+                                ctx.deadline,
+                                f"rpc_reconnect:{info.endpoint}")
                         except ConnectionRefusedError as e2:
                             # REFUSED specifically proves the process is
                             # gone (closed listening port) — other OSErrors
@@ -719,6 +790,9 @@ class Client:
                 break
         except BaseException:
             stopper.cancel()
+            w = live["writer"]
+            if w is not None:      # e.g. deadline expiry mid-exchange: the
+                w.close()          # half-used socket must not leak/pool
             tracer.finish(call_span, status="error")
             raise
 
@@ -731,7 +805,20 @@ class Client:
                                       control.get("code", 500))
                 # else: prologue
                 while True:
-                    control, payload = await fr.read()
+                    # inter-frame timeout: a worker that stalls mid-stream
+                    # (or dies without RST) becomes a clean 504, not a hang
+                    try:
+                        control, payload = await dl.wait_for(
+                            fr.read(), ctx.deadline,
+                            f"rpc_stream:{info.endpoint}", slack=0.25)
+                    except (asyncio.IncompleteReadError,
+                            ConnectionResetError) as e:
+                        # worker died mid-stream: a typed 503, never a raw
+                        # transport exception leaking to the frontend
+                        self.breaker.record_failure(iid)
+                        raise EngineError(
+                            f"instance {iid:x} dropped the stream "
+                            f"mid-response: {type(e).__name__}", 503) from e
                     kind = control.get("kind")
                     if kind == "data":
                         if control.get("ctype") == "bin":

@@ -34,10 +34,14 @@ class Context:
     Reference capability: ``AsyncEngineContext`` (lib/runtime/src/engine.rs:71-109).
     """
 
-    __slots__ = ("id", "_stopped", "_killed", "_children")
+    __slots__ = ("id", "deadline", "_stopped", "_killed", "_children")
 
-    def __init__(self, id: Optional[str] = None):
+    def __init__(self, id: Optional[str] = None,
+                 deadline: Optional[float] = None):
         self.id: str = id or uuid.uuid4().hex
+        # absolute wall-clock (time.time()) end-to-end deadline; rides the
+        # wire envelope so every hop can refuse work nobody awaits anymore
+        self.deadline: Optional[float] = deadline
         self._stopped = asyncio.Event()
         self._killed = asyncio.Event()
         self._children: list["Context"] = []
@@ -70,8 +74,9 @@ class Context:
         await self._killed.wait()
 
     def child(self, id: Optional[str] = None) -> "Context":
-        """A linked context: signals on self propagate to the child."""
-        c = Context(id or self.id)
+        """A linked context: signals on self propagate to the child (the
+        deadline is inherited — a sub-call cannot outlive its request)."""
+        c = Context(id or self.id, deadline=self.deadline)
         if self.is_killed:
             c.kill()
         elif self.is_stopped:

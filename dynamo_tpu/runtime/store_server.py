@@ -56,6 +56,10 @@ class _Lease:
     ttl: float
     expires: float
     keys: Set[str] = field(default_factory=set)
+    # owning connection (process liveness binding): when it dies the lease
+    # expires immediately — unless a reconnecting client re-adopts the
+    # lease id first (session re-establishment)
+    owner: Optional["_Conn"] = None
 
 
 @dataclass
@@ -114,7 +118,13 @@ class StoreServer:
         self.host, self.port = host, port
         self._kv: Dict[str, _KeyVal] = {}
         self._leases: Dict[int, _Lease] = {}
-        self._lease_ids = itertools.count(1)
+        # fresh lease ids start at boot wall-clock millis: a RESTARTED
+        # store must never hand out an id a pre-restart client still holds
+        # in its session — that client's reuse-grant would otherwise adopt
+        # the fresh grantee's lease and give it two owners. Monotonic
+        # across restarts as long as boots are >1ms apart and a single
+        # boot grants fewer leases than milliseconds it was down.
+        self._lease_ids = itertools.count(int(time.time() * 1000))
         self._watchers: Dict[int, Tuple[_Conn, int, str]] = {}  # gid -> (conn, wid, prefix)
         self._watch_gids = itertools.count(1)
         self._subs: Dict[str, Dict[int, Tuple[_Conn, int]]] = {}  # subject -> gid -> (conn, sid)
@@ -174,6 +184,7 @@ class StoreServer:
         fr = FrameReader(reader)
         try:
             while True:
+                # unbounded-ok: server op loop; lives as long as the client
                 msg = await fr.read()
                 try:
                     reply = await self._dispatch(conn, msg)
@@ -205,8 +216,13 @@ class StoreServer:
                 self._queue_waiters[qname] = collections.deque(
                     (c, rid) for c, rid in w if c is not conn)
         # leases owned by this connection expire immediately (process death)
+        # — unless a reconnecting client already re-adopted the lease id
+        # (half-open TCP: the new connection can land before the old one's
+        # EOF is observed; adoption transferred ownership away from us)
         for lid in list(conn.leases):
-            await self._expire_lease(lid)
+            lease = self._leases.get(lid)
+            if lease is not None and lease.owner is conn:
+                await self._expire_lease(lid)
 
     # ------------------------------------------------------------------
     async def _dispatch(self, conn: _Conn, m: Dict[str, Any]) -> Optional[Dict]:
@@ -277,8 +293,33 @@ class StoreServer:
     # -- leases ----------------------------------------------------------
     async def _op_lease_grant(self, conn, m):
         ttl = float(m.get("ttl", DEFAULT_TTL))
-        lid = next(self._lease_ids)
-        self._leases[lid] = _Lease(lid, ttl, time.monotonic() + ttl)
+        reuse = m.get("reuse")
+        if reuse is not None:
+            # session re-establishment: a reconnecting client re-grants its
+            # previous lease ID so identity derived from it (worker_id,
+            # endpoint keys) survives a store/connection restart. If the
+            # lease still exists (expiry hasn't caught up, or a half-open
+            # old connection holds it) the new connection ADOPTS it —
+            # etcd-style: leases belong to sessions, not TCP connections.
+            lid = int(reuse)
+            lease = self._leases.get(lid)
+            if lease is not None:
+                old = lease.owner
+                if old is not None and old is not conn:
+                    old.leases.discard(lid)
+                lease.owner = conn
+                lease.ttl = ttl
+                lease.expires = time.monotonic() + ttl
+                conn.leases.add(lid)
+                return {"lease": lid, "ttl": ttl}
+        else:
+            lid = next(self._lease_ids)
+            # a restarted store's counter restarts too: never collide with
+            # ids re-granted by reconnecting clients
+            while lid in self._leases:
+                lid = next(self._lease_ids)
+        self._leases[lid] = _Lease(lid, ttl, time.monotonic() + ttl,
+                                   owner=conn)
         conn.leases.add(lid)
         return {"lease": lid, "ttl": ttl}
 

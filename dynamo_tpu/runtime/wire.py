@@ -77,11 +77,15 @@ class FrameReader:
     async def read(self) -> Any:
         """Read one frame; raises asyncio.IncompleteReadError on EOF."""
         if self._pending_len is None:
+            # unbounded-ok: read() is the framing PRIMITIVE — boundedness
+            # is the caller's contract (deadline.wait_for on request paths,
+            # connection-lifetime rx loops elsewhere)
             hdr = await self._r.readexactly(4)
             (n,) = struct.unpack(">I", hdr)
             if n > MAX_FRAME:
                 raise ValueError(f"frame of {n} bytes exceeds MAX_FRAME")
             self._pending_len = n
+        # unbounded-ok: see header read above — callers bound read()
         body = await self._r.readexactly(self._pending_len)
         self._pending_len = None
         return msgpack.unpackb(body, raw=False)
@@ -89,4 +93,6 @@ class FrameReader:
 
 async def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
     writer.write(pack(obj))
+    # unbounded-ok: drain parks only on TCP backpressure from a live peer;
+    # a dead peer errors it, and request paths carry their own deadline
     await writer.drain()

@@ -4,10 +4,13 @@ token tree.
 ``Worker.execute(main)`` is the process entry used by every long-running
 binary: it installs SIGINT/SIGTERM handlers that cancel the root
 ``CancellationToken``; the app receives the token (and usually hands child
-tokens to its runtimes/endpoints). On cancellation the worker stops taking
-new work, asks in-flight requests to stop, waits up to ``grace`` seconds
-for them to drain, then hard-kills the rest. A second signal skips the
-grace period.
+tokens to its runtimes/endpoints). On cancellation the worker FIRST makes
+itself invisible — endpoint registrations deregister via lease revoke
+(``DistributedRuntime.prepare_drain``) so the watch plane stops routing new
+work here, and queue-pull loops see the ``draining`` flag — then lets
+in-flight streams run to completion for up to ``grace`` seconds
+(``DYN_DRAIN_TIMEOUT``), cooperatively stops any stragglers (short flush
+window), and hard-kills the rest. A second signal skips the grace period.
 
 Reference capability: lib/runtime/src/worker.rs:60-99,182 (Worker::execute
 + ctrl-c → CancellationToken tree) and the ControlMessage Stop/Kill
@@ -82,7 +85,15 @@ class Worker:
         Worker().execute(app)
     """
 
-    def __init__(self, grace: float = 10.0):
+    def __init__(self, grace: Optional[float] = None):
+        if grace is None:
+            # drain budget: how long in-flight streams get to finish after
+            # SIGTERM before the cooperative stop escalates to kill
+            import os
+            try:
+                grace = float(os.environ.get("DYN_DRAIN_TIMEOUT", 10.0))
+            except ValueError:
+                grace = 10.0
         self.grace = grace
         self.token = CancellationToken()
         self._runtimes: List[object] = []
@@ -135,17 +146,36 @@ class Worker:
             cancel_wait.cancel()
 
     async def _shutdown(self, app_task: asyncio.Task) -> None:
-        # 1. stop taking new work + ask in-flight requests to stop
+        # 0. become invisible FIRST: deregister endpoints (lease revoke) so
+        # the watch plane routes new work elsewhere, and flag draining so
+        # queue-pull loops stop taking jobs — all before any stream is
+        # disturbed.
+        for drt in self._runtimes:
+            prepare = getattr(drt, "prepare_drain", None)
+            if prepare is not None:
+                try:
+                    await prepare()
+                except Exception:  # noqa: BLE001 - drain is best-effort
+                    log.exception("prepare_drain failed")
+        # 1. natural drain: being deregistered, no NEW work arrives — let
+        # in-flight streams run to completion within the drain budget
+        # (clients get their full responses, not truncations)
+        def active() -> int:
+            return sum(len(getattr(drt, "_active", {}))
+                       for drt in self._runtimes)
+
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + self.grace
+        while loop.time() < deadline and not self._force and active():
+            await asyncio.sleep(0.05)
+        # 2. budget spent: cooperatively stop the stragglers (engines
+        # flush what they have and end their streams cleanly) and give
+        # them a short flush window
         for drt in self._runtimes:
             for ctx in list(getattr(drt, "_active", {}).values()):
                 ctx.stop_generating()
-        # 2. wait for drain (or the app to exit) within the grace window
-        deadline = asyncio.get_event_loop().time() + self.grace
-        while asyncio.get_event_loop().time() < deadline and not self._force:
-            active = sum(len(getattr(drt, "_active", {}))
-                         for drt in self._runtimes)
-            if active == 0:
-                break
+        flush_deadline = loop.time() + min(1.0, self.grace)
+        while loop.time() < flush_deadline and not self._force and active():
             await asyncio.sleep(0.05)
         # 3. kill whatever is left
         for drt in self._runtimes:

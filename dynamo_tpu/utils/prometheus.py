@@ -300,6 +300,32 @@ class StageMetrics:
             # per-worker label (pid): render_states merges same-component
             # gauges last-write-wins, which would collapse replicas
             ("worker",))
+        # robustness plane (store reconnect / deadlines / circuit breaker):
+        # counted here so they ride the existing publish_stage_metrics →
+        # aggregator merge path with zero new plumbing
+        self.store_reconnects = r.counter(
+            "dyn_store_reconnects_total",
+            "Store reconnect outcomes", ("result",))   # attempt|ok|fail
+        self.lease_regrants = r.counter(
+            "dyn_lease_regrants_total",
+            "Leases re-granted after a store reconnect", ())
+        self.session_replays = r.counter(
+            "dyn_session_replay_total",
+            "Session state replayed on reconnect", ("kind",))
+        self.deadline_expiries = r.counter(
+            "dyn_deadline_expiries_total",
+            "Requests expired at a pipeline stage", ("stage",))
+        self.circuit_state = r.gauge(
+            "dyn_circuit_state",
+            "Per-instance circuit breaker state "
+            "(0=closed 1=half-open 2=open)",
+            # observer label (pid): each client process has its OWN view of
+            # an instance's circuit; merging them last-write-wins would
+            # make the series flap between observers' states
+            ("observer", "instance"))
+        self.faults_injected = r.counter(
+            "dyn_faults_injected_total",
+            "Fault-injection points fired", ("point", "action"))
 
 
 _stage: Optional[StageMetrics] = None

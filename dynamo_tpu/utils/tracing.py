@@ -439,11 +439,16 @@ class StoreSpanSink:
                                      json.dumps(s.to_dict()).encode(),
                                      lease=lease)
                 written += 1
-        except BaseException:
+        except BaseException as e:
             # transient store failure: put the unwritten tail back at the
             # front (original order) so the next flush retries it — the
             # deque's drop-oldest bound still caps memory during an outage
             self._pending.extendleft(reversed(batch[written:]))
+            # a restarted (empty) store no longer knows our no-keepalive
+            # lease: drop it so the next flush re-grants instead of
+            # stalling spans until the ttl/2 rotation
+            if getattr(e, "code", "") in ("lease_not_found", "conn_lost"):
+                self._lease = None
             raise
         return written
 

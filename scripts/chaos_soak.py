@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""Chaos soak: sustained traffic while the store and workers are kill -9'd.
+
+Launches the dynstore and N echo workers as real OS processes, drives
+concurrent request streams through the runtime data plane from this process,
+and meanwhile:
+
+- kill -9's random workers and respawns them (membership churn),
+- kill -9's the store itself and restarts it on the same port
+  (control-plane outage: every client must reconnect and replay its
+  session — leases re-granted, endpoints re-registered, watches diffed).
+
+Every request carries an end-to-end deadline and a hang-detection harness
+above it. The soak PASSES iff:
+
+- zero hung requests: every submitted request reaches a terminal state
+  (stream complete, or a typed error) within its deadline + slack;
+- the success rate stays >= --min-success (default 0.9) — requests caught
+  mid-stream on a killed worker may fail (typed), everything else must
+  route around the churn.
+
+    JAX_PLATFORMS=cpu python scripts/chaos_soak.py [--duration 30]
+
+Exit 0 = pass. CPU-only, no model weights; runnable in CI (the pytest
+wrapper is marked ``chaos`` + ``slow`` and excluded from tier-1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NAMESPACE = "chaos"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Procs:
+    """Store + worker subprocesses, logs tee'd for failure dumps."""
+
+    def __init__(self, logdir: str, store_port: int):
+        self.logdir = logdir
+        self.store_port = store_port
+        self.env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                    "DYNAMO_TPU_DATAPLANE": "python",
+                    "DYN_TOKEN_ECHO_DELAY_MS": "5",
+                    "DYN_STORE_RECONNECT_BASE": "0.05",
+                    "DYN_STORE_RECONNECT_ATTEMPTS": "12"}
+        self.store = None            # (proc, log path)
+        self.workers = {}            # idx -> (proc, log path)
+        self._n = 0
+
+    def _spawn(self, name: str, *argv: str):
+        path = os.path.join(self.logdir, f"{name}.log")
+        log = open(path, "wb")
+        proc = subprocess.Popen([sys.executable, "-m", *argv], cwd=REPO,
+                                env=self.env, stdout=log,
+                                stderr=subprocess.STDOUT)
+        return proc, path
+
+    def start_store(self) -> None:
+        self.store = self._spawn(
+            f"store-{int(time.time() * 1000)}",
+            "dynamo_tpu.runtime.store_server", "--impl", "python",
+            "--host", "127.0.0.1", "--port", str(self.store_port))
+        self._wait_log(self.store[1], "dynstore listening", 20)
+
+    def kill_store(self) -> None:
+        self.store[0].send_signal(signal.SIGKILL)
+        self.store[0].wait()
+
+    def start_worker(self) -> int:
+        idx = self._n
+        self._n += 1
+        self.workers[idx] = self._spawn(
+            f"worker{idx}", "dynamo_tpu.cli.worker", "--engine", "echo",
+            "--store", f"127.0.0.1:{self.store_port}",
+            "--advertise-host", "127.0.0.1", "--namespace", NAMESPACE,
+            "--metrics-interval", "0.5")
+        try:
+            self._wait_log(self.workers[idx][1], "serving", 30,
+                           proc=self.workers[idx][0])
+        except RuntimeError:
+            self.workers.pop(idx, None)
+            raise
+        return idx
+
+    def kill_worker(self, idx: int) -> None:
+        proc, _ = self.workers.pop(idx)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+
+    def _wait_log(self, path: str, needle: str, timeout: float,
+                  proc=None) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with open(path, "rb") as f:
+                if needle.encode() in f.read():
+                    return
+            if proc is not None and proc.poll() is not None:
+                raise RuntimeError(f"{path}: process exited "
+                                   f"rc={proc.returncode} before ready")
+            time.sleep(0.2)
+        raise RuntimeError(f"{path}: {needle!r} not seen in {timeout}s")
+
+    def dump(self, tail: int = 2500) -> None:
+        paths = [self.store[1]] + [p for _, p in self.workers.values()]
+        for path in paths:
+            try:
+                with open(path, "rb") as f:
+                    body = f.read()[-tail:].decode(errors="replace")
+                print(f"\n--- {os.path.basename(path)} ---\n{body}",
+                      flush=True)
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        procs = [self.store[0]] if self.store else []
+        procs += [p for p, _ in self.workers.values()]
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+class Stats:
+    def __init__(self):
+        self.submitted = 0
+        self.ok = 0
+        self.typed_failures = 0
+        self.hung = 0
+        self.failure_kinds = {}
+
+    def fail(self, kind: str) -> None:
+        self.typed_failures += 1
+        self.failure_kinds[kind] = self.failure_kinds.get(kind, 0) + 1
+
+    def summary(self) -> str:
+        total = self.submitted
+        rate = (self.ok / total) if total else 0.0
+        return (f"submitted={total} ok={self.ok} typed_failures="
+                f"{self.typed_failures} hung={self.hung} "
+                f"success={rate:.1%} kinds={self.failure_kinds}")
+
+
+async def soak(duration: float, n_workers: int, concurrency: int,
+               request_deadline: float, min_success: float,
+               store_kills: int, logdir: str) -> Stats:
+    from dynamo_tpu.llm.protocols.common import BackendInput
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.engine import Context, EngineError
+
+    rng = random.Random(11)
+    store_port = _free_port()
+    procs = Procs(logdir, store_port)
+    stats = Stats()
+    procs.start_store()
+    for _ in range(n_workers):
+        procs.start_worker()
+
+    drt = await DistributedRuntime(store_port=store_port,
+                                   advertise_host="127.0.0.1").connect()
+    client = await (drt.namespace(NAMESPACE).component("backend")
+                    .endpoint("generate").client().start())
+    await client.wait_for_instances(n_workers, timeout=30)
+
+    stop_at = time.monotonic() + duration
+    payload = BackendInput(token_ids=list(range(1, 9))).to_dict()
+
+    async def one_request() -> None:
+        stats.submitted += 1
+        ctx = Context(deadline=time.time() + request_deadline)
+
+        async def run():
+            items = []
+            async for item in client.generate(payload, ctx):
+                items.append(item)
+            return items
+
+        try:
+            # hang harness: the deadline layer must fire FIRST; tripping
+            # this outer wait_for means a request failed to reach a
+            # terminal state — the one unforgivable outcome
+            await asyncio.wait_for(run(), request_deadline + 10.0)
+            stats.ok += 1
+        except asyncio.TimeoutError:
+            stats.hung += 1
+        except EngineError as e:
+            stats.fail(f"engine:{e.code}")
+        except Exception as e:  # noqa: BLE001 - typed == not hung
+            stats.fail(type(e).__name__)
+
+    async def traffic() -> None:
+        while time.monotonic() < stop_at:
+            burst = [asyncio.create_task(one_request())
+                     for _ in range(concurrency)]
+            await asyncio.gather(*burst)
+            await asyncio.sleep(0.05)
+
+    async def respawn_worker() -> None:
+        # worker startup is seconds; run it off-thread and retry — a spawn
+        # landing inside a store outage dies at initial connect
+        for _ in range(4):
+            try:
+                idx = await asyncio.to_thread(procs.start_worker)
+                print(f"chaos: spawned worker{idx}", flush=True)
+                return
+            except RuntimeError as e:
+                print(f"chaos: worker spawn failed ({e}); retrying",
+                      flush=True)
+                await asyncio.sleep(1.0)
+
+    async def churn() -> None:
+        # deterministic schedule: 6 evenly spaced chaos events; store
+        # kill -9s at fixed slots, worker kill(+background respawn) at the
+        # rest. Never kills the LAST worker — total extinction measures
+        # respawn latency, not churn-proofness.
+        t0 = time.monotonic()
+        n_events = 6
+        store_slots = {1, 4} if store_kills >= 2 else (
+            {2} if store_kills == 1 else set())
+        respawns = []
+        for i in range(n_events):
+            at = duration * (i + 1) / (n_events + 1)
+            await asyncio.sleep(max(0.0, t0 + at - time.monotonic()))
+            if time.monotonic() >= stop_at:
+                break
+            if i in store_slots:
+                print("chaos: kill -9 store", flush=True)
+                procs.kill_store()
+                await asyncio.sleep(0.4)
+                await asyncio.to_thread(procs.start_store)
+                print("chaos: store restarted", flush=True)
+            elif len(procs.workers) >= 2:
+                victim = rng.choice(list(procs.workers))
+                print(f"chaos: kill -9 worker{victim}", flush=True)
+                procs.kill_worker(victim)
+                respawns.append(asyncio.create_task(respawn_worker()))
+        for t in respawns:
+            await t
+
+    try:
+        await asyncio.gather(traffic(), churn())
+        # settle: the live set must converge to the surviving workers
+        await asyncio.sleep(1.0)
+        live = client.instance_ids()
+        print(f"live instances at end: {len(live)} "
+              f"(worker procs: {len(procs.workers)})", flush=True)
+    finally:
+        try:
+            await drt.close()
+        except Exception:
+            pass
+        ok = (stats.hung == 0 and stats.submitted > 0
+              and stats.ok / max(stats.submitted, 1) >= min_success)
+        if not ok:
+            procs.dump()
+        procs.stop()
+    return stats
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="chaos_soak")
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--workers", type=int, default=3)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--request-deadline", type=float, default=10.0)
+    ap.add_argument("--min-success", type=float, default=0.9)
+    ap.add_argument("--store-kills", type=int, default=2)
+    a = ap.parse_args()
+    logdir = tempfile.mkdtemp(prefix="chaos_soak_")
+    print(f"chaos soak: {a.duration}s, {a.workers} workers, logs {logdir}",
+          flush=True)
+    stats = asyncio.run(soak(a.duration, a.workers, a.concurrency,
+                             a.request_deadline, a.min_success,
+                             a.store_kills, logdir))
+    print(stats.summary(), flush=True)
+    if stats.hung:
+        print(f"FAIL: {stats.hung} hung request(s)", flush=True)
+        return 1
+    if not stats.submitted or stats.ok / stats.submitted < a.min_success:
+        print(f"FAIL: success rate below {a.min_success:.0%}", flush=True)
+        return 1
+    print("PASS: zero hung requests, success rate within bounds",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

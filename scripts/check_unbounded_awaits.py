@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Static check: network awaits in ``dynamo_tpu/runtime/`` must be bounded.
+
+Every ``await`` of a network primitive (``asyncio.open_connection``, frame/
+stream ``read``/``readexactly``, writer ``drain``, queue ``q_pull``) is a
+potential hang: if the peer stalls without closing the socket, the coroutine
+parks forever and the request above it never reaches a terminal state. This
+check walks the runtime layer's ASTs and flags any such await that is
+
+- not wrapped in a ``wait_for`` (``asyncio.wait_for`` or the deadline
+  layer's ``deadline.wait_for``), and
+- not annotated ``# unbounded-ok`` on the await's line or a contiguous
+  comment block directly above it (the annotation asserts the await's
+  lifetime is bounded by something else — e.g. an rx loop that lives
+  exactly as long as its connection and has a loss path).
+
+Runnable standalone (exit 1 on findings) and as a tier-1 test
+(tests/test_churn.py::test_no_unbounded_network_awaits).
+
+    python scripts/check_unbounded_awaits.py [paths...]
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATHS = [os.path.join(REPO, "dynamo_tpu", "runtime")]
+
+# method/function names whose await parks on the network
+NETWORK_CALLS = {"open_connection", "readexactly", "read", "drain",
+                 "q_pull"}
+# enclosing call names that bound the await
+GUARD_CALLS = {"wait_for"}
+ANNOTATION = "unbounded-ok"
+
+
+def _call_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            return f.attr
+        if isinstance(f, ast.Name):
+            return f.id
+    return ""
+
+
+def _annotated(lines: List[str], lineno: int) -> bool:
+    """True when the await's own line, or the contiguous comment block
+    directly above it, carries the ``# unbounded-ok`` annotation."""
+    if ANNOTATION in lines[lineno - 1]:
+        return True
+    i = lineno - 2
+    while i >= 0 and lines[i].strip().startswith("#"):
+        if ANNOTATION in lines[i]:
+            return True
+        i -= 1
+    return False
+
+
+def check_file(path: str) -> List[Tuple[int, str]]:
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    lines = src.splitlines()
+    tree = ast.parse(src, filename=path)
+    # parent links, to detect an enclosing wait_for(...) call
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    findings: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Await):
+            continue
+        name = _call_name(node.value)
+        if name not in NETWORK_CALLS:
+            continue
+        # guarded: some ancestor expression is a wait_for(...) call
+        cur, guarded = node, False
+        while cur in parents:
+            cur = parents[cur]
+            if _call_name(cur) in GUARD_CALLS:
+                guarded = True
+                break
+            if isinstance(cur, (ast.AsyncFunctionDef, ast.FunctionDef)):
+                break
+        if guarded or _annotated(lines, node.lineno):
+            continue
+        findings.append((node.lineno, name))
+    return findings
+
+
+def run(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for root in paths:
+        files = [root] if root.endswith(".py") else [
+            os.path.join(dp, fn) for dp, _, fns in os.walk(root)
+            for fn in sorted(fns) if fn.endswith(".py")]
+        for path in sorted(files):
+            for lineno, name in check_file(path):
+                rel = os.path.relpath(path, REPO)
+                out.append(
+                    f"{rel}:{lineno}: unbounded network await "
+                    f"({name}) — wrap in wait_for()/deadline.wait_for() "
+                    f"or annotate '# unbounded-ok: <why bounded>'")
+    return out
+
+
+def main(argv: List[str]) -> int:
+    findings = run(argv[1:] or DEFAULT_PATHS)
+    for line in findings:
+        print(line)
+    if findings:
+        print(f"\n{len(findings)} unbounded network await(s)")
+        return 1
+    print("ok: no unbounded network awaits")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
