@@ -48,6 +48,12 @@ BUDGET_S = float(os.environ.get("DYNAMO_BENCH_BUDGET", "1500"))
 PARTIAL_PATH = os.environ.get(
     "DYNAMO_BENCH_PARTIAL", os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "BENCH_PARTIAL.json"))
+# Besides the rolling partial, every (model, batch) point gets its OWN
+# artifact file the moment it lands — a later wedge (or a corrupted rolling
+# write) can never take already-measured points with it.
+POINTS_DIR = os.environ.get(
+    "DYNAMO_BENCH_POINTS_DIR", os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "bench_points"))
 
 _PEAK_BF16 = (  # device_kind substring -> peak dense bf16 FLOP/s per chip
     ("v6", 918e12),
@@ -95,6 +101,21 @@ def _flush_partial(payload: dict) -> None:
         with open(tmp, "w") as f:
             json.dump(payload, f)
         os.replace(tmp, PARTIAL_PATH)
+    except Exception:
+        pass
+
+
+def _flush_point(model: str, entry: dict, meta: dict) -> None:
+    """One self-contained JSON artifact per (model, batch) point, carrying
+    the platform tag so even a single surviving point is attributable."""
+    try:
+        os.makedirs(POINTS_DIR, exist_ok=True)
+        batch = entry.get("batch", "x")
+        path = os.path.join(POINTS_DIR, f"{model}_b{batch}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({**meta, "model": model, **entry}, f)
+        os.replace(tmp, path)
     except Exception:
         pass
 
@@ -163,7 +184,7 @@ def _run_model(model_cfg, batches, prompt_len, gen_tokens, max_context,
     def _record(entry):
         sweep.append(entry)
         if flush is not None:
-            flush(n_params, sweep)
+            flush(n_params, sweep, entry)
 
     for b in batches:
         if time.monotonic() > deadline:
@@ -303,6 +324,12 @@ def main() -> None:
             "wall_s": round(time.monotonic() - t_start, 1),
         }
 
+    point_meta = {"platform": platform, "device_kind": dev.device_kind,
+                  "tpu": tpu_status}
+    # an artifact must exist BEFORE the first point: a wedge inside the
+    # first warmup/compile round still leaves a platform-tagged record
+    _flush_partial(assemble(partial=True))
+
     for name, mcfg, batches, plen, gen, ctx in runs:
         if time.monotonic() > deadline:
             sweeps.append({"model": name, "skipped": "time budget"})
@@ -311,10 +338,11 @@ def main() -> None:
                 "results": []}
         sweeps.append(live)
 
-        def flush(n_params, sweep, live=live):
+        def flush(n_params, sweep, entry, live=live, name=name):
             live["n_params"] = n_params
             live["results"] = sweep
             _flush_partial(assemble(partial=True))
+            _flush_point(name, entry, point_meta)
 
         try:
             n_params, sweep = _run_model(mcfg, batches, plen, gen, ctx,

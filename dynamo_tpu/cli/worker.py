@@ -271,7 +271,11 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
             if core is not None:
                 m = ForwardPassMetrics(**core.utilization())
             else:
-                m = ForwardPassMetrics(request_total_slots=64)
+                # echo engine: real in-flight count (the planner's
+                # occupancy signal), capacity from --echo-slots
+                m = ForwardPassMetrics(
+                    request_active_slots=len(drt._active),
+                    request_total_slots=getattr(args, "echo_slots", 64))
             try:
                 await drt.store.put(key, json.dumps(m.to_dict()).encode(),
                                     lease=drt.lease)
@@ -324,6 +328,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--kv-block-size", type=int, default=64)
     p.add_argument("--metrics-interval", type=float, default=1.0)
+    p.add_argument("--echo-slots", type=int, default=64,
+                   help="advertised request slots of the echo engine "
+                        "(its occupancy signal for the planner)")
     p.add_argument("--enable-disagg", action="store_true",
                    help="decode role: remote-prefill long cold prompts")
     p.add_argument("--max-local-prefill-length", type=int, default=1000)

@@ -47,9 +47,12 @@ def load_llama_params(path: str, cfg: LlamaConfig,
     tensors = _open_all(path)
     L, D, Hq, Hkv, Dh = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
                          cfg.num_kv_heads, cfg.head_dim)
-    # Gemma3 VLM checkpoints nest the text model under language_model
+    # Gemma3 VLM checkpoints nest the text model under language_model; the
+    # hub's actual naming is "language_model.model." (transformers <4.52
+    # export), newer exports flatten to "model.language_model."
     pfx = ""
-    for cand in ("model.language_model.", "language_model.", "model."):
+    for cand in ("model.language_model.", "language_model.model.",
+                 "language_model.", "model."):
         if any(k.startswith(cand + "layers.") for k in tensors):
             pfx = cand
             break
@@ -114,8 +117,12 @@ def load_llama_params(path: str, cfg: LlamaConfig,
         params["layers"]["bv"] = np.stack(
             [bias(i, "self_attn.v_proj", Hkv) for i in range(L)])
     if not cfg.tie_embeddings:
-        head = ("lm_head.weight" if "lm_head.weight" in tensors
-                else f"{pfx}lm_head.weight")
+        # the VLM nesting puts lm_head BESIDE the inner model
+        # ("language_model.lm_head.weight"), not under the layer prefix
+        head = next(
+            (k for k in ("lm_head.weight", f"{pfx}lm_head.weight",
+                         pfx.rsplit("model.", 1)[0] + "lm_head.weight")
+             if k in tensors), f"{pfx}lm_head.weight")
         params["lm_head"] = _get(tensors, head).astype(dt).T
 
     from .engine import global_put

@@ -60,6 +60,23 @@ async def publish_stage_metrics(store, namespace: str, component: str,
                     lease=lease)
 
 
+async def fetch_worker_metrics(store, namespace: str, component: str
+                               ) -> Dict[int, "ForwardPassMetrics"]:
+    """One component's live ForwardPassMetrics snapshots, keyed by worker
+    id — the aggregator's scrape unit, shared with the planner's signal
+    collector (which reads the same prefix without a DistributedRuntime)."""
+    prefix = f"{METRICS_PREFIX}{namespace}/{component}/"
+    workers: Dict[int, ForwardPassMetrics] = {}
+    for key, value in await store.get_prefix(prefix):
+        try:
+            wid = int(key.rsplit("/", 1)[1], 16)
+            workers[wid] = ForwardPassMetrics.from_dict(
+                json.loads(value.decode()))
+        except Exception:
+            log.warning("malformed metrics at %s", key)
+    return workers
+
+
 async def fetch_stage_states(store, namespace: Optional[str] = None
                              ) -> List[tuple]:
     """All published stage dumps as ``(component, state_dump)`` pairs, ready
@@ -145,16 +162,8 @@ class ClusterMetricsAggregator:
     # ------------------------------------------------------------------
     async def scrape_once(self) -> None:
         for comp in self.components:
-            prefix = f"{METRICS_PREFIX}{self.namespace}/{comp}/"
-            items = await self.drt.store.get_prefix(prefix)
-            workers: Dict[int, ForwardPassMetrics] = {}
-            for key, value in items:
-                try:
-                    wid = int(key.rsplit("/", 1)[1], 16)
-                    workers[wid] = ForwardPassMetrics.from_dict(
-                        json.loads(value.decode()))
-                except Exception:
-                    log.warning("malformed metrics at %s", key)
+            workers = await fetch_worker_metrics(self.drt.store,
+                                                 self.namespace, comp)
             self.workers[comp] = workers
             self._export(comp, workers)
         self.stage_states = await fetch_stage_states(self.drt.store,

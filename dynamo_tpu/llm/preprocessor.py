@@ -187,13 +187,17 @@ class Preprocessor:
         encode normally; each sentinel becomes [boi] + mm_tokens x
         [image_token_id] + [eoi] from the card's model config."""
         mc = self.card.model_config or {}
-        img_id = mc.get("image_token_id")
+        # hub Gemma3 configs spell these *_index (image_token_index,
+        # boi/eoi_token_index); newer transformers re-exports *_id — accept
+        # both, or every real image request is rejected below
+        img_id = mc.get("image_token_id", mc.get("image_token_index"))
         if img_id is None:
             raise ProtocolError(
                 "this model takes no image input (no image_token_id in "
                 "its config)")
         mm_tokens = int(mc.get("mm_tokens_per_image", 256))
-        boi, eoi = mc.get("boi_token_id"), mc.get("eoi_token_id")
+        boi = mc.get("boi_token_id", mc.get("boi_token_index"))
+        eoi = mc.get("eoi_token_id", mc.get("eoi_token_index"))
         ids: List[int] = []
         pieces = _IMG_SPLIT.split(prompt)
         # split() yields [text, idx, text, idx, ..., text]

@@ -44,6 +44,28 @@ _GEMMA_ARCHS = ("GemmaForCausalLM", "Gemma2ForCausalLM",
 
 _GEMMA_VLM_ARCH = "Gemma3ForConditionalGeneration"
 
+# Gemma3TextConfig defaults (transformers): real hub checkpoints ship sparse
+# text_configs that omit these entirely (e.g. google/gemma-3-4b-it's
+# text_config has no vocab_size) and rely on the class defaults — without
+# them from_hf_config KeyErrors at startup on a real checkpoint.
+_GEMMA3_TEXT_DEFAULTS: Dict[str, Any] = {
+    "vocab_size": 262208,
+    "hidden_size": 2304,
+    "intermediate_size": 9216,
+    "num_hidden_layers": 26,
+    "num_attention_heads": 8,
+    "num_key_value_heads": 4,
+    "head_dim": 256,
+    "rope_theta": 1e6,
+    "rope_local_base_freq": 10000.0,
+    "query_pre_attn_scalar": 256,
+    "max_position_embeddings": 131072,
+    "rms_norm_eps": 1e-6,
+    # omitting sliding_window must NOT read as "no sliding attention":
+    # the class default (4096) keeps layer_sliding() live
+    "sliding_window": 4096,
+}
+
 
 def _is_gemma(cfg: Dict[str, Any]) -> bool:
     archs = cfg.get("architectures", []) or []
@@ -165,8 +187,15 @@ class LlamaConfig:
                 "vision": dict(cfg["vision_config"]),
                 "mm_tokens_per_image": int(cfg.get("mm_tokens_per_image",
                                                    256)),
-                "image_token_id": int(cfg.get("image_token_id", 262144)),
+                # the hub config spells it image_token_index (boi/eoi
+                # likewise); newer transformers re-exports *_id — accept both
+                "image_token_id": int(
+                    cfg.get("image_token_id",
+                            cfg.get("image_token_index", 262144))),
             })
+        if cfg.get("model_type") == "gemma3_text":
+            # sparse real-checkpoint text_config: class defaults fill the gaps
+            cfg = {**_GEMMA3_TEXT_DEFAULTS, **cfg}
         return cls(
             vocab_size=cfg["vocab_size"],
             hidden_size=cfg["hidden_size"],

@@ -1,0 +1,233 @@
+"""What the planner observes: per-pool load/SLA signals from the store.
+
+Everything the decision engine consumes is collapsed into one
+:class:`PoolSignals` snapshot per pool, assembled from planes that already
+exist:
+
+- live replica count      — the endpoint registration prefix (lease-bound,
+  so dead workers vanish with their lease);
+- slot/KV occupancy       — per-worker ForwardPassMetrics snapshots under
+  ``metrics/`` (the aggregator's scrape source, read directly);
+- prefill queue depth     — the shared dynstore work queue's ``q_len``;
+- TTFT / ITL percentiles  — the per-stage latency histograms workers publish
+  under ``metrics_stage/`` (PR 1), merged across processes;
+- circuit-breaker state   — ``dyn_circuit_state`` series in the same dumps
+  (instances any observer currently sees OPEN).
+
+The collector is store-only (no data-plane client, no DistributedRuntime
+needed beyond a StoreClient), so the planner can run anywhere the store is
+reachable — including inside the frontend or as its own binary.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import asdict, dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..llm.disagg import prefill_queue_name
+from ..llm.metrics_aggregator import STAGE_PREFIX, fetch_worker_metrics
+from ..runtime.component import endpoint_prefix
+
+log = logging.getLogger("dynamo_tpu.planner")
+
+
+@dataclass
+class PoolSignals:
+    """One pool's observation snapshot — the decision engine's whole input."""
+
+    pool: str                       # "decode" | "prefill" (or any component)
+    replicas: int = 0               # live registered instances
+    active_slots: float = 0.0       # sum of request_active_slots
+    total_slots: float = 0.0        # sum of request_total_slots
+    queue_depth: float = 0.0        # prefill queue len / requests waiting
+    kv_active: float = 0.0
+    kv_total: float = 0.0
+    ttft_p90: Optional[float] = None
+    itl_p90: Optional[float] = None
+    breaker_open: int = 0           # instances some observer sees OPEN
+    worker_ids: List[int] = field(default_factory=list)
+
+    @property
+    def occupancy(self) -> float:
+        """Batch occupancy 0..1+ (echo/overcommitted engines can exceed 1)."""
+        return self.active_slots / self.total_slots if self.total_slots \
+            else 0.0
+
+    @property
+    def kv_utilization(self) -> float:
+        return self.kv_active / self.kv_total if self.kv_total else 0.0
+
+    @property
+    def healthy_replicas(self) -> int:
+        """Replicas the breaker is not currently vetoing."""
+        return max(self.replicas - self.breaker_open, 0)
+
+    def to_dict(self) -> Dict:
+        d = asdict(self)
+        d["occupancy"] = round(self.occupancy, 4)
+        d["kv_utilization"] = round(self.kv_utilization, 4)
+        return d
+
+
+# ---------------------------------------------------------------------------
+# histogram quantiles over published stage-metric state dumps
+# ---------------------------------------------------------------------------
+def quantile_from_states(states: Iterable[Tuple[str, Dict]], metric: str,
+                         q: float) -> Optional[float]:
+    """Estimate the ``q``-quantile of a histogram metric across every
+    published state dump (all label series merged). Linear interpolation
+    inside the winning bucket, bounded by its edges; None when no samples.
+    """
+    buckets: Optional[List[float]] = None
+    counts: Optional[List[int]] = None
+    total = 0
+    for _component, dump in states:
+        st = dump.get(metric)
+        if not st or st.get("kind") != "histogram":
+            continue
+        b = list(st.get("buckets") or ())
+        if buckets is None:
+            buckets, counts = b, [0] * len(b)
+        elif b != buckets:
+            continue    # mixed bucket layouts: skip rather than lie
+        for series in st.get("series", {}).values():
+            c = series.get("counts") or []
+            for i in range(min(len(c), len(counts))):
+                counts[i] += c[i]
+            total += int(series.get("total", 0))
+    if not total or buckets is None:
+        return None
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= rank:
+            lo = buckets[i - 1] if i else 0.0
+            hi = buckets[i]
+            frac = (rank - (cum - c)) / c if c else 1.0
+            return lo + (hi - lo) * frac
+    # rank landed in +Inf (observations above the last bucket): the last
+    # edge is the honest lower bound
+    return buckets[-1]
+
+
+def breaker_open_instances(states: Iterable[Tuple[str, Dict]],
+                           worker_ids: Iterable[int]) -> int:
+    """Instances in ``worker_ids`` that at least one observer's exported
+    ``dyn_circuit_state`` series currently marks OPEN (value 2)."""
+    ids = {f"{w:x}" for w in worker_ids}
+    open_ids = set()
+    for _component, dump in states:
+        st = dump.get("dyn_circuit_state")
+        if not st or st.get("kind") != "gauge":
+            continue
+        labels = list(st.get("labels") or ())
+        try:
+            pos = labels.index("instance")
+        except ValueError:
+            continue
+        for skey, val in st.get("series", {}).items():
+            parts = skey.split("\x1f")
+            if len(parts) > pos and parts[pos] in ids and val == 2:
+                open_ids.add(parts[pos])
+    return len(open_ids)
+
+
+class SignalCollector:
+    """Assembles :class:`PoolSignals` for each configured pool from one
+    round of store reads. ``pools`` maps a pool name to the component whose
+    workers make it up (e.g. ``{"decode": "backend", "prefill": "prefill"}``).
+    """
+
+    def __init__(self, store, namespace: str, pools: Dict[str, str],
+                 endpoint: str = "generate"):
+        self.store = store
+        self.namespace = namespace
+        self.pools = dict(pools)
+        self.endpoint = endpoint
+
+    async def live_instances(self, component: str,
+                             known: Iterable[int] = ()) -> List[int]:
+        """Live worker ids of one component: endpoint registrations
+        (decode-shaped workers) unioned with ``known`` — ids the caller
+        already holds from the lease-bound metrics and stage-metrics
+        planes. Queue-pull prefill workers register no endpoint at all, so
+        counting endpoints alone would read the prefill pool as permanently
+        empty (never scaled down, spurious scale-ups forever)."""
+        ids = set(known)
+        prefix = endpoint_prefix(self.namespace, component, self.endpoint)
+        for key, _value in await self.store.get_prefix(prefix):
+            try:
+                ids.add(int(key.rsplit(":", 1)[1], 16))
+            except ValueError:
+                log.warning("malformed endpoint key %s", key)
+        return sorted(ids)
+
+    async def _fetch_stage(self) -> Tuple[List[Tuple[str, Dict]],
+                                          Dict[str, Set[int]]]:
+        """One scan of the namespace's stage-metrics prefix yielding BOTH
+        the ``(component, state_dump)`` pairs (quantiles, breaker state)
+        and the per-component worker-id sets (liveness) — the dumps are
+        multi-KB, so fetching them once per tick instead of 1+P times
+        matters on a standing daemon."""
+        states: List[Tuple[str, Dict]] = []
+        ids: Dict[str, Set[int]] = {}
+        prefix = f"{STAGE_PREFIX}{self.namespace}/"
+        for key, value in await self.store.get_prefix(prefix):
+            comp, _, widhex = key[len(prefix):].partition("/")
+            try:
+                ids.setdefault(comp, set()).add(int(widhex, 16))
+            except ValueError:
+                log.warning("malformed stage key %s", key)
+                continue
+            try:
+                d = json.loads(value.decode())
+                states.append((d.get("component") or comp, d["metrics"]))
+            except Exception:
+                log.warning("malformed stage metrics at %s", key)
+        return states, ids
+
+    async def collect(self) -> Dict[str, PoolSignals]:
+        stage_states, stage_ids = await self._fetch_stage()
+        try:
+            prefill_q = await self.store.q_len(
+                prefill_queue_name(self.namespace))
+        except Exception:  # noqa: BLE001 - queue plane optional
+            prefill_q = 0
+        out: Dict[str, PoolSignals] = {}
+        for pool, component in self.pools.items():
+            workers = await fetch_worker_metrics(self.store, self.namespace,
+                                                 component)
+            ids = await self.live_instances(
+                component,
+                known=set(workers) | stage_ids.get(component, set()))
+            s = PoolSignals(pool=pool, replicas=len(ids), worker_ids=ids)
+            for m in workers.values():
+                s.active_slots += m.request_active_slots
+                s.total_slots += m.request_total_slots
+                s.kv_active += m.kv_active_blocks
+                s.kv_total += m.kv_total_blocks
+                s.queue_depth += m.num_requests_waiting
+            if pool == "prefill":
+                # the shared remote-prefill queue is THE prefill backlog.
+                # TTFT/ITL are end-to-end serving SLOs recorded by the
+                # frontend/decode side — attributing them to the prefill
+                # pool would ratchet prefill replicas up for a latency
+                # problem more prefill workers cannot fix; its SLA lever
+                # is the queue depth above.
+                s.queue_depth += prefill_q
+            else:
+                s.ttft_p90 = quantile_from_states(
+                    stage_states, "llm_ttft_seconds", 0.90)
+                s.itl_p90 = quantile_from_states(
+                    stage_states, "llm_inter_token_seconds", 0.90)
+            s.breaker_open = breaker_open_instances(stage_states, ids)
+            out[pool] = s
+        return out
+
+
+def fake_signals(pool: str, **kw) -> PoolSignals:
+    """Test/chaos helper: a PoolSignals with keyword overrides."""
+    return PoolSignals(pool=pool, **kw)

@@ -93,7 +93,7 @@ class Procs:
             f"worker{idx}", "dynamo_tpu.cli.worker", "--engine", "echo",
             "--store", f"127.0.0.1:{self.store_port}",
             "--advertise-host", "127.0.0.1", "--namespace", NAMESPACE,
-            "--metrics-interval", "0.5")
+            "--metrics-interval", "0.5", "--echo-slots", "4")
         try:
             self._wait_log(self.workers[idx][1], "serving", 30,
                            proc=self.workers[idx][0])
@@ -151,6 +151,7 @@ class Stats:
         self.typed_failures = 0
         self.hung = 0
         self.failure_kinds = {}
+        self.planner_scale_ups = None   # set by the --planner scenario
 
     def fail(self, kind: str) -> None:
         self.typed_failures += 1
@@ -166,7 +167,8 @@ class Stats:
 
 async def soak(duration: float, n_workers: int, concurrency: int,
                request_deadline: float, min_success: float,
-               store_kills: int, logdir: str) -> Stats:
+               store_kills: int, logdir: str,
+               planner: bool = False) -> Stats:
     from dynamo_tpu.llm.protocols.common import BackendInput
     from dynamo_tpu.runtime.component import DistributedRuntime
     from dynamo_tpu.runtime.engine import Context, EngineError
@@ -185,16 +187,44 @@ async def soak(duration: float, n_workers: int, concurrency: int,
                     .endpoint("generate").client().start())
     await client.wait_for_instances(n_workers, timeout=30)
 
+    plan = None
+    if planner:
+        # planner-enabled scenario: the autoscaler rides the SAME churn —
+        # local connector spawning real echo workers, a mid-run load surge
+        # that must scale the pool up, graceful drain back down after
+        from dynamo_tpu.planner.connectors import LocalConnector, PoolSpec
+        from dynamo_tpu.planner.loop import Planner, PlannerConfig
+        from dynamo_tpu.planner.policy import LoadPolicy
+
+        connector = LocalConnector(
+            f"127.0.0.1:{store_port}", NAMESPACE,
+            {"decode": PoolSpec(component="backend", engine="echo",
+                                extra_args=["--echo-slots", "4"],
+                                env=dict(procs.env))},
+            platform="cpu", logdir=logdir)
+        plan = await Planner(
+            drt, NAMESPACE, {"decode": "backend"}, LoadPolicy(),
+            connector,
+            PlannerConfig(interval=1.0, min_replicas=1,
+                          max_replicas=n_workers + 3, cooldown_up=3.0,
+                          cooldown_down=8.0, down_consensus=2)).start()
+        print("chaos: planner enabled (local connector)", flush=True)
+
     stop_at = time.monotonic() + duration
     payload = BackendInput(token_ids=list(range(1, 9))).to_dict()
+    # the surge payload holds a slot ~8x longer, saturating occupancy
+    surge_payload = BackendInput(token_ids=list(range(1, 65))).to_dict()
+    surge_window = (duration / 3.0, 2.0 * duration / 3.0) if planner \
+        else None
+    t_start = time.monotonic()
 
-    async def one_request() -> None:
+    async def one_request(req=None) -> None:
         stats.submitted += 1
         ctx = Context(deadline=time.time() + request_deadline)
 
         async def run():
             items = []
-            async for item in client.generate(payload, ctx):
+            async for item in client.generate(req or payload, ctx):
                 items.append(item)
             return items
 
@@ -213,8 +243,13 @@ async def soak(duration: float, n_workers: int, concurrency: int,
 
     async def traffic() -> None:
         while time.monotonic() < stop_at:
-            burst = [asyncio.create_task(one_request())
-                     for _ in range(concurrency)]
+            n, req = concurrency, None
+            if surge_window is not None:
+                t = time.monotonic() - t_start
+                if surge_window[0] <= t < surge_window[1]:
+                    n, req = concurrency * 4, surge_payload
+            burst = [asyncio.create_task(one_request(req))
+                     for _ in range(n)]
             await asyncio.gather(*burst)
             await asyncio.sleep(0.05)
 
@@ -267,7 +302,20 @@ async def soak(duration: float, n_workers: int, concurrency: int,
         live = client.instance_ids()
         print(f"live instances at end: {len(live)} "
               f"(worker procs: {len(procs.workers)})", flush=True)
+        if plan is not None:
+            ups = sum(1 for d in plan.decisions_log
+                      if d.action == "scale_up")
+            downs = sum(1 for d in plan.decisions_log
+                        if d.action == "scale_down")
+            stats.planner_scale_ups = ups
+            print(f"planner: {len(plan.decisions_log)} decisions, "
+                  f"{ups} scale_up, {downs} scale_down", flush=True)
     finally:
+        if plan is not None:
+            try:
+                await plan.stop()   # drains planner-spawned workers
+            except Exception:
+                pass
         try:
             await drt.close()
         except Exception:
@@ -288,19 +336,26 @@ def main() -> int:
     ap.add_argument("--request-deadline", type=float, default=10.0)
     ap.add_argument("--min-success", type=float, default=0.9)
     ap.add_argument("--store-kills", type=int, default=2)
+    ap.add_argument("--planner", action="store_true",
+                    help="run the SLA planner (local connector) under a "
+                         "mid-run load surge; the pool must scale up")
     a = ap.parse_args()
     logdir = tempfile.mkdtemp(prefix="chaos_soak_")
-    print(f"chaos soak: {a.duration}s, {a.workers} workers, logs {logdir}",
-          flush=True)
+    print(f"chaos soak: {a.duration}s, {a.workers} workers, logs {logdir}"
+          + (" [planner]" if a.planner else ""), flush=True)
     stats = asyncio.run(soak(a.duration, a.workers, a.concurrency,
                              a.request_deadline, a.min_success,
-                             a.store_kills, logdir))
+                             a.store_kills, logdir, planner=a.planner))
     print(stats.summary(), flush=True)
     if stats.hung:
         print(f"FAIL: {stats.hung} hung request(s)", flush=True)
         return 1
     if not stats.submitted or stats.ok / stats.submitted < a.min_success:
         print(f"FAIL: success rate below {a.min_success:.0%}", flush=True)
+        return 1
+    if a.planner and not stats.planner_scale_ups:
+        print("FAIL: planner never scaled the pool up under the surge",
+              flush=True)
         return 1
     print("PASS: zero hung requests, success rate within bounds",
           flush=True)

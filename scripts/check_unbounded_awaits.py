@@ -28,7 +28,10 @@ import sys
 from typing import List, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DEFAULT_PATHS = [os.path.join(REPO, "dynamo_tpu", "runtime")]
+# the planner is a standing control loop over the same store primitives —
+# an unbounded await there parks the whole autoscaler, so it is gated too
+DEFAULT_PATHS = [os.path.join(REPO, "dynamo_tpu", "runtime"),
+                 os.path.join(REPO, "dynamo_tpu", "planner")]
 
 # method/function names whose await parks on the network
 NETWORK_CALLS = {"open_connection", "readexactly", "read", "drain",

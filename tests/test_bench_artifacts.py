@@ -1,0 +1,48 @@
+"""Bench artifact durability: every (model, batch) point leaves its own
+platform-tagged JSON file the moment it lands, and the rolling partial is
+written atomically — a mid-run tunnel wedge can no longer erase a TPU
+window's only measurements (the round-4 failure mode)."""
+
+import json
+import os
+
+
+def test_flush_point_writes_one_artifact_per_point(tmp_path, monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "POINTS_DIR", str(tmp_path / "points"))
+    meta = {"platform": "tpu", "device_kind": "TPU v5e", "tpu": "ok"}
+    bench._flush_point("llama-3.2-1b", {"batch": 8, "decode_tok_s": 123.4},
+                       meta)
+    bench._flush_point("llama-3.2-1b", {"batch": 32, "decode_tok_s": 99.0},
+                       meta)
+    files = sorted(os.listdir(tmp_path / "points"))
+    assert files == ["llama-3.2-1b_b32.json", "llama-3.2-1b_b8.json"]
+    d = json.load(open(tmp_path / "points" / "llama-3.2-1b_b8.json"))
+    assert d["platform"] == "tpu" and d["model"] == "llama-3.2-1b"
+    assert d["batch"] == 8 and d["decode_tok_s"] == 123.4
+    # a later flush of the same point overwrites atomically, not appends
+    bench._flush_point("llama-3.2-1b", {"batch": 8, "decode_tok_s": 200.0},
+                       meta)
+    d = json.load(open(tmp_path / "points" / "llama-3.2-1b_b8.json"))
+    assert d["decode_tok_s"] == 200.0
+
+
+def test_flush_point_never_raises(tmp_path, monkeypatch):
+    import bench
+
+    # an unwritable points dir loses the hedge, not the run
+    monkeypatch.setattr(bench, "POINTS_DIR",
+                        str(tmp_path / "nope" / "\0bad"))
+    bench._flush_point("m", {"batch": 1}, {"platform": "cpu"})
+
+
+def test_flush_partial_atomic(tmp_path, monkeypatch):
+    import bench
+
+    path = str(tmp_path / "BENCH_PARTIAL.json")
+    monkeypatch.setattr(bench, "PARTIAL_PATH", path)
+    bench._flush_partial({"partial": True, "platform": "tpu"})
+    d = json.load(open(path))
+    assert d["partial"] is True and d["platform"] == "tpu"
+    assert not os.path.exists(path + ".tmp")

@@ -36,6 +36,65 @@ def test_safetensors_roundtrip(tmp_path):
     jax.tree.map(close, params, loaded)
 
 
+def test_vlm_nested_prefix_load(tmp_path):
+    """Real Gemma3 VLM checkpoints nest the text stack as
+    ``language_model.model.layers...`` with ``language_model.lm_head`` —
+    the hub's actual naming. The loader must resolve that prefix (and the
+    other known layouts) to identical params."""
+    from safetensors.numpy import save_file
+
+    from dynamo_tpu.engine.loader import load_llama_params, save_llama_params
+    from dynamo_tpu.parallel.mesh import tp_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = llama.preset("tiny-byte", tie_embeddings=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(7))
+    flat = str(tmp_path / "flat")
+    save_llama_params(flat, params, cfg)
+
+    mesh = tp_mesh(1)
+    sh = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                      llama.param_specs(cfg),
+                      is_leaf=lambda x: isinstance(x, P))
+    want = load_llama_params(flat, cfg, sh)
+
+    # rewrite with each nested VLM naming; every layout must load the same
+    from safetensors import safe_open
+
+    with safe_open(str(tmp_path / "flat" / "model.safetensors"),
+                   framework="numpy") as f:
+        tensors = {k: f.get_tensor(k) for k in f.keys()}
+
+    def renamed(prefix_map):
+        out = {}
+        for k, v in tensors.items():
+            for old, new in prefix_map:
+                if k.startswith(old):
+                    out[new + k[len(old):]] = v
+                    break
+            else:
+                out[k] = v
+        return out
+
+    layouts = {
+        # transformers <4.52 hub export
+        "hub": [("model.", "language_model.model."),
+                ("lm_head.weight", "language_model.lm_head.weight")],
+        # newer flattened export
+        "flat2": [("model.", "model.language_model."),
+                  ("lm_head.weight", "lm_head.weight")],
+    }
+    for name, pm in layouts.items():
+        d = tmp_path / name
+        d.mkdir()
+        save_file(renamed(pm), str(d / "model.safetensors"))
+        got = load_llama_params(str(d), cfg, sh)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a, np.float32), np.asarray(b, np.float32)),
+            want, got)
+
+
 def test_model_card_from_model_dir(tmp_path):
     """A saved model dir with config.json loads into a working engine config."""
     from dynamo_tpu.engine.engine import JaxEngineConfig

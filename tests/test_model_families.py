@@ -756,6 +756,59 @@ def test_gemma3_vlm_flat_config_rejected():
             "intermediate_size": 128})
 
 
+def test_gemma3_vlm_sparse_text_config_real_hub_shape():
+    """The REAL hub config shape (google/gemma-3-4b-it): text_config is
+    sparse (no vocab_size / heads / head_dim / rope_theta — transformers
+    class defaults fill them), mm wiring uses the *_index spellings. Must
+    load without KeyError and land the Gemma3TextConfig defaults."""
+    cfg = llama.LlamaConfig.from_hf_config({
+        "architectures": ["Gemma3ForConditionalGeneration"],
+        "model_type": "gemma3",
+        "boi_token_index": 255999,
+        "eoi_token_index": 256000,
+        "image_token_index": 262144,
+        "mm_tokens_per_image": 256,
+        "text_config": {
+            "model_type": "gemma3_text",
+            "hidden_size": 2560,
+            "intermediate_size": 10240,
+            "num_hidden_layers": 34,
+            "sliding_window": 1024,
+            "rope_scaling": {"rope_type": "linear", "factor": 8.0},
+        },
+        "vision_config": {
+            "model_type": "siglip_vision_model",
+            "hidden_size": 1152, "image_size": 896, "patch_size": 14,
+        },
+    })
+    # Gemma3TextConfig defaults applied via model_type, not KeyError'd
+    assert cfg.vocab_size == 262208
+    assert cfg.num_heads == 8 and cfg.num_kv_heads == 4
+    assert cfg.head_dim == 256
+    assert cfg.rope_theta == 1e6
+    assert cfg.query_pre_attn_scalar == 256
+    assert cfg.max_position == 131072
+    assert cfg.rms_eps == 1e-6
+    # explicit values still win over the defaults
+    assert cfg.hidden_size == 2560 and cfg.num_layers == 34
+    # gemma3 family knobs fired off the restored architecture marker
+    assert cfg.qk_norm and cfg.sliding_pattern == 6
+    # image_token_index (the hub spelling) reached image_token_id
+    assert cfg.image_token_id == 262144
+    assert cfg.vision is not None and cfg.mm_tokens_per_image == 256
+    # a text_config that ALSO omits sliding_window/hidden_size still maps,
+    # with sliding attention alive at the class-default window (a None
+    # window would silently disable sliding layers -> wrong logits)
+    cfg2 = llama.LlamaConfig.from_hf_config({
+        "architectures": ["Gemma3ForConditionalGeneration"],
+        "text_config": {"model_type": "gemma3_text"},
+        "vision_config": {"model_type": "siglip_vision_model"},
+    })
+    assert cfg2.sliding_window == 4096 and cfg2.hidden_size == 2304
+    assert cfg2.num_layers == 26 and cfg2.rope_local_theta == 10000.0
+    assert cfg2.layer_sliding(0) and not cfg2.layer_sliding(5)
+
+
 def test_gemma3_vlm_matches_hf():
     """Full Gemma3 VLM stack parity vs HF Gemma3ForConditionalGeneration:
     SigLIP tower + avg-pool/RMS/project projector + soft-token injection

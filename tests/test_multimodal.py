@@ -197,6 +197,49 @@ def test_preprocessor_image_parts_to_backend_input():
     assert err is None and len(toks) == 4
 
 
+def test_preprocessor_accepts_real_hf_index_spellings():
+    """A real Gemma3 hub config.json spells the mm wiring image_token_index
+    / boi_token_index / eoi_token_index (not *_id): the preprocessor must
+    accept those names, or every real image request is rejected as 'this
+    model takes no image input'."""
+    import base64
+    import io
+
+    from PIL import Image
+
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import Preprocessor
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+
+    card = ModelDeploymentCard.synthetic(name="vlm-hub", model_config={
+        "image_token_index": IMG, "mm_tokens_per_image": MM_TOK,
+        "boi_token_index": 248, "eoi_token_index": 249})
+    pre = Preprocessor(card)
+    buf = io.BytesIO()
+    Image.new("RGB", (16, 16)).save(buf, format="PNG")
+    b64 = base64.b64encode(buf.getvalue()).decode()
+    req = ChatCompletionRequest.from_dict({
+        "model": "vlm-hub",
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "look: "},
+            {"type": "image_url",
+             "image_url": {"url": f"data:image/png;base64,{b64}"}},
+        ]}],
+        "max_tokens": 2,
+    })
+    ids = pre.preprocess_chat(req).backend_input.token_ids
+    k = ids.index(248)
+    assert ids[k:k + MM_TOK + 2] == [248] + [IMG] * MM_TOK + [249]
+    # the *_id spellings still win when both are present
+    both = ModelDeploymentCard.synthetic(name="vlm-both", model_config={
+        "image_token_id": IMG, "image_token_index": IMG + 1,
+        "mm_tokens_per_image": MM_TOK,
+        "boi_token_id": 248, "boi_token_index": 247,
+        "eoi_token_id": 249, "eoi_token_index": 246})
+    ids2 = Preprocessor(both).preprocess_chat(req).backend_input.token_ids
+    assert ids2.count(IMG) == MM_TOK and ids2.count(IMG + 1) == 0
+
+
 def test_preprocessor_image_on_text_model_is_protocol_error():
     from dynamo_tpu.llm.model_card import ModelDeploymentCard
     from dynamo_tpu.llm.preprocessor import Preprocessor
