@@ -213,7 +213,9 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
             host = core.tiered
             prefix_hit = core.pool.probe_prefix(
                 bi.token_ids, (lambda h: h in host) if host else None,
-                lora_id=bi.lora_id)
+                # kv_salt: the salted chain VLM blocks are actually stored
+                # under (falls back to lora_id for text-only requests)
+                lora_id=bi.kv_salt or bi.lora_id)
             remote = False
             if drouter.length_exceeds_local(len(bi.token_ids), prefix_hit):
                 # only candidates pay the queue-depth RPC

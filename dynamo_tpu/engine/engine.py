@@ -110,6 +110,12 @@ class JaxEngineConfig:
     host_cache_blocks: int = 0          # host-DRAM KV tier capacity (0 = off)
     disk_cache_blocks: int = 0          # mmap spill tier capacity (0 = off)
     disk_cache_path: Optional[str] = None
+    # speculative decoding (engine/spec.py). None => consult the DYN_SPEC*
+    # env knobs; "" / "off" force-disables regardless of env. Off by
+    # default: zero extra compiled programs, decode path untouched.
+    spec: Optional[str] = None          # "ngram" | "draft" | "off"/None
+    spec_k: Optional[int] = None        # max drafts/lane (None => DYN_SPEC_K)
+    spec_draft: Optional[str] = None    # draft preset/dir (None => env)
 
     @classmethod
     def from_card(cls, card: ModelDeploymentCard, tensor_parallel: int = 1,
@@ -205,9 +211,21 @@ class EngineCore:
 
         self.stage = stage_metrics()   # cached: observe() runs per harvest
         self.page_size = cfg.page_size
+        # speculative decoding: resolved up front because the page-pad and
+        # bucket sizing below must cover the verify program's k+1 positions
+        from .spec import resolve_spec
+        self.spec = resolve_spec(cfg)
+        if self.spec is not None and cfg.pp > 1:
+            raise ValueError("speculative decoding does not compose with "
+                             "pp > 1 yet (the staged decode path takes no "
+                             "multi-position verify inputs)")
         # every sequence may overshoot up to 2*decode_steps speculative
-        # tokens: one dispatch in flight plus one chained behind it
-        self._spec_pad = -(-2 * cfg.decode_steps // cfg.page_size) * cfg.page_size
+        # tokens (one dispatch in flight plus one chained behind it) — or,
+        # under spec decode, k_max drafts + 1 bonus token per verify round
+        overshoot = 2 * cfg.decode_steps
+        if self.spec is not None:
+            overshoot = max(overshoot, self.spec.k_max + 1)
+        self._spec_pad = -(-overshoot // cfg.page_size) * cfg.page_size
         # ceil: a seq at max_context with the speculative pad must always fit
         self.max_pages_per_seq = -(-(cfg.max_context + self._spec_pad)
                                    // cfg.page_size)
@@ -423,6 +441,18 @@ class EngineCore:
         self.b_buckets = _buckets(1, max(1, min(lanes, cfg.max_batch)))
         self._decode_fns: Dict[int, Any] = {}
         self._prefill_batch_fns: Dict[Tuple[int, int, int], Any] = {}
+        # verify programs, keyed (S, K): compiled lazily, and ONLY when spec
+        # decoding is enabled — spec off costs zero extra programs
+        self._verify_fns: Dict[Tuple[int, int], Any] = {}
+        self.proposer = None
+        self._spec_states: Dict[str, Any] = {}
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
+        self.spec_dispatch_total = 0
+        if self.spec is not None:
+            from .spec import build_proposer
+            self.proposer = build_proposer(self.spec, cfg, self.s_buckets,
+                                           self.c_buckets)
 
         # --- in-flight decode dispatches (device-chained) -------------
         # Each record is a dispatch whose results have not been fetched yet.
@@ -480,6 +510,19 @@ class EngineCore:
                 s.temperature, s.top_p, s.top_k, key2,
                 self.gen_counts, fresh, act, s.freq_pen, s.pres_pen)
             n += 2
+            if self.spec is not None:
+                # spec enabled: also pre-compile every (S, K-bucket) verify
+                # program (spec off compiles zero of these)
+                U = self.spec.k_max + 1
+                for K in self.spec.k_buckets:
+                    vfn = self._verify_fn(S, K)
+                    (_, _, self.k_pool, self.v_pool, self.gen_counts) = vfn(
+                        self.params, np.zeros((B, K + 1), np.int32),
+                        self.k_pool, self.v_pool, pt, ones,
+                        s.temperature, s.top_p, s.top_k, s.key,
+                        self.gen_counts, fresh, act, s.freq_pen, s.pres_pen,
+                        np.zeros((B, U), np.int32), np.zeros((B, U), bool))
+                    n += 1
         for Bp in self.b_buckets:
             for C in self.c_buckets:
                 for S in self.s_buckets:
@@ -495,6 +538,8 @@ class EngineCore:
                         np.ones(Bp, np.float32), np.zeros(Bp, np.int32),
                         keys)
                     n += 1
+        if self.proposer is not None:
+            n += self.proposer.warmup()   # draft model's own bucket set
         jax.block_until_ready(self.k_pool)
         log.info("warmup compiled %d bucket programs in %.1fs",
                  n, time.monotonic() - t0)
@@ -629,6 +674,70 @@ class EngineCore:
             self._prefill_batch_fns[(Bp, C, S, mm)] = fn
         return self._prefill_batch_fns[(Bp, C, S, mm)]
 
+    def _verify_fn(self, S: int, K: int):
+        """Speculative-decoding verify program: ONE forward over K+1
+        positions per lane against the paged pool (the prefill machinery —
+        device-computed write/read indices off the page tables — at decode
+        membership), then in-program verify sampling. Column 0 of
+        ``tokens`` is each lane's last committed token (whose KV this
+        dispatch writes, exactly like single-token decode); columns 1..K
+        are draft tokens. The host accepts/rejects afterwards; rejected
+        tokens are never accounted, so their stale KV slots are overwritten
+        by the next dispatch (the standard decode write-then-read
+        contract). ``upd_tok``/``upd_mask`` fold the PREVIOUS round's
+        committed tokens into the penalty counts; ``fresh`` lanes restart
+        their counts first (same mechanic as the decode scan)."""
+        if (S, K) not in self._verify_fns:
+            from .sampling import spec_verify
+
+            cfg = self.cfg
+            impl = "flash" if self.decode_attn_impl == "pallas" else "xla"
+            mesh = self.mesh
+            rep, kv = self._rep_sharding, self.kv_sharding
+            B = cfg.max_batch
+            T = K + 1
+            page = self.page_size
+
+            # upd_tok/upd_mask width is k_max+1 (the most one round can
+            # commit), NOT T: a lane can emit more tokens under a wide
+            # bucket than the next round's narrower bucket could carry
+            @partial(jax.jit, donate_argnums=(2, 3, 10),
+                     out_shardings=(rep, rep, kv, kv, rep))
+            def fn(params, tokens, k_pool, v_pool, page_tables, lengths,
+                   temp, top_p, top_k, key, counts, fresh, active,
+                   freq_pen, pres_pen, upd_tok, upd_mask):
+                lane = jnp.arange(B)
+                counts = jnp.where(fresh[:, None],
+                                   jnp.zeros_like(counts), counts)
+                counts = counts.at[lane[:, None], upd_tok].add(
+                    (upd_mask & active[:, None]).astype(jnp.int32))
+                pos = (lengths - 1)[:, None] + jnp.arange(T)[None, :]
+                write_idx = (jnp.take_along_axis(page_tables, pos // page,
+                                                 axis=1) * page + pos % page)
+                t = jnp.arange(S, dtype=jnp.int32)
+                rp = jnp.take_along_axis(
+                    page_tables,
+                    jnp.broadcast_to((t // page)[None], (B, S)), axis=1)
+                read_idx = rp * page + (t % page)[None]
+                read_pos = jnp.broadcast_to(t[None], (B, S))
+                # causality (read_pos <= position) masks the not-yet-written
+                # tail per query; validity only needs the max coverage
+                read_valid = t[None] < (lengths[:, None] + K)
+                logits, k_pool, v_pool = llama.forward(
+                    params, cfg.model, tokens, pos, k_pool, v_pool,
+                    write_idx, read_idx, read_pos, read_valid,
+                    attn_impl=impl, mesh=mesh)          # [B, T, V]
+                cf = counts.astype(jnp.float32)[:, None, :]
+                lg = (logits - freq_pen[:, None, None] * cf
+                      - pres_pen[:, None, None]
+                      * (cf > 0).astype(jnp.float32))
+                packed, new_key = spec_verify(lg, tokens[:, 1:], temp,
+                                              top_p, top_k, key)
+                return packed, new_key, k_pool, v_pool, counts
+
+            self._verify_fns[(S, K)] = fn
+        return self._verify_fns[(S, K)]
+
     @staticmethod
     def _bucket(n: int, buckets: List[int]) -> int:
         for b in buckets:
@@ -669,6 +778,12 @@ class EngineCore:
             "kv_total_blocks": float(total),
             "num_requests_waiting": float(len(self.waiting)),
             "gpu_prefix_cache_hit_rate": hit_rate,
+            # speculative decoding: drafted-token acceptance rate (0 when
+            # spec is off or nothing proposed yet) — surfaced through
+            # ForwardPassMetrics so the planner/router/tracectl can see it
+            "spec_accept_rate": (
+                self.spec_accepted_total / self.spec_proposed_total
+                if self.spec_proposed_total else 0.0),
         }
 
     # ------------------------------------------------------------------
@@ -823,6 +938,17 @@ class EngineCore:
         admit_possible = bool(self.waiting) and None in self.slots
         sync_needed = prefill_work or admit_possible or n_reaped > 0
 
+        if self.spec is not None:
+            # speculative mode is synchronous per round (acceptance needs
+            # the fetch), so there is never an in-flight decode window
+            self._apply_deferred_release()
+            if prefill_work or admit_possible:
+                self._prefill_round(out)
+            if any(s is not None and s.prefill_done >= len(s.prompt)
+                   for s in self.slots):
+                self._spec_round(out)
+            return out
+
         if self._inflight:
             if not sync_needed and self._can_chain():
                 self._dispatch_decode()
@@ -868,6 +994,9 @@ class EngineCore:
         self._pending_seeds = [(ix, sd) for ix, sd in self._pending_seeds
                                if ix != i]
         self._decode_seen.pop(i, None)
+        self._spec_states.pop(slot.seq_id, None)
+        if self.proposer is not None:
+            self.proposer.drop(slot.seq_id)
         if self._inflight:
             # an enqueued decode dispatch may still write into this
             # sequence's pages; hold the release until the window drains so
@@ -959,8 +1088,14 @@ class EngineCore:
                            for im in req.images])
         except ValueError as e:
             return str(e)
-        digest = int.from_bytes(
-            hashlib.blake2b(px.tobytes(), digest_size=8).digest(), "little")
+        digest = 0
+        if not getattr(req, "kv_salt", 0):
+            # only needed when the frontend didn't already salt the request
+            # (preprocessor.image_kv_salt): hashing the full normalized
+            # pixel stack on the engine thread is pure waste otherwise
+            digest = int.from_bytes(
+                hashlib.blake2b(px.tobytes(), digest_size=8).digest(),
+                "little")
         soft = np.asarray(self._encode_images(jnp.asarray(px)))
         return spans, soft, digest
 
@@ -1000,8 +1135,12 @@ class EngineCore:
             # salt the block-hash chain with the image content: identical
             # (prompt, images) requests still prefix-match, but the same
             # placeholder ids with DIFFERENT images can never alias — in
-            # local reuse or the router index
-            chain_salt = (chain_salt ^ img_digest) & ((1 << 63) - 1)
+            # local reuse or the router index. When the FRONTEND already
+            # computed a salt (BackendInput.kv_salt, preprocessor digest),
+            # use it verbatim: the router's prefix-overlap scoring hashes
+            # with that same salt, so published VLM blocks stay routable
+            chain_salt = (getattr(req, "kv_salt", 0)
+                          or (chain_salt ^ img_digest) & ((1 << 63) - 1))
         self.waiting.popleft()
         slot_idx = self.slots.index(None)
         slot = _Slot(seq_id, req, prompt)
@@ -1240,10 +1379,12 @@ class EngineCore:
         return None
 
     # ------------------------------------------------------------------
-    def _decode_eligible(self):
+    def _decode_eligible(self, lookahead: Optional[int] = None):
         """(slot_idx, slot, phys_len) for every decode-ready slot whose next
-        dispatch's pages could be reserved; deferred = ready but no pages."""
-        N = self.cfg.decode_steps
+        dispatch's pages could be reserved; deferred = ready but no pages.
+        ``lookahead`` is the page reservation beyond phys (default: the
+        chained decode window; the spec path passes its verify window)."""
+        N = self.cfg.decode_steps if lookahead is None else lookahead
         active, deferred = [], []
         for i, slot in enumerate(self.slots):
             if slot is None or slot.prefill_done < len(slot.prompt):
@@ -1288,6 +1429,20 @@ class EngineCore:
                 return False
         return True
 
+    def _evict_largest_deferred(self, deferred, out: List[StepOutput]) -> None:
+        """No decode-ready lane can be dispatched and every deferred lane
+        is blocked on KV capacity: evict the largest consumer so the rest
+        of the system unblocks (capacity error). Shared by the chained
+        decode path and the speculative verify path."""
+        i, slot = max(deferred,
+                      key=lambda t: len(self.pool.seqs[t[1].seq_id].pages))
+        out.append(StepOutput(
+            slot.seq_id, slot.last_token, slot.cum_logprob,
+            FinishReason.ERROR,
+            error="evicted under KV pool pressure (no capacity to "
+                  "continue decoding)"))
+        self._free_slot(i)
+
     def _dispatch_decode(self, out: Optional[List[StepOutput]] = None) -> None:
         """Enqueue one multi-step decode dispatch WITHOUT fetching results.
         If a dispatch is already in flight, chain off its on-device token
@@ -1298,17 +1453,7 @@ class EngineCore:
         active, deferred = self._decode_eligible()
         if not active:
             if deferred and not chain and out is not None:
-                # nothing can make progress: evict the largest consumer so
-                # the rest of the system unblocks (capacity error)
-                i, slot = max(
-                    deferred,
-                    key=lambda t: len(self.pool.seqs[t[1].seq_id].pages))
-                out.append(StepOutput(
-                    slot.seq_id, slot.last_token, slot.cum_logprob,
-                    FinishReason.ERROR,
-                    error="evicted under KV pool pressure (no capacity to "
-                          "continue decoding)"))
-                self._free_slot(i)
+                self._evict_largest_deferred(deferred, out)
             return
         self._flush_evictions()   # ensure_pages() may have evicted pages
         S = self._bucket(max(phys for _, _, phys in active) + N,
@@ -1376,6 +1521,156 @@ class EngineCore:
         self._last_final_tok = final_tok
         return packed, final_tok
 
+    # ------------------------------------------------------------------
+    # speculative decoding (engine/spec.py owns proposers + acceptance)
+    # ------------------------------------------------------------------
+    def _run_verify_program(self, S: int, K: int, tokens, page_tables,
+                            lengths, fresh, active_mask, upd_tok, upd_mask):
+        """Execute the verify program. The SAME code path runs on the
+        leader and on follower mirrors (multi-host lockstep)."""
+        s = self.sampling
+        fn = self._verify_fn(S, K)
+        with _trace_annotation(f"dynamo.verify[S{S},K{K}]"):
+            (packed, new_key, self.k_pool, self.v_pool,
+             self.gen_counts) = fn(
+                self.params, tokens, self.k_pool, self.v_pool, page_tables,
+                lengths, s.temperature, s.top_p, s.top_k, s.key,
+                self.gen_counts, fresh, active_mask, s.freq_pen, s.pres_pen,
+                upd_tok, upd_mask)
+        s.key = new_key
+        return packed
+
+    @staticmethod
+    def _spec_opt_out(req: BackendInput) -> bool:
+        """Lanes that must not speculate (they still ride the verify
+        dispatch with zero drafts, which IS a plain single-token decode
+        step): per-request opt-out, and penalty requests — the verify
+        program applies penalty counts per-dispatch, which is only exact
+        when each dispatch commits one token."""
+        if getattr(req, "no_spec", False):
+            return True
+        sp = req.sampling
+        return bool(sp.frequency_penalty or sp.presence_penalty)
+
+    def _spec_seq_state(self, slot: _Slot):
+        from .spec import SeqSpecState
+
+        st = self._spec_states.get(slot.seq_id)
+        if st is None:
+            # created at first decode entry: exactly one generated token
+            # exists (the prefill- or injection-sampled first token)
+            st = SeqSpecState(
+                tokens=list(slot.prompt) + [int(slot.last_token)],
+                k=self.spec.k_max,
+                pending=[int(slot.last_token)])
+            self._spec_states[slot.seq_id] = st
+        return st
+
+    def _spec_round(self, out: List[StepOutput]) -> None:
+        """One synchronous speculative-decoding round: propose k drafts per
+        lane, verify all of them in ONE wider forward, accept host-side,
+        commit only accepted tokens. Unlike the chained decode path this is
+        a sync point every round (acceptance needs the fetch), but each
+        dispatch can commit up to k+1 tokens instead of one."""
+        from .sampling import spec_accept, spec_unpack
+
+        cfg, sp = self.cfg, self.spec
+        B = cfg.max_batch
+        # reserve the whole verify window (k drafts + bonus) up front:
+        # rollback is then pure bookkeeping, never data movement
+        active, deferred = self._decode_eligible(lookahead=sp.k_max + 1)
+        if not active:
+            if deferred:
+                self._evict_largest_deferred(deferred, out)
+            return
+        self._flush_evictions()   # ensure_pages() may have evicted pages
+
+        drafts: Dict[int, List[int]] = {}
+        for i, slot, phys in active:
+            st = self._spec_seq_state(slot)
+            d: List[int] = []
+            if not self._spec_opt_out(slot.request):
+                d = self.proposer.propose(slot.seq_id, st, st.k)[:st.k]
+            drafts[i] = [int(x) for x in d]
+            self.spec_proposed_total += len(d)
+            if d:
+                self.stage.spec_proposed.inc(amount=float(len(d)))
+
+        K = sp.bucket(max(len(d) for d in drafts.values()))
+        T = K + 1
+        S = self._bucket(max(phys for _, _, phys in active) + K,
+                         self.s_buckets)
+        P = S // self.page_size
+        U = sp.k_max + 1
+        tokens = np.zeros((B, T), np.int32)
+        lengths = np.ones(B, np.int32)     # inactive lanes write to page 0
+        page_tables = np.zeros((B, P), np.int32)
+        upd_tok = np.zeros((B, U), np.int32)
+        upd_mask = np.zeros((B, U), bool)
+        fresh = np.zeros(B, bool)
+        active_mask = np.zeros(B, bool)
+        for i, slot, phys in active:
+            st = self._spec_states[slot.seq_id]
+            d = drafts[i]
+            tokens[i, 0] = slot.last_token
+            tokens[i, 1:1 + len(d)] = d
+            lengths[i] = phys
+            page_tables[i] = self.pool.page_table_row(slot.seq_id, P)
+            upd = st.pending[-U:]
+            upd_tok[i, :len(upd)] = upd
+            upd_mask[i, :len(upd)] = True
+            active_mask[i] = True
+            if self._decode_seen.get(i) != slot.seq_id:
+                fresh[i] = True
+                self._decode_seen[i] = slot.seq_id
+
+        s = self.sampling
+        if self.dispatch_hook is not None:
+            self.dispatch_hook("verify", {"S": S, "K": K}, {
+                "tokens": tokens, "page_tables": page_tables,
+                "lengths": lengths, "fresh": fresh,
+                "active_mask": active_mask, "upd_tok": upd_tok,
+                "upd_mask": upd_mask, "temp": s.temperature,
+                "top_p": s.top_p, "top_k": s.top_k,
+                "freq_pen": s.freq_pen, "pres_pen": s.pres_pen})
+        t0 = time.perf_counter()
+        packed = self._run_verify_program(
+            S, K, tokens, page_tables, lengths, fresh, active_mask,
+            upd_tok, upd_mask)
+        r = spec_unpack(np.asarray(packed), K)      # ONE host fetch
+        n_emitted = 0
+        self.spec_dispatch_total += 1               # one verify dispatch
+        for i, slot, phys in active:
+            st = self._spec_states[slot.seq_id]
+            d = drafts[i]
+            lane = {k: v[i] for k, v in r.items()}
+            greedy = float(s.temperature[i]) <= 0.0
+            toks, lps, acc = spec_accept(d, greedy, lane)
+            self.spec_accepted_total += acc
+            if d:
+                self.stage.spec_accepted.inc(amount=float(acc))
+                self.stage.spec_per_dispatch.observe(value=float(acc))
+            st.pending = []
+            for tok, lp in zip(toks, lps):
+                self.pool.account_tokens(slot.seq_id, [tok])
+                slot.generated += 1
+                slot.last_token = tok
+                slot.cum_logprob += lp
+                st.tokens.append(tok)
+                st.pending.append(tok)
+                n_emitted += 1
+                fin = self._finish_reason(slot, tok)
+                out.append(StepOutput(slot.seq_id, tok, slot.cum_logprob,
+                                      fin, token_logprob=lp))
+                if fin is not None:
+                    self._free_slot(i)
+                    break
+            if d and self.slots[i] is slot:
+                st.k = sp.next_k(st.k, acc, len(d))
+        if n_emitted:
+            self.stage.decode_step.observe(
+                value=(time.perf_counter() - t0) / n_emitted)
+
     def mirror_dispatch(self, kind: str, meta: Dict[str, Any],
                         arrs: Dict[str, np.ndarray]) -> None:
         """Follower-side replay of a leader dispatch (multi-host mode): runs
@@ -1406,6 +1701,17 @@ class EngineCore:
             self._run_decode_program(
                 meta["S"], arrs.get("tokens"), arrs["page_tables"],
                 arrs["lengths"], arrs["fresh"], arrs["active_mask"])
+        elif kind == "verify":
+            s = self.sampling
+            s.temperature = arrs["temp"]
+            s.top_p = arrs["top_p"]
+            s.top_k = arrs["top_k"]
+            s.freq_pen = arrs["freq_pen"]
+            s.pres_pen = arrs["pres_pen"]
+            self._run_verify_program(
+                meta["S"], meta["K"], arrs["tokens"], arrs["page_tables"],
+                arrs["lengths"], arrs["fresh"], arrs["active_mask"],
+                arrs["upd_tok"], arrs["upd_mask"])
         else:
             raise ValueError(f"unknown dispatch kind {kind!r}")
 

@@ -10,7 +10,7 @@ for pure top-p, since mass beyond the top-64 logits is negligible for LLMs).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -86,3 +86,151 @@ def sample(logits: jax.Array, temperature: jax.Array, top_p: jax.Array,
     logp_all = jax.nn.log_softmax(logits, axis=-1)
     logprob = jnp.take_along_axis(logp_all, token[:, None], axis=-1)[:, 0]
     return token.astype(jnp.int32), logprob, new_keys
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: verify-side sampling (in-jit) + host-side acceptance
+# ---------------------------------------------------------------------------
+# The verify program runs one forward over T = K+1 positions per lane
+# (position 0 = the last committed token; positions 1..K = draft tokens) and
+# hands the host everything acceptance needs in ONE packed fetch:
+#
+#   greedy_tok[t]   argmax of the target distribution at position t
+#   full_tok[t]     a token sampled from the full target distribution
+#   resid_tok[i]    a token sampled from the RESIDUAL distribution at draft
+#                   position i: the target with the draft token's mass
+#                   removed, renormalized
+#   p_draft[i]      target probability of draft token i (within the masked
+#                   sampling window — the distribution sample() actually
+#                   draws from)
+#   u[i]            uniform draw for the accept test
+#
+# Both in-tree proposers are DETERMINISTIC (n-gram lookup; greedy draft
+# model), i.e. the proposal distribution q is a point mass at the drafted
+# token. Rejection sampling then reduces to: accept draft d with probability
+# min(1, p(d)/q(d)) = p(d); on rejection emit a token from
+# norm(max(0, p - q)) = p with d's mass removed — which preserves the target
+# distribution exactly (Leviathan et al., 2023, spec-sampling lemma with a
+# delta proposal). Greedy lanes skip all of that: accept iff d == argmax.
+
+
+def spec_pack_width(K: int) -> int:
+    """Columns in the packed verify output for draft length ``K``."""
+    return 4 * (K + 1) + 5 * K
+
+
+def spec_verify(logits: jax.Array, drafts: jax.Array,
+                temperature: jax.Array, top_p: jax.Array, top_k: jax.Array,
+                key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """In-jit verify sampling. ``logits`` [B, K+1, V] f32 (penalties already
+    applied), ``drafts`` [B, K] i32. Returns (packed [B, spec_pack_width(K)]
+    f32, new_keys [B]). Token ids < 2^24 are exact in f32, so one packed
+    array carries ids and logprobs losslessly (same trick as decode)."""
+    B, T, V = logits.shape
+    K = T - 1
+    greedy = jnp.argmax(logits, axis=-1)                          # [B,T]
+    logp_all = jax.nn.log_softmax(logits, axis=-1)                # [B,T,V]
+    logp_greedy = jnp.take_along_axis(
+        logp_all, greedy[..., None], axis=-1)[..., 0]             # [B,T]
+
+    # the masked sampling window, replicating sample() exactly: top-STATIC_K
+    # candidates, temperature scaling, then top-k/top-p masks
+    vals, idxs = jax.lax.top_k(logits, STATIC_K)                  # [B,T,Kw]
+    temp = jnp.maximum(temperature, 1e-6)[:, None, None]
+    scaled = vals / temp
+    probs = jax.nn.softmax(scaled, axis=-1)
+    karr = jnp.where(top_k > 0, top_k, STATIC_K)[:, None, None]
+    kmask = jnp.arange(STATIC_K)[None, None, :] < karr
+    cum = jnp.cumsum(probs, axis=-1)
+    pmask = (cum - probs) < top_p[:, None, None]
+    mask = kmask & pmask
+    masked = jnp.where(mask, scaled, -jnp.inf)                    # [B,T,Kw]
+    win_p = jax.nn.softmax(masked, axis=-1)
+
+    # draft-token probability under the target sampling distribution; a
+    # draft outside the masked window has p=0 and is always rejected (the
+    # non-spec sampler could never have emitted it)
+    in_win = (idxs[:, :K] == drafts[:, :, None]) & mask[:, :K]    # [B,K,Kw]
+    p_draft = jnp.sum(jnp.where(in_win, win_p[:, :K], 0.0), -1)   # [B,K]
+    resid = jnp.where(in_win, -jnp.inf, masked[:, :K])            # [B,K,Kw]
+
+    # per-lane subkeys: T full draws + K residual draws + 1 uniform vector
+    sub = jax.vmap(lambda k: jax.random.split(k, T + K + 2))(key)
+    new_keys = sub[:, 0]
+    cat = jax.vmap(jax.vmap(jax.random.categorical))
+    full_w = cat(sub[:, 1:1 + T], masked)                         # [B,T]
+    resid_w = cat(sub[:, 1 + T:1 + T + K], resid)                 # [B,K]
+    full_tok = jnp.take_along_axis(idxs, full_w[..., None], -1)[..., 0]
+    resid_tok = jnp.take_along_axis(
+        idxs[:, :K], resid_w[..., None], -1)[..., 0]
+    u = jax.vmap(lambda k: jax.random.uniform(k, (K,)))(sub[:, T + K + 1])
+
+    # logprobs are reported from the UNSCALED post-penalty distribution,
+    # matching sample()'s contract
+    def lp_at(tok):
+        return jnp.take_along_axis(
+            logp_all[:, :tok.shape[1]], tok[..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+
+    packed = jnp.concatenate([
+        greedy.astype(jnp.float32), logp_greedy,
+        full_tok.astype(jnp.float32), lp_at(full_tok),
+        resid_tok.astype(jnp.float32), lp_at(resid_tok),
+        lp_at(drafts), p_draft, u.astype(jnp.float32),
+    ], axis=1)
+    return packed, new_keys
+
+
+def spec_unpack(packed: np.ndarray, K: int) -> Dict[str, np.ndarray]:
+    """Split the packed verify fetch back into named host arrays [B, ...]."""
+    T = K + 1
+    cuts = {"greedy_tok": T, "logp_greedy": T, "full_tok": T,
+            "logp_full": T, "resid_tok": K, "logp_resid": K,
+            "logp_draft": K, "p_draft": K, "u": K}
+    out: Dict[str, np.ndarray] = {}
+    off = 0
+    for name, w in cuts.items():
+        out[name] = packed[:, off:off + w]
+        off += w
+    return out
+
+
+def spec_accept(drafts: List[int], is_greedy: bool, lane: Dict[str, np.ndarray]
+                ) -> Tuple[List[int], List[float], int]:
+    """Host-side acceptance for ONE lane. ``lane`` holds that lane's rows of
+    :func:`spec_unpack`'s arrays. Returns (tokens, token_logprobs,
+    n_accepted_drafts); between 1 and len(drafts)+1 tokens are emitted.
+
+    Greedy: accept drafts while they match argmax; the emitted token at the
+    first mismatch IS the argmax (what non-spec decode would have produced),
+    so greedy output is token-identical to the non-speculative path.
+    Temperature>0: accept draft i iff u_i < p(d_i); on rejection emit the
+    residual-distribution token; if every draft is accepted, emit one bonus
+    token sampled from the full target distribution at the next position."""
+    toks: List[int] = []
+    lps: List[float] = []
+    acc = 0
+    for i, d in enumerate(drafts):
+        if is_greedy:
+            tgt = int(lane["greedy_tok"][i])
+            toks.append(tgt)
+            lps.append(float(lane["logp_greedy"][i]))
+            if tgt != int(d):
+                return toks, lps, acc
+            acc += 1
+        elif float(lane["u"][i]) < float(lane["p_draft"][i]):
+            toks.append(int(d))
+            lps.append(float(lane["logp_draft"][i]))
+            acc += 1
+        else:
+            toks.append(int(lane["resid_tok"][i]))
+            lps.append(float(lane["logp_resid"][i]))
+            return toks, lps, acc
+    j = len(drafts)
+    if is_greedy:
+        toks.append(int(lane["greedy_tok"][j]))
+        lps.append(float(lane["logp_greedy"][j]))
+    else:
+        toks.append(int(lane["full_tok"][j]))
+        lps.append(float(lane["logp_full"][j]))
+    return toks, lps, acc

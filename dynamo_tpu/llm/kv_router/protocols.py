@@ -100,6 +100,10 @@ class ForwardPassMetrics:
     num_requests_waiting: float = 0.0
     gpu_cache_usage_perc: float = 0.0   # kept name for API familiarity
     gpu_prefix_cache_hit_rate: float = 0.0
+    # speculative decoding: drafted-token acceptance rate (0 = spec off or
+    # nothing proposed); lets the planner/router see whether a worker's
+    # decode throughput is spec-amplified
+    spec_accept_rate: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)

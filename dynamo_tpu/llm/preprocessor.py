@@ -79,6 +79,25 @@ def _decode_data_url(url: str):
         raise ProtocolError(f"could not decode image: {e}") from e
 
 
+def image_kv_salt(lora_id: int, images: List[Any]) -> int:
+    """KV block-hash chain salt for a VLM request: ``lora_id`` folded with a
+    digest of the raw decoded pixel content. Computed HERE (frontend) and
+    carried on ``BackendInput.kv_salt`` so the KV router's prefix-overlap
+    scoring and the engine's published blocks hash under the SAME salt —
+    identical (prompt, images) requests match across workers, while the same
+    placeholder tokens with different images can never alias."""
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.blake2b(digest_size=8)
+    for im in images:
+        arr = np.ascontiguousarray(np.asarray(im))
+        h.update(arr.tobytes())
+    digest = int.from_bytes(h.digest(), "little")
+    return (lora_id ^ digest) & ((1 << 63) - 1)
+
+
 def extract_images(messages: List[Dict[str, Any]]
                    ) -> Tuple[List[Any], List[Dict[str, Any]]]:
     """Pull image_url parts out of OpenAI multipart messages; each becomes
@@ -178,6 +197,7 @@ class Preprocessor:
         )
         if images:
             bi.images = images
+            bi.kv_salt = image_kv_salt(bi.lora_id, images)
         annotations = self._annotations(req.ext, prompt, token_ids)
         bi.annotations = annotations
         return PreprocessedRequest(bi, prompt, annotations)

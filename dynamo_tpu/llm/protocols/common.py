@@ -75,24 +75,63 @@ class BackendInput:
     # block-hash chain so adapter KV can never alias base/other-adapter KV
     # in prefix reuse or the router index (ref C ABI lib.rs:253-283).
     lora_id: int = 0
-    # VLM: normalized pixel arrays ([3, H, W] nested float lists — wire-
-    # serializable; the engine's vision tower encodes them at prefill).
+    # KV block-hash chain salt (0 = derive from lora_id / image content at
+    # the engine). The frontend sets this for VLM requests — lora_id folded
+    # with an image-content digest — so the KV router's prefix-overlap
+    # scoring hashes with the SAME salt the engine publishes blocks under
+    # (without it, KV-aware routing is silently a no-op for image prompts).
+    kv_salt: int = 0
+    # speculative decoding opt-out: the engine proposes zero drafts for
+    # this request (its decode degenerates to plain single-token steps
+    # inside the verify dispatch).
+    no_spec: bool = False
+    # VLM: normalized pixel arrays ([3, H, W]; the engine's vision tower
+    # encodes them at prefill). On the wire each image travels as
+    # {"b64": base64 raw bytes, "shape": [...], "dtype": "..."} — nested
+    # per-pixel int lists (~tens of MB per image as JSON numbers) are still
+    # ACCEPTED on read for one release, but no longer produced.
     # Image k fills the k-th ``image_token_id`` placeholder run.
     images: Optional[List[Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         if self.images is None:
             return asdict(self)
+        import base64
+
         import numpy as np
         from dataclasses import replace
 
         # exclude the pixel arrays from asdict's deep copy; convert once
         d = asdict(replace(self, images=None))
-        d["images"] = [np.asarray(im).tolist() for im in self.images]
+        d["images"] = []
+        for im in self.images:
+            arr = np.ascontiguousarray(np.asarray(im))
+            d["images"].append({
+                "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+            })
         return d
+
+    @staticmethod
+    def _decode_image(e: Any):
+        """One wire image -> pixel array: base64 envelope or the legacy
+        nested-list encoding (accepted for one release)."""
+        if isinstance(e, dict) and "b64" in e:
+            import base64
+
+            import numpy as np
+            return np.frombuffer(
+                base64.b64decode(e["b64"]),
+                dtype=np.dtype(e.get("dtype", "uint8"))
+            ).reshape(e.get("shape", (-1,)))
+        return e
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "BackendInput":
+        images = d.get("images")
+        if images is not None:
+            images = [cls._decode_image(e) for e in images]
         return cls(
             token_ids=list(d["token_ids"]),
             sampling=SamplingOptions(**d.get("sampling", {})),
@@ -103,7 +142,9 @@ class BackendInput:
             mdc_sum=d.get("mdc_sum"),
             annotations=dict(d.get("annotations", {})),
             lora_id=int(d.get("lora_id", 0)),
-            images=d.get("images"),
+            kv_salt=int(d.get("kv_salt", 0)),
+            no_spec=bool(d.get("no_spec", False)),
+            images=images,
         )
 
 

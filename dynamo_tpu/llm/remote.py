@@ -69,8 +69,12 @@ class RemoteCoreEngine(AsyncEngine[BackendInput, EngineOutput]):
         if self.router_client is not None and self.router_client.instances:
             try:
                 async for resp in self.router_client.generate(
+                        # kv_salt (VLM: lora ^ image digest) is the salt the
+                        # engine publishes blocks under — score overlap with
+                        # it so image prompts get router-side prefix credit
                         {"token_ids": request.token_ids,
-                         "lora_id": request.lora_id}, context.child()):
+                         "lora_id": request.kv_salt or request.lora_id},
+                        context.child()):
                     wid = resp.get("worker_id")
                     if wid is not None and wid in self.worker_client.instances:
                         mode, instance_id = "direct", wid

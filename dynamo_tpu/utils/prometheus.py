@@ -326,6 +326,20 @@ class StageMetrics:
         self.faults_injected = r.counter(
             "dyn_faults_injected_total",
             "Fault-injection points fired", ("point", "action"))
+        # speculative decoding (engine/spec.py): proposal/acceptance volume
+        # plus the accepted-per-dispatch shape — the two numbers that tell
+        # an operator whether spec decode is paying for its verify passes
+        self.spec_proposed = r.counter(
+            "dyn_spec_proposed_total",
+            "Draft tokens proposed for speculative verification", ())
+        self.spec_accepted = r.counter(
+            "dyn_spec_accepted_total",
+            "Draft tokens accepted by speculative verification", ())
+        self.spec_per_dispatch = r.histogram(
+            "dyn_spec_accepted_per_dispatch",
+            "Accepted draft tokens per verify dispatch (per lane)", (),
+            # token counts, not latencies: one bucket per plausible k
+            buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0))
 
 
 _stage: Optional[StageMetrics] = None

@@ -29,9 +29,13 @@ from typing import List, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # the planner is a standing control loop over the same store primitives —
-# an unbounded await there parks the whole autoscaler, so it is gated too
+# an unbounded await there parks the whole autoscaler, so it is gated too.
+# engine/spec.py is gated because it runs ON the engine thread: any await
+# (or blocking network read) sneaking into a proposer would stall every
+# request in the batch, so the file must stay visibly clean under this gate
 DEFAULT_PATHS = [os.path.join(REPO, "dynamo_tpu", "runtime"),
-                 os.path.join(REPO, "dynamo_tpu", "planner")]
+                 os.path.join(REPO, "dynamo_tpu", "planner"),
+                 os.path.join(REPO, "dynamo_tpu", "engine", "spec.py")]
 
 # method/function names whose await parks on the network
 NETWORK_CALLS = {"open_connection", "readexactly", "read", "drain",

@@ -265,6 +265,43 @@ async def test_lora_id_publisher_to_indexer_no_alias():
     assert idx.find_matches_for_tokens(tokens).scores == {1: 2}
 
 
+def test_vlm_kv_salt_gives_router_prefix_credit():
+    """The frontend computes an image-content salt (BackendInput.kv_salt,
+    preprocessor.image_kv_salt) and the engine seals VLM blocks under that
+    SAME salt — so hashing a route query with kv_salt matches the published
+    chain (ADVICE r5 low: router overlap scoring used the plain lora_id and
+    VLM requests silently never got prefix credit)."""
+    import numpy as np
+
+    from dynamo_tpu.engine.cache import PagePool
+    from dynamo_tpu.llm.preprocessor import image_kv_salt
+
+    img = np.random.RandomState(0).randint(0, 255, (8, 8, 3), np.uint8)
+    salt = image_kv_salt(0, [img])
+    assert salt == image_kv_salt(0, [img])            # content-stable
+    assert salt != image_kv_salt(0, [img ^ 1])        # content-sensitive
+    assert salt != image_kv_salt(3, [img])            # adapter-distinct
+
+    # engine side: blocks sealed under the salted chain
+    pool = PagePool(num_pages=16, page_size=4)
+    sealed = []
+    pool.on_block_sealed = (
+        lambda seq, blk, page, lora: sealed.append(blk.sequence_hash))
+    tokens = list(range(8))
+    pool.create("v", lora_id=salt)
+    pool.extend("v", tokens)
+    # router side: the overlap query hashes with kv_salt -> same chain
+    assert sealed == compute_seq_hashes(tokens, 4, lora_id=salt)
+    # ...and the UNSALTED query can never alias the image blocks
+    assert not set(sealed) & set(compute_seq_hashes(tokens, 4))
+
+    # end to end through the radix index
+    idx = KvIndexer(block_size=4)
+    idx.apply_sync(stored(1, sealed))
+    assert idx.find_matches_for_tokens(tokens, lora_id=salt).scores == {1: 2}
+    assert idx.find_matches_for_tokens(tokens).scores == {}
+
+
 def test_local_prefix_reuse_respects_lora():
     """Engine-local prefix reuse (match_prefix/probe_prefix) must walk the
     SALTED chain: adapter requests never adopt base-model blocks, and DO

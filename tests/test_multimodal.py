@@ -276,14 +276,32 @@ def test_preprocessor_image_on_text_model_is_protocol_error():
 
 def test_backend_input_image_wire_roundtrip_serves():
     """BackendInput with images survives to_dict -> from_dict (the worker
-    wire path): pixels serialize as nested int lists and the engine's
-    normalize_image still accepts them (review finding: int64 HWC off the
-    wire was rejected)."""
+    wire path): pixels now travel as base64 raw bytes + shape/dtype — a
+    ~26x smaller wire payload than the old nested per-pixel int lists
+    (ADVICE r5: tens of MB of JSON numbers per real image) — and the
+    legacy nested-list encoding is still accepted on read for one
+    release."""
+    import json
+
     img8 = np.random.RandomState(0).randint(0, 255, (24, 24, 3), np.uint8)
     bi = BackendInput(token_ids=vlm_prompt(), images=[img8],
                       stop=StopConditions(max_tokens=3, ignore_eos=True))
-    wire = BackendInput.from_dict(bi.to_dict())
-    assert isinstance(wire.images[0], list)        # nested lists, not array
+    d = bi.to_dict()
+    env = d["images"][0]
+    assert set(env) == {"b64", "shape", "dtype"}
+    # base64 is ~4/3 of the raw bytes; nested lists were ~4 chars/pixel
+    assert len(json.dumps(env)) < 2 * img8.nbytes
+    wire = BackendInput.from_dict(json.loads(json.dumps(d)))  # real wire
+    assert isinstance(wire.images[0], np.ndarray)
+    assert wire.images[0].dtype == np.uint8
+    assert np.array_equal(wire.images[0], img8)
+
+    # one-release compatibility: the legacy list encoding still decodes
+    legacy = dict(d)
+    legacy["images"] = [img8.tolist()]
+    wl = BackendInput.from_dict(legacy)
+    assert np.array_equal(np.asarray(wl.images[0]), img8)
+
     core = vlm_core()
     core.submit("w", wire)
     toks, err = [], None
