@@ -55,24 +55,6 @@ POINTS_DIR = os.environ.get(
     "DYNAMO_BENCH_POINTS_DIR", os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "bench_points"))
 
-_PEAK_BF16 = (  # device_kind substring -> peak dense bf16 FLOP/s per chip
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),
-    ("v5lite", 197e12),
-    ("v4", 275e12),
-)
-
-
-def _chip_peak_flops(kind: str):
-    k = kind.lower()
-    for sub, peak in _PEAK_BF16:
-        if sub in k:
-            return peak
-    return None
-
-
 def _probe_backend(timeout_s: float):
     """Initialize the jax backend in a subprocess. Returns (platform,
     device_kind) or None. A hung PJRT plugin kills the child, not us."""
@@ -121,7 +103,7 @@ def _flush_point(model: str, entry: dict, meta: dict) -> None:
 
 
 def _run_model(model_cfg, batches, prompt_len, gen_tokens, max_context,
-               on_tpu, peak_flops, deadline, flush=None):
+               on_tpu, deadline, flush=None):
     """For each batch size, build an EngineCore sized max_batch=b (decode
     dispatches always run at full engine width, so measuring batch b inside a
     max-sized engine would measure padding, not batch-b performance), run a
@@ -199,8 +181,10 @@ def _run_model(model_cfg, batches, prompt_len, gen_tokens, max_context,
                 n_params = sum(int(a.size)
                                for a in jax.tree.leaves(core.params))
             round_(f"warm{b}_", b, salt=2 * b)       # compile + warm caches
+            g0 = core.goodput.lifetime()             # timed-round baseline
             tokens, wall, ttfts, t_first, post_tokens = round_(
                 f"bench{b}_", b, salt=2 * b + 1)
+            g1 = core.goodput.lifetime()
         except Exception as e:
             # one batch failing (e.g. OOM at the largest size) must not
             # discard the batches already measured for this model
@@ -220,9 +204,19 @@ def _run_model(model_cfg, batches, prompt_len, gen_tokens, max_context,
                               if ttfts else None),
             "total_tok_s": round(tokens / wall, 1),
         }
-        if peak_flops:
-            # decode FLOPs/token ~= 2 * params (attention adds <2% at 256 ctx)
-            entry["mfu"] = round(tok_s * 2.0 * n_params / peak_flops, 4)
+        # goodput accounting (utils/roofline.py): analytic FLOPs/bytes of
+        # the timed round's dispatches over their measured wall time,
+        # against the platform peak (TPU table / calibrated CPU). Non-null
+        # on EVERY platform — `mfu: null` is dead.
+        busy = g1["busy_s"] - g0["busy_s"]
+        if busy > 0:
+            d_flops = g1["flops_total"] - g0["flops_total"]
+            d_bytes = g1["bytes_total"] - g0["bytes_total"]
+            entry["mfu"] = round(d_flops / busy / g1["peak_flops"], 4)
+            entry["mbu"] = round(
+                d_bytes / busy / (g1["peak_hbm_gbps"] * 1e9), 4)
+            entry["hbm_gbps"] = round(d_bytes / busy / 1e9, 2)
+            entry["peak_source"] = g1["peak_source"]
         try:
             # prefix-reuse TTFT: the same prompts again — admission matches
             # the cached blocks, so only the last token truly prefills
@@ -366,7 +360,8 @@ def main() -> None:
     dev = jax.devices()[0]
     platform = dev.platform
     on_tpu = platform not in ("cpu",)
-    peak = _chip_peak_flops(dev.device_kind) if on_tpu else None
+    # peak normalization lives in utils/roofline.py now (one table for the
+    # engine's goodput plane and this bench); entries carry peak_source
 
     from dynamo_tpu.models import llama
 
@@ -418,6 +413,9 @@ def main() -> None:
             "best_batch": best.get("batch") if best else None,
             "p50_ttft_s": best.get("p50_ttft_s") if best else None,
             "mfu": best.get("mfu") if best else None,
+            "mbu": best.get("mbu") if best else None,
+            "hbm_gbps": best.get("hbm_gbps") if best else None,
+            "peak_source": best.get("peak_source") if best else None,
             "paged_kernel": (os.environ.get("DYNAMO_TPU_PAGED_KERNEL", "dma")
                              if platform == "tpu" else "simple[interpret]"),
             "sweep": sweeps,
@@ -448,7 +446,7 @@ def main() -> None:
 
         try:
             n_params, sweep = _run_model(mcfg, batches, plen, gen, ctx,
-                                         on_tpu, peak, deadline, flush=flush)
+                                         on_tpu, deadline, flush=flush)
         except Exception as e:
             # a later run (e.g. the conditional 8B sweep) must never zero an
             # already-measured headline — record and keep going

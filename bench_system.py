@@ -216,20 +216,35 @@ async def replay(base: str, prompts: List[str], max_tokens: int,
 class RouteProbe:
     """Per-request routing instrumentation (VERDICT r4 item #5).
 
-    - worker choice + prefix overlap per routed request, from the router's
-      own KVHitRateEvent telemetry (scheduler.rs:31-36 equivalent);
+    - worker choice + prefix overlap per routed request, read back from the
+      router's decision-audit ring via the frontend's
+      ``GET /v1/router/decisions`` (the first-class plane that replaced
+      this harness's private kv-hit-rate event counters) — only decisions
+      made AFTER ``start()`` count, via the ring's monotonic ``seq``;
     - queue-depth samples: each worker's active slots + waiting count
       polled during the replay, so tail latencies can be attributed to
       queueing at the preferred worker vs cache misses.
     """
 
-    def __init__(self, store: str, namespace: str = "dynamo"):
+    def __init__(self, store: str, base: str, namespace: str = "dynamo"):
         self.store = store
+        self.base = base.rstrip("/")
         self.namespace = namespace
-        self.routes: List[Dict[str, Any]] = []
         self.depth_samples: List[Dict[int, Tuple[float, float]]] = []
         self._drt = None
         self._sampler: Optional[asyncio.Task] = None
+        self._seq_watermark = 0
+
+    async def _fetch_decisions(self) -> List[Dict[str, Any]]:
+        import aiohttp
+
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=30)) as session:
+            async with session.get(
+                    f"{self.base}/v1/router/decisions") as resp:
+                if resp.status != 200:
+                    return []
+                return (await resp.json()).get("decisions", [])
 
     async def start(self) -> "RouteProbe":
         from dynamo_tpu.llm.metrics_aggregator import ClusterMetricsAggregator
@@ -238,12 +253,11 @@ class RouteProbe:
         host, port = self.store.split(":")
         self._drt = await DistributedRuntime(
             store_host=host, store_port=int(port)).connect()
-        ns = self._drt.namespace(self.namespace)
 
-        async def on_hit(payload):
-            self.routes.append(dict(payload))
-
-        await ns.subscribe("kv-hit-rate", on_hit)
+        # warm-replay decisions are already in the ring: remember where the
+        # measured window begins
+        pre = await self._fetch_decisions()
+        self._seq_watermark = max((d.get("seq", 0) for d in pre), default=0)
         agg = ClusterMetricsAggregator(self._drt, self.namespace,
                                        ["backend"])
         self._agg = agg
@@ -276,13 +290,19 @@ class RouteProbe:
                      for m in self._agg.workers.get("backend", {}).values()]
         except Exception:
             pass
+        try:
+            routes = [d for d in await self._fetch_decisions()
+                      if d.get("seq", 0) > self._seq_watermark
+                      and d.get("worker_id") is not None]
+        except Exception:
+            routes = []
         if self._drt:
             await self._drt.close()
         per_worker: Dict[str, int] = {}
         overlaps = []
-        for r in self.routes:
-            per_worker[str(r.get("worker_id"))] = \
-                per_worker.get(str(r.get("worker_id")), 0) + 1
+        for r in routes:
+            wid = r["worker_id"]
+            per_worker[f"{wid}"] = per_worker.get(f"{wid}", 0) + 1
             if r.get("isl_blocks"):
                 overlaps.append(r.get("overlap_blocks", 0)
                                 / r["isl_blocks"])
@@ -291,7 +311,7 @@ class RouteProbe:
         max_waiting = max((w for s in self.depth_samples
                            for _, w in s.values()), default=0)
         return {
-            "routed_requests": len(self.routes),
+            "routed_requests": len(routes),
             "per_worker_requests": per_worker,
             "mean_route_overlap": (round(sum(overlaps) / len(overlaps), 3)
                                    if overlaps else None),
@@ -354,7 +374,7 @@ def routing_ab(requests: int = 100, groups: int = 8, prefix_len: int = 256,
         await replay(base, warm, max_tokens, concurrency)
         prompts = make_workload(groups, requests, prefix_len, suffix_len,
                                 seed=2)
-        probe = await RouteProbe(store).start()
+        probe = await RouteProbe(store, base).start()
         stats = await replay(base, prompts, max_tokens, concurrency)
         stats["routing_probe"] = await probe.stop()
         stats["kv_hit_rate"] = stats["routing_probe"].pop("kv_hit_rate")

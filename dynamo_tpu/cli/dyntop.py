@@ -1,0 +1,250 @@
+"""dyntop: the operator's single pane of glass — a live cluster view.
+
+    python -m dynamo_tpu.cli.dyntop --store 127.0.0.1:4222 \
+        [--namespace dynamo] [--component backend --component prefill] \
+        [--interval 1.0] [--once] [--plain]
+
+Reads the same planes the metrics aggregator and the planner's signal
+collector read — per-worker ``ForwardPassMetrics`` snapshots under
+``metrics/`` and the stage-histogram dumps under ``metrics_stage/`` — and
+renders, per worker: active/total slots, KV occupancy, prefix hit rate,
+MFU / MBU / achieved HBM GB/s, spec accept rate, and circuit-breaker
+state; plus cluster-level TTFT/ITL p90, prefill queue depth, compile
+counters, and SLO burn rates (when ``DYN_SLO_*`` objectives are set).
+
+Renders with curses when stdout is a TTY (plain ANSI-refresh otherwise or
+with ``--plain``); ``--once`` prints a single snapshot and exits (what the
+loopback smoke test drives).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.dynconfig import EnvDefaultsParser
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = EnvDefaultsParser(prog="dyntop")
+    p.add_argument("--store", default="127.0.0.1:4222")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", action="append", default=None,
+                   help="worker component to watch (repeatable; "
+                        "default: backend + prefill)")
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit")
+    p.add_argument("--plain", action="store_true",
+                   help="force plain-refresh output (no curses)")
+    return p.parse_args(argv)
+
+
+# ---------------------------------------------------------------------------
+# collection (one store round-trip set per refresh)
+# ---------------------------------------------------------------------------
+class ClusterSnapshotter:
+    """Assembles one renderable snapshot per tick from the store planes.
+    Owns an :class:`~dynamo_tpu.utils.slo.SloMonitor` so burn rates
+    accumulate across refreshes."""
+
+    def __init__(self, store, namespace: str, components: List[str]):
+        from ..utils.slo import SloMonitor
+
+        self.store = store
+        self.namespace = namespace
+        self.components = list(components)
+        # gauge=None: dyntop observes, it does not export
+        self.slo = SloMonitor(registry_gauge=None)
+
+    async def collect(self) -> Dict:
+        from ..llm.disagg import prefill_queue_name
+        from ..llm.metrics_aggregator import (fetch_stage_states,
+                                              fetch_worker_metrics)
+        from ..planner.signals import open_instance_ids, quantile_from_states
+
+        states = await fetch_stage_states(self.store, self.namespace)
+        workers: Dict[str, Dict] = {}
+        for comp in self.components:
+            workers[comp] = await fetch_worker_metrics(
+                self.store, self.namespace, comp)
+        try:
+            q_depth = await self.store.q_len(
+                prefill_queue_name(self.namespace))
+        except Exception:  # noqa: BLE001 - queue plane optional
+            q_depth = 0
+        burn = self.slo.observe(states) if self.slo.objectives else {}
+        return {
+            "at": time.time(),
+            "namespace": self.namespace,
+            "workers": workers,
+            "breaker_open": open_instance_ids(states),
+            "ttft_p90": quantile_from_states(states, "llm_ttft_seconds",
+                                             0.90),
+            "itl_p90": quantile_from_states(states,
+                                            "llm_inter_token_seconds", 0.90),
+            "prefill_queue": q_depth,
+            "compiles": _compile_totals(states),
+            "slo_burn": burn,
+        }
+
+
+def _compile_totals(states) -> Dict[str, Tuple[float, float]]:
+    """{kind: (programs, seconds)} summed across every published dump."""
+    progs: Dict[str, float] = {}
+    secs: Dict[str, float] = {}
+    for _component, dump in states:
+        for name, acc in (("dyn_compiled_programs", progs),
+                          ("dyn_compile_seconds_total", secs)):
+            st = dump.get(name)
+            if not st or st.get("kind") != "counter":
+                continue
+            for skey, val in st.get("series", {}).items():
+                kind = skey.split("\x1f")[0] if skey else "?"
+                acc[kind] = acc.get(kind, 0.0) + val
+    return {k: (progs.get(k, 0.0), secs.get(k, 0.0))
+            for k in sorted(set(progs) | set(secs))}
+
+
+# ---------------------------------------------------------------------------
+# rendering (pure; unit-tested)
+# ---------------------------------------------------------------------------
+def _fmt(v: Optional[float], spec: str = "5.3f", na: str = "    -") -> str:
+    return na if v is None else format(v, spec)
+
+
+def render(snap: Dict) -> str:
+    lines: List[str] = []
+    hdr = (f"dyntop — ns={snap['namespace']}  "
+           f"ttft_p90={_fmt(snap.get('ttft_p90'))}s  "
+           f"itl_p90={_fmt(snap.get('itl_p90'))}s  "
+           f"prefill_q={snap.get('prefill_queue', 0)}")
+    lines.append(hdr)
+    comps = snap.get("compiles") or {}
+    if comps:
+        lines.append("compiles: " + "  ".join(
+            f"{k}={int(n)} ({s:.1f}s)" for k, (n, s) in comps.items()))
+    for slo, per_w in (snap.get("slo_burn") or {}).items():
+        burns = "  ".join(f"{int(w)}s={b:.2f}" for w, b in
+                          sorted(per_w.items()))
+        worst = max(per_w.values()) if per_w else 0.0
+        flag = "  BREACH" if worst > 1.0 else ""
+        lines.append(f"slo {slo}: burn {burns}{flag}")
+    lines.append(
+        f"{'worker':>10} {'comp':<9} {'slots':>7} {'kv%':>5} {'hit%':>5} "
+        f"{'mfu%':>6} {'mbu%':>6} {'GB/s':>7} {'spec%':>6} {'brk':>4}")
+    open_set = snap.get("breaker_open") or set()
+    n = 0
+    for comp, workers in sorted((snap.get("workers") or {}).items()):
+        for wid, m in sorted(workers.items()):
+            n += 1
+            kv = (100.0 * m.kv_active_blocks / m.kv_total_blocks
+                  if m.kv_total_blocks else 0.0)
+            brk = "OPEN" if f"{wid:x}" in open_set else "ok"
+            lines.append(
+                f"{wid:>10x} {comp:<9} "
+                f"{int(m.request_active_slots):>3}/{int(m.request_total_slots):<3} "
+                f"{kv:>5.1f} {100.0 * m.gpu_prefix_cache_hit_rate:>5.1f} "
+                f"{100.0 * m.mfu:>6.2f} {100.0 * m.mbu:>6.2f} "
+                f"{m.hbm_gbps:>7.2f} {100.0 * m.spec_accept_rate:>6.1f} "
+                f"{brk:>4}")
+    if not n:
+        lines.append("(no live workers publishing metrics)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+async def run_once(args) -> str:
+    from ..runtime.store_client import StoreClient
+
+    host, port = args.store.split(":")
+    store = StoreClient(host, int(port))
+    await store.connect()
+    try:
+        snap = await ClusterSnapshotter(
+            store, args.namespace,
+            args.component or ["backend", "prefill"]).collect()
+        return render(snap)
+    finally:
+        await store.close()
+
+
+async def _loop_plain(args) -> None:
+    from ..runtime.store_client import StoreClient
+
+    host, port = args.store.split(":")
+    store = StoreClient(host, int(port))
+    await store.connect()
+    snapper = ClusterSnapshotter(store, args.namespace,
+                                 args.component or ["backend", "prefill"])
+    try:
+        while True:
+            text = render(await snapper.collect())
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[H\x1b[2J")   # home + clear
+            sys.stdout.write(text + "\n")
+            sys.stdout.flush()
+            await asyncio.sleep(args.interval)
+    finally:
+        await store.close()
+
+
+async def _loop_curses(args) -> None:
+    import curses
+
+    from ..runtime.store_client import StoreClient
+
+    host, port = args.store.split(":")
+    store = StoreClient(host, int(port))
+    await store.connect()
+    snapper = ClusterSnapshotter(store, args.namespace,
+                                 args.component or ["backend", "prefill"])
+    scr = curses.initscr()
+    curses.noecho()
+    curses.cbreak()
+    scr.nodelay(True)
+    try:
+        while True:
+            text = render(await snapper.collect())
+            scr.erase()
+            maxy, maxx = scr.getmaxyx()
+            for i, line in enumerate(text.splitlines()[:maxy - 1]):
+                scr.addnstr(i, 0, line, maxx - 1)
+            scr.refresh()
+            if scr.getch() in (ord("q"), 27):   # q / ESC
+                return
+            await asyncio.sleep(args.interval)
+    finally:
+        curses.nocbreak()
+        curses.echo()
+        curses.endwin()
+        await store.close()
+
+
+def main() -> None:
+    from ..utils.logging_ext import init_logging
+
+    init_logging()
+    args = parse_args()
+    try:
+        if args.once:
+            print(asyncio.run(run_once(args)))
+        elif args.plain or not sys.stdout.isatty():
+            asyncio.run(_loop_plain(args))
+        else:
+            try:
+                asyncio.run(_loop_curses(args))
+            except Exception:
+                # a terminal curses can't drive falls back to plain
+                asyncio.run(_loop_plain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -31,12 +31,17 @@ log = logging.getLogger("dynamo_tpu.http")
 
 class DiscoveryFrontend:
     def __init__(self, drt: DistributedRuntime, manager: ModelManager,
-                 router_component: Optional[str] = None):
+                 router_component: Optional[str] = None,
+                 namespace: Optional[str] = None):
         self.drt = drt
         self.manager = manager
         self.router_component = router_component
+        # configured namespace: the decisions-fetch fallback before any
+        # model has registered (discovery would otherwise guess "dynamo")
+        self.namespace = namespace
         self._clients: Dict[str, Client] = {}       # endpoint path -> client
         self._router_clients: Dict[str, Client] = {}
+        self._decision_clients: Dict[str, Client] = {}  # ns -> audit client
         # (name, mtype) -> live registration store-keys. A model serves as
         # long as ANY registrant lives (replicas register under per-lease
         # keys; one replica dying must not unserve the others).
@@ -65,6 +70,37 @@ class DiscoveryFrontend:
                 .client().start()
             self._router_clients[ns] = cl
         return self._router_clients[ns]
+
+    async def fetch_router_decisions(self, limit: int = 0):
+        """GET /v1/router/decisions backend: read the router's decision-
+        audit ring over its ``decisions`` endpoint. Namespaces come from
+        the models already discovered (falling back to the default
+        namespace before any model registers). None = no live router; a
+        LIVE router whose fetch fails raises, so the HTTP layer answers
+        502 (router broken) instead of 404 (router absent)."""
+        if not self.router_component:
+            return None
+        last_err: Optional[Exception] = None
+        namespaces = (list(self._router_clients)
+                      or [self.namespace or "dynamo"])
+        for ns in namespaces:
+            if ns not in self._decision_clients:
+                self._decision_clients[ns] = await self.drt.namespace(ns) \
+                    .component(self.router_component).endpoint("decisions") \
+                    .client().start()
+            cl = self._decision_clients[ns]
+            if not cl.instances:
+                continue
+            try:
+                async for resp in cl.generate({"limit": int(limit)}):
+                    return resp.get("decisions", [])
+            except Exception as e:  # noqa: BLE001 - surfaced as 502 below
+                log.exception("router decisions fetch from %s failed", ns)
+                last_err = e
+        if last_err is not None:
+            raise RuntimeError(f"live router failed the decisions fetch: "
+                               f"{last_err}") from last_err
+        return None
 
     async def _on_change(self, key: str, value: Optional[bytes],
                          deleted: bool) -> None:
@@ -132,7 +168,8 @@ async def run_http(args, *, ready_event=None,
         drt = await DistributedRuntime(store_host=host,
                                        store_port=int(port)).connect()
     manager = ModelManager()
-    frontend = DiscoveryFrontend(drt, manager, args.router_component)
+    frontend = DiscoveryFrontend(drt, manager, args.router_component,
+                                 namespace=getattr(args, "namespace", None))
     await frontend.start()
     # store-wired service: /v1/traces stitches spans published by workers,
     # /metrics merges their per-stage histograms
@@ -140,7 +177,30 @@ async def run_http(args, *, ready_event=None,
     configure_tracing(component="http")
     svc = HttpService(manager, host=args.host, port=args.port,
                       store=drt.store,
-                      namespace=getattr(args, "namespace", None))
+                      namespace=getattr(args, "namespace", None),
+                      router_decisions=(frontend.fetch_router_decisions
+                                        if args.router_component else None))
+    # publish this frontend's stage dump (TTFT/ITL histograms recorded at
+    # the streaming edge) plus its HTTP request counters to the store —
+    # the planner's ttft_p90 signal, the SLO monitor's latency AND
+    # availability objectives, and dyntop all read metrics_stage/; a
+    # frontend that only *served* /metrics would keep those planes blind
+    from ..llm.metrics_aggregator import publish_stage_metrics
+
+    svc.stage_worker_id = drt.worker_id   # /metrics skips our own dump
+    pub_ns = getattr(args, "namespace", None) or "dynamo"
+
+    async def stage_publish_loop():
+        while True:
+            try:
+                await publish_stage_metrics(
+                    drt.store, pub_ns, "http", drt.worker_id, drt.lease,
+                    extra_metrics=svc.registry.state_dump())
+            except Exception:
+                log.debug("frontend stage publish skipped", exc_info=True)
+            await asyncio.sleep(2.0)
+
+    svc._stage_pub_task = asyncio.create_task(stage_publish_loop())
     actual = await svc.start()
     print(f"dynamo_tpu http frontend on :{actual} (discovery mode)",
           flush=True)

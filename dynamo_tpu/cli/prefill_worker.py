@@ -189,6 +189,12 @@ async def run_prefill_worker(args, *,
             await span_sink.stop()   # final flush: short-lived runs
         except Exception:            # (max_jobs) must not lose spans
             pass
+        # deregistration: drop the published stage dump so aggregators
+        # stop rendering this worker when a shared runtime outlives it
+        from ..llm.metrics_aggregator import clear_worker_keys
+
+        await clear_worker_keys(drt.store, args.namespace,
+                                PREFILL_COMPONENT, drt.worker_id)
         engine.shutdown()
         if own_drt:
             await drt.close()

@@ -4,10 +4,15 @@ pretty-print it as an ASCII waterfall (or save Chrome trace-event JSON).
     python -m dynamo_tpu.cli.tracectl <request_id> \
         [--url http://127.0.0.1:8080] [--chrome out.json] [--json]
     python -m dynamo_tpu.cli.tracectl --list [--url ...]
+    python -m dynamo_tpu.cli.tracectl decisions [--limit N] [--json]
 
 The request id is the ``x-request-id`` response header every frontend
 response carries. ``--chrome`` writes Perfetto-loadable trace-event JSON
 (open at https://ui.perfetto.dev or chrome://tracing).
+
+``decisions`` prints the KV router's decision audit
+(``GET /v1/router/decisions``): one line per routed request with the
+chosen worker and each candidate's overlap/cache_usage/load score terms.
 """
 
 from __future__ import annotations
@@ -80,10 +85,38 @@ def render_timeline(spans: List[Dict[str, Any]], width: int = BAR_WIDTH
     return "\n".join(lines)
 
 
+def render_decisions(decisions: List[Dict[str, Any]]) -> str:
+    """One line per audited routing decision (pure function; unit-tested):
+    chosen worker + the per-candidate ``logit=2*ovl-usage-load`` terms."""
+    if not decisions:
+        return "(no routing decisions recorded)"
+    lines = [f"{len(decisions)} routing decisions (oldest first)"]
+    for d in decisions:
+        wid = d.get("worker_id")
+        chosen = f"{wid:x}" if wid is not None else "WAITED"
+        retries = f" retries={d['retries']}" if d.get("retries") else ""
+        salt = f" salt={d['salt']:x}" if d.get("salt") else ""
+        lines.append(
+            f"#{d.get('seq', '?')} isl={d.get('isl_tokens', '?')}tok/"
+            f"{d.get('isl_blocks', '?')}blk{salt} -> {chosen} "
+            f"(ovl={d.get('overlap_blocks', 0)}blk){retries}")
+        for c in d.get("candidates", []):
+            mark = "*" if c.get("worker_id") == wid else " "
+            sat = "  SATURATED" if c.get("saturated") else ""
+            lines.append(
+                f"   {mark} {c['worker_id']:x}: logit={c['logit']:+.4f} "
+                f"(ovl={c['overlap_norm']:.2f} usage={c['cache_usage']:.2f}"
+                f" load={c['load']:.2f}){sat}")
+    return "\n".join(lines)
+
+
 def parse_args(argv=None) -> argparse.Namespace:
     p = EnvDefaultsParser(prog="dynamo-tracectl")
     p.add_argument("request_id", nargs="?", default=None,
-                   help="trace/request id (x-request-id response header)")
+                   help="trace/request id (x-request-id response header), "
+                        "or the literal 'decisions' for the router audit")
+    p.add_argument("--limit", type=int, default=0,
+                   help="decisions: max entries to fetch (0 = ring size)")
     p.add_argument("--url", default="http://127.0.0.1:8080",
                    help="frontend base URL")
     p.add_argument("--list", action="store_true",
@@ -106,6 +139,14 @@ def run(args) -> int:
         if not args.request_id:
             print("error: request_id required (or --list)", file=sys.stderr)
             return 2
+        if args.request_id == "decisions":
+            data = _fetch_json(
+                f"{base}/v1/router/decisions?limit={args.limit}")
+            if args.json:
+                print(json.dumps(data, indent=2))
+            else:
+                print(render_decisions(data.get("decisions", [])))
+            return 0
         if args.chrome:
             chrome = _fetch_json(
                 f"{base}/v1/traces/{args.request_id}?format=chrome")

@@ -313,6 +313,19 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
         except Exception:
             pass
         await pub.stop()
+        # deregistration cleanup: drop the published metric snapshots and
+        # this engine's per-worker gauge series so aggregators/dyntop stop
+        # rendering a ghost worker when the process (or a shared runtime)
+        # outlives this serve loop
+        from ..llm.metrics_aggregator import clear_worker_keys
+
+        await clear_worker_keys(drt.store, args.namespace, args.component,
+                                drt.worker_id)
+        if core is not None:
+            try:
+                engine.shutdown()   # joins the engine thread, clears gauges
+            except Exception:
+                log.exception("engine shutdown failed")
         if own_drt:
             await drt.close()
 

@@ -425,6 +425,29 @@ class EngineCore:
             out_shardings=self._rep_sharding)()
         self._decode_seen: Dict[int, str] = {}
 
+        # --- goodput accounting (utils/roofline.py) -------------------
+        # analytic FLOPs/bytes per dispatch over measured dispatch wall
+        # time, against the platform peak (whole-mesh: per-chip table
+        # peaks scale by device count; the calibrated CPU fallback is
+        # already host-wide, virtual devices share one memory bus)
+        from ..utils import roofline
+
+        dev0 = next(iter(self.mesh.devices.flat))
+        peaks = roofline.detect_peaks(dev0.device_kind, dev0.platform)
+        if peaks.source.startswith("table"):
+            n_dev = int(self.mesh.devices.size)
+            peaks = roofline.Peaks(peaks.flops * n_dev,
+                                   peaks.hbm_bytes * n_dev, peaks.source)
+        weight_bytes = float(sum(
+            int(a.size) * np.dtype(a.dtype).itemsize
+            for a in jax.tree.leaves(self.params)))
+        self.costs = roofline.model_costs(m, weight_bytes=weight_bytes)
+        self.goodput = roofline.GoodputMeter(self.costs, peaks)
+        # set by the compile-instrumentation wrapper when a dispatch's
+        # first call just XLA-compiled: that dispatch's wall time is
+        # compile, not compute, and must not poison the MFU window
+        self._just_compiled = False
+
         # --- compiled programs ---------------------------------------
         # decode reads are indexed through page tables of width S/page_size:
         # every S bucket MUST be a page multiple or the final partial page
@@ -541,12 +564,29 @@ class EngineCore:
         if self.proposer is not None:
             n += self.proposer.warmup()   # draft model's own bucket set
         jax.block_until_ready(self.k_pool)
+        # warmup's own compiles are counted; the first SERVING dispatch
+        # must not be skipped by the goodput meter on their account
+        self._just_compiled = False
         log.info("warmup compiled %d bucket programs in %.1fs",
                  n, time.monotonic() - t0)
 
     # ------------------------------------------------------------------
     # compiled program builders
     # ------------------------------------------------------------------
+    def _record_compile(self, kind: str, seconds: float) -> None:
+        """A fresh bucket program's first call just traced+XLA-compiled:
+        count it (compile plane) and flag the enclosing dispatch so the
+        goodput meter skips its wall time."""
+        from ..utils.roofline import record_compile
+
+        record_compile(kind, seconds)
+        self._just_compiled = True
+
+    def _take_compiled_flag(self) -> bool:
+        flag = self._just_compiled
+        self._just_compiled = False
+        return flag
+
     def _decode_fn(self, S: int):
         """Multi-step decode: N autoregressive iterations inside one jitted
         lax.scan — indices computed on device from page tables, sampled token
@@ -619,7 +659,9 @@ class EngineCore:
                 packed = jnp.stack([toks.astype(jnp.float32), logps], -1)
                 return packed, tok, key, k_pool, v_pool, counts
 
-            self._decode_fns[S] = step
+            from ..utils.roofline import instrument_compile
+            self._decode_fns[S] = instrument_compile(
+                "decode", step, self._record_compile)
         return self._decode_fns[S]
 
     def _prefill_fn(self, Bp: int, C: int, S: int, mm: bool = False):
@@ -671,7 +713,9 @@ class EngineCore:
                 packed = jnp.stack([tok.astype(jnp.float32), logp], -1)
                 return packed, tok, new_keys, k_pool, v_pool
 
-            self._prefill_batch_fns[(Bp, C, S, mm)] = fn
+            from ..utils.roofline import instrument_compile
+            self._prefill_batch_fns[(Bp, C, S, mm)] = instrument_compile(
+                "prefill", fn, self._record_compile)
         return self._prefill_batch_fns[(Bp, C, S, mm)]
 
     def _verify_fn(self, S: int, K: int):
@@ -735,7 +779,9 @@ class EngineCore:
                                               top_p, top_k, key)
                 return packed, new_key, k_pool, v_pool, counts
 
-            self._verify_fns[(S, K)] = fn
+            from ..utils.roofline import instrument_compile
+            self._verify_fns[(S, K)] = instrument_compile(
+                "verify", fn, self._record_compile)
         return self._verify_fns[(S, K)]
 
     @staticmethod
@@ -771,6 +817,7 @@ class EngineCore:
         total = self.pool.num_pages - 1
         hit_rate = (self.prefix_hit_tokens / self.prefix_query_tokens
                     if self.prefix_query_tokens else 0.0)
+        goodput = self.goodput.snapshot()
         return {
             "request_active_slots": float(self.active),
             "request_total_slots": float(self.cfg.max_batch),
@@ -784,6 +831,11 @@ class EngineCore:
             "spec_accept_rate": (
                 self.spec_accepted_total / self.spec_proposed_total
                 if self.spec_proposed_total else 0.0),
+            # goodput plane: windowed device-efficiency rates (0 when the
+            # engine has been idle for the whole window)
+            "mfu": goodput["mfu"],
+            "mbu": goodput["mbu"],
+            "hbm_gbps": goodput["hbm_gbps"],
         }
 
     # ------------------------------------------------------------------
@@ -1331,12 +1383,19 @@ class EngineCore:
                 "Bp": Bp, "C": C, "S": S, "seeds": seeds,
                 "last_lanes": last_lanes, "mm": bool(mm_arrays),
             }, arrays)
+        t_disp = time.perf_counter()
         packed = self._run_prefill_program(
             Bp, C, S, tokens, positions, write_idx, read_idx, read_pos,
             read_valid, last_i, temp, top_p, top_k, idxs, last_lanes,
             mm_arrays=mm_arrays)
 
         packed_np = np.asarray(packed)            # ONE host fetch
+        if not self._take_compiled_flag():
+            from ..utils.roofline import prefill_cost
+
+            fl, by, tk = prefill_cost(
+                self.costs, [(w[2], w[3]) for w in work])
+            self.goodput.account(fl, by, time.perf_counter() - t_disp, tk)
         for lane, (i, slot, start, count, is_last) in enumerate(work):
             slot.prefill_done = start + count
             if not is_last:
@@ -1500,6 +1559,8 @@ class EngineCore:
             S, tokens, page_tables, lengths, fresh, active_mask)
         self._inflight.append({"packed": packed, "final_tok": final_tok,
                                "active": active,
+                               "lengths": [phys for _, _, phys in active],
+                               "compiled": self._take_compiled_flag(),
                                "dispatched_at": time.perf_counter()})
 
     def _run_decode_program(self, S: int, tokens, page_tables, lengths,
@@ -1638,6 +1699,12 @@ class EngineCore:
             S, K, tokens, page_tables, lengths, fresh, active_mask,
             upd_tok, upd_mask)
         r = spec_unpack(np.asarray(packed), K)      # ONE host fetch
+        if not self._take_compiled_flag():
+            from ..utils.roofline import verify_cost
+
+            fl, by, tk = verify_cost(
+                self.costs, [phys for _, _, phys in active], T)
+            self.goodput.account(fl, by, time.perf_counter() - t0, tk)
         n_emitted = 0
         self.spec_dispatch_total += 1               # one verify dispatch
         for i, slot, phys in active:
@@ -1724,8 +1791,13 @@ class EngineCore:
             # effective per-token decode latency: dispatch -> results on
             # host, amortized over the dispatch's N steps (pipelined
             # dispatches overlap compute, which this deliberately reflects)
-            self.stage.decode_step.observe(
-                value=(time.perf_counter() - rec["dispatched_at"]) / N)
+            elapsed = time.perf_counter() - rec["dispatched_at"]
+            self.stage.decode_step.observe(value=elapsed / N)
+            if not rec.get("compiled"):
+                from ..utils.roofline import decode_cost
+
+                fl, by, tk = decode_cost(self.costs, rec["lengths"], N)
+                self.goodput.account(fl, by, elapsed, tk)
         outs: List[StepOutput] = []
         for i, slot, _ in rec["active"]:
             if self.slots[i] is not slot:
@@ -1857,6 +1929,8 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                         os.environ.get("DYN_PROFILE_STEPS"))
             profile_steps = 32
         profiling = False
+        last_gauges = 0.0
+        last_disp = 0
         while self._running:
             moved = False
             while True:
@@ -1886,6 +1960,12 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                         log.exception("prefill_extract failed")
                         loop.call_soon_threadsafe(_set_exception, fut, e)
             if not self.core.has_work:
+                # idle: keep the windowed goodput gauges honest (they
+                # decay to 0 as the last burst ages out of the window)
+                now = time.monotonic()
+                if now - last_gauges >= 5.0:
+                    last_gauges = now
+                    self._set_goodput_gauges(stage)
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
@@ -1910,6 +1990,16 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                 self.core._reap_cancelled()
             stage.batch_occupancy.set(str(os.getpid()),
                                       value=self.core.active)
+            # goodput gauges: refresh once dispatches have actually been
+            # accounted — throttled mid-burst, and ALWAYS at the end of a
+            # burst (has_work just drained) so a short request's MFU is
+            # visible on /metrics instead of a frozen pre-burst zero
+            disp = self.core.goodput.dispatches
+            now = time.monotonic()
+            if disp != last_disp and (now - last_gauges >= 0.5
+                                      or not self.core.has_work):
+                last_gauges, last_disp = now, disp
+                self._set_goodput_gauges(stage)
             if profiling:
                 profile_steps -= 1
                 if profile_steps <= 0:
@@ -1938,6 +2028,13 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                 log.info("XLA profile capture written to %s", profile_dir)
             except Exception:
                 log.exception("stopping XLA profile failed")
+
+    def _set_goodput_gauges(self, stage) -> None:
+        pid = str(os.getpid())
+        snap = self.core.goodput.snapshot()
+        stage.mfu.set(pid, value=snap["mfu"])
+        stage.mbu.set(pid, value=snap["mbu"])
+        stage.hbm_gbps.set(pid, value=snap["hbm_gbps"])
 
     def _deliver(self, so: StepOutput) -> None:
         loop = self._loop
@@ -2017,3 +2114,9 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
         self._running = False
         self._wake.set()
         self._thread.join(timeout=5)
+        # the engine's per-worker gauge series must die with it: a process
+        # that outlives its engine (model remove/re-add, shared-runtime
+        # tests) would otherwise export ghost occupancy/MFU forever
+        from ..utils.prometheus import stage_metrics
+
+        stage_metrics().clear_worker(str(os.getpid()))

@@ -269,7 +269,9 @@ class DraftModelProposer:
                 return (jnp.argmax(logits[:, 0], -1).astype(jnp.int32),
                         k_pool, v_pool)
 
-            self._sync_fns[(C, S)] = fn
+            from ..utils.roofline import instrument_compile, record_compile
+            self._sync_fns[(C, S)] = instrument_compile(
+                "draft", fn, record_compile)
         return self._sync_fns[(C, S)]
 
     def _prop_fn(self, S: int):
@@ -295,7 +297,9 @@ class DraftModelProposer:
                     one, (tok, length, k_pool, v_pool), None, length=n_steps)
                 return toks[:, 0], k_pool, v_pool   # [n_steps]
 
-            self._prop_fns[S] = fn
+            from ..utils.roofline import instrument_compile, record_compile
+            self._prop_fns[S] = instrument_compile(
+                "draft", fn, record_compile)
         return self._prop_fns[S]
 
     @staticmethod

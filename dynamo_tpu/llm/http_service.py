@@ -74,7 +74,8 @@ class ModelManager:
 class HttpService:
     def __init__(self, manager: Optional[ModelManager] = None,
                  host: str = "0.0.0.0", port: int = 8080, store=None,
-                 namespace: Optional[str] = None):
+                 namespace: Optional[str] = None,
+                 router_decisions=None):
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
@@ -84,6 +85,14 @@ class HttpService:
         # deployments' dumps, which must not pollute this scrape)
         self.store = store
         self.namespace = namespace
+        # optional async callable ``(limit) -> list | None``: fetches the
+        # KV router's decision-audit ring (None = router not reachable);
+        # unset when the deployment has no router at all
+        self.router_decisions = router_decisions
+        # set when this frontend also PUBLISHES a stage dump to the store
+        # (cli/http discovery mode): /metrics must skip its own published
+        # key or the scrape would merge this process's counters twice
+        self.stage_worker_id: Optional[int] = None
         self.stage = stage_metrics()
         self.registry = Registry()
         m = self.registry
@@ -111,6 +120,7 @@ class HttpService:
         app.router.add_get("/v1/models", self._models)
         app.router.add_get("/v1/traces", self._list_traces)
         app.router.add_get("/v1/traces/{request_id}", self._get_trace)
+        app.router.add_get("/v1/router/decisions", self._router_decisions)
         app.router.add_get("/health", self._health)
         app.router.add_get("/metrics", self._metrics)
         return app
@@ -127,6 +137,9 @@ class HttpService:
         return self.port
 
     async def stop(self) -> None:
+        pub = getattr(self, "_stage_pub_task", None)
+        if pub is not None:          # discovery-mode stage publish loop
+            pub.cancel()
         if self._runner:
             await self._runner.cleanup()
 
@@ -151,8 +164,9 @@ class HttpService:
             try:
                 from .metrics_aggregator import fetch_stage_states
 
-                states += await fetch_stage_states(self.store,
-                                                   self.namespace)
+                states += await fetch_stage_states(
+                    self.store, self.namespace,
+                    exclude_worker=self.stage_worker_id)
             except Exception:
                 log.exception("stage metrics scrape failed")
         text += render_states(states)
@@ -183,6 +197,27 @@ class HttpService:
             return web.json_response(tracing.to_chrome_trace(spans))
         return web.json_response(
             {"trace_id": rid, "spans": [s.to_dict() for s in spans]})
+
+    async def _router_decisions(self, req: web.Request) -> web.Response:
+        """The KV router's decision audit: per-request score breakdowns
+        (overlap/cache_usage/load per candidate, chosen worker, salt) from
+        the router's bounded ring. 404 when no router is configured."""
+        if self.router_decisions is None:
+            return _err(404, "no KV router configured on this frontend")
+        try:
+            limit = int(req.query.get("limit", "0"))
+        except ValueError:
+            return _err(400, "limit must be an integer")
+        try:
+            decisions = await self.router_decisions(limit)
+        except Exception as e:  # noqa: BLE001 - surface, don't 500-trace
+            log.exception("router decisions fetch failed")
+            return _err(502, f"router decisions fetch failed: {e}")
+        if decisions is None:
+            return _err(404, "router not reachable (no live router "
+                             "instance, or none discovered yet)")
+        return web.json_response({"decisions": decisions,
+                                  "count": len(decisions)})
 
     async def _models(self, _req: web.Request) -> web.Response:
         now = int(time.time())
@@ -446,7 +481,7 @@ def _request_timeout(req: web.Request) -> Optional[float]:
 
 
 _ERR_TYPES = {400: "invalid_request_error", 404: "not_found_error",
-              504: "timeout_error"}
+              502: "bad_gateway_error", 504: "timeout_error"}
 
 
 def _err(code: int, message: str,

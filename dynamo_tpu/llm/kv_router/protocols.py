@@ -104,6 +104,13 @@ class ForwardPassMetrics:
     # nothing proposed); lets the planner/router see whether a worker's
     # decode throughput is spec-amplified
     spec_accept_rate: float = 0.0
+    # goodput (utils/roofline.py): analytic MFU / memory-bandwidth
+    # utilization / achieved GB/s over the engine's recent dispatch window
+    # — "how close to the hardware" per worker, scraped by the aggregator,
+    # planner and dyntop alongside the capacity numbers above
+    mfu: float = 0.0
+    mbu: float = 0.0
+    hbm_gbps: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)

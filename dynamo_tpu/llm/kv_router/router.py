@@ -103,9 +103,14 @@ class KvRouterService:
     async def route(self, token_ids, lora_id: int = 0) -> Dict:
         overlaps = self.indexer.find_matches_for_tokens(token_ids,
                                                         lora_id=lora_id)
-        wid = await self.scheduler.schedule_or_wait(token_ids, overlaps)
+        wid = await self.scheduler.schedule_or_wait(token_ids, overlaps,
+                                                    salt=lora_id)
         return {"worker_id": wid,
                 "overlap_blocks": overlaps.scores.get(wid, 0)}
+
+    def decisions(self, limit: int = 0):
+        """The audit ring: every routed request's full score breakdown."""
+        return self.scheduler.decision_log(limit)
 
     async def serve(self, component: Component,
                     endpoint_name: str = "route") -> None:
@@ -114,3 +119,11 @@ class KvRouterService:
                                    int(request.get("lora_id", 0)))
 
         await component.endpoint(endpoint_name).serve(handler)
+
+        # decision audit: the frontend's GET /v1/router/decisions and
+        # `tracectl decisions` read the ring over this endpoint
+        async def decisions_handler(request, ctx):
+            limit = int((request or {}).get("limit", 0) or 0)
+            yield {"decisions": self.decisions(limit)}
+
+        await component.endpoint("decisions").serve(decisions_handler)

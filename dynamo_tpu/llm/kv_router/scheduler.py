@@ -12,9 +12,12 @@ override point, components/router/src/main.rs:36-95).
 from __future__ import annotations
 
 import asyncio
+import collections
+import os
 import random
+import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .indexer import OverlapScores
 from .protocols import ForwardPassMetrics, KVHitRateEvent
@@ -53,37 +56,80 @@ WorkerSelector = Callable[
     Optional[int]]
 
 
+def score_candidates(tokens: Sequence[int], block_size: int,
+                     overlaps: OverlapScores,
+                     endpoints: ProcessedEndpoints) -> List[Dict[str, Any]]:
+    """The full per-candidate score breakdown of the default cost — one
+    dict per live worker with every term the logit is built from, so a
+    routing decision is auditable after the fact instead of being a bare
+    worker id (the decision-audit ring and ``/v1/router/decisions`` expose
+    exactly this)."""
+    isl_blocks = max(1, len(tokens) // block_size)
+    out: List[Dict[str, Any]] = []
+    for wid, m in endpoints.workers.items():
+        saturated = bool(
+            m.request_total_slots
+            and m.request_active_slots >= m.request_total_slots
+            and m.num_requests_waiting > 0)
+        overlap = overlaps.scores.get(wid, 0)
+        overlap_norm = overlap / isl_blocks
+        load = (m.request_active_slots / m.request_total_slots
+                if m.request_total_slots else 0.0)
+        # full precision: the selector's tie-break compares these — the
+        # audit ring rounds at serialization time, not here
+        out.append({
+            "worker_id": wid,
+            "overlap_blocks": overlap,
+            "overlap_norm": overlap_norm,
+            "cache_usage": m.cache_usage,
+            "load": load,
+            "logit": 2.0 * overlap_norm - m.cache_usage - load,
+            "saturated": saturated,
+        })
+    return out
+
+
 def default_selector(tokens: Sequence[int], block_size: int,
                      overlaps: OverlapScores,
                      endpoints: ProcessedEndpoints,
-                     rng: Optional[random.Random] = None) -> Optional[int]:
-    """The 2*overlap - usage - load cost; None => no capacity anywhere."""
+                     rng: Optional[random.Random] = None,
+                     candidates: Optional[List[Dict[str, Any]]] = None
+                     ) -> Optional[int]:
+    """The 2*overlap - usage - load cost; None => no capacity anywhere.
+    ``candidates`` takes a precomputed :func:`score_candidates` result so
+    the audited scheduler scores each decision exactly once."""
     rng = rng or random
-    isl_blocks = max(1, len(tokens) // block_size)
     best: List[int] = []
     best_logit = None
-    for wid, m in endpoints.workers.items():
-        if (m.request_total_slots
-                and m.request_active_slots >= m.request_total_slots
-                and m.num_requests_waiting > 0):
-            continue  # saturated
-        overlap = overlaps.scores.get(wid, 0)
-        logit = (2.0 * (overlap / isl_blocks)
-                 - m.cache_usage
-                 - (m.request_active_slots / m.request_total_slots
-                    if m.request_total_slots else 0.0))
+    if candidates is None:
+        candidates = score_candidates(tokens, block_size, overlaps,
+                                      endpoints)
+    for c in candidates:
+        if c["saturated"]:
+            continue
+        logit = c["logit"]
         if best_logit is None or logit > best_logit + 1e-9:
-            best, best_logit = [wid], logit
+            best, best_logit = [c["worker_id"]], logit
         elif abs(logit - best_logit) <= 1e-9:
-            best.append(wid)
+            best.append(c["worker_id"])
     if not best:
         return None
     return rng.choice(best)
 
 
+def _audit_ring_size() -> int:
+    try:
+        return max(1, int(os.environ.get("DYN_ROUTER_AUDIT", "512")))
+    except ValueError:
+        return 512
+
+
 class KvScheduler:
     """Combines overlap scores + live endpoint metrics into a decision; emits
-    KVHitRateEvent telemetry for each routed request."""
+    KVHitRateEvent telemetry for each routed request and records every
+    decision's full score breakdown into a bounded audit ring
+    (``DYN_ROUTER_AUDIT`` entries, default 512) — the source behind
+    ``GET /v1/router/decisions`` and ``tracectl decisions``."""
 
     def __init__(self, block_size: int,
                  selector: Optional[WorkerSelector] = None,
@@ -92,6 +138,9 @@ class KvScheduler:
         self.selector = selector
         self.on_hit_rate = on_hit_rate
         self.endpoints = ProcessedEndpoints()
+        self.decisions: collections.deque = collections.deque(
+            maxlen=_audit_ring_size())
+        self._seq = 0
 
     def update_endpoints(self, workers: Dict[int, ForwardPassMetrics]) -> None:
         self.endpoints = ProcessedEndpoints(dict(workers))
@@ -99,13 +148,60 @@ class KvScheduler:
     def remove_worker(self, worker_id: int) -> None:
         self.endpoints.workers.pop(worker_id, None)
 
+    def decision_log(self, limit: int = 0) -> List[Dict[str, Any]]:
+        """The most recent decisions, oldest first; ``limit`` 0 = all that
+        survive in the ring."""
+        out = list(self.decisions)
+        return out[-limit:] if limit else out
+
+    def _record(self, tokens: Sequence[int], salt: int,
+                candidates: List[Dict[str, Any]],
+                wid: Optional[int]) -> None:
+        if wid is None:
+            # capacity-wait retries poll schedule() every ~50ms: collapse
+            # each waiter's saturation streak into ONE audited entry so
+            # waiting requests cannot flush the ring. CONCURRENT waiters
+            # interleave their polls, so scan the whole trailing run of
+            # None-decisions (bounded by the waiter count) for this
+            # waiter's entry, not just the newest one.
+            for d in reversed(self.decisions):
+                if d["worker_id"] is not None:
+                    break
+                if d["isl_tokens"] == len(tokens) and d["salt"] == salt:
+                    d["retries"] = d.get("retries", 0) + 1
+                    d["at"] = time.time()
+                    return
+        self._seq += 1
+        # candidates are rounded here (display precision); the selector
+        # saw the full-precision values
+        self.decisions.append({
+            "seq": self._seq,
+            "at": time.time(),
+            "isl_tokens": len(tokens),
+            "isl_blocks": max(1, len(tokens) // self.block_size),
+            "salt": salt,
+            "worker_id": wid,           # None = no capacity anywhere
+            "overlap_blocks": (next(
+                (c["overlap_blocks"] for c in candidates
+                 if c["worker_id"] == wid), 0) if wid is not None else 0),
+            "candidates": [
+                {**c, "overlap_norm": round(c["overlap_norm"], 4),
+                 "cache_usage": round(c["cache_usage"], 4),
+                 "load": round(c["load"], 4),
+                 "logit": round(c["logit"], 4)}
+                for c in candidates],
+        })
+
     def schedule(self, tokens: Sequence[int],
-                 overlaps: OverlapScores) -> Optional[int]:
+                 overlaps: OverlapScores, salt: int = 0) -> Optional[int]:
+        candidates = score_candidates(tokens, self.block_size, overlaps,
+                                      self.endpoints)
         if self.selector is not None:
             wid = self.selector(tokens, self.block_size, overlaps, self.endpoints)
         else:
             wid = default_selector(tokens, self.block_size, overlaps,
-                                   self.endpoints)
+                                   self.endpoints, candidates=candidates)
+        self._record(tokens, salt, candidates, wid)
         if wid is not None and self.on_hit_rate:
             self.on_hit_rate(KVHitRateEvent(
                 worker_id=wid,
@@ -116,11 +212,12 @@ class KvScheduler:
     async def schedule_or_wait(self, tokens: Sequence[int],
                                overlaps: OverlapScores,
                                poll_s: float = 0.05,
-                               timeout_s: float = 30.0) -> int:
+                               timeout_s: float = 30.0,
+                               salt: int = 0) -> int:
         """Wait for capacity when all workers are saturated."""
         deadline = asyncio.get_event_loop().time() + timeout_s
         while True:
-            wid = self.schedule(tokens, overlaps)
+            wid = self.schedule(tokens, overlaps, salt=salt)
             if wid is not None:
                 return wid
             if asyncio.get_event_loop().time() > deadline:

@@ -47,17 +47,41 @@ def stage_key(namespace: str, component: str, worker_id: int) -> str:
 
 
 async def publish_stage_metrics(store, namespace: str, component: str,
-                                worker_id: int, lease: int) -> None:
+                                worker_id: int, lease: int,
+                                extra_metrics: Optional[Dict] = None) -> None:
     """One refresh of this process's stage-histogram dump (workers call
-    this from their metrics loop)."""
+    this from their metrics loop). ``extra_metrics`` merges additional
+    registry ``state_dump()``s into the payload — the HTTP frontend ships
+    its request counters (`dyn_http_*`) this way so availability SLOs can
+    be evaluated cluster-wide."""
     from ..utils.prometheus import stage_metrics
 
+    metrics = stage_metrics().registry.state_dump()
+    if extra_metrics:
+        metrics.update(extra_metrics)
     payload = json.dumps({
         "component": component,
-        "metrics": stage_metrics().registry.state_dump(),
+        "metrics": metrics,
     }).encode()
     await store.put(stage_key(namespace, component, worker_id), payload,
                     lease=lease)
+
+
+async def clear_worker_keys(store, namespace: str, component: str,
+                            worker_id: int) -> None:
+    """Drop a worker's published metric snapshots at deregistration.
+
+    The keys are lease-bound, so a DEAD worker's snapshots vanish on their
+    own — but a worker that exits while its runtime (and lease) live on
+    (shared-runtime embedding, model remove/re-add) would otherwise keep
+    exporting ghost occupancy/MFU until the process dies. Best-effort: a
+    store mid-outage just leaves the lease TTL to do the same job later."""
+    for key in (metrics_key(namespace, component, worker_id),
+                stage_key(namespace, component, worker_id)):
+        try:
+            await store.delete(key)
+        except Exception:  # noqa: BLE001 - cleanup must never mask exit
+            log.debug("metrics key cleanup failed for %s", key)
 
 
 async def fetch_worker_metrics(store, namespace: str, component: str
@@ -77,13 +101,19 @@ async def fetch_worker_metrics(store, namespace: str, component: str
     return workers
 
 
-async def fetch_stage_states(store, namespace: Optional[str] = None
+async def fetch_stage_states(store, namespace: Optional[str] = None,
+                             exclude_worker: Optional[int] = None
                              ) -> List[tuple]:
     """All published stage dumps as ``(component, state_dump)`` pairs, ready
-    for :func:`dynamo_tpu.utils.prometheus.render_states`."""
+    for :func:`dynamo_tpu.utils.prometheus.render_states`.
+    ``exclude_worker`` skips one publisher's dump — a frontend that both
+    publishes and scrapes must not merge its own counters twice."""
     prefix = STAGE_PREFIX + (f"{namespace}/" if namespace else "")
     states: List[tuple] = []
     for key, value in await store.get_prefix(prefix):
+        if exclude_worker is not None and key.rsplit("/", 1)[-1] == \
+                f"{exclude_worker:x}":
+            continue
         try:
             d = json.loads(value.decode())
             states.append((d.get("component")

@@ -48,6 +48,16 @@ class PoolSignals:
     itl_p90: Optional[float] = None
     breaker_open: int = 0           # instances some observer sees OPEN
     worker_ids: List[int] = field(default_factory=list)
+    # SLO pressure (utils/slo.py): worst error-budget burn per declared
+    # objective across windows — burn > 1 means the budget is being spent
+    # faster than sustainable, i.e. direct scale-up pressure. Empty when
+    # no DYN_SLO_* objectives are configured.
+    slo_burn: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def slo_pressure(self) -> float:
+        """The single worst burn across objectives (0 = within budget)."""
+        return max(self.slo_burn.values(), default=0.0)
 
     @property
     def occupancy(self) -> float:
@@ -113,12 +123,11 @@ def quantile_from_states(states: Iterable[Tuple[str, Dict]], metric: str,
     return buckets[-1]
 
 
-def breaker_open_instances(states: Iterable[Tuple[str, Dict]],
-                           worker_ids: Iterable[int]) -> int:
-    """Instances in ``worker_ids`` that at least one observer's exported
-    ``dyn_circuit_state`` series currently marks OPEN (value 2)."""
-    ids = {f"{w:x}" for w in worker_ids}
-    open_ids = set()
+def open_instance_ids(states: Iterable[Tuple[str, Dict]]) -> Set[str]:
+    """Hex instance ids at least one observer's exported
+    ``dyn_circuit_state`` series currently marks OPEN (value 2) — shared
+    between the planner's breaker signal and dyntop's breaker column."""
+    open_ids: Set[str] = set()
     for _component, dump in states:
         st = dump.get("dyn_circuit_state")
         if not st or st.get("kind") != "gauge":
@@ -130,9 +139,15 @@ def breaker_open_instances(states: Iterable[Tuple[str, Dict]],
             continue
         for skey, val in st.get("series", {}).items():
             parts = skey.split("\x1f")
-            if len(parts) > pos and parts[pos] in ids and val == 2:
+            if len(parts) > pos and val == 2:
                 open_ids.add(parts[pos])
-    return len(open_ids)
+    return open_ids
+
+
+def breaker_open_instances(states: Iterable[Tuple[str, Dict]],
+                           worker_ids: Iterable[int]) -> int:
+    """Instances in ``worker_ids`` some observer currently sees OPEN."""
+    return len(open_instance_ids(states) & {f"{w:x}" for w in worker_ids})
 
 
 class SignalCollector:
@@ -143,10 +158,16 @@ class SignalCollector:
 
     def __init__(self, store, namespace: str, pools: Dict[str, str],
                  endpoint: str = "generate"):
+        from ..utils.slo import SloMonitor
+
         self.store = store
         self.namespace = namespace
         self.pools = dict(pools)
         self.endpoint = endpoint
+        # SLO burn monitor over the same stage dumps: its gauges land on
+        # the planner's stage registry (published with the dyn_planner_*
+        # series), its breach log feeds PoolSignals.slo_burn
+        self.slo = SloMonitor()
 
     async def live_instances(self, component: str,
                              known: Iterable[int] = ()) -> List[int]:
@@ -191,6 +212,9 @@ class SignalCollector:
 
     async def collect(self) -> Dict[str, PoolSignals]:
         stage_states, stage_ids = await self._fetch_stage()
+        if self.slo.objectives:
+            self.slo.observe(stage_states)
+        slo_burn = self.slo.max_burn()
         try:
             prefill_q = await self.store.q_len(
                 prefill_queue_name(self.namespace))
@@ -223,6 +247,10 @@ class SignalCollector:
                     stage_states, "llm_ttft_seconds", 0.90)
                 s.itl_p90 = quantile_from_states(
                     stage_states, "llm_inter_token_seconds", 0.90)
+                # end-to-end SLO burn is serving-side pressure, same
+                # attribution rule as ttft/itl above (more prefill
+                # replicas can't fix a decode-side latency breach)
+                s.slo_burn = dict(slo_burn)
             s.breaker_open = breaker_open_instances(stage_states, ids)
             out[pool] = s
         return out

@@ -340,6 +340,41 @@ class StageMetrics:
             "Accepted draft tokens per verify dispatch (per lane)", (),
             # token counts, not latencies: one bucket per plausible k
             buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0))
+        # goodput plane (utils/roofline.py): analytic FLOPs/bytes per
+        # dispatch over measured dispatch wall time against the platform
+        # peak table — "how close to the hardware is this worker"
+        self.mfu = r.gauge(
+            "dyn_mfu", "Model FLOP utilization over the recent dispatch "
+            "window (analytic cost model / platform peak)", ("worker",))
+        self.mbu = r.gauge(
+            "dyn_mbu", "Memory bandwidth utilization over the recent "
+            "dispatch window", ("worker",))
+        self.hbm_gbps = r.gauge(
+            "dyn_hbm_gbps", "Achieved main-memory GB/s over the recent "
+            "dispatch window", ("worker",))
+        # compile plane: warmup cost and bucket-explosion regressions are
+        # invisible in latency histograms until they hit a request — count
+        # every XLA program build (first call of a fresh bucket program)
+        self.compile_seconds = r.counter(
+            "dyn_compile_seconds_total",
+            "Wall seconds spent XLA-compiling bucket programs", ("kind",))
+        self.compiled_programs = r.counter(
+            "dyn_compiled_programs",
+            "Bucket programs compiled", ("kind",))   # prefill|decode|verify|draft
+        # SLO burn rates (utils/slo.py): whoever runs an SloMonitor in this
+        # process exports through here and the stage-metrics merge path
+        self.slo_burn = r.gauge(
+            "dyn_slo_burn_rate",
+            "Error-budget burn rate per SLO and window (1.0 = budget "
+            "consumed exactly at the sustainable rate)", ("slo", "window"))
+
+    def clear_worker(self, worker: str) -> None:
+        """Drop every per-worker gauge series for ``worker`` (pid). Wired
+        into engine shutdown/deregistration so a process that outlives its
+        engine (shared-runtime tests, model remove/re-add) stops exporting
+        ghost occupancy/MFU for an engine that no longer exists."""
+        for g in (self.batch_occupancy, self.mfu, self.mbu, self.hbm_gbps):
+            g.clear_label(0, worker)
 
 
 _stage: Optional[StageMetrics] = None

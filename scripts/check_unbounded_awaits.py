@@ -35,7 +35,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # request in the batch, so the file must stay visibly clean under this gate
 DEFAULT_PATHS = [os.path.join(REPO, "dynamo_tpu", "runtime"),
                  os.path.join(REPO, "dynamo_tpu", "planner"),
-                 os.path.join(REPO, "dynamo_tpu", "engine", "spec.py")]
+                 os.path.join(REPO, "dynamo_tpu", "engine", "spec.py"),
+                 # goodput plane: roofline runs on the engine thread, the
+                 # SLO monitor inside standing daemons (planner, dyntop),
+                 # and dyntop itself is a standing store-polling loop —
+                 # an unbounded await in any of them parks its owner
+                 os.path.join(REPO, "dynamo_tpu", "utils", "roofline.py"),
+                 os.path.join(REPO, "dynamo_tpu", "utils", "slo.py"),
+                 os.path.join(REPO, "dynamo_tpu", "cli", "dyntop.py")]
 
 # method/function names whose await parks on the network
 NETWORK_CALLS = {"open_connection", "readexactly", "read", "drain",

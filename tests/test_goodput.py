@@ -1,0 +1,628 @@
+"""Goodput telemetry plane: roofline cost model (hand-computed values),
+SLO burn-rate windows, router decision audit (ring + loopback endpoint),
+dyntop rendering, ghost-worker gauge cleanup, and the metrics-catalog gate.
+
+Engine-dependent tests share ONE tiny module core (tier-1 is near its
+timeout budget; every extra engine build compiles bucket programs).
+"""
+
+import asyncio
+import importlib.util
+import json
+import os
+
+import pytest
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.utils import roofline
+from dynamo_tpu.utils.prometheus import Registry, StageMetrics
+from dynamo_tpu.utils.slo import SloMonitor, SloObjective
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# roofline cost model: hand-computed values on a tiny sliding-window config
+# ---------------------------------------------------------------------------
+def tiny_cfg():
+    import jax.numpy as jnp
+
+    # layer 0 slides (window 4), layer 1 is full attention
+    return llama.LlamaConfig(
+        vocab_size=32, hidden_size=8, num_layers=2, num_heads=2,
+        num_kv_heads=1, head_dim=4, intermediate_size=16,
+        sliding_window=4, sliding_pattern=2, dtype=jnp.bfloat16)
+
+
+def test_model_costs_hand_computed():
+    c = roofline.model_costs(tiny_cfg())
+    # attn proj 192 + mlp 384 per layer, 2 layers, 2 FLOPs/MAC
+    assert c.mat_flops_per_token == 2 * 2 * (192 + 384) == 2304
+    assert c.lm_head_flops == 2 * 8 * 32 == 512
+    assert c.attn_flops_coef == 4 * 2 * 4 == 32
+    assert c.kv_bytes_per_tok_layer == 2 * 1 * 4 * 2 == 16
+    # V*D embed + per-layer weights + untied head, bf16
+    assert c.weight_bytes == (256 + 2 * 576 + 256) * 2 == 3328
+    assert dict(c.window_groups) == {4: 1, None: 1}
+
+
+def test_decode_cost_hand_computed():
+    c = roofline.model_costs(tiny_cfg())
+    # one lane at kv length 10, two scan steps:
+    # j=0: touched = min(10,4)+10 = 14 -> 2304+512+32*14 = 3264
+    # j=1: touched = min(11,4)+11 = 15 -> 2304+512+32*15 = 3296
+    flops, bytes_, tokens = roofline.decode_cost(c, [10], steps=2)
+    assert flops == 3264 + 3296 == 6560
+    # 2x weights + kv reads (14+15)*16 + writes 2 tok * 2 layers * 16
+    assert bytes_ == 2 * 3328 + 29 * 16 + 64 == 7184
+    assert tokens == 2
+
+
+def test_prefill_cost_hand_computed():
+    c = roofline.model_costs(tiny_cfg())
+    # one lane prefilling tokens 0..2; LM head charged once per lane
+    # touched at s=1,2,3: 2, 4, 6 (window 4 never clamps yet)
+    flops, bytes_, tokens = roofline.prefill_cost(c, [(0, 3)])
+    assert flops == 3 * 2304 + 512 + 32 * (2 + 4 + 6) == 7808
+    assert bytes_ == 3328 + (2 + 4 + 6) * 16 + 3 * 2 * 16 == 3616
+    assert tokens == 3
+    # deep into the prompt the sliding layer clamps: s=50 -> 4+50
+    flops2, _, _ = roofline.prefill_cost(c, [(49, 1)])
+    assert flops2 == 2304 + 512 + 32 * 54
+
+
+def test_verify_cost_hand_computed():
+    c = roofline.model_costs(tiny_cfg())
+    # spec verify: same per-token math as decode but weights stream ONCE
+    flops, bytes_, tokens = roofline.verify_cost(c, [10], t=2)
+    assert flops == 6560
+    assert bytes_ == 3328 + 29 * 16 + 64 == 3856
+    assert tokens == 2
+
+
+def test_peaks_table_and_env_override(monkeypatch):
+    p = roofline.detect_peaks("TPU v5e", "tpu")
+    assert p.flops == 197e12 and p.hbm_bytes == 819e9
+    assert p.source == "table:v5e"
+    monkeypatch.setenv("DYN_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("DYN_PEAK_GBPS", "100")
+    p = roofline.detect_peaks("TPU v5e", "tpu")
+    assert p.flops == 1e12 and p.hbm_bytes == 100e9 and p.source == "env"
+
+
+def test_goodput_meter_windows_and_lifetime():
+    c = roofline.model_costs(tiny_cfg())
+    m = roofline.GoodputMeter(c, roofline.Peaks(1e9, 1e9, "test"),
+                              window_s=60.0)
+    m.account(flops=5e8, bytes_=2.5e8, elapsed_s=1.0, tokens=8)
+    snap = m.snapshot()
+    assert snap["mfu"] == pytest.approx(0.5)
+    assert snap["mbu"] == pytest.approx(0.25)
+    assert snap["hbm_gbps"] == pytest.approx(0.25)
+    life = m.lifetime()
+    assert life["tokens"] == 8 and life["dispatches"] == 1
+    assert life["mfu"] == pytest.approx(0.5)
+    # zero-elapsed accounting is dropped, not a divide-by-zero
+    m.account(1.0, 1.0, 0.0)
+    assert m.lifetime()["dispatches"] == 1
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate monitor on synthetic histogram / counter states
+# ---------------------------------------------------------------------------
+def _hist_state(total, bad):
+    # buckets 0.1/0.5/1.0; threshold 0.5 puts `bad` observations above it
+    return {"llm_ttft_seconds": {
+        "kind": "histogram", "labels": ["model"],
+        "buckets": [0.1, 0.5, 1.0],
+        "series": {"m": {"counts": [total - bad, 0, bad],
+                         "sum": 1.0, "total": total}}}}
+
+
+def test_slo_burn_multi_window():
+    o = SloObjective("ttft_p90", 0.90, "llm_ttft_seconds", 0.5)
+    mon = SloMonitor([o], windows=(60.0, 300.0), registry_gauge=None)
+    mon.observe([("http", _hist_state(0, 0))], now=1000.0)
+    # 30s later: 100 requests, 5 over threshold -> 5% bad / 10% budget
+    burn = mon.observe([("http", _hist_state(100, 5))], now=1030.0)
+    assert burn["ttft_p90"][60.0] == pytest.approx(0.5)
+    assert burn["ttft_p90"][300.0] == pytest.approx(0.5)
+    assert not mon.breaches
+    # 30s later again: 100 more requests, 40 of them bad -> the 60s window
+    # sees (45 bad / 200 total) since t=1000 -> burn 2.25, breach logged
+    burn = mon.observe([("http", _hist_state(200, 45))], now=1060.0)
+    assert burn["ttft_p90"][60.0] == pytest.approx(2.25)
+    assert mon.breaches and mon.breaches[-1].slo == "ttft_p90"
+    assert mon.max_burn()["ttft_p90"] == pytest.approx(2.25)
+
+
+def test_slo_availability_counts_5xx_only():
+    o = SloObjective("availability", 0.99, "dyn_http_requests_total")
+    mon = SloMonitor([o], windows=(60.0,), registry_gauge=None)
+
+    def state(ok, s404, s500):
+        series = {}
+        if ok:
+            series["m\x1fchat\x1f200"] = ok
+        if s404:
+            series["m\x1fchat\x1f404"] = s404
+        if s500:
+            series["m\x1fchat\x1f500"] = s500
+        return {"dyn_http_requests_total": {
+            "kind": "counter", "labels": ["model", "endpoint", "status"],
+            "series": series}}
+
+    mon.observe([("http", state(0, 0, 0))], now=0.0)
+    burn = mon.observe([("http", state(96, 2, 2))], now=30.0)
+    # 2 bad / 100 total = 2% against a 1% budget -> burn 2 (404s are free)
+    assert burn["availability"][60.0] == pytest.approx(2.0)
+
+
+def test_slo_objectives_from_env(monkeypatch):
+    from dynamo_tpu.utils.slo import objectives_from_env, windows_from_env
+
+    assert objectives_from_env({}) == []
+    objs = objectives_from_env({"DYN_SLO_TTFT_P90": "0.5",
+                                "DYN_SLO_AVAILABILITY": "0.999"})
+    assert {o.name for o in objs} == {"ttft_p90", "availability"}
+    assert windows_from_env({"DYN_SLO_WINDOWS": "30,60"}) == (30.0, 60.0)
+    assert windows_from_env({"DYN_SLO_WINDOWS": "bogus"}) == (60.0, 300.0,
+                                                              1800.0)
+
+
+# ---------------------------------------------------------------------------
+# router decision audit
+# ---------------------------------------------------------------------------
+def _endpoints(workers):
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+
+    return {wid: ForwardPassMetrics(**kw) for wid, kw in workers.items()}
+
+
+def test_scheduler_records_decision_breakdown():
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+    from dynamo_tpu.llm.kv_router.scheduler import KvScheduler
+
+    sched = KvScheduler(block_size=4)
+    sched.update_endpoints(_endpoints({
+        1: dict(request_active_slots=1, request_total_slots=4,
+                kv_active_blocks=10, kv_total_blocks=100),
+        2: dict(request_active_slots=3, request_total_slots=4,
+                kv_active_blocks=90, kv_total_blocks=100),
+    }))
+    ov = OverlapScores()
+    ov.scores[1] = 2
+    wid = sched.schedule(list(range(16)), ov, salt=7)
+    assert wid == 1
+    (d,) = sched.decision_log()
+    assert d["worker_id"] == 1 and d["salt"] == 7
+    assert d["isl_blocks"] == 4 and d["overlap_blocks"] == 2
+    by_wid = {c["worker_id"]: c for c in d["candidates"]}
+    assert set(by_wid) == {1, 2}
+    # worker 1: 2*(2/4) - 0.1 - 0.25 = 0.65 ; worker 2: -0.9 - 0.75
+    assert by_wid[1]["logit"] == pytest.approx(0.65)
+    assert by_wid[2]["logit"] == pytest.approx(-1.65)
+    assert by_wid[1]["overlap_norm"] == pytest.approx(0.5)
+    assert not by_wid[1]["saturated"]
+
+
+def test_scheduler_collapses_capacity_wait_retries():
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+    from dynamo_tpu.llm.kv_router.scheduler import KvScheduler
+
+    sched = KvScheduler(block_size=4)
+    sched.update_endpoints(_endpoints({
+        1: dict(request_active_slots=4, request_total_slots=4,
+                num_requests_waiting=2),
+    }))
+    for _ in range(5):
+        assert sched.schedule([1, 2, 3, 4], OverlapScores(), salt=0) is None
+    log = sched.decision_log()
+    assert len(log) == 1
+    assert log[0]["worker_id"] is None and log[0]["retries"] == 4
+
+
+def test_scheduler_collapse_survives_interleaved_waiters():
+    """Two concurrent saturated waiters (different prompt lengths) poll
+    schedule() alternately: each keeps ONE collapsed entry — interleaving
+    must not defeat the collapse and flush the ring."""
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+    from dynamo_tpu.llm.kv_router.scheduler import KvScheduler
+
+    sched = KvScheduler(block_size=4)
+    sched.update_endpoints(_endpoints({
+        1: dict(request_active_slots=4, request_total_slots=4,
+                num_requests_waiting=2),
+    }))
+    for _ in range(6):   # alternate polls, like two schedule_or_wait loops
+        assert sched.schedule([1] * 8, OverlapScores()) is None
+        assert sched.schedule([1] * 12, OverlapScores()) is None
+    log = sched.decision_log()
+    assert len(log) == 2
+    assert {d["isl_tokens"] for d in log} == {8, 12}
+    assert all(d["retries"] == 5 for d in log)
+
+
+def test_goodput_meter_thread_safe():
+    """account() on the engine thread races snapshot()/lifetime() on the
+    metrics loop — must never raise 'deque mutated during iteration'."""
+    import threading
+
+    c = roofline.model_costs(tiny_cfg())
+    m = roofline.GoodputMeter(c, roofline.Peaks(1e9, 1e9, "test"),
+                              window_s=0.001)   # constant popleft churn
+    stop = threading.Event()
+    errs = []
+
+    def writer():
+        while not stop.is_set():
+            m.account(1e6, 1e6, 1e-4, 1)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(3000):
+            m.snapshot()
+            m.lifetime()
+    except RuntimeError as e:   # pragma: no cover - the bug under test
+        errs.append(e)
+    finally:
+        stop.set()
+        t.join()
+    assert not errs
+
+
+def test_render_decisions():
+    from dynamo_tpu.cli.tracectl import render_decisions
+
+    assert "no routing decisions" in render_decisions([])
+    text = render_decisions([{
+        "seq": 3, "at": 0.0, "isl_tokens": 16, "isl_blocks": 4, "salt": 0,
+        "worker_id": 26, "overlap_blocks": 2, "candidates": [
+            {"worker_id": 26, "overlap_blocks": 2, "overlap_norm": 0.5,
+             "cache_usage": 0.1, "load": 0.25, "logit": 0.65,
+             "saturated": False},
+            {"worker_id": 27, "overlap_blocks": 0, "overlap_norm": 0.0,
+             "cache_usage": 0.9, "load": 0.75, "logit": -1.65,
+             "saturated": True}]}])
+    assert "-> 1a" in text and "logit=+0.6500" in text
+    assert "SATURATED" in text
+
+
+async def test_decisions_endpoint_loopback_smoke():
+    """Store + router service + frontend as a real loopback: every routed
+    request shows up on GET /v1/router/decisions with its breakdown."""
+    import aiohttp
+
+    from dynamo_tpu.llm.http_service import HttpService, ModelManager
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.kv_router.router import KvRouterService
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    srv = StoreServer()
+    port = await srv.start()
+    http = None
+    try:
+        rdrt = await DistributedRuntime(store_port=port).connect()
+        cdrt = await DistributedRuntime(store_port=port).connect()
+        svc = KvRouterService(rdrt, "dynamo", "backend", block_size=4)
+        svc.scheduler.update_endpoints({
+            0xaa: ForwardPassMetrics(request_active_slots=0,
+                                     request_total_slots=4),
+            0xbb: ForwardPassMetrics(request_active_slots=1,
+                                     request_total_slots=4)})
+        comp = rdrt.namespace("dynamo").component("router")
+        await svc.serve(comp)
+
+        route_cl = await cdrt.namespace("dynamo").component("router") \
+            .endpoint("route").client().start()
+        dec_cl = await cdrt.namespace("dynamo").component("router") \
+            .endpoint("decisions").client().start()
+
+        routed = 0
+        for i in range(3):
+            async for resp in route_cl.generate(
+                    {"token_ids": list(range(8 + i))}):
+                assert resp["worker_id"] in (0xaa, 0xbb)
+                routed += 1
+
+        async def fetch(limit):
+            async for resp in dec_cl.generate({"limit": int(limit)}):
+                return resp.get("decisions", [])
+            return None
+
+        http = HttpService(ModelManager(), host="127.0.0.1", port=0,
+                           router_decisions=fetch)
+        hport = await http.start()
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                    f"http://127.0.0.1:{hport}/v1/router/decisions") as r:
+                assert r.status == 200
+                body = await r.json()
+        decs = body["decisions"]
+        # a breakdown for EVERY routed request
+        assert len(decs) == routed == 3
+        for d in decs:
+            assert d["worker_id"] in (0xaa, 0xbb)
+            assert {c["worker_id"] for c in d["candidates"]} == {0xaa, 0xbb}
+            for c in d["candidates"]:
+                assert {"overlap_norm", "cache_usage", "load",
+                        "logit"} <= set(c)
+        await svc.stop()
+        await cdrt.close()
+        await rdrt.close()
+    finally:
+        if http is not None:
+            await http.stop()
+        await srv.stop()
+
+
+async def test_decisions_endpoint_404_without_router():
+    import aiohttp
+
+    from dynamo_tpu.llm.http_service import HttpService, ModelManager
+
+    http = HttpService(ModelManager(), host="127.0.0.1", port=0)
+    hport = await http.start()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                    f"http://127.0.0.1:{hport}/v1/router/decisions") as r:
+                assert r.status == 404
+    finally:
+        await http.stop()
+
+
+# ---------------------------------------------------------------------------
+# dyntop
+# ---------------------------------------------------------------------------
+def test_dyntop_render():
+    from dynamo_tpu.cli.dyntop import render
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+
+    snap = {
+        "namespace": "dynamo",
+        "ttft_p90": 0.25, "itl_p90": 0.004, "prefill_queue": 3,
+        "compiles": {"decode": (4, 2.5), "prefill": (9, 11.0)},
+        "slo_burn": {"ttft_p90": {60.0: 2.25, 300.0: 0.4}},
+        "breaker_open": {"bb"},
+        "workers": {"backend": {
+            0xaa: ForwardPassMetrics(
+                request_active_slots=3, request_total_slots=4,
+                kv_active_blocks=50, kv_total_blocks=100,
+                gpu_prefix_cache_hit_rate=0.5, spec_accept_rate=0.9,
+                mfu=0.123, mbu=0.456, hbm_gbps=321.0),
+            0xbb: ForwardPassMetrics(request_total_slots=4),
+        }},
+    }
+    text = render(snap)
+    assert "ttft_p90=0.250" in text and "prefill_q=3" in text
+    assert "decode=4 (2.5s)" in text
+    assert "BREACH" in text and "60s=2.25" in text
+    row = next(l for l in text.splitlines() if l.lstrip().startswith("aa"))
+    assert "3/4" in row and "12.30" in row and "45.60" in row \
+        and "321.00" in row and "90.0" in row and "ok" in row
+    row_b = next(l for l in text.splitlines()
+                 if l.lstrip().startswith("bb"))
+    assert "OPEN" in row_b
+    # empty cluster renders a hint, not a crash
+    assert "no live workers" in render({"namespace": "x", "workers": {}})
+
+
+async def test_dyntop_collect_loopback():
+    from dynamo_tpu.cli.dyntop import ClusterSnapshotter, render
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.metrics_aggregator import metrics_key, stage_key
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    srv = StoreServer()
+    port = await srv.start()
+    try:
+        drt = await DistributedRuntime(store_port=port).connect()
+        m = ForwardPassMetrics(request_active_slots=2,
+                               request_total_slots=8, kv_active_blocks=5,
+                               kv_total_blocks=10, mfu=0.2, mbu=0.3,
+                               hbm_gbps=42.0)
+        await drt.store.put(
+            metrics_key("dynamo", "backend", drt.worker_id),
+            json.dumps(m.to_dict()).encode(), lease=drt.lease)
+        await drt.store.put(
+            stage_key("dynamo", "backend", drt.worker_id),
+            json.dumps({"component": "backend", "metrics": {
+                "dyn_compiled_programs": {
+                    "kind": "counter", "labels": ["kind"],
+                    "series": {"decode": 3.0}}}}).encode(),
+            lease=drt.lease)
+        snap = await ClusterSnapshotter(
+            drt.store, "dynamo", ["backend"]).collect()
+        assert snap["compiles"]["decode"][0] == 3.0
+        text = render(snap)
+        assert f"{drt.worker_id:x}" in text and "42.00" in text
+        await drt.close()
+    finally:
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# ghost-worker cleanup (churn)
+# ---------------------------------------------------------------------------
+def test_stage_metrics_clear_worker():
+    sm = StageMetrics(Registry())
+    for pid in ("11", "22"):
+        sm.batch_occupancy.set(pid, value=3.0)
+        sm.mfu.set(pid, value=0.5)
+        sm.hbm_gbps.set(pid, value=9.0)
+    sm.clear_worker("11")
+    assert sm.batch_occupancy.get("11") == 0.0
+    assert sm.mfu.get("11") == 0.0 and sm.hbm_gbps.get("11") == 0.0
+    assert sm.batch_occupancy.get("22") == 3.0 and sm.mfu.get("22") == 0.5
+
+
+async def test_worker_churn_clears_published_keys():
+    """A worker exiting under a STILL-LIVE lease (shared runtime) must not
+    leave ghost metric snapshots: clear_worker_keys drops them and the
+    aggregator's next scrape stops rendering the worker."""
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.metrics_aggregator import (
+        ClusterMetricsAggregator, clear_worker_keys, metrics_key, stage_key)
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    srv = StoreServer()
+    port = await srv.start()
+    try:
+        w = await DistributedRuntime(store_port=port).connect()
+        agg_rt = await DistributedRuntime(store_port=port).connect()
+        m = ForwardPassMetrics(request_active_slots=1, request_total_slots=4)
+        await w.store.put(metrics_key("dynamo", "backend", w.worker_id),
+                          json.dumps(m.to_dict()).encode(), lease=w.lease)
+        await w.store.put(
+            stage_key("dynamo", "backend", w.worker_id),
+            json.dumps({"component": "backend", "metrics": {}}).encode(),
+            lease=w.lease)
+
+        agg = ClusterMetricsAggregator(agg_rt, "dynamo", ["backend"])
+        await agg.scrape_once()
+        assert w.worker_id in agg.workers["backend"]
+        assert agg.stage_states
+
+        # deregistration cleanup — the lease stays alive (shared runtime)
+        await clear_worker_keys(w.store, "dynamo", "backend", w.worker_id)
+        await agg.scrape_once()
+        assert agg.workers["backend"] == {}
+        assert agg.stage_states == []
+        assert agg.g_slots_active.get(
+            "backend", f"{w.worker_id:x}") == 0.0   # series gone
+        await w.close()
+        await agg_rt.close()
+    finally:
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# metrics catalog gate + engine integration
+# ---------------------------------------------------------------------------
+def test_metrics_catalog_in_sync():
+    path = os.path.join(REPO, "scripts", "check_metrics_catalog.py")
+    spec = importlib.util.spec_from_file_location("check_catalog", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    findings = mod.run()
+    assert findings == [], "\n".join(findings)
+    # sanity: the walker actually sees the registries
+    names = mod.registered_metrics()
+    assert "dyn_mfu" in names and "llm_ttft_seconds" in names
+    assert "llm_kv_hit_rate_percent" in names   # alias-registered (g = ...)
+
+
+def test_forward_pass_metrics_roundtrip_with_goodput():
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+
+    m = ForwardPassMetrics(mfu=0.3, mbu=0.6, hbm_gbps=123.0)
+    again = ForwardPassMetrics.from_dict(m.to_dict())
+    assert (again.mfu, again.mbu, again.hbm_gbps) == (0.3, 0.6, 123.0)
+    # old-format dicts (no goodput fields) still parse
+    legacy = {k: v for k, v in m.to_dict().items()
+              if k not in ("mfu", "mbu", "hbm_gbps")}
+    assert ForwardPassMetrics.from_dict(legacy).mfu == 0.0
+
+
+def test_engine_goodput_accounting_and_compile_counters():
+    """One tiny engine run: utilization() exports non-zero goodput, every
+    dispatch kind lands in the meter, and the compile plane counted the
+    bucket programs (kept to ONE engine build for tier-1 budget)."""
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+    from dynamo_tpu.llm.protocols.common import BackendInput, StopConditions
+    from dynamo_tpu.utils.prometheus import stage_metrics
+
+    sm = stage_metrics()
+    prog0 = {k: sm.compiled_programs.get(k) for k in ("prefill", "decode")}
+    core = EngineCore(JaxEngineConfig(
+        model=llama.preset("tiny-byte"), tp=1, page_size=8, max_batch=2,
+        max_context=128, prefill_chunk=32))
+    core.submit("g1", BackendInput(
+        token_ids=list(range(1, 20)),
+        stop=StopConditions(max_tokens=10, ignore_eos=True)))
+    done = False
+    for _ in range(400):
+        for so in core.step():
+            done = done or so.finish is not None
+        if done:
+            break
+    assert done
+    u = core.utilization()
+    assert u["mfu"] > 0 and u["mbu"] > 0 and u["hbm_gbps"] > 0
+    life = core.goodput.lifetime()
+    assert life["dispatches"] >= 2 and life["tokens"] > 0
+    assert life["flops_total"] > 0 and life["busy_s"] > 0
+    assert sm.compiled_programs.get("prefill") >= prog0["prefill"] + 1
+    assert sm.compiled_programs.get("decode") >= prog0["decode"] + 1
+    assert sm.compile_seconds.get("decode") > 0
+    # the peak denominator is real on CPU too (calibrated fallback)
+    assert life["peak_flops"] > 0 and life["peak_source"] in (
+        "calibrated-cpu", "env") or life["peak_source"].startswith("table")
+
+
+async def test_frontend_stage_publish_feeds_slo_monitor():
+    """The SLO monitor's inputs must actually REACH the store plane: a
+    frontend publishing its stage dump + HTTP request counters (the
+    cli/http discovery-mode loop) makes latency AND availability
+    objectives evaluable from fetch_stage_states — and the frontend's own
+    /metrics scrape can exclude its published key (no double-merge)."""
+    from dynamo_tpu.llm.metrics_aggregator import (fetch_stage_states,
+                                                   publish_stage_metrics)
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+    from dynamo_tpu.utils.prometheus import Registry
+
+    srv = StoreServer()
+    port = await srv.start()
+    try:
+        drt = await DistributedRuntime(store_port=port).connect()
+        http_reg = Registry()
+        req_counter = http_reg.counter("dyn_http_requests_total", "rq",
+                                       ("model", "endpoint", "status"))
+        req_counter.inc("m", "chat", "200", amount=98)
+        req_counter.inc("m", "chat", "500", amount=2)
+        await publish_stage_metrics(
+            drt.store, "dynamo", "http", drt.worker_id, drt.lease,
+            extra_metrics=http_reg.state_dump())
+
+        states = await fetch_stage_states(drt.store, "dynamo")
+        assert any("dyn_http_requests_total" in dump
+                   for _c, dump in states)
+        mon = SloMonitor(
+            [SloObjective("availability", 0.99, "dyn_http_requests_total")],
+            windows=(60.0,), registry_gauge=None)
+        mon.observe(states, now=0.0)
+        # cumulative counters: the first delta IS the published totals
+        burn = mon.observe(states, now=30.0)
+        assert burn["availability"][60.0] == pytest.approx(0.0)  # no delta
+        req_counter.inc("m", "chat", "500", amount=2)
+        await publish_stage_metrics(
+            drt.store, "dynamo", "http", drt.worker_id, drt.lease,
+            extra_metrics=http_reg.state_dump())
+        states2 = await fetch_stage_states(drt.store, "dynamo")
+        burn = mon.observe(states2, now=60.0)
+        # 2 new bad / 2 new total over the window -> 100% bad / 1% budget
+        assert burn["availability"][60.0] == pytest.approx(100.0)
+
+        # the publisher's own scrape skips its key; others still see it
+        assert await fetch_stage_states(
+            drt.store, "dynamo", exclude_worker=drt.worker_id) == []
+        assert len(await fetch_stage_states(drt.store, "dynamo")) == 1
+        await drt.close()
+    finally:
+        await srv.stop()
+
+
+def test_planner_signals_carry_slo_burn():
+    from dynamo_tpu.planner.signals import PoolSignals
+
+    s = PoolSignals(pool="decode", slo_burn={"ttft_p90": 2.5,
+                                             "availability": 0.1})
+    assert s.slo_pressure == 2.5
+    assert s.to_dict()["slo_burn"]["ttft_p90"] == 2.5
+    assert PoolSignals(pool="prefill").slo_pressure == 0.0
