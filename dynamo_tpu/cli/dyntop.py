@@ -61,22 +61,31 @@ class ClusterSnapshotter:
         self.slo = SloMonitor(registry_gauge=None)
 
     async def collect(self) -> Dict:
-        from ..llm.disagg import prefill_queue_name
+        from ..llm.disagg import prefill_queue_names
         from ..llm.metrics_aggregator import (fetch_stage_states,
                                               fetch_worker_metrics)
         from ..planner.signals import open_instance_ids, quantile_from_states
+        from ..utils.overload import (admission_depth_total,
+                                      brownout_level_from_states,
+                                      shed_totals)
 
         states = await fetch_stage_states(self.store, self.namespace)
         workers: Dict[str, Dict] = {}
         for comp in self.components:
             workers[comp] = await fetch_worker_metrics(
                 self.store, self.namespace, comp)
-        try:
-            q_depth = await self.store.q_len(
-                prefill_queue_name(self.namespace))
-        except Exception:  # noqa: BLE001 - queue plane optional
-            q_depth = 0
+        q_depth = 0
+        for qname in prefill_queue_names(self.namespace):
+            try:
+                q_depth += await self.store.q_len(qname)
+            except Exception:  # noqa: BLE001 - queue plane optional
+                pass
         burn = self.slo.observe(states) if self.slo.objectives else {}
+        overload = {
+            "brownout": brownout_level_from_states(states),
+            "shed_total": shed_totals(states),
+            "admission_depth": admission_depth_total(states),
+        }
         return {
             "at": time.time(),
             "namespace": self.namespace,
@@ -89,6 +98,7 @@ class ClusterSnapshotter:
             "prefill_queue": q_depth,
             "compiles": _compile_totals(states),
             "slo_burn": burn,
+            "overload": overload,
         }
 
 
@@ -133,6 +143,16 @@ def render(snap: Dict) -> str:
         worst = max(per_w.values()) if per_w else 0.0
         flag = "  BREACH" if worst > 1.0 else ""
         lines.append(f"slo {slo}: burn {burns}{flag}")
+    ov = snap.get("overload") or {}
+    if any(ov.get(k) for k in ("brownout", "shed_total",
+                               "admission_depth")):
+        from ..utils.overload import LEVEL_NAMES
+
+        lvl = int(ov.get("brownout", 0))
+        lines.append(
+            f"overload: brownout=L{lvl} ({LEVEL_NAMES.get(lvl, '?')})  "
+            f"shed={int(ov.get('shed_total', 0))}  "
+            f"admit_q={int(ov.get('admission_depth', 0))}")
     lines.append(
         f"{'worker':>10} {'comp':<9} {'slots':>7} {'kv%':>5} {'hit%':>5} "
         f"{'mfu%':>6} {'mbu%':>6} {'GB/s':>7} {'spec%':>6} {'brk':>4}")

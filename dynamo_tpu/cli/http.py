@@ -189,6 +189,14 @@ async def run_http(args, *, ready_event=None,
 
     svc.stage_worker_id = drt.worker_id   # /metrics skips our own dump
     pub_ns = getattr(args, "namespace", None) or "dynamo"
+    # fleet brownout level (utils/overload.py): watch the store key the
+    # controller publishes so THIS frontend's admission gate applies the
+    # active degradation level — the level is fleet state, not local state
+    try:
+        await svc.brownout.watch(drt.store, pub_ns)
+    except Exception:
+        log.warning("brownout watch failed; serving at level 0",
+                    exc_info=True)
 
     async def stage_publish_loop():
         while True:

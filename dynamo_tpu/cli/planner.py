@@ -51,6 +51,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--down-consensus", type=int, default=3)
     p.add_argument("--dry-run", action="store_true",
                    help="publish decisions but never actuate")
+    p.add_argument("--brownout", action="store_true",
+                   help="run the SLO-burn brownout controller on this "
+                        "loop (publishes the fleet degradation level; "
+                        "DYN_BROWNOUT_* knobs)")
     # load policy knobs
     p.add_argument("--queue-high", type=float, default=1.0)
     p.add_argument("--occupancy-high", type=float, default=0.85)
@@ -153,7 +157,8 @@ async def run_planner(args, *, ready_event=None, drt=None) -> None:
         interval=args.interval, min_replicas=args.min_replicas,
         max_replicas=args.max_replicas, cooldown_up=args.cooldown_up,
         cooldown_down=args.cooldown_down,
-        down_consensus=args.down_consensus, dry_run=args.dry_run)
+        down_consensus=args.down_consensus, dry_run=args.dry_run,
+        brownout=args.brownout)
     planner = await Planner(drt, args.namespace, pools, policy, connector,
                             cfg).start()
     mode = "DRY-RUN" if args.dry_run else "live"

@@ -18,6 +18,7 @@ from ..utils.dynconfig import EnvDefaultsParser
 import asyncio
 import json
 import logging
+import time
 from typing import Optional
 
 from ..llm.disagg import PrefillQueue
@@ -141,7 +142,15 @@ async def run_prefill_worker(args, *,
                         "prefill.compute", parent=job_parent,
                         request_id=job.request_id,
                         prompt_tokens=len(bi.token_ids)) as csp:
+                    compute_t0 = time.monotonic()
                     k, v, tok, logp = await engine.prefill_extract(bi, ctx)
+                    # pure per-item compute cost, published for operators
+                    # (the decode side's predictive shed runs on its own
+                    # depth-normalized turnaround EWMA)
+                    from ..utils.prometheus import stage_metrics
+
+                    stage_metrics().stage_service.observe(
+                        "prefill", value=time.monotonic() - compute_t0)
                 if await queue.consume_cancelled(job.request_id):
                     # submitter gave up mid-compute: skip the (large) push
                     await queue.ack(msg_id)
@@ -168,9 +177,12 @@ async def run_prefill_worker(args, *,
                 await queue.ack(msg_id)
                 if job.attempts < MAX_ATTEMPTS:
                     # restamp so queue-wait measures THIS attempt's wait,
-                    # not wait + failed compute + backoff since the first
+                    # not wait + failed compute + backoff since the first.
+                    # Bounds are NOT re-enforced: the job was already
+                    # admitted once — a retry must not be shed by a queue
+                    # that filled up behind it
                     job.enqueued_at = 0.0
-                    await queue.enqueue(job)
+                    await queue.enqueue(job, enforce_bounds=False)
                 else:
                     try:
                         await push_kv_error(kv_client, job.decode_worker_id,
@@ -185,6 +197,7 @@ async def run_prefill_worker(args, *,
             done += 1
     finally:
         stage_task.cancel()
+        queue.close()   # cancel parked per-priority pulls
         try:
             await span_sink.stop()   # final flush: short-lived runs
         except Exception:            # (max_jobs) must not lose spans

@@ -42,6 +42,15 @@ async def run_router(args, *, ready_event=None,
             advertise_host=args.advertise_host).connect()
     svc = await KvRouterService(drt, args.namespace, args.worker_component,
                                 block_size=args.block_size).start()
+    # fleet brownout level: any level above normal switches the scheduler
+    # to fast-fail instead of capacity-wait polling (utils/overload.py)
+    from ..utils.overload import BrownoutState
+
+    try:
+        svc.brownout = await BrownoutState().watch(drt.store, args.namespace)
+    except Exception:
+        log.warning("brownout watch failed; router stays in wait mode",
+                    exc_info=True)
     await svc.serve(drt.namespace(args.namespace).component(args.component))
     print(f"kv router serving {args.namespace}.{args.component}.route "
           f"(workers: {args.worker_component})", flush=True)

@@ -19,6 +19,7 @@ from ..utils.dynconfig import EnvDefaultsParser
 import asyncio
 import json
 import logging
+import time
 from typing import Optional
 
 from ..llm.disagg import (DisaggConfig, DisaggRouter, PrefillQueue,
@@ -32,7 +33,7 @@ from ..llm.protocols.common import BackendInput
 from ..llm.remote import register_model, serve_core_engine
 from ..runtime.component import DistributedRuntime
 from ..runtime.store_client import StoreError
-from ..utils import tracing
+from ..utils import overload, tracing
 
 log = logging.getLogger("dynamo_tpu.worker")
 
@@ -175,6 +176,11 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
         core.pool.on_blocks_removed = pub.blocks_removed
 
     # --- serve endpoint ----------------------------------------------
+    # worker-ingress overload gate (DYN_WORKER_SLOTS / DYN_WORKER_QUEUE_
+    # DEPTH, unset = off): bounded, priority-ordered slot queue with
+    # predictive shedding — excess load fails in milliseconds as a typed
+    # 429 naming this stage instead of queueing into a deadline burn
+    gate = overload.gate_from_env()
     endpoint = component.endpoint("generate")
     if getattr(args, "enable_disagg", False) and core is not None:
         # decode worker with conditional remote prefill (SURVEY §3.2):
@@ -201,6 +207,19 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                                    remote_timeout)
 
         async def generate_handler(request, ctx):
+            if gate is not None:
+                await gate.acquire(ctx.priority, ctx.deadline)
+                svc_started = time.monotonic()
+                try:
+                    async for item in _generate_disagg(request, ctx):
+                        yield item
+                finally:
+                    gate.release(time.monotonic() - svc_started)
+            else:
+                async for item in _generate_disagg(request, ctx):
+                    yield item
+
+        async def _generate_disagg(request, ctx):
             bi = BackendInput.from_dict(request)
             # local prefix-cache hits count against remoting: a prompt we
             # mostly have cached prefills locally regardless of length.
@@ -231,15 +250,39 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                                        trace_id=ctx.id,
                                        prompt_tokens=len(bi.token_ids),
                                        prefix_hit_tokens=prefix_hit) as wsp:
-                    await queue.enqueue(RemotePrefillRequest(
-                        ctx.id, drt.worker_id, request,
-                        deadline=ctx.deadline))
+                    remote_t0 = time.monotonic()
                     try:
-                        kv = await await_remote_kv(ctx, fut)
-                    except RemotePrefillError as e:
-                        log.warning("remote prefill for %s dead-lettered "
-                                    "(%s); prefilling locally", ctx.id, e)
+                        await queue.enqueue(RemotePrefillRequest(
+                            ctx.id, drt.worker_id, request,
+                            deadline=ctx.deadline,
+                            priority=ctx.priority))
+                    except overload.OverloadError as e:
+                        # bounded-queue / predictive shed at enqueue: the
+                        # remote path is refused in milliseconds; local
+                        # prefill (deadline-bounded) takes over
+                        receiver.abandon(ctx.id)
+                        log.info("prefill enqueue shed for %s (%s); "
+                                 "prefilling locally", ctx.id, e.reason)
                         kv = None
+                    else:
+                        try:
+                            kv = await await_remote_kv(ctx, fut)
+                        except RemotePrefillError as e:
+                            log.warning("remote prefill for %s dead-"
+                                        "lettered (%s); prefilling "
+                                        "locally", ctx.id, e)
+                            kv = None
+                        if kv is not None:
+                            # the predictive shed needs PER-ITEM service
+                            # time; the observed turnaround includes the
+                            # queue wait behind ~qsize earlier jobs, so
+                            # normalize by the depth seen at the remote
+                            # decision — feeding raw turnaround would
+                            # double-count the queue and self-reinforce
+                            # (deeper queue -> bigger estimate -> shed)
+                            queue.observe_service(
+                                (time.monotonic() - remote_t0)
+                                / max(qsize + 1, 1))
                     if wsp is not None:
                         wsp.attrs["fallback_local"] = kv is None
                 if kv is not None:
@@ -257,7 +300,10 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
 
         await endpoint.serve(generate_handler)
     else:
-        await serve_core_engine(endpoint, engine)
+        await serve_core_engine(
+            endpoint,
+            engine if gate is None
+            else overload.SlotGatedEngine(engine, gate))
     if args.register_model:
         await register_model(drt.store, card, endpoint.path,
                              model_type="chat", lease=drt.lease)

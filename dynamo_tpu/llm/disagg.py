@@ -19,12 +19,17 @@ patch's RemotePrefillRequest (patch:3716-3789).
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
+import os
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
+from ..utils.overload import (OverloadError, PRIORITIES,
+                              PRIORITY_INTERACTIVE, ServiceTimeEstimator,
+                              should_shed)
 from ..utils.prometheus import stage_metrics
 from ..utils.tracing import extract_wire, get_tracer, wire_context
 
@@ -37,8 +42,19 @@ def disagg_config_key(namespace: str, model: str = "default") -> str:
     return f"{DISAGG_CONFIG_PREFIX}{namespace}/{model}"
 
 
-def prefill_queue_name(namespace: str) -> str:
-    return f"{namespace}.prefill"
+def prefill_queue_name(namespace: str,
+                       priority: str = PRIORITY_INTERACTIVE) -> str:
+    """Per-priority queue names: interactive keeps the legacy name (old
+    producers/consumers interoperate unchanged), batch gets a sibling."""
+    base = f"{namespace}.prefill"
+    if priority and priority != PRIORITY_INTERACTIVE:
+        return f"{base}.{priority}"
+    return base
+
+
+def prefill_queue_names(namespace: str) -> List[str]:
+    """Every priority's queue — depth readers (planner, dyntop) sum these."""
+    return [prefill_queue_name(namespace, p) for p in PRIORITIES]
 
 
 @dataclass
@@ -55,6 +71,9 @@ class RemotePrefillRequest:
     request: Dict[str, Any]
     prefix_hit_tokens: int = 0
     attempts: int = 0
+    # overload-control class: routes the job to its priority's queue;
+    # consumers drain interactive strictly first
+    priority: str = PRIORITY_INTERACTIVE
     # span context ([trace_id, parent_span_id]) + enqueue wall-clock: the
     # prefill worker parents its spans under the decode worker's and turns
     # the enqueue->dequeue gap into the queue-wait span/histogram
@@ -76,21 +95,122 @@ class RemotePrefillRequest:
         return cls(**json.loads(b.decode()))
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        log.warning("ignoring malformed %s=%r", name, os.environ.get(name))
+        return default
+
+
 class PrefillQueue:
     """Shared work queue of RemotePrefillRequests over the dynstore queue
     plane. Unacked messages are redelivered when a prefill worker dies
-    mid-job (at-least-once, like the durable JetStream pull consumer)."""
+    mid-job (at-least-once, like the durable JetStream pull consumer).
 
-    def __init__(self, store, namespace: str):
+    Overload control (utils/overload.py):
+
+    - one queue PER PRIORITY; :meth:`dequeue` drains interactive strictly
+      before batch;
+    - hard depth bounds (``DYN_PREFILL_QUEUE_MAX``, batch's lower
+      ``DYN_PREFILL_QUEUE_MAX_BATCH``; 0 = unbounded) enforced at enqueue;
+    - predictive shedding at enqueue: when queue depth x the observed
+      per-item remote-prefill service time already exceeds the job's
+      remaining deadline, the enqueue raises :class:`OverloadError` in
+      milliseconds instead of queueing work that is doomed to expire —
+      the decode worker falls back to local prefill.
+    """
+
+    def __init__(self, store, namespace: str,
+                 max_depth: Optional[int] = None,
+                 max_depth_batch: Optional[int] = None):
         self.store = store
-        self.queue = prefill_queue_name(namespace)
+        self.namespace = namespace
+        self.queue = prefill_queue_name(namespace)   # interactive/legacy
+        self.queues = {p: prefill_queue_name(namespace, p)
+                       for p in PRIORITIES}
+        self.max_depth = _env_int("DYN_PREFILL_QUEUE_MAX", 0) \
+            if max_depth is None else int(max_depth)
+        if max_depth_batch is None:
+            max_depth_batch = _env_int("DYN_PREFILL_QUEUE_MAX_BATCH",
+                                       self.max_depth // 2)
+        self.max_depth_batch = int(max_depth_batch)
+        # observed full remote-prefill turnaround (decode-side), the
+        # predictive shed's per-item service estimate
+        self.service = ServiceTimeEstimator()
+        self._pulls: Dict[str, asyncio.Task] = {}   # parked per-queue pulls
+        self._msg_queue: Dict[int, str] = {}        # msg_id -> queue name
 
-    async def enqueue(self, req: RemotePrefillRequest) -> int:
+    def observe_service(self, seconds: float) -> None:
+        self.service.observe(seconds)
+        stage_metrics().stage_service.observe("prefill_remote",
+                                              value=seconds)
+
+    def _bound(self, priority: str) -> int:
+        return self.max_depth_batch if priority != PRIORITY_INTERACTIVE \
+            else self.max_depth
+
+    async def enqueue(self, req: RemotePrefillRequest,
+                      enforce_bounds: bool = True) -> int:
+        qname = self.queues.get(req.priority, self.queue)
+        if enforce_bounds:
+            depth = await self.store.q_len(qname)
+            if req.priority != PRIORITY_INTERACTIVE:
+                # batch's (lower) bound counts TOTAL backlog: interactive
+                # depth alone closes the door on batch — strictly prefer
+                # interactive at every decision point
+                depth += await self.store.q_len(self.queue)
+            bound = self._bound(req.priority)
+            svc = self.service.mean()
+            if bound and depth >= bound:
+                stage_metrics().queue_shed.inc("prefill_enqueue")
+                raise OverloadError(
+                    f"prefill queue full ({depth} >= {bound}, "
+                    f"priority={req.priority})",
+                    stage="prefill_enqueue", reason="queue_full",
+                    retry_after=max(svc or 0.0, 0.05))
+            remaining = None if req.deadline is None \
+                else req.deadline - time.time()
+            if should_shed(depth + 1, svc, remaining):
+                stage_metrics().queue_shed.inc("prefill_enqueue")
+                raise OverloadError(
+                    f"prefill queue wait ~{(depth + 1) * (svc or 0):.2f}s "
+                    f"exceeds the remaining deadline "
+                    f"({remaining:.2f}s); shedding at enqueue",
+                    stage="prefill_enqueue", reason="predicted_late",
+                    retry_after=svc)
         if req.trace is None:
             req.trace = wire_context()
         if not req.enqueued_at:
             req.enqueued_at = time.time()
-        return await self.store.q_push(self.queue, req.to_bytes())
+        return await self.store.q_push(qname, req.to_bytes())
+
+    async def _pull_any(self) -> Tuple[int, bytes, str]:
+        """One message from any priority queue, interactive strictly first.
+        Keeps a PARKED pull per queue across calls (never cancelled mid-
+        delivery — a cancelled pull could strand a delivered message until
+        the connection closes); a message landing on the other queue's
+        parked pull is simply returned by the next call."""
+        while True:
+            for p in PRIORITIES:
+                q = self.queues[p]
+                if q not in self._pulls:
+                    self._pulls[q] = asyncio.ensure_future(
+                        self.store.q_pull(q))
+            tasks = [self._pulls[self.queues[p]] for p in PRIORITIES]
+            # unbounded-ok: queue consumers park until work arrives by
+            # design; drain cancels the dequeue() wrapper task
+            await asyncio.wait(tasks, return_when=asyncio.FIRST_COMPLETED)
+            for p in PRIORITIES:            # strict priority order
+                q = self.queues[p]
+                t = self._pulls.get(q)
+                if t is not None and t.done():
+                    del self._pulls[q]
+                    exc = t.exception()
+                    if exc is not None:
+                        raise exc
+                    msg_id, payload = t.result()
+                    return msg_id, payload, q
 
     async def dequeue(self) -> tuple:
         """Blocks until work is available. Returns (msg_id, request);
@@ -99,7 +219,8 @@ class PrefillQueue:
         dropped here — never handed to the engine (counted per stage in
         ``dyn_deadline_expiries_total{stage="prefill_dequeue"}``)."""
         while True:
-            msg_id, payload = await self.store.q_pull(self.queue)
+            msg_id, payload, qname = await self._pull_any()
+            self._msg_queue[msg_id] = qname
             req = RemotePrefillRequest.from_bytes(payload)
             if not req.expired:
                 break
@@ -122,10 +243,22 @@ class PrefillQueue:
         return msg_id, req
 
     async def ack(self, msg_id: int) -> None:
-        await self.store.q_ack(self.queue, msg_id)
+        await self.store.q_ack(self._msg_queue.pop(msg_id, self.queue),
+                               msg_id)
 
     async def size(self) -> int:
-        return await self.store.q_len(self.queue)
+        total = 0
+        for q in self.queues.values():
+            total += await self.store.q_len(q)
+        return total
+
+    def close(self) -> None:
+        """Cancel parked pulls (worker drain / tests). Any message a
+        cancelled pull had already been handed is requeued when this
+        client's store connection closes (at-least-once)."""
+        for t in self._pulls.values():
+            t.cancel()
+        self._pulls.clear()
 
     # ------------------------------------------------------------------
     # cancellation: the submitter gave up (timeout / client gone). A

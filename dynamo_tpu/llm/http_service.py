@@ -29,7 +29,7 @@ from aiohttp import web
 
 from ..runtime import deadline as dl
 from ..runtime.engine import AsyncEngine, Context, EngineError
-from ..utils import tracing
+from ..utils import overload, tracing
 from ..utils.prometheus import Registry, render_states, stage_metrics
 
 log = logging.getLogger("dynamo_tpu.http_service")
@@ -75,10 +75,16 @@ class HttpService:
     def __init__(self, manager: Optional[ModelManager] = None,
                  host: str = "0.0.0.0", port: int = 8080, store=None,
                  namespace: Optional[str] = None,
-                 router_decisions=None):
+                 router_decisions=None, admission=None):
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
+        # overload control (utils/overload.py): admission gate (DYN_ADMIT_*
+        # knobs; inert when none are set) + this process's view of the
+        # fleet brownout level (armed against the store by cli/http)
+        self.admission = admission if admission is not None \
+            else overload.AdmissionController.from_env()
+        self.brownout = overload.BrownoutState()
         # optional dynstore client: lets /v1/traces fetch spans published by
         # worker processes and /metrics merge their stage histograms —
         # scoped to ``namespace`` when set (a shared store may carry other
@@ -240,6 +246,31 @@ class HttpService:
 
     async def _serve(self, req: web.Request, endpoint: str) -> web.StreamResponse:
         started = time.monotonic()
+        # ---- overload admission: the cheapest possible shed, decided from
+        # headers alone before the body is even read. A rejected request
+        # costs microseconds and a 429 + Retry-After — never a queue slot,
+        # never a deadline burn.
+        try:
+            priority = overload.parse_priority(
+                req.headers.get(overload.PRIORITY_HEADER))
+        except ValueError as e:
+            self.m_requests.inc("unknown", endpoint, "400")
+            return _err(400, str(e))
+        level = self.brownout.level
+        shed = overload.brownout_reject(priority, level) \
+            or self.admission.try_admit(priority)
+        if shed is not None:
+            self.m_requests.inc("unknown", endpoint, str(shed.code))
+            return _err_engine(shed)
+        try:
+            return await self._serve_admitted(req, endpoint, started,
+                                              priority, level)
+        finally:
+            self.admission.release()
+
+    async def _serve_admitted(self, req: web.Request, endpoint: str,
+                              started: float, priority: str,
+                              level: int) -> web.StreamResponse:
         model_name = "unknown"
         try:
             body = await req.json()
@@ -266,6 +297,15 @@ class HttpService:
         except ValueError as e:
             self.m_requests.inc("unknown", endpoint, "400")
             return _err(400, str(e))
+        # brownout degradation (fleet level, store-published): shrink the
+        # work an admitted request may cost — cap max_tokens, drop
+        # speculative decoding's extra programs
+        cap = overload.max_tokens_cap(level)
+        if cap is not None:
+            oai_req.max_tokens = cap if oai_req.max_tokens is None \
+                else min(oai_req.max_tokens, cap)
+        if overload.disables_spec(level):
+            oai_req.ext["no_spec"] = True
         model_name = oai_req.model
         served = self.manager.get(model_name)
         engine = served and (served.chat_engine if endpoint == "chat"
@@ -278,8 +318,9 @@ class HttpService:
 
         # end-to-end deadline (x-request-timeout header, DYN_REQUEST_TIMEOUT
         # default): every downstream hop sees it via the context / wire
-        # envelope; expiry anywhere surfaces as a 504 naming the stage
-        ctx = Context(deadline=dl.from_timeout(timeout))
+        # envelope; expiry anywhere surfaces as a 504 naming the stage.
+        # The priority class rides the same envelope.
+        ctx = Context(deadline=dl.from_timeout(timeout), priority=priority)
         # request-id span: every log line in this async call chain (and in
         # remote workers via the wire context_id) carries ctx.id
         from ..utils.logging_ext import request_id_var
@@ -336,7 +377,7 @@ class HttpService:
                 return _err(400, str(e), ctx.id)
             except EngineError as e:
                 status = str(e.code)
-                return _err(e.code, str(e), ctx.id)
+                return _err_engine(e, ctx.id)
             agg = (aggregate_chat_chunks(chunks) if endpoint == "chat"
                    else aggregate_completion_chunks(chunks))
             return web.json_response(agg,
@@ -366,7 +407,7 @@ class HttpService:
         except ProtocolError as e:
             return _err(400, str(e), ctx.id)
         except EngineError as e:
-            return _err(e.code, str(e), ctx.id)
+            return _err_engine(e, ctx.id)
         if isinstance(first_item, dict) and "error" in first_item:
             # a pipeline that reports failures in-stream (tool matcher) may
             # fail before any content chunk; nothing is committed yet so it
@@ -481,17 +522,53 @@ def _request_timeout(req: web.Request) -> Optional[float]:
 
 
 _ERR_TYPES = {400: "invalid_request_error", 404: "not_found_error",
-              502: "bad_gateway_error", 504: "timeout_error"}
+              429: "overloaded_error", 502: "bad_gateway_error",
+              503: "service_unavailable_error", 504: "timeout_error"}
+
+# typed-error fallbacks for EngineErrors raised by layers that predate the
+# stage/reason fields (e.g. a bare 503 from the dispatch client): every
+# 429/503/504 body names A stage and reason even when the thrower didn't
+_FALLBACK_STAGE = {429: "admission", 502: "router", 503: "dispatch"}
+_FALLBACK_REASON = {429: "overload", 503: "no_capacity", 504: "deadline"}
 
 
-def _err(code: int, message: str,
-         request_id: Optional[str] = None) -> web.Response:
-    # error responses for requests that got far enough to have an id carry
-    # x-request-id too — failed requests are the ones operators trace
-    return web.json_response(
-        {"error": {"message": message,
-                   "type": _ERR_TYPES.get(code, "internal_error"),
-                   "code": code}},
-        status=code,
-        headers={"x-request-id": request_id} if request_id else None,
-    )
+def _err(code: int, message: str, request_id: Optional[str] = None, *,
+         stage: Optional[str] = None, reason: Optional[str] = None,
+         retry_after: Optional[float] = None) -> web.Response:
+    """The ONE error-body shape: ``{"error": {message, type, code, stage?,
+    reason?, retry_after?}}``. Overload (429) and unavailability (503)
+    responses always carry ``Retry-After``; errors for requests that got
+    far enough to have an id carry ``x-request-id`` too — failed requests
+    are the ones operators trace."""
+    import math
+
+    err: Dict[str, Any] = {"message": message,
+                           "type": _ERR_TYPES.get(code, "internal_error"),
+                           "code": code}
+    if stage is not None:
+        err["stage"] = stage
+    if reason is not None:
+        err["reason"] = reason
+    headers: Dict[str, str] = {}
+    if request_id:
+        headers["x-request-id"] = request_id
+    if retry_after is None and code in (429, 503):
+        retry_after = 1.0
+    if retry_after is not None:
+        err["retry_after"] = round(float(retry_after), 3)
+        headers["Retry-After"] = str(max(1, math.ceil(retry_after)))
+    return web.json_response({"error": err}, status=code,
+                             headers=headers or None)
+
+
+def _err_engine(e: Exception,
+                request_id: Optional[str] = None) -> web.Response:
+    """Typed EngineError -> uniform error response: its stage/reason/
+    retry_after (which survive the wire from remote workers) land in the
+    body, with per-code fallbacks for untyped throwers."""
+    code = getattr(e, "code", 500)
+    return _err(code, str(e), request_id,
+                stage=getattr(e, "stage", None) or _FALLBACK_STAGE.get(code),
+                reason=(getattr(e, "reason", None)
+                        or _FALLBACK_REASON.get(code)),
+                retry_after=getattr(e, "retry_after", None))

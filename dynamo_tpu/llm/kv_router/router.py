@@ -40,6 +40,10 @@ class KvRouterService:
         self._scrape_task: Optional[asyncio.Task] = None
         self.worker_client: Optional[Client] = None
         self._hit_events = 0
+        # fleet brownout view (utils/overload.BrownoutState, armed by the
+        # router binary): any level above normal turns on scheduler
+        # fast-fail — under declared overload, capacity-waiting is doomed
+        self.brownout = None
 
     def _emit_hit_rate(self, ev) -> None:
         self._hit_events += 1
@@ -59,6 +63,13 @@ class KvRouterService:
 
         # live worker set: prune index + scheduler on death
         self.worker_client = await component.endpoint("generate").client().start()
+        # breaker visibility for the scheduler's fast-fail: instances THIS
+        # process's client currently holds OPEN count as non-candidates
+        from ...runtime.circuit_breaker import OPEN
+
+        self.scheduler.breaker_open = lambda: {
+            i for i in self.worker_client.instances
+            if self.worker_client.breaker.state(i) == OPEN}
 
         def on_change():
             live = set(self.worker_client.instances)
@@ -103,8 +114,13 @@ class KvRouterService:
     async def route(self, token_ids, lora_id: int = 0) -> Dict:
         overlaps = self.indexer.find_matches_for_tokens(token_ids,
                                                         lora_id=lora_id)
+        # brownout level > 0 forces fast-fail regardless of the env knob;
+        # None defers to DYN_ROUTER_FAST_FAIL
+        fast_fail = True if (self.brownout is not None
+                             and self.brownout.level > 0) else None
         wid = await self.scheduler.schedule_or_wait(token_ids, overlaps,
-                                                    salt=lora_id)
+                                                    salt=lora_id,
+                                                    fast_fail=fast_fail)
         return {"worker_id": wid,
                 "overlap_blocks": overlaps.scores.get(wid, 0)}
 

@@ -19,8 +19,19 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ...runtime.engine import EngineError
 from .indexer import OverlapScores
 from .protocols import ForwardPassMetrics, KVHitRateEvent
+
+
+def _fast_fail_enabled() -> bool:
+    """``DYN_ROUTER_FAST_FAIL=1``: a fully saturated/breaker-open candidate
+    set answers 503 immediately instead of capacity-wait polling for up to
+    ``timeout_s`` — under overload the wait is doomed, and every parked
+    waiter holds resources the fleet needs to drain. Default off (the
+    pre-overload-control wait behavior)."""
+    return os.environ.get("DYN_ROUTER_FAST_FAIL", "0").lower() in (
+        "1", "true", "yes", "on")
 
 
 @dataclass
@@ -138,6 +149,10 @@ class KvScheduler:
         self.selector = selector
         self.on_hit_rate = on_hit_rate
         self.endpoints = ProcessedEndpoints()
+        # optional callable -> set of breaker-OPEN worker ids (wired by the
+        # router service when it has breaker visibility); fast-fail treats
+        # those as non-candidates
+        self.breaker_open: Optional[Callable[[], set]] = None
         self.decisions: collections.deque = collections.deque(
             maxlen=_audit_ring_size())
         self._seq = 0
@@ -209,15 +224,50 @@ class KvScheduler:
                 overlap_blocks=overlaps.scores.get(wid, 0)))
         return wid
 
+    def _all_unavailable(self, tokens: Sequence[int],
+                         overlaps: OverlapScores, wid: Optional[int]
+                         ) -> Optional[str]:
+        """Fast-fail predicate: None when some candidate can take work,
+        else the reason ("saturated" / "breaker_open") why every live
+        candidate is unavailable right now."""
+        if not self.endpoints.workers:
+            return None            # membership empty: 503s elsewhere
+        open_ids = set(self.breaker_open()) if self.breaker_open else set()
+        if wid is None:
+            return "saturated"     # selector found no capacity anywhere
+        if wid in open_ids:
+            cands = score_candidates(tokens, self.block_size, overlaps,
+                                     self.endpoints)
+            if all(c["saturated"] or c["worker_id"] in open_ids
+                   for c in cands):
+                return "breaker_open"
+        return None
+
     async def schedule_or_wait(self, tokens: Sequence[int],
                                overlaps: OverlapScores,
                                poll_s: float = 0.05,
                                timeout_s: float = 30.0,
-                               salt: int = 0) -> int:
-        """Wait for capacity when all workers are saturated."""
+                               salt: int = 0,
+                               fast_fail: Optional[bool] = None) -> int:
+        """Wait for capacity when all workers are saturated — unless
+        ``fast_fail`` (param, or ``DYN_ROUTER_FAST_FAIL``, or a brownout
+        level above normal at the router service) is active: then a fully
+        saturated/breaker-open candidate set raises a typed 503
+        immediately, shedding in milliseconds instead of parking every
+        overload victim in a retry loop."""
+        if fast_fail is None:
+            fast_fail = _fast_fail_enabled()
         deadline = asyncio.get_event_loop().time() + timeout_s
         while True:
             wid = self.schedule(tokens, overlaps, salt=salt)
+            if fast_fail:
+                why = self._all_unavailable(tokens, overlaps, wid)
+                if why is not None:
+                    n = len(self.endpoints.workers)
+                    raise EngineError(
+                        f"router fast-fail: all {n} candidates "
+                        f"unavailable ({why})", 503,
+                        stage="router", reason=why, retry_after=1.0)
             if wid is not None:
                 return wid
             if asyncio.get_event_loop().time() > deadline:

@@ -198,6 +198,10 @@ class Preprocessor:
         if images:
             bi.images = images
             bi.kv_salt = image_kv_salt(bi.lora_id, images)
+        if req.ext.get("no_spec"):
+            # per-request speculative-decoding opt-out — also how the
+            # frontend's brownout level >= 3 sheds spec's extra programs
+            bi.no_spec = True
         annotations = self._annotations(req.ext, prompt, token_ids)
         bi.annotations = annotations
         return PreprocessedRequest(bi, prompt, annotations)
@@ -274,6 +278,8 @@ class Preprocessor:
             logprobs=req.logprobs,
             echo=req.echo,
         )
+        if req.ext.get("no_spec"):
+            bi.no_spec = True   # see preprocess_chat
         annotations = self._annotations(req.ext, prompt, token_ids)
         bi.annotations = annotations
         return PreprocessedRequest(bi, prompt, annotations)

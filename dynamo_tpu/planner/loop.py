@@ -109,6 +109,10 @@ class PlannerConfig:
     down_consensus: int = 3             # agreeing ticks before scale-down
     dry_run: bool = False
     keep_decisions: int = 200           # decision-ring length in the store
+    # run the SLO-burn brownout controller (utils/overload.py) on this
+    # loop's already-collected signals, publishing level changes to the
+    # store for every frontend/router to apply fleet-wide
+    brownout: bool = False
 
 
 class Planner:
@@ -131,6 +135,14 @@ class Planner:
             down_consensus=self.config.down_consensus,
             dry_run=self.config.dry_run)
         self.collector = SignalCollector(drt.store, namespace, self.pools)
+        self.brownout: Optional[object] = None
+        if self.config.brownout:
+            from ..utils.overload import BrownoutMonitor
+
+            # the monitor's own SloMonitor goes unused — the planner feeds
+            # the burn its signal collector already computed into apply()
+            self.brownout = BrownoutMonitor(drt.store, namespace,
+                                            lease=drt.lease)
         self.metrics = PlannerMetrics()
         self.metrics.dry_run.set(value=1.0 if self.config.dry_run else 0.0)
         self.decisions_log: List[Decision] = []   # in-process tail
@@ -211,6 +223,7 @@ class Planner:
         async with tracer.span("planner.evaluate"):
             signals = await self.collector.collect()
             self._last_signals = signals
+            await self._brownout_tick(signals)
             decisions = self.core.evaluate(signals, now)
             for d in decisions:
                 await self._publish_decision(d)
@@ -220,6 +233,16 @@ class Planner:
         self.metrics.evaluations.inc()
         await self._publish_state(now)
         return decisions
+
+    async def _brownout_tick(self, signals: Dict[str, PoolSignals]) -> None:
+        """Step the brownout controller on the worst SLO burn the signal
+        collector just observed; BrownoutMonitor.apply owns the gauge +
+        store publication (lease-bound: a dead planner's brownout expires
+        with its lease)."""
+        if self.brownout is None:
+            return
+        burn = max((s.slo_pressure for s in signals.values()), default=0.0)
+        await self.brownout.apply(burn)
 
     async def _actuate(self, d: Decision) -> None:
         tracer = tracing.get_tracer()

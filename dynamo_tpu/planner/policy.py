@@ -90,10 +90,14 @@ class LoadPolicy:
 
     def propose(self, s: PoolSignals) -> Tuple[int, str]:
         healthy = max(s.healthy_replicas, 1)
-        per_replica_q = s.queue_depth / healthy
+        # rejected demand counts as backlog: shedding keeps the visible
+        # queues bounded, so an overloaded-but-shedding fleet would read
+        # as idle from queue depth alone (each shed/s ~ one waiting seq)
+        backlog = s.queue_depth + s.shed_rate
+        per_replica_q = backlog / healthy
         hot = []
         if per_replica_q > self.queue_high:
-            hot.append(f"queue {s.queue_depth:.0f} "
+            hot.append(f"queue {s.queue_depth:.0f} + shed {s.shed_rate:.1f}/s "
                        f"(> {self.queue_high}/replica)")
         if s.occupancy > self.occupancy_high:
             hot.append(f"occupancy {s.occupancy:.2f} "
@@ -103,14 +107,15 @@ class LoadPolicy:
         if hot:
             slots_per_replica = (s.total_slots / s.replicas
                                  if s.replicas and s.total_slots else 1.0)
-            backlog_steps = math.ceil(s.queue_depth / slots_per_replica) \
-                if s.queue_depth else 0
+            backlog_steps = math.ceil(backlog / slots_per_replica) \
+                if backlog else 0
             step = max(1, backlog_steps, s.breaker_open)
             return s.replicas + step, "; ".join(hot)
         cold = (s.queue_depth <= self.queue_low
                 and s.occupancy < self.occupancy_low
                 and s.kv_utilization < self.kv_low
-                and s.breaker_open == 0)
+                and s.breaker_open == 0
+                and s.shed_rate <= 0.0)
         if cold:
             return s.replicas - 1, (
                 f"idle: queue {s.queue_depth:.0f}, "
@@ -141,11 +146,15 @@ class SlaPolicy:
         self.capacity = max(cap * headroom, 1e-9)
 
     def propose(self, s: PoolSignals) -> Tuple[int, str]:
-        demand = s.active_slots + s.queue_depth
+        # shed_rate is REJECTED demand (req/s the fleet refused): without
+        # it the SLA maths would size the fleet to only the traffic that
+        # survived admission — overload would read as fitting capacity
+        demand = s.active_slots + s.queue_depth + s.shed_rate
         need = max(1, math.ceil(demand / self.capacity))
         # breaker-open instances serve nothing: replace them
         need += s.breaker_open
-        reason = (f"demand {demand:.0f} seqs / capacity "
+        reason = (f"demand {demand:.0f} seqs (incl. shed "
+                  f"{s.shed_rate:.1f}/s) / capacity "
                   f"{self.capacity:.1f} per replica -> {need}")
         if s.ttft_p90 is not None and s.ttft_p90 > self.ttft_target:
             need = max(need, s.replicas + 1)

@@ -23,12 +23,14 @@ from __future__ import annotations
 
 import json
 import logging
+import time
 from dataclasses import asdict, dataclass, field
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from ..llm.disagg import prefill_queue_name
+from ..llm.disagg import prefill_queue_names
 from ..llm.metrics_aggregator import STAGE_PREFIX, fetch_worker_metrics
 from ..runtime.component import endpoint_prefix
+from ..utils.overload import admission_depth_total, shed_totals
 
 log = logging.getLogger("dynamo_tpu.planner")
 
@@ -53,6 +55,13 @@ class PoolSignals:
     # faster than sustainable, i.e. direct scale-up pressure. Empty when
     # no DYN_SLO_* objectives are configured.
     slo_burn: Dict[str, float] = field(default_factory=dict)
+    # overload plane (utils/overload.py): demand the fleet REJECTED.
+    # shed_rate is admission rejects + queue sheds per second across the
+    # fleet — backlog gauges alone go blind exactly when shedding keeps
+    # the queues bounded, so policies must scale on rejected demand too.
+    shed_rate: float = 0.0
+    # in-flight requests currently held by admission controllers
+    admission_depth: float = 0.0
 
     @property
     def slo_pressure(self) -> float:
@@ -168,6 +177,9 @@ class SignalCollector:
         # the planner's stage registry (published with the dyn_planner_*
         # series), its breach log feeds PoolSignals.slo_burn
         self.slo = SloMonitor()
+        # shed-rate derivation: cumulative fleet shed counters from the
+        # last collect, differentiated against the wall between ticks
+        self._shed_prev: Optional[Tuple[float, float]] = None
 
     async def live_instances(self, component: str,
                              known: Iterable[int] = ()) -> List[int]:
@@ -210,16 +222,31 @@ class SignalCollector:
                 log.warning("malformed stage metrics at %s", key)
         return states, ids
 
+    def _shed_rate(self, stage_states) -> float:
+        total = shed_totals(stage_states)
+        now = time.monotonic()
+        rate = 0.0
+        if self._shed_prev is not None:
+            dt = now - self._shed_prev[0]
+            if dt > 0:
+                # max(0): a restarted frontend resets its counters
+                rate = max(0.0, (total - self._shed_prev[1]) / dt)
+        self._shed_prev = (now, total)
+        return rate
+
     async def collect(self) -> Dict[str, PoolSignals]:
         stage_states, stage_ids = await self._fetch_stage()
         if self.slo.objectives:
             self.slo.observe(stage_states)
         slo_burn = self.slo.max_burn()
-        try:
-            prefill_q = await self.store.q_len(
-                prefill_queue_name(self.namespace))
-        except Exception:  # noqa: BLE001 - queue plane optional
-            prefill_q = 0
+        shed_rate = self._shed_rate(stage_states)
+        admission_depth = admission_depth_total(stage_states)
+        prefill_q = 0
+        for qname in prefill_queue_names(self.namespace):
+            try:
+                prefill_q += await self.store.q_len(qname)
+            except Exception:  # noqa: BLE001 - queue plane optional
+                pass
         out: Dict[str, PoolSignals] = {}
         for pool, component in self.pools.items():
             workers = await fetch_worker_metrics(self.store, self.namespace,
@@ -251,6 +278,10 @@ class SignalCollector:
                 # attribution rule as ttft/itl above (more prefill
                 # replicas can't fix a decode-side latency breach)
                 s.slo_burn = dict(slo_burn)
+                # rejected demand is serving-side pressure too: admission
+                # and worker-queue sheds are absorbed by the decode fleet
+                s.shed_rate = shed_rate
+                s.admission_depth = admission_depth
             s.breaker_open = breaker_open_instances(stage_states, ids)
             out[pool] = s
         return out

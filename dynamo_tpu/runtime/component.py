@@ -34,11 +34,36 @@ from . import deadline as dl
 from .circuit_breaker import InstanceBreaker
 from .engine import AsyncEngine, Context, EngineError
 from .store_client import StoreClient
-from .wire import FrameReader, attach_trace, extract_trace, write_frame
+from .wire import (PRIORITY_KEY, FrameReader, attach_trace, extract_trace,
+                   write_frame)
 
 log = logging.getLogger("dynamo_tpu.runtime")
 
 Handler = Callable[[Any, Context], AsyncIterator[Any]]
+
+
+def error_control(e: Exception, code: Optional[int] = None) -> dict:
+    """Error-frame control header for an exception. Typed EngineErrors keep
+    their http-ish code AND their overload/deadline fields (stage, reason,
+    retry_after) so the far end re-raises an equally typed error — a remote
+    shed/expiry must reach the frontend's error body naming its stage."""
+    c: dict = {"kind": "error", "message": str(e),
+               "code": code if code is not None else (
+                   e.code if isinstance(e, EngineError) else 500)}
+    for k in ("stage", "reason", "retry_after"):
+        v = getattr(e, k, None)
+        if v is not None:
+            c[k] = v
+    return c
+
+
+def error_from_control(control: dict) -> EngineError:
+    """The inverse: re-raise a wire error frame as a typed EngineError."""
+    return EngineError(control.get("message", "remote error"),
+                       control.get("code", 500),
+                       stage=control.get("stage"),
+                       reason=control.get("reason"),
+                       retry_after=control.get("retry_after"))
 
 
 async def drive_handler_stream(stream, send) -> bool:
@@ -55,7 +80,7 @@ async def drive_handler_stream(stream, send) -> bool:
     except StopAsyncIteration:
         have_first = False
     except EngineError as e:
-        await send({"kind": "error", "message": str(e), "code": e.code}, None)
+        await send(error_control(e), None)
         return False
     except Exception as e:  # noqa: BLE001
         await send({"kind": "error", "message": str(e), "code": 500}, None)
@@ -76,12 +101,10 @@ async def drive_handler_stream(stream, send) -> bool:
     except (ConnectionResetError, BrokenPipeError):
         raise
     except Exception as e:  # noqa: BLE001 - mid-stream failure
-        # typed engine errors (e.g. DeadlineExceeded=504) keep their code
-        # so the far end can map them; everything else is a 500
-        code = e.code if isinstance(e, EngineError) else 500
+        # typed engine errors (e.g. DeadlineExceeded=504, OverloadError=429)
+        # keep their code + stage/reason; everything else is a 500
         try:
-            await send({"kind": "error", "message": str(e), "code": code},
-                       None)
+            await send(error_control(e), None)
         except Exception:
             pass
         return False
@@ -310,10 +333,10 @@ class DistributedRuntime:
             # the request died in transit/queueing: refuse to burn compute
             # on work nobody is waiting for (counted per stage)
             err = dl.expire(f"worker_ingress:{ep}", req_deadline)
-            await write_frame(writer, [{"kind": "error", "code": err.code,
-                                        "message": str(err)}, None])
+            await write_frame(writer, [error_control(err), None])
             return None
-        ctx = Context(ctx_id, deadline=req_deadline)
+        ctx = Context(ctx_id, deadline=req_deadline,
+                      priority=control.get(PRIORITY_KEY) or "interactive")
         self._active[ctx.id] = ctx
         from ..utils.logging_ext import request_id_var
         from ..utils.tracing import current_span_var, get_tracer
@@ -625,6 +648,11 @@ class Client:
             # the deadline rides the envelope next to context_id/trace so
             # every downstream hop can drop work nobody awaits anymore
             base_control[dl.DEADLINE_KEY] = ctx.deadline
+        if getattr(ctx, "priority", "interactive") != "interactive":
+            # non-default priority rides the envelope so worker-side
+            # shedding/queue ordering can prefer interactive (absent =>
+            # interactive, the protective default)
+            base_control[PRIORITY_KEY] = ctx.priority
         if parts is not None:
             base_control["streaming"] = True
         # client span around the whole exchange; its context rides the wire
@@ -801,8 +829,7 @@ class Client:
             try:
                 control, payload = first
                 if control.get("kind") == "error":
-                    raise EngineError(control.get("message", "remote error"),
-                                      control.get("code", 500))
+                    raise error_from_control(control)
                 # else: prologue
                 while True:
                     # inter-frame timeout: a worker that stalls mid-stream
@@ -829,8 +856,7 @@ class Client:
                         clean = True
                         return
                     elif kind == "error":
-                        raise EngineError(control.get("message", "remote"),
-                                          control.get("code", 500))
+                        raise error_from_control(control)
             finally:
                 stopper.cancel()
                 try:

@@ -38,8 +38,8 @@ class DeadlineExceeded(EngineError):
         late = f" ({time.time() - deadline:.2f}s past deadline)" \
             if deadline else ""
         super().__init__(
-            f"request deadline exceeded at stage {stage!r}{late}", 504)
-        self.stage = stage
+            f"request deadline exceeded at stage {stage!r}{late}", 504,
+            stage=stage, reason="deadline")
 
 
 def expire(stage: str, deadline: Optional[float] = None) -> DeadlineExceeded:

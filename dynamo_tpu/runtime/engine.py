@@ -34,14 +34,20 @@ class Context:
     Reference capability: ``AsyncEngineContext`` (lib/runtime/src/engine.rs:71-109).
     """
 
-    __slots__ = ("id", "deadline", "_stopped", "_killed", "_children")
+    __slots__ = ("id", "deadline", "priority", "_stopped", "_killed",
+                 "_children")
 
     def __init__(self, id: Optional[str] = None,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 priority: str = "interactive"):
         self.id: str = id or uuid.uuid4().hex
         # absolute wall-clock (time.time()) end-to-end deadline; rides the
         # wire envelope so every hop can refuse work nobody awaits anymore
         self.deadline: Optional[float] = deadline
+        # overload-control class ("interactive" | "batch", utils/overload):
+        # rides the wire envelope too — shedding and queue ordering at
+        # every stage strictly prefer interactive
+        self.priority: str = priority
         self._stopped = asyncio.Event()
         self._killed = asyncio.Event()
         self._children: list["Context"] = []
@@ -76,7 +82,8 @@ class Context:
     def child(self, id: Optional[str] = None) -> "Context":
         """A linked context: signals on self propagate to the child (the
         deadline is inherited — a sub-call cannot outlive its request)."""
-        c = Context(id or self.id, deadline=self.deadline)
+        c = Context(id or self.id, deadline=self.deadline,
+                    priority=self.priority)
         if self.is_killed:
             c.kill()
         elif self.is_stopped:
@@ -128,11 +135,21 @@ async def collect(stream: AsyncIterator[Resp]) -> list[Resp]:
 
 class EngineError(Exception):
     """An error produced by an engine before or during streaming; carries an
-    optional http-ish status code so frontends can map it."""
+    optional http-ish status code so frontends can map it, plus the typed
+    overload/deadline fields every failure response exposes uniformly:
+    ``stage`` (which pipeline hop failed), ``reason`` (which rule fired)
+    and ``retry_after`` (seconds — the 429/503 Retry-After hint). All three
+    survive the wire (error-frame control fields) so a frontend's error
+    body names the REMOTE stage that shed or expired the request."""
 
-    def __init__(self, message: str, code: int = 500):
+    def __init__(self, message: str, code: int = 500, *,
+                 stage: Optional[str] = None, reason: Optional[str] = None,
+                 retry_after: Optional[float] = None):
         super().__init__(message)
         self.code = code
+        self.stage = stage
+        self.reason = reason
+        self.retry_after = retry_after
 
 
 Any_ = Any

@@ -27,6 +27,11 @@ MAX_FRAME = 256 * 1024 * 1024  # 256 MB: KV block transfers ride this plane
 # control fields (the native C parser) degrade to trace_id == context_id.
 TRACE_KEY = "trace"
 
+# Optional overload-priority field on request control headers
+# ("interactive" | "batch", utils/overload.py). Absent => interactive —
+# planes that drop unknown fields degrade to the protective default.
+PRIORITY_KEY = "priority"
+
 
 def attach_trace(control: dict) -> dict:
     """Stamp the ambient span context onto a request control header."""

@@ -367,6 +367,30 @@ class StageMetrics:
             "dyn_slo_burn_rate",
             "Error-budget burn rate per SLO and window (1.0 = budget "
             "consumed exactly at the sustainable rate)", ("slo", "window"))
+        # overload-control plane (utils/overload.py): sheds are the
+        # goodput-preserving outcome under pressure — they must be as
+        # visible as the failures they replace
+        self.admission_rejects = r.counter(
+            "dyn_admission_rejects_total",
+            "Requests rejected at HTTP admission (immediate 429)",
+            ("reason", "priority"))   # rate_limit|concurrency|brownout...
+        self.queue_shed = r.counter(
+            "dyn_queue_shed_total",
+            "Requests shed at a bounded stage queue (depth bound or "
+            "predicted-late)", ("stage",))
+        self.brownout_level = r.gauge(
+            "dyn_brownout_level",
+            "Active brownout degradation level (0=normal 1=shed-batch "
+            "2=cap-tokens 3=no-spec 4=shed-all)", ())
+        self.admission_depth = r.gauge(
+            "dyn_admission_queue_depth",
+            "In-flight requests currently held by the admission "
+            "controller", ())
+        self.stage_service = r.histogram(
+            "dyn_stage_service_seconds",
+            "Observed per-item service time of a bounded stage (the "
+            "predictive shed's wait estimate input)", ("stage",),
+            buckets=LATENCY_BUCKETS_FAST + (2.5, 10.0, 60.0))
 
     def clear_worker(self, worker: str) -> None:
         """Drop every per-worker gauge series for ``worker`` (pid). Wired

@@ -53,16 +53,23 @@ def _free_port() -> int:
 
 
 class Procs:
-    """Store + worker subprocesses, logs tee'd for failure dumps."""
+    """Store + worker subprocesses, logs tee'd for failure dumps.
+    ``worker_extra`` / ``env_extra`` let other harnesses (the overload
+    soak) reuse this with different worker knobs."""
 
-    def __init__(self, logdir: str, store_port: int):
+    def __init__(self, logdir: str, store_port: int,
+                 namespace: str = NAMESPACE, worker_extra=(),
+                 env_extra=None):
         self.logdir = logdir
         self.store_port = store_port
+        self.namespace = namespace
+        self.worker_extra = list(worker_extra)
         self.env = {**os.environ, "JAX_PLATFORMS": "cpu",
                     "DYNAMO_TPU_DATAPLANE": "python",
                     "DYN_TOKEN_ECHO_DELAY_MS": "5",
                     "DYN_STORE_RECONNECT_BASE": "0.05",
-                    "DYN_STORE_RECONNECT_ATTEMPTS": "12"}
+                    "DYN_STORE_RECONNECT_ATTEMPTS": "12",
+                    **(env_extra or {})}
         self.store = None            # (proc, log path)
         self.workers = {}            # idx -> (proc, log path)
         self._n = 0
@@ -92,8 +99,10 @@ class Procs:
         self.workers[idx] = self._spawn(
             f"worker{idx}", "dynamo_tpu.cli.worker", "--engine", "echo",
             "--store", f"127.0.0.1:{self.store_port}",
-            "--advertise-host", "127.0.0.1", "--namespace", NAMESPACE,
-            "--metrics-interval", "0.5", "--echo-slots", "4")
+            "--advertise-host", "127.0.0.1",
+            "--namespace", self.namespace,
+            "--metrics-interval", "0.5", "--echo-slots", "4",
+            *self.worker_extra)
         try:
             self._wait_log(self.workers[idx][1], "serving", 30,
                            proc=self.workers[idx][0])
@@ -339,7 +348,19 @@ def main() -> int:
     ap.add_argument("--planner", action="store_true",
                     help="run the SLA planner (local connector) under a "
                          "mid-run load surge; the pool must scale up")
+    ap.add_argument("--overload", action="store_true",
+                    help="run the overload-control ramp scenario instead "
+                         "(scripts/overload_soak.py: open-loop 3x ramp, "
+                         "goodput must plateau)")
     a = ap.parse_args()
+    if a.overload:
+        # the overload soak IS a chaos scenario: same process harness,
+        # different failure mode (congestion instead of kill -9)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from overload_soak import main as overload_main
+
+        sys.argv = [sys.argv[0]]
+        return overload_main()
     logdir = tempfile.mkdtemp(prefix="chaos_soak_")
     print(f"chaos soak: {a.duration}s, {a.workers} workers, logs {logdir}"
           + (" [planner]" if a.planner else ""), flush=True)

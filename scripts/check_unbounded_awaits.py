@@ -42,7 +42,13 @@ DEFAULT_PATHS = [os.path.join(REPO, "dynamo_tpu", "runtime"),
                  # an unbounded await in any of them parks its owner
                  os.path.join(REPO, "dynamo_tpu", "utils", "roofline.py"),
                  os.path.join(REPO, "dynamo_tpu", "utils", "slo.py"),
-                 os.path.join(REPO, "dynamo_tpu", "cli", "dyntop.py")]
+                 os.path.join(REPO, "dynamo_tpu", "cli", "dyntop.py"),
+                 # overload plane: the admission gate runs inside every
+                 # request, the brownout controller inside standing
+                 # daemons, and the soak is the harness that must itself
+                 # never hang while proving nothing else does
+                 os.path.join(REPO, "dynamo_tpu", "utils", "overload.py"),
+                 os.path.join(REPO, "scripts", "overload_soak.py")]
 
 # method/function names whose await parks on the network
 NETWORK_CALLS = {"open_connection", "readexactly", "read", "drain",

@@ -121,6 +121,82 @@ async def test_errors_and_metrics():
         await svc.stop()
 
 
+async def test_typed_error_shape_unified():
+    """429/503/504 all emit the SAME typed JSON error body — {message,
+    type, code, stage, reason} plus Retry-After where applicable — so a
+    client retry loop needs exactly one parser."""
+    from dynamo_tpu.utils.overload import (AdmissionConfig,
+                                           AdmissionController)
+
+    card = ModelDeploymentCard.synthetic("echo")
+    manager = ModelManager()
+    manager.add(ServedModel(
+        card,
+        build_chat_engine(card, "echo_core"),
+        build_completion_engine(card, "echo_core"),
+    ))
+    admission = AdmissionController(AdmissionConfig(concurrency=1))
+    svc = HttpService(manager, host="127.0.0.1", port=0,
+                      admission=admission)
+    port = await svc.start()
+    base = f"http://127.0.0.1:{port}"
+
+    def check_shape(err, code, type_, stage):
+        assert err["code"] == code
+        assert err["type"] == type_
+        assert err["stage"] == stage
+        assert isinstance(err["reason"], str) and err["reason"]
+        assert isinstance(err["message"], str) and err["message"]
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            # 429: admission shed (controller saturated)
+            admission.inflight = 1
+            async with s.post(f"{base}/v1/completions",
+                              json={"model": "echo", "prompt": "ab"}) as r:
+                assert r.status == 429
+                assert int(r.headers["Retry-After"]) >= 1
+                check_shape((await r.json())["error"], 429,
+                            "overloaded_error", "admission")
+            admission.inflight = 0
+            # 504: end-to-end deadline expired mid-pipeline — stage names
+            # the hop (a stalled engine; the deadline guard fires first)
+            class StallEngine:
+                async def generate(self, request, context):
+                    await asyncio.sleep(30)
+                    yield {}
+
+            real = manager.get("echo").completion_engine
+            manager.get("echo").completion_engine = StallEngine()
+            async with s.post(f"{base}/v1/completions",
+                              headers={"x-request-timeout": "0.05"},
+                              json={"model": "echo", "prompt": "ab"}) as r:
+                assert r.status == 504
+                err = (await r.json())["error"]
+                assert err["code"] == 504
+                assert err["type"] == "timeout_error"
+                assert err["reason"] == "deadline"
+                assert err["stage"]          # e.g. http_aggregate
+            manager.get("echo").completion_engine = real
+            # 503: an engine with no capacity anywhere (typed EngineError)
+            from dynamo_tpu.runtime.engine import EngineError
+
+            class DownEngine:
+                async def generate(self, request, context):
+                    raise EngineError("no live instances", 503)
+                    yield  # pragma: no cover
+
+            manager.get("echo").completion_engine = DownEngine()
+            async with s.post(f"{base}/v1/completions",
+                              json={"model": "echo", "prompt": "ab"}) as r:
+                assert r.status == 503
+                assert "Retry-After" in r.headers
+                check_shape((await r.json())["error"], 503,
+                            "service_unavailable_error", "dispatch")
+    finally:
+        await svc.stop()
+
+
 async def test_annotations_sse_event():
     svc, base = await start_service()
     try:

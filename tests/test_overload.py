@@ -1,0 +1,669 @@
+"""Overload-control layer tests: admission caps, predictive shed math,
+priority ordering, brownout level transitions, router fast-fail — all with
+fakes and virtual clocks (no real sleeps; the multi-process ramp soak is
+the ``chaos``-marked wrapper at the bottom).
+"""
+
+import asyncio
+import json
+import subprocess
+import sys
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.llm.disagg import (PrefillQueue, RemotePrefillRequest,
+                                   prefill_queue_name)
+from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.llm.kv_router.scheduler import KvScheduler
+from dynamo_tpu.runtime.engine import Context, EngineError
+from dynamo_tpu.utils import overload
+from dynamo_tpu.utils.overload import (AdmissionConfig, AdmissionController,
+                                       BrownoutController, OverloadError,
+                                       PriorityGate, TokenBucket)
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# priorities + token bucket + admission
+# ---------------------------------------------------------------------------
+def test_parse_priority():
+    assert overload.parse_priority(None) == "interactive"
+    assert overload.parse_priority("") == "interactive"
+    assert overload.parse_priority("Interactive") == "interactive"
+    assert overload.parse_priority(" batch ") == "batch"
+    with pytest.raises(ValueError):
+        overload.parse_priority("realtime")
+
+
+def test_token_bucket_rate_burst_and_retry_after():
+    clk = Clock()
+    b = TokenBucket(rate=10.0, burst=5.0, clock=clk)
+    assert all(b.take() for _ in range(5))   # the full burst
+    assert not b.take()                      # drained
+    # refill at 10/s: 0.1s buys exactly one token
+    clk.advance(0.1)
+    assert b.take()
+    assert not b.take()
+    assert b.retry_after() == pytest.approx(0.1, abs=1e-6)
+    # a floor (the batch reserve) blocks takes that would dip below it
+    clk.advance(0.2)                         # 2 tokens available
+    assert not b.take(floor=2.0)
+    assert b.take(floor=1.0)
+
+
+def test_admission_concurrency_batch_sheds_first():
+    ctrl = AdmissionController(AdmissionConfig(concurrency=2, queue=2),
+                               clock=Clock())
+    assert ctrl.try_admit("interactive") is None
+    assert ctrl.try_admit("batch") is None
+    # at the concurrency cap: batch is refused, interactive rides the
+    # extra queue headroom
+    rej = ctrl.try_admit("batch")
+    assert rej is not None and rej.reason == "concurrency"
+    assert rej.code == 429 and rej.stage == "admission"
+    assert ctrl.try_admit("interactive") is None
+    assert ctrl.try_admit("interactive") is None
+    # headroom exhausted: now interactive sheds too
+    assert ctrl.try_admit("interactive").reason == "concurrency"
+    ctrl.release()
+    assert ctrl.try_admit("interactive") is None
+
+
+def test_admission_rate_limit_and_batch_reserve():
+    clk = Clock()
+    cfg = AdmissionConfig(rps=10.0, burst=4.0, batch_reserve=0.5)
+    ctrl = AdmissionController(cfg, clock=clk)
+    # batch may only drain down to the 50% reserve (2 of 4 tokens)
+    assert ctrl.try_admit("batch") is None
+    assert ctrl.try_admit("batch") is None
+    rej = ctrl.try_admit("batch")
+    assert rej is not None and rej.reason == "rate_limit"
+    assert rej.retry_after > 0
+    # interactive digs into the reserve
+    assert ctrl.try_admit("interactive") is None
+    assert ctrl.try_admit("interactive") is None
+    assert ctrl.try_admit("interactive").reason == "rate_limit"
+
+
+def test_admission_disabled_admits_everything():
+    ctrl = AdmissionController(AdmissionConfig())
+    assert not ctrl.enabled
+    for _ in range(100):
+        assert ctrl.try_admit("batch") is None
+
+
+# ---------------------------------------------------------------------------
+# predictive shed math
+# ---------------------------------------------------------------------------
+def test_predictive_shed_math():
+    # 6 queued items at 0.5s each over 2 servers => 1.5s estimated wait
+    assert overload.predicted_wait(6, 0.5, servers=2) == pytest.approx(1.5)
+    assert overload.should_shed(6, 0.5, remaining_s=1.0, servers=2)
+    assert not overload.should_shed(6, 0.5, remaining_s=2.0, servers=2)
+    # no service observation or no deadline => never shed blind
+    assert overload.predicted_wait(6, None) is None
+    assert not overload.should_shed(6, None, remaining_s=0.1)
+    assert not overload.should_shed(6, 0.5, remaining_s=None)
+
+
+def test_histogram_mean_and_estimator():
+    from dynamo_tpu.utils.prometheus import Histogram
+
+    h = Histogram("t", "t", ("stage",))
+    assert overload.histogram_mean(h) is None
+    h.observe("a", value=1.0)
+    h.observe("b", value=3.0)
+    assert overload.histogram_mean(h) == pytest.approx(2.0)
+
+    est = overload.ServiceTimeEstimator(alpha=0.5)
+    assert est.mean() is None
+    est.observe(1.0)
+    assert est.mean() == pytest.approx(1.0)
+    est.observe(3.0)
+    assert est.mean() == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# priority gate (worker ingress)
+# ---------------------------------------------------------------------------
+async def test_priority_gate_wakes_interactive_first():
+    gate = PriorityGate(slots=1, max_queue=10, max_queue_batch=10)
+    await gate.acquire("interactive", None)      # take the only slot
+    order = []
+
+    async def waiter(pri, tag):
+        await gate.acquire(pri, None)
+        order.append(tag)
+
+    tb = asyncio.create_task(waiter("batch", "b1"))
+    await asyncio.sleep(0)                        # batch queues first
+    ti = asyncio.create_task(waiter("interactive", "i1"))
+    await asyncio.sleep(0)
+    assert gate.waiting == 2
+    gate.release(0.1)                             # interactive wakes FIRST
+    await asyncio.sleep(0)
+    gate.release(0.1)
+    await asyncio.sleep(0)
+    await asyncio.gather(ti, tb)
+    assert order == ["i1", "b1"]
+
+
+async def test_priority_gate_bounds_batch_lower():
+    gate = PriorityGate(slots=1, max_queue=3, max_queue_batch=1)
+    await gate.acquire("interactive", None)
+    t1 = asyncio.create_task(gate.acquire("interactive", None))
+    await asyncio.sleep(0)
+    # 1 waiter >= batch bound 1: batch refused while interactive still fits
+    with pytest.raises(OverloadError) as ei:
+        await gate.acquire("batch", None)
+    assert ei.value.reason == "queue_full"
+    assert ei.value.stage == "worker_queue"
+    t2 = asyncio.create_task(gate.acquire("interactive", None))
+    t3 = asyncio.create_task(gate.acquire("interactive", None))
+    await asyncio.sleep(0)
+    assert gate.waiting == 3
+    with pytest.raises(OverloadError):            # interactive bound = 3
+        await gate.acquire("interactive", None)
+    for _ in range(4):                            # drain: holder + 3 waiters
+        gate.release()
+        await asyncio.sleep(0)
+    await asyncio.gather(t1, t2, t3)
+    assert gate.free == 1
+
+
+async def test_priority_gate_predictive_shed():
+    gate = PriorityGate(slots=1, max_queue=100)
+    gate.service.observe(1.0)                     # 1s per item observed
+    await gate.acquire("interactive", None)
+    t1 = asyncio.create_task(gate.acquire("interactive", None))
+    await asyncio.sleep(0)
+    # 2 ahead x 1s each on 1 slot = 2s estimated wait > 0.5s remaining
+    with pytest.raises(OverloadError) as ei:
+        await gate.acquire("interactive", time.time() + 0.5)
+    assert ei.value.reason == "predicted_late"
+    # a deadline with room is admitted to the queue (no shed)
+    t2 = asyncio.create_task(gate.acquire("interactive", time.time() + 60))
+    await asyncio.sleep(0)
+    assert gate.waiting == 2
+    gate.release()
+    gate.release()
+    await asyncio.gather(t1, t2)
+
+
+async def test_slot_gated_engine_releases_on_completion():
+    from dynamo_tpu.llm.engines import EchoCoreEngine
+    from dynamo_tpu.llm.protocols.common import BackendInput
+    from dynamo_tpu.utils.overload import SlotGatedEngine
+
+    gate = PriorityGate(slots=1, max_queue=4)
+    eng = SlotGatedEngine(EchoCoreEngine(delay_s=0), gate)
+    bi = BackendInput(token_ids=[1, 2, 3])
+    for _ in range(3):                 # slot must be released every time
+        out = [o async for o in eng.generate(bi, Context())]
+        assert out
+    assert gate.free == 1
+    assert gate.service.mean() is not None
+
+
+# ---------------------------------------------------------------------------
+# brownout controller
+# ---------------------------------------------------------------------------
+def test_brownout_steps_up_and_down_with_hysteresis():
+    clk = Clock()
+    c = BrownoutController(up_burn=2.0, down_burn=0.5, dwell_up=5.0,
+                           dwell_down=30.0, clock=clk)
+    assert c.update(0.3) == 0
+    assert c.update(2.5) == 1                 # first step is immediate
+    assert c.update(9.9) == 1                 # dwell_up gates the next
+    clk.advance(5.0)
+    assert c.update(9.9) == 2
+    # the hysteresis band (0.5 < burn < 2.0) holds the level forever
+    clk.advance(100.0)
+    assert c.update(1.0) == 2
+    # calm must be SUSTAINED dwell_down seconds before stepping down
+    assert c.update(0.2) == 2
+    clk.advance(29.0)
+    assert c.update(0.2) == 2
+    clk.advance(1.0)
+    assert c.update(0.2) == 1
+    clk.advance(30.0)
+    assert c.update(0.2) == 0
+    # a burn spike inside the calm window resets it
+    c.level = 1
+    c._calm_since = None
+    assert c.update(0.2) == 1
+    clk.advance(15.0)
+    assert c.update(1.0) == 1                 # band: calm resets
+    assert c.update(0.2) == 1                 # new calm window opens here
+    clk.advance(29.0)
+    assert c.update(0.2) == 1                 # only 29s of NEW calm
+    clk.advance(1.0)
+    assert c.update(0.2) == 0
+
+
+def test_brownout_max_level_and_effects():
+    clk = Clock()
+    c = BrownoutController(up_burn=2.0, down_burn=0.5, dwell_up=0.0,
+                           dwell_down=1.0, max_level=2, clock=clk)
+    for _ in range(10):
+        clk.advance(1.0)
+        c.update(5.0)
+    assert c.level == 2                       # clamped at max_level
+    assert not overload.sheds_batch(0)
+    assert overload.sheds_batch(1)
+    assert overload.max_tokens_cap(1) is None
+    assert overload.max_tokens_cap(2, {"DYN_BROWNOUT_MAX_TOKENS": "64"}) == 64
+    assert not overload.disables_spec(2)
+    assert overload.disables_spec(3)
+    assert not overload.sheds_all(3)
+    assert overload.sheds_all(4)
+    with pytest.raises(ValueError):           # down >= up: no hysteresis
+        BrownoutController(up_burn=1.0, down_burn=1.0)
+
+
+def test_brownout_reject_matrix():
+    assert overload.brownout_reject("interactive", 0) is None
+    assert overload.brownout_reject("batch", 0) is None
+    assert overload.brownout_reject("interactive", 1) is None
+    rej = overload.brownout_reject("batch", 1)
+    assert rej is not None and rej.reason == "brownout_batch"
+    rej = overload.brownout_reject("interactive", 4)
+    assert rej is not None and rej.reason == "brownout_shed_all"
+
+
+# ---------------------------------------------------------------------------
+# router fast-fail
+# ---------------------------------------------------------------------------
+def _metrics(active, total, waiting=1):
+    return ForwardPassMetrics(request_active_slots=active,
+                              request_total_slots=total,
+                              num_requests_waiting=waiting)
+
+
+async def test_router_fast_fail_when_all_saturated():
+    sch = KvScheduler(block_size=4)
+    sch.update_endpoints({1: _metrics(4, 4), 2: _metrics(4, 4)})
+    with pytest.raises(EngineError) as ei:
+        await sch.schedule_or_wait([1, 2, 3, 4], OverlapScores(),
+                                   fast_fail=True)
+    assert ei.value.code == 503
+    assert ei.value.stage == "router" and ei.value.reason == "saturated"
+    # with capacity available fast_fail routes normally
+    sch.update_endpoints({1: _metrics(4, 4), 2: _metrics(1, 4)})
+    wid = await sch.schedule_or_wait([1, 2, 3, 4], OverlapScores(),
+                                     fast_fail=True)
+    assert wid == 2
+
+
+async def test_router_fast_fail_counts_breaker_open():
+    sch = KvScheduler(block_size=4)
+    sch.update_endpoints({1: _metrics(4, 4), 2: _metrics(0, 4)})
+    sch.breaker_open = lambda: {2}           # the only unsaturated one
+    with pytest.raises(EngineError) as ei:
+        await sch.schedule_or_wait([1, 2, 3, 4], OverlapScores(),
+                                   fast_fail=True)
+    assert ei.value.reason == "breaker_open"
+
+
+async def test_router_waits_without_fast_fail():
+    sch = KvScheduler(block_size=4)
+    sch.update_endpoints({1: _metrics(4, 4)})
+    with pytest.raises(TimeoutError):        # legacy capacity-wait
+        await sch.schedule_or_wait([1, 2], OverlapScores(),
+                                   poll_s=0.001, timeout_s=0.01,
+                                   fast_fail=False)
+
+
+# ---------------------------------------------------------------------------
+# bounded priority prefill queue (fake store)
+# ---------------------------------------------------------------------------
+class FakeStore:
+    """In-memory q_push/q_pull/q_len/q_ack with parked pulls."""
+
+    def __init__(self):
+        self.queues = {}
+        self.waiters = {}
+        self._ids = iter(range(1, 10_000))
+
+    async def q_push(self, queue, payload):
+        mid = next(self._ids)
+        ws = self.waiters.get(queue)
+        if ws:
+            ws.pop(0).set_result((mid, payload))
+        else:
+            self.queues.setdefault(queue, []).append((mid, payload))
+        return mid
+
+    async def q_pull(self, queue):
+        q = self.queues.get(queue)
+        if q:
+            return q.pop(0)
+        fut = asyncio.get_event_loop().create_future()
+        self.waiters.setdefault(queue, []).append(fut)
+        return await fut
+
+    async def q_len(self, queue):
+        return len(self.queues.get(queue, []))
+
+    async def q_ack(self, queue, msg_id):
+        pass
+
+
+def _job(rid, priority="interactive", deadline=None):
+    return RemotePrefillRequest(rid, 7, {"token_ids": [1]},
+                                priority=priority, deadline=deadline,
+                                trace=[None, None])
+
+
+async def test_prefill_queue_priority_order_and_roundtrip():
+    store = FakeStore()
+    q = PrefillQueue(store, "ns", max_depth=10, max_depth_batch=5)
+    await q.enqueue(_job("b1", "batch"))
+    await q.enqueue(_job("i1", "interactive"))
+    await q.enqueue(_job("b2", "batch"))
+    # interactive drains strictly first, then batch in FIFO order
+    got = []
+    for _ in range(3):
+        msg_id, job = await q.dequeue()
+        got.append(job.request_id)
+        await q.ack(msg_id)
+    assert got == ["i1", "b1", "b2"]
+    assert await q.size() == 0
+    q.close()
+
+
+async def test_prefill_queue_blocking_pull_across_priorities():
+    store = FakeStore()
+    q = PrefillQueue(store, "ns", max_depth=0)
+    pull = asyncio.create_task(q.dequeue())
+    await asyncio.sleep(0)
+    assert not pull.done()
+    await q.enqueue(_job("late-batch", "batch"))   # batch arrival wakes it
+    msg_id, job = await asyncio.wait_for(pull, 2.0)
+    assert job.request_id == "late-batch"
+    await q.ack(msg_id)
+    q.close()
+
+
+async def test_prefill_queue_depth_bounds_and_predictive_shed():
+    store = FakeStore()
+    q = PrefillQueue(store, "ns", max_depth=2, max_depth_batch=1)
+    await q.enqueue(_job("i1"))
+    await q.enqueue(_job("i2"))
+    with pytest.raises(OverloadError) as ei:
+        await q.enqueue(_job("i3"))
+    assert ei.value.reason == "queue_full"
+    assert ei.value.stage == "prefill_enqueue"
+    with pytest.raises(OverloadError):             # batch bound is lower
+        await q.enqueue(_job("b1", "batch"))
+    # a retry of admitted work bypasses the bounds
+    await q.enqueue(_job("i3-retry"), enforce_bounds=False)
+    # predictive: 1 queued x 2s service > 0.5s remaining deadline
+    q2 = PrefillQueue(store, "ns2", max_depth=100)
+    q2.observe_service(2.0)
+    await q2.enqueue(_job("ok", deadline=time.time() + 60))
+    with pytest.raises(OverloadError) as ei:
+        await q2.enqueue(_job("doomed", deadline=time.time() + 0.5))
+    assert ei.value.reason == "predicted_late"
+    q.close()
+    q2.close()
+
+
+def test_prefill_queue_names_are_per_priority():
+    assert prefill_queue_name("ns") == "ns.prefill"
+    assert prefill_queue_name("ns", "interactive") == "ns.prefill"
+    assert prefill_queue_name("ns", "batch") == "ns.prefill.batch"
+
+
+# ---------------------------------------------------------------------------
+# planner: policies scale up on rejected demand
+# ---------------------------------------------------------------------------
+def test_load_policy_scales_up_on_shed_rate():
+    from dynamo_tpu.planner.policy import LoadPolicy
+    from dynamo_tpu.planner.signals import fake_signals
+
+    p = LoadPolicy(queue_high=1.0, queue_low=0.0, occupancy_low=1.1,
+                   kv_low=1.1)
+    calm = fake_signals("decode", replicas=2, total_slots=8,
+                        active_slots=1)
+    n, _ = p.propose(calm)
+    assert n == 1                        # idle: proposes scale-down
+    # same pool, but the fleet is REJECTING 12 req/s: scale up sized to it
+    shedding = fake_signals("decode", replicas=2, total_slots=8,
+                            active_slots=1, shed_rate=12.0)
+    n, reason = p.propose(shedding)
+    assert n > 2 and "shed" in reason
+    # any shedding at all vetoes scale-down
+    trickle = fake_signals("decode", replicas=2, total_slots=8,
+                           active_slots=1, shed_rate=0.5)
+    n, _ = p.propose(trickle)
+    assert n == 2
+
+
+def test_sla_policy_counts_shed_demand():
+    from dynamo_tpu.planner.policy import SlaPolicy
+
+    class Table:
+        def capacity_per_replica(self, ttft, itl):
+            return 10.0
+
+    from dynamo_tpu.planner.signals import fake_signals
+
+    p = SlaPolicy(Table(), ttft_target=1.0, itl_target=0.1, headroom=1.0)
+    without = p.propose(fake_signals("decode", replicas=1,
+                                     active_slots=5.0))[0]
+    with_shed = p.propose(fake_signals("decode", replicas=1,
+                                       active_slots=5.0,
+                                       shed_rate=20.0))[0]
+    assert without == 1 and with_shed == 3
+
+
+def test_signal_helpers_read_overload_dumps():
+    states = [
+        ("http", {
+            "dyn_admission_rejects_total": {
+                "kind": "counter", "labels": ["reason", "priority"],
+                "series": {"rate_limit\x1fbatch": 5.0,
+                           "concurrency\x1finteractive": 2.0}},
+            "dyn_queue_shed_total": {
+                "kind": "counter", "labels": ["stage"],
+                "series": {"worker_queue": 3.0}},
+            "dyn_admission_queue_depth": {
+                "kind": "gauge", "labels": [], "series": {"": 7.0}},
+            "dyn_brownout_level": {
+                "kind": "gauge", "labels": [], "series": {"": 2.0}},
+        }),
+        ("planner", {"dyn_brownout_level": {
+            "kind": "gauge", "labels": [], "series": {"": 1.0}}}),
+    ]
+    assert overload.shed_totals(states) == pytest.approx(10.0)
+    assert overload.admission_depth_total(states) == pytest.approx(7.0)
+    assert overload.brownout_level_from_states(states) == 2
+
+
+# ---------------------------------------------------------------------------
+# brownout store plane round-trip (in-process store server)
+# ---------------------------------------------------------------------------
+async def test_brownout_publish_watch_roundtrip():
+    from dynamo_tpu.runtime.store_client import StoreClient
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    server = StoreServer()
+    port = await server.start()
+    store = StoreClient(port=port)
+    await store.connect()
+    try:
+        state = await overload.BrownoutState().watch(store, "ns")
+        assert state.level == 0
+        await overload.publish_brownout(store, "ns", 2, burn=3.5)
+        for _ in range(100):
+            if state.level == 2:
+                break
+            await asyncio.sleep(0.01)
+        assert state.level == 2
+        raw = await store.get(overload.brownout_key("ns"))
+        d = json.loads(raw.decode())
+        assert d["name"] == "cap_tokens" and d["burn"] == 3.5
+    finally:
+        await store.close()
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP ingress integration (echo engines, real aiohttp)
+# ---------------------------------------------------------------------------
+async def _start_http(admission=None):
+    from dynamo_tpu.llm.http_service import (HttpService, ModelManager,
+                                             ServedModel)
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.pipeline import (build_chat_engine,
+                                         build_completion_engine)
+
+    card = ModelDeploymentCard.synthetic("echo")
+    manager = ModelManager()
+    manager.add(ServedModel(card, build_chat_engine(card, "echo_core"),
+                            build_completion_engine(card, "echo_core")))
+    svc = HttpService(manager, host="127.0.0.1", port=0,
+                      admission=admission)
+    port = await svc.start()
+    return svc, f"http://127.0.0.1:{port}"
+
+
+async def test_http_admission_429_shape_and_release():
+    ctrl = AdmissionController(AdmissionConfig(concurrency=1, queue=0))
+    svc, base = await _start_http(admission=ctrl)
+    try:
+        ctrl.inflight = 1                     # saturate the controller
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/completions",
+                              json={"model": "echo", "prompt": "ab"}) as r:
+                assert r.status == 429
+                assert "Retry-After" in r.headers
+                err = (await r.json())["error"]
+                assert err["type"] == "overloaded_error"
+                assert err["stage"] == "admission"
+                assert err["reason"] == "concurrency"
+                assert err["retry_after"] > 0
+            ctrl.inflight = 0                 # capacity back: serves, and
+            for _ in range(3):                # release() keeps it there
+                async with s.post(f"{base}/v1/completions",
+                                  json={"model": "echo",
+                                        "prompt": "ab"}) as r:
+                    assert r.status == 200
+            assert ctrl.inflight == 0
+    finally:
+        await svc.stop()
+
+
+async def test_http_priority_header_validation():
+    svc, base = await _start_http()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/completions",
+                              headers={"x-priority": "express"},
+                              json={"model": "echo", "prompt": "ab"}) as r:
+                assert r.status == 400
+                assert "x-priority" in (await r.json())["error"]["message"]
+    finally:
+        await svc.stop()
+
+
+async def test_http_brownout_sheds_batch_and_caps_tokens(monkeypatch):
+    monkeypatch.setenv("DYN_BROWNOUT_MAX_TOKENS", "2")
+    svc, base = await _start_http()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "echo", "prompt": "abcdefgh",
+                    "max_tokens": 8}
+            svc.brownout.level = 1
+            async with s.post(f"{base}/v1/completions", json=body,
+                              headers={"x-priority": "batch"}) as r:
+                assert r.status == 429
+                err = (await r.json())["error"]
+                assert err["reason"] == "brownout_batch"
+            async with s.post(f"{base}/v1/completions", json=body) as r:
+                assert r.status == 200        # interactive unaffected at L1
+                assert len((await r.json())["choices"][0]["text"]) == 8
+            svc.brownout.level = 2            # cap_tokens shrinks the work
+            async with s.post(f"{base}/v1/completions", json=body) as r:
+                assert r.status == 200
+                assert len((await r.json())["choices"][0]["text"]) == 2
+            svc.brownout.level = 4            # shed_all rejects everyone
+            async with s.post(f"{base}/v1/completions", json=body) as r:
+                assert r.status == 429
+                assert (await r.json())["error"]["reason"] == \
+                    "brownout_shed_all"
+    finally:
+        await svc.stop()
+
+
+async def test_ext_no_spec_reaches_backend_input():
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import Preprocessor
+    from dynamo_tpu.llm.protocols.openai import CompletionRequest
+
+    pre = Preprocessor(ModelDeploymentCard.synthetic("echo"))
+    req = CompletionRequest.from_dict(
+        {"model": "echo", "prompt": "abc", "ext": {"no_spec": True}})
+    assert pre.preprocess_completion(req).backend_input.no_spec
+    req = CompletionRequest.from_dict({"model": "echo", "prompt": "abc"})
+    assert not pre.preprocess_completion(req).backend_input.no_spec
+
+
+# ---------------------------------------------------------------------------
+# typed errors survive the wire
+# ---------------------------------------------------------------------------
+def test_error_control_roundtrip():
+    from dynamo_tpu.runtime.component import (error_control,
+                                              error_from_control)
+
+    e = OverloadError("shed", stage="worker_queue", reason="queue_full",
+                      retry_after=0.25)
+    c = error_control(e)
+    assert c == {"kind": "error", "message": "shed", "code": 429,
+                 "stage": "worker_queue", "reason": "queue_full",
+                 "retry_after": 0.25}
+    back = error_from_control(c)
+    assert (back.code, back.stage, back.reason, back.retry_after) == \
+        (429, "worker_queue", "queue_full", 0.25)
+    # untyped errors stay minimal
+    c2 = error_control(ValueError("boom"))
+    assert c2 == {"kind": "error", "message": "boom", "code": 500}
+
+
+def test_context_priority_inherited_by_children():
+    ctx = Context(priority="batch", deadline=123.0)
+    child = ctx.child()
+    assert child.priority == "batch" and child.deadline == 123.0
+    assert Context().priority == "interactive"
+
+
+# ---------------------------------------------------------------------------
+# the ramp soak itself (multi-process; excluded from tier-1)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_overload_soak_ramp(tmp_path):
+    import os
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, "scripts/overload_soak.py",
+         "--baseline-s", "6", "--overload-s", "14", "--recovery-s", "10",
+         "--out", str(tmp_path / "overload_soak.json")],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
