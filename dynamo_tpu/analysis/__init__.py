@@ -1,0 +1,28 @@
+"""dynalint — repo-native static analysis for the serving control plane.
+
+The framework generalizes the two ad-hoc AST gates that already paid for
+themselves (``check_unbounded_awaits``, ``check_metrics_catalog``) into a
+shared rule engine: every hang, dropped task, or unguarded shared field in
+async serving code becomes a stuck request at fleet scale, so whole bug
+classes are caught at commit time instead of in chaos soaks.
+
+Pieces:
+
+- :mod:`.core` — ``Finding``/``Rule``/``Module`` plus the rule registry and
+  the ``# dynalint: ok(<rule>) <reason>`` suppression scanner;
+- :mod:`.baseline` — checked-in grandfather file for pre-existing findings
+  (every entry carries a one-line justification);
+- :mod:`.runner` — walks paths, runs rules, applies suppressions +
+  baseline, renders text/JSON;
+- :mod:`.rules` — the rule implementations (importing it populates the
+  registry).
+
+Everything here is stdlib-only (``ast``/``re``/``json``) — importing the
+package never pulls in jax or the runtime, so the tier-1 gate stays cheap.
+
+Entry point: ``python scripts/dynalint.py`` (see docs/static_analysis.md).
+"""
+
+from .core import (Finding, Module, Rule, all_rules, get_rule,  # noqa: F401
+                   register)
+from .runner import LintResult, run_lint  # noqa: F401

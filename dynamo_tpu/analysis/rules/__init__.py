@@ -1,0 +1,15 @@
+"""dynalint rule implementations.
+
+Importing this package registers every rule with the framework registry
+(:func:`dynamo_tpu.analysis.core.all_rules` triggers the import). Each rule
+lives in its own module; adding a rule = adding a module here with a
+``@register``-decorated ``Rule`` subclass and importing it below.
+"""
+
+from . import blocking_async      # noqa: F401
+from . import fire_forget         # noqa: F401
+from . import knob_drift          # noqa: F401
+from . import lock_discipline     # noqa: F401
+from . import metrics_catalog     # noqa: F401
+from . import silent_except       # noqa: F401
+from . import unbounded_await     # noqa: F401

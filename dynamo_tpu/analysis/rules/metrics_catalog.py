@@ -1,0 +1,124 @@
+"""Rule ``metrics-catalog``: docs/observability.md cannot rot.
+
+Re-homed from ``scripts/check_metrics_catalog.py``, behavior-pinned by
+``tests/test_goodput.py::test_metrics_catalog_in_sync``. Collects every
+metric name registered through the in-tree registry (``.counter("name",
+...)`` / ``.gauge`` / ``.histogram`` with a literal first argument,
+including local aliases ``g = registry.gauge``) and cross-checks the
+catalog in ``docs/observability.md`` two-way:
+
+- every registered metric must appear in the doc;
+- every metric-shaped doc token (``dyn_*`` / ``llm_*``, minus wildcard
+  families and histogram exposition suffixes) must be registered —
+  documented metrics no code exports are exactly how operators end up
+  alerting on series that never appear.
+
+The collection functions are module-level so the legacy standalone CLI
+(and its pinned test asserting specific registered names) can reuse them
+unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set
+
+from ..core import Finding, Module, Rule, register
+
+REGISTER_METHODS = {"counter", "gauge", "histogram"}
+DOC_TOKEN = re.compile(r"\b(?:dyn|llm)_[a-z0-9_]+\b")
+DOC_REL = "docs/observability.md"
+CODE_PREFIX = "dynamo_tpu/"
+
+
+def registered_in_module(mod: Module) -> Dict[str, List[str]]:
+    """{metric name: [``rel:line``, ...]} for one parsed module."""
+    out: Dict[str, List[str]] = {}
+    # local aliases of a register method (`g = registry.gauge`) register
+    # through a bare Name call — resolve them too
+    aliases: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr in REGISTER_METHODS):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    aliases.add(t.id)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else "")
+        if (name not in REGISTER_METHODS and name not in aliases) \
+                or not node.args:
+            continue
+        arg0 = node.args[0]
+        if isinstance(arg0, ast.Constant) and isinstance(
+                arg0.value, str) and DOC_TOKEN.fullmatch(arg0.value):
+            out.setdefault(arg0.value, []).append(
+                f"{mod.rel}:{node.lineno}")
+    return out
+
+
+def documented_tokens(doc_path: str) -> Set[str]:
+    with open(doc_path, "r", encoding="utf-8") as f:
+        text = f.read()
+    # drop wildcard families like `llm_kv_blocks_*`: they are prose
+    # shorthand, not catalog entries (the expanded names must still appear)
+    text = re.sub(r"\b(?:dyn|llm)_[a-z0-9_]+\*", " ", text)
+    return set(DOC_TOKEN.findall(text))
+
+
+def catalog_findings(registered: Dict[str, List[str]],
+                     documented: Set[str], rule: str = "metrics-catalog"
+                     ) -> List[Finding]:
+    findings: List[Finding] = []
+    for name in sorted(registered):
+        if name not in documented:
+            where = registered[name][0]
+            path, _, line = where.rpartition(":")
+            findings.append(Finding(
+                rule=rule, path=path, line=int(line),
+                message=(f"undocumented metric {name!r} — add it to "
+                         f"docs/observability.md"),
+                key=f"undocumented:{name}"))
+    # exposition-format suffixes of registered histograms/counters are
+    # legitimate doc tokens (e.g. `llm_ttft_seconds_bucket`)
+    expanded = set(registered)
+    for name in registered:
+        for sfx in ("_bucket", "_sum", "_count", "_total"):
+            expanded.add(name + sfx)
+    for token in sorted(documented):
+        if token not in expanded:
+            findings.append(Finding(
+                rule=rule, path=DOC_REL, line=0,
+                message=(f"documented metric {token!r} is not registered "
+                         f"anywhere under dynamo_tpu/ — stale catalog "
+                         f"entry (or a typo)"),
+                key=f"stale:{token}"))
+    return findings
+
+
+@register
+class MetricsCatalogRule(Rule):
+    name = "metrics-catalog"
+    description = ("registered Prometheus metrics <-> docs/observability.md "
+                   "catalog, two-way (legacy check_metrics_catalog gate)")
+
+    def check_repo(self, modules: List[Module], repo: str) -> List[Finding]:
+        registered: Dict[str, List[str]] = {}
+        for mod in modules:
+            if not mod.rel.startswith(CODE_PREFIX):
+                continue
+            for name, sites in registered_in_module(mod).items():
+                registered.setdefault(name, []).extend(sites)
+        doc_path = os.path.join(repo, DOC_REL)
+        if not os.path.exists(doc_path):
+            return [Finding(rule=self.name, path=DOC_REL, line=0,
+                            message="docs/observability.md is missing",
+                            key="doc:missing")]
+        return catalog_findings(registered, documented_tokens(doc_path),
+                                rule=self.name)

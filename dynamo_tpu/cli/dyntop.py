@@ -259,8 +259,10 @@ def main() -> None:
         else:
             try:
                 asyncio.run(_loop_curses(args))
-            except Exception:
+            except Exception as e:
                 # a terminal curses can't drive falls back to plain
+                print(f"(curses UI unavailable: {e!r}; plain mode)",
+                      file=sys.stderr)
                 asyncio.run(_loop_plain(args))
     except KeyboardInterrupt:
         pass

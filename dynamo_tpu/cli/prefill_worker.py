@@ -201,7 +201,8 @@ async def run_prefill_worker(args, *,
         try:
             await span_sink.stop()   # final flush: short-lived runs
         except Exception:            # (max_jobs) must not lose spans
-            pass
+            log.warning("span sink final flush failed; tail spans lost",
+                        exc_info=True)
         # deregistration: drop the published stage dump so aggregators
         # stop rendering this worker when a shared runtime outlives it
         from ..llm.metrics_aggregator import clear_worker_keys

@@ -357,7 +357,8 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
         try:
             await span_sink.stop()
         except Exception:
-            pass
+            log.warning("span sink final flush failed; tail spans lost",
+                        exc_info=True)
         await pub.stop()
         # deregistration cleanup: drop the published metric snapshots and
         # this engine's per-worker gauge series so aggregators/dyntop stop

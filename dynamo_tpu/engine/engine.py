@@ -53,6 +53,8 @@ def _trace_annotation(name: str):
     request spans in captured profiles."""
     try:
         return jax.profiler.TraceAnnotation(name)
+    # dynalint: ok(swallowed-exception) profiler unavailable => no-op
+    # scope by design; this wraps EVERY device dispatch and must not log
     except Exception:
         return contextlib.nullcontext()
 

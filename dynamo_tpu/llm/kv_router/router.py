@@ -19,6 +19,7 @@ import logging
 from typing import Dict, Optional
 
 from ...runtime.component import Client, Component, DistributedRuntime
+from ...utils.aiotasks import cancel_all, spawn
 from .indexer import KvIndexer
 from .protocols import KV_EVENT_SUBJECT, ForwardPassMetrics, RouterEvent
 from .scheduler import KvScheduler
@@ -40,6 +41,7 @@ class KvRouterService:
         self._scrape_task: Optional[asyncio.Task] = None
         self.worker_client: Optional[Client] = None
         self._hit_events = 0
+        self._publish_tasks: set = set()   # in-flight hit-rate publishes
         # fleet brownout view (utils/overload.BrownoutState, armed by the
         # router binary): any level above normal turns on scheduler
         # fast-fail — under declared overload, capacity-waiting is doomed
@@ -47,9 +49,11 @@ class KvRouterService:
 
     def _emit_hit_rate(self, ev) -> None:
         self._hit_events += 1
-        asyncio.ensure_future(
-            self.drt.namespace(self.namespace).publish(
-                "kv-hit-rate", ev.to_dict()))
+        # retained handle: a failed publish (store outage mid-churn) must
+        # log, not vanish as a GC'd "exception never retrieved"
+        spawn(self.drt.namespace(self.namespace).publish(
+                  "kv-hit-rate", ev.to_dict()),
+              name="kv-hit-rate-publish", store=self._publish_tasks)
 
     # ------------------------------------------------------------------
     async def start(self) -> "KvRouterService":
@@ -86,6 +90,7 @@ class KvRouterService:
     async def stop(self) -> None:
         if self._scrape_task:
             self._scrape_task.cancel()
+        await cancel_all(self._publish_tasks)
 
     async def _scrape_loop(self) -> None:
         from ..metrics_aggregator import METRICS_PREFIX

@@ -17,7 +17,8 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import (Any, AsyncIterator, Callable, Dict, Iterator, List,
+                    Optional)
 
 EventFilter = Callable[[Dict[str, Any]], bool]
 
@@ -95,10 +96,11 @@ class Recorder:
         self.close()
 
 
-def replay(path: str, speed: Optional[float] = None
-           ) -> Iterator[Dict[str, Any]]:
-    """Yield recorded events; ``speed`` (e.g. 1.0) reproduces original pacing,
-    None replays as fast as possible."""
+def _iter_paced(path: str, speed: Optional[float]) -> Iterator[tuple]:
+    """Shared parse-and-pace core of :func:`replay` / :func:`areplay`:
+    yields ``(delay_s, event)``, where ``delay_s`` is how long a paced
+    replay waits BEFORE delivering the event (0.0 unpaced). The two
+    public replays differ ONLY in how they sleep."""
     prev_ts: Optional[float] = None
     with open(path) as f:
         for line in f:
@@ -106,12 +108,40 @@ def replay(path: str, speed: Optional[float] = None
             if not line:
                 continue
             rec = json.loads(line)
+            delay = 0.0
             if speed and prev_ts is not None:
-                delta = (rec["ts"] - prev_ts) / speed
-                if delta > 0:
-                    time.sleep(delta)
+                delay = max(0.0, (rec["ts"] - prev_ts) / speed)
             prev_ts = rec["ts"]
-            yield rec["event"]
+            yield delay, rec["event"]
+
+
+def replay(path: str, speed: Optional[float] = None
+           ) -> Iterator[Dict[str, Any]]:
+    """Yield recorded events; ``speed`` (e.g. 1.0) reproduces original pacing,
+    None replays as fast as possible.
+
+    Offline/sync use only: pacing blocks in ``time.sleep``. From a running
+    event loop (replaying a capture into a live router/indexer) use
+    :func:`areplay` — a paced sync replay on the loop would freeze every
+    other coroutine for the capture's full duration.
+    """
+    for delay, event in _iter_paced(path, speed):
+        if delay > 0:
+            time.sleep(delay)
+        yield event
+
+
+async def areplay(path: str, speed: Optional[float] = None
+                  ) -> "AsyncIterator[Dict[str, Any]]":
+    """Async :func:`replay`: paces with ``asyncio.sleep`` so a live replay
+    shares the loop instead of parking it."""
+    import asyncio
+
+    for delay, event in _iter_paced(path, speed):
+        # sleep(0) on the unpaced path is a bare yield: replaying a large
+        # capture must not park every other coroutine on the loop
+        await asyncio.sleep(delay)
+        yield event
 
 
 class KvRecorder(Recorder):
@@ -149,6 +179,17 @@ class KvRecorder(Recorder):
                     speed: Optional[float] = None) -> int:
         n = 0
         for ev in replay(self.path, speed=speed):
+            apply(ev["payload"])
+            n += 1
+        return n
+
+    async def replay_into_async(self, apply: Callable[[Dict[str, Any]],
+                                                      None],
+                                speed: Optional[float] = None) -> int:
+        """:meth:`replay_into` for a running event loop: paced replays
+        into a LIVE indexer/router must not block its loop."""
+        n = 0
+        async for ev in areplay(self.path, speed=speed):
             apply(ev["payload"])
             n += 1
         return n

@@ -182,7 +182,9 @@ class Planner:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            # dynalint: ok(swallowed-exception) reaping our own cancelled
+            # loop task; _run_loop logs its own failures with exc_info
+            except (asyncio.CancelledError, Exception):
                 pass
             self._task = None
         close = getattr(self.connector, "close", None)

@@ -106,7 +106,9 @@ async def drive_handler_stream(stream, send) -> bool:
         try:
             await send(error_control(e), None)
         except Exception:
-            pass
+            # peer is already gone — the error frame has no one to reach
+            log.debug("error frame undeliverable (peer gone)",
+                      exc_info=True)
         return False
     return True
 
@@ -219,7 +221,8 @@ class DistributedRuntime:
             try:
                 await self.store.lease_revoke(self.lease)
             except Exception:
-                pass
+                # store likely gone already; TTL expiry reaps the lease
+                log.debug("lease revoke failed during close", exc_info=True)
         if self._dp_server:
             self._dp_server.close()
         # established connections must die with the runtime (a dead process
@@ -230,6 +233,9 @@ class DistributedRuntime:
         for w in list(self._conn_writers):
             try:
                 w.close()
+            # dynalint: ok(swallowed-exception) best-effort socket
+            # teardown while the runtime is exiting; nothing can act on a
+            # close() failure and the fd dies with the process
             except Exception:
                 pass
         self._conn_writers.clear()
@@ -417,6 +423,9 @@ class DistributedRuntime:
                 except asyncio.CancelledError:
                     if not watcher.cancelled():
                         raise   # OUR task was cancelled, not the watcher
+                # dynalint: ok(swallowed-exception) reaping our own
+                # cancelled control watcher; a watcher error mid-request
+                # already surfaced as the request's stop/kill outcome
                 except Exception:
                     pass
             self._active.pop(ctx.id, None)
@@ -690,8 +699,11 @@ class Client:
                     try:
                         await write_frame(w, [{"kind": "stop"}, None])
                         return
+                    # dynalint: ok(swallowed-exception) the exception IS
+                    # the retried condition: writer died mid-send, loop
+                    # retries against the failover successor writer
                     except Exception:
-                        pass   # writer died mid-send: retry the successor
+                        pass
                 await asyncio.sleep(0.05)
 
         stopper = asyncio.create_task(forward_stop())
@@ -864,6 +876,9 @@ class Client:
                 except asyncio.CancelledError:
                     if not stopper.cancelled():
                         raise   # OUR task was cancelled, not the stopper
+                # dynalint: ok(swallowed-exception) reaping our own
+                # cancelled stop-forwarder; its send errors were already
+                # retried inside forward_stop until cancellation
                 except Exception:
                     pass
         finally:

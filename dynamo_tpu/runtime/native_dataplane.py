@@ -26,6 +26,7 @@ from typing import Any, Dict, Optional
 
 from .wire import pack
 from .engine import Context, EngineError
+from ..utils.aiotasks import spawn
 
 log = logging.getLogger("dynamo_tpu.native_dataplane")
 
@@ -73,6 +74,7 @@ class NativeDataPlane:
         self.port: int = 0
         self._contexts: Dict[int, Context] = {}
         self._part_queues: Dict[int, asyncio.Queue] = {}
+        self._run_tasks: set = set()    # in-flight handler tasks (spawn)
         # keep callback objects alive for the lifetime of the server
         self._cb_request = _REQUEST_CB(self._on_request)
         self._cb_part = _PART_CB(self._on_part)
@@ -160,8 +162,11 @@ class NativeDataPlane:
         # handler runs to completion against a dead client
         ctx = Context(ctx_id)
         self._contexts[sid] = ctx
-        asyncio.ensure_future(
-            self._run(sid, endpoint, ctx, ctype, payload, streaming))
+        # retained handle: _run catches transport errors itself, but a bug
+        # BEFORE its try (or a cancelled loop) must still surface instead
+        # of vanishing with the dropped task
+        spawn(self._run(sid, endpoint, ctx, ctype, payload, streaming),
+              name=f"native-dp-run-{sid}", store=self._run_tasks)
 
     async def _run(self, sid: int, endpoint: str, ctx: Context,
                    ctype: str, payload: bytes, streaming: bool) -> None:
@@ -246,7 +251,10 @@ class NativeDataPlane:
                 self._send(sid, {"kind": "error", "message": str(e),
                                  "code": 500}, None)
             except Exception:
-                pass
+                # stream already torn down native-side: the error frame
+                # has no one to reach
+                log.debug("error frame undeliverable (stream %d gone)",
+                          sid, exc_info=True)
         finally:
             drt._active.pop(ctx.id, None)
             self._contexts.pop(sid, None)

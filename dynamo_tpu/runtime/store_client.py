@@ -185,7 +185,10 @@ class StoreClient:
             try:
                 self._writer.close()
             except Exception:
-                pass
+                # transport already torn down under us — reconnect (or
+                # closed.set below) is the real recovery path either way
+                log.debug("writer close failed in _conn_lost",
+                          exc_info=True)
         if self._closing or not self.reconnect.enabled:
             self.closed.set()
             return

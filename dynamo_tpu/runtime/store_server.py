@@ -109,8 +109,11 @@ class _Conn:
                 obj = self._outbox.get_nowait()
                 async with self._send_lock:
                     await write_frame(self.writer, obj)
+        # dynalint: ok(swallowed-exception) broken pipe: the reader loop
+        # reaps the connection, and logging per lost frame would spam on
+        # every ordinary client drop
         except Exception:
-            pass   # broken pipe: the reader loop will reap the connection
+            pass
 
 
 class StoreServer:
@@ -155,6 +158,9 @@ class StoreServer:
             for conn in list(self._conns):
                 try:
                     conn.writer.close()
+                # dynalint: ok(swallowed-exception) force-closing leaked
+                # client sockets at shutdown; nothing can act on a close()
+                # failure and wait_closed() below is the real gate
                 except Exception:
                     pass
             await self._server.wait_closed()
@@ -406,6 +412,9 @@ class StoreServer:
             try:
                 await conn.push({"id": rid, "ok": True, "msg_id": msg.id,
                                  "payload": msg.payload})
+            # dynalint: ok(swallowed-exception) the handler IS the
+            # recovery: the message is requeued for the next kick and the
+            # broken connection is reaped by its own reader loop
             except Exception:
                 q.appendleft(msg)
                 conn.unacked.pop((qname, msg.id), None)

@@ -192,8 +192,12 @@ class Worker:
         app_task.cancel()
         try:
             await app_task
-        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+        except asyncio.CancelledError:
             pass
+        except Exception:
+            # the app coroutine failed BEFORE shutdown and nobody awaited
+            # it yet — this reap is the last chance to see why
+            log.exception("app task failed")
 
     def execute(self, app: Callable[[CancellationToken], Awaitable]) -> None:
         asyncio.run(self._run(app))

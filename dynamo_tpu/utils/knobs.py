@@ -1,0 +1,323 @@
+"""Central registry of every ``DYN_*`` environment knob.
+
+Every env var the system reads is declared here ONCE, with its type,
+default, owning subsystem, and a one-line description. Two gates keep the
+registry honest (rule ``knob-drift`` in ``dynamo_tpu/analysis``):
+
+- every literal ``DYN_*`` name read anywhere under ``dynamo_tpu/`` +
+  ``scripts/`` must have an entry here (an undeclared knob is an
+  undocumented operational surface);
+- every non-derived entry here must still be read somewhere (a stale
+  entry is a knob operators set to no effect);
+- ``docs/configuration.md`` is *generated* from this table
+  (``python -m dynamo_tpu.utils.knobs --write``) and gated two-way
+  against it, mirroring the metrics-catalog gate.
+
+``derived=True`` marks knobs that never appear as literals in code: the
+``utils/dynconfig.py`` layering materializes ``DYN_<PROG>_<FLAG>`` /
+``DYN_<FLAG>`` names from CLI flags at argparse time (the planner's whole
+``DYN_PLANNER_*`` surface works this way). They are registered so the doc
+table is complete, and exempt from the must-be-read-literally check.
+
+This module is stdlib-only and import-light on purpose — the lint
+framework and tier-1 tests import it without touching jax or the runtime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+#: doc shorthand per subsystem (keeps the table rows terse)
+_DOCS = {
+    "runtime": "docs/robustness.md",
+    "overload": "docs/robustness.md",
+    "faults": "docs/robustness.md",
+    "spec": "docs/speculative.md",
+    "engine": "docs/observability.md",
+    "tracing": "docs/observability.md",
+    "logging": "docs/observability.md",
+    "slo": "docs/observability.md",
+    "roofline": "docs/observability.md",
+    "disagg": "docs/disagg_serving.md",
+    "router": "docs/kv_cache_routing.md",
+    "planner": "docs/planner.md",
+    "sdk": "docs/architecture.md",
+    "config": "docs/architecture.md",
+    "llm": "docs/benchmarking.md",
+}
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str            # the full env var name, e.g. "DYN_LEASE_TTL"
+    type: str            # str | int | float | bool | csv | json
+    default: str         # human-readable default ("" = unset/off)
+    subsystem: str       # key into _DOCS (owning plane)
+    description: str     # one line, imperative, no trailing period
+    derived: bool = False  # materialized by dynconfig flag layering
+
+    @property
+    def doc(self) -> str:
+        return _DOCS[self.subsystem]
+
+
+def _k(name: str, type: str, default: str, subsystem: str,
+       description: str, derived: bool = False) -> Knob:
+    return Knob(name, type, default, subsystem, description, derived)
+
+
+_ALL: List[Knob] = [
+    # ------------------------------------------------------------- runtime
+    _k("DYN_STORE_RECONNECT", "bool", "1", "runtime",
+       "store-client reconnect + session replay on connection loss"),
+    _k("DYN_STORE_RECONNECT_ATTEMPTS", "int", "10", "runtime",
+       "max reconnect attempts before the client reports closed"),
+    _k("DYN_STORE_RECONNECT_BASE", "float", "0.05", "runtime",
+       "reconnect backoff base delay, seconds (doubles per attempt)"),
+    _k("DYN_STORE_RECONNECT_MAX", "float", "2.0", "runtime",
+       "reconnect backoff ceiling, seconds"),
+    _k("DYN_LEASE_TTL", "float", "10.0", "runtime",
+       "store lease liveness TTL, seconds (keepalives fire every ttl/3)"),
+    _k("DYN_DRAIN_TIMEOUT", "float", "10.0", "runtime",
+       "graceful-drain grace on SIGTERM before cooperative stop, seconds"),
+    _k("DYN_CB_THRESHOLD", "int", "3", "runtime",
+       "consecutive failures that open an instance circuit breaker "
+       "(0 disables)"),
+    _k("DYN_CB_COOLDOWN", "float", "5.0", "runtime",
+       "breaker OPEN hold before the half-open probe, seconds"),
+    _k("DYN_REQUEST_TIMEOUT", "float", "", "runtime",
+       "default end-to-end request deadline when the client sends none, "
+       "seconds"),
+    # ------------------------------------------------------------ overload
+    _k("DYN_ADMIT_RPS", "float", "0", "overload",
+       "token-bucket admission rate at HTTP ingress (0 = no rate cap)"),
+    _k("DYN_ADMIT_BURST", "float", "2*rps", "overload",
+       "token-bucket burst size"),
+    _k("DYN_ADMIT_CONCURRENCY", "int", "0", "overload",
+       "max in-flight requests admitted (0 = no concurrency cap)"),
+    _k("DYN_ADMIT_QUEUE", "int", "-1", "overload",
+       "admission wait-queue depth (-1 = unbounded, 0 = reject at cap)"),
+    _k("DYN_ADMIT_BATCH_RESERVE", "float", "0.25", "overload",
+       "fraction of admission capacity batch-priority traffic may use "
+       "when interactive traffic is waiting"),
+    _k("DYN_WORKER_SLOTS", "int", "0", "overload",
+       "worker decode slot gate (0/unset = ungated)"),
+    _k("DYN_WORKER_QUEUE_DEPTH", "int", "2*slots", "overload",
+       "bounded wait queue behind the worker slot gate"),
+    _k("DYN_WORKER_BATCH_QUEUE_DEPTH", "int", "-1", "overload",
+       "batch-priority share of the worker wait queue (-1 = half)"),
+    _k("DYN_BROWNOUT_MAX_TOKENS", "int", "256", "overload",
+       "max_tokens ceiling applied at brownout level 2+"),
+    _k("DYN_BROWNOUT_UP_BURN", "float", "2.0", "overload",
+       "worst-SLO burn rate that steps the brownout ladder up"),
+    _k("DYN_BROWNOUT_DOWN_BURN", "float", "0.75", "overload",
+       "burn rate below which the ladder steps back down"),
+    _k("DYN_BROWNOUT_DWELL_UP", "float", "5.0", "overload",
+       "min seconds between upward brownout steps"),
+    _k("DYN_BROWNOUT_DWELL_DOWN", "float", "30.0", "overload",
+       "min seconds between downward brownout steps"),
+    _k("DYN_BROWNOUT_MAX_LEVEL", "int", "3", "overload",
+       "highest brownout level the controller may reach (ladder max 4)"),
+    # -------------------------------------------------------------- faults
+    _k("DYN_FAULTS", "csv", "", "faults",
+       "fault-injection table armed at process start, "
+       "e.g. 'store.connect:refuse,kv.push.part:drop:0.5'"),
+    # ---------------------------------------------------------------- spec
+    _k("DYN_SPEC", "str", "", "spec",
+       "speculative decoding mode: '' (off) | ngram | draft"),
+    _k("DYN_SPEC_K", "int", "4", "spec",
+       "max draft tokens per lane per dispatch"),
+    _k("DYN_SPEC_K_MIN", "int", "1", "spec", "adaptive-k floor"),
+    _k("DYN_SPEC_ADAPT", "bool", "1", "spec",
+       "per-lane adaptive k on acceptance history"),
+    _k("DYN_SPEC_NGRAM_MAX", "int", "3", "spec",
+       "longest suffix n-gram the prompt-lookup proposer tries"),
+    _k("DYN_SPEC_NGRAM_MIN", "int", "1", "spec",
+       "shortest suffix n-gram fallback"),
+    _k("DYN_SPEC_NGRAM_WINDOW", "int", "2048", "spec",
+       "trailing-token window the n-gram proposer indexes"),
+    _k("DYN_SPEC_DRAFT", "str", "", "spec",
+       "draft model preset name or checkpoint dir (mode=draft)"),
+    # -------------------------------------------------------------- engine
+    _k("DYN_PROFILE_DIR", "str", "", "engine",
+       "capture an XLA profile of the first working iterations into "
+       "this directory"),
+    _k("DYN_PROFILE_STEPS", "int", "32", "engine",
+       "engine iterations the DYN_PROFILE_DIR capture spans"),
+    # ----------------------------------------------------- tracing/logging
+    _k("DYN_TRACING", "bool", "1", "tracing",
+       "request span tracing (0 disables recording entirely)"),
+    _k("DYN_TRACE_BUFFER", "int", "4096", "tracing",
+       "per-process span ring-buffer capacity"),
+    _k("DYN_LOG", "str", "info", "logging",
+       "root log level, with per-target overrides "
+       "('info,dynamo_tpu.runtime=debug')"),
+    _k("DYN_LOGGING_JSONL", "str", "", "logging",
+       "JSONL log output: '1'/'stderr' = JSON lines on stderr, "
+       "other values = file path"),
+    # ----------------------------------------------------------------- slo
+    _k("DYN_SLO_TTFT_P90", "float", "", "slo",
+       "TTFT p90 objective, seconds (unset = SLO not monitored)"),
+    _k("DYN_SLO_ITL_P90", "float", "", "slo",
+       "inter-token latency p90 objective, seconds"),
+    _k("DYN_SLO_AVAILABILITY", "float", "", "slo",
+       "good-request fraction objective, e.g. 0.999"),
+    _k("DYN_SLO_WINDOWS", "csv", "60,300,1800", "slo",
+       "burn-rate windows, seconds"),
+    # ------------------------------------------------------------ roofline
+    _k("DYN_PEAK_FLOPS", "float", "", "roofline",
+       "override peak accelerator FLOP/s for MFU accounting"),
+    _k("DYN_PEAK_GBPS", "float", "", "roofline",
+       "override peak HBM GB/s for MBU accounting"),
+    # -------------------------------------------------------------- disagg
+    _k("DYN_PREFILL_QUEUE_MAX", "int", "0", "disagg",
+       "bounded shared prefill queue depth (0 = unbounded)"),
+    _k("DYN_PREFILL_QUEUE_MAX_BATCH", "int", "max/2", "disagg",
+       "batch-priority share of the prefill queue"),
+    # -------------------------------------------------------------- router
+    _k("DYN_ROUTER_FAST_FAIL", "bool", "0", "router",
+       "fail saturated scheduling with a typed 503 instead of "
+       "capacity-waiting"),
+    _k("DYN_ROUTER_AUDIT", "int", "512", "router",
+       "router decision audit ring capacity"),
+    # ----------------------------------------------------------------- llm
+    _k("DYN_TOKEN_ECHO_DELAY_MS", "float", "10", "llm",
+       "echo-engine per-token pacing, milliseconds (0 = as fast as "
+       "possible; test/bench fixture)"),
+    # ------------------------------------------------------------- sdk
+    _k("DYN_SERVICE_CONFIG", "json", "", "sdk",
+       "service-graph config JSON injected into sdk.serve children"),
+    _k("DYN_SERVICE_CONFIG_FILE", "str", "", "sdk",
+       "path to the service config JSON (set by deploy manifests)"),
+    # ------------------------------------------------- dynconfig (derived)
+    _k("DYN_PORT", "int", "per-flag", "config",
+       "global flag override: DYN_<FLAG> applies to every binary's "
+       "matching --flag", derived=True),
+    _k("DYN_HTTP_PORT", "int", "per-flag", "config",
+       "binary-scoped flag override (DYN_<PROG>_<FLAG>); set by deploy "
+       "manifests for the frontend port", derived=True),
+]
+
+# The planner daemon's whole flag surface is env-drivable as
+# DYN_PLANNER_<FLAG> through the dynconfig layering — registered here so
+# docs/configuration.md lists every operator-facing knob.
+_PLANNER = [
+    ("STORE", "str", "127.0.0.1:4222", "store host:port"),
+    ("NAMESPACE", "str", "dynamo", "runtime namespace"),
+    ("DECODE_COMPONENT", "str", "backend", "decode pool component"),
+    ("PREFILL_COMPONENT", "str", "", "prefill pool component "
+                                     "('' = decode only)"),
+    ("POLICY", "str", "load", "scaling policy: load | sla"),
+    ("CONNECTOR", "str", "none", "actuator: local | kube | none"),
+    ("INTERVAL", "float", "2.0", "control-loop period, seconds"),
+    ("MIN_REPLICAS", "int", "1", "per-pool replica floor"),
+    ("MAX_REPLICAS", "int", "8", "per-pool replica ceiling"),
+    ("COOLDOWN_UP", "float", "30.0", "min seconds between scale-ups"),
+    ("COOLDOWN_DOWN", "float", "120.0", "min seconds between scale-downs"),
+    ("DOWN_CONSENSUS", "int", "3", "consecutive down-votes before a "
+                                   "scale-down actuates"),
+    ("DRY_RUN", "bool", "0", "publish decisions but never actuate"),
+    ("BROWNOUT", "bool", "0", "run the SLO-burn brownout controller on "
+                              "the planner loop"),
+    ("QUEUE_HIGH", "float", "1.0", "load policy: queue-depth-per-replica "
+                                   "scale-up threshold"),
+    ("OCCUPANCY_HIGH", "float", "0.85", "load policy: slot occupancy "
+                                        "scale-up threshold"),
+    ("OCCUPANCY_LOW", "float", "0.3", "load policy: slot occupancy "
+                                      "scale-down threshold"),
+    ("KV_HIGH", "float", "0.9", "load policy: KV occupancy scale-up "
+                                "threshold"),
+    ("KV_LOW", "float", "0.5", "load policy: KV occupancy scale-down "
+                               "threshold"),
+    ("PROFILE", "str", "", "SLA policy: profile table path "
+                           "(planner.profile output)"),
+    ("TTFT_TARGET", "float", "2.0", "SLA policy: TTFT target, seconds"),
+    ("ITL_TARGET", "float", "0.05", "SLA policy: inter-token target, "
+                                    "seconds"),
+    ("WORKER_ENGINE", "str", "jax", "local connector: engine for spawned "
+                                    "workers"),
+    ("WORKER_CHIPS", "int", "0", "local connector: chips per decode "
+                                 "worker (0 = auto)"),
+    ("PREFILL_WORKER_CHIPS", "int", "0", "local connector: chips per "
+                                         "prefill worker"),
+    ("TOTAL_CHIPS", "int", "4", "local connector: chip budget for the "
+                                "sdk allocator"),
+    ("PLATFORM", "str", "cpu", "local connector: cpu | tpu"),
+    ("WORKER_ARGS", "str", "", "local connector: extra args appended to "
+                               "spawned worker command lines"),
+    ("KUBE_URL", "str", "", "kube connector: API server URL"),
+    ("KUBE_TOKEN", "str", "", "kube connector: bearer token"),
+    ("KUBE_INSECURE", "bool", "0", "kube connector: skip TLS verify"),
+    ("KUBE_NAMESPACE", "str", "default", "kube connector: namespace"),
+    ("KUBE_DEPLOYMENT", "str", "", "kube connector: DynamoDeployment / "
+                                   "Deployment name"),
+    ("KUBE_MODE", "str", "crd", "kube connector: crd | deployment"),
+]
+_ALL.extend(
+    _k(f"DYN_PLANNER_{flag}", typ, default, "planner", desc, derived=True)
+    for flag, typ, default, desc in _PLANNER)
+
+KNOBS: Dict[str, Knob] = {k.name: k for k in _ALL}
+if len(KNOBS) != len(_ALL):
+    raise RuntimeError("duplicate knob registration")
+
+
+def render_markdown() -> str:
+    """The generated body of docs/configuration.md."""
+    out = [
+        "# Configuration — the `DYN_*` environment knob surface",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand. "
+        "Regenerate: python -m dynamo_tpu.utils.knobs --write -->",
+        "",
+        "Every environment variable the system reads, generated from the",
+        "central registry in `dynamo_tpu/utils/knobs.py` and gated two-way",
+        "against it by the `knob-drift` rule (`python scripts/dynalint.py`;",
+        "see [static analysis](static_analysis.md)). Add a knob by",
+        "registering it there, then regenerate this file.",
+        "",
+        "Knobs marked *derived* are materialized from CLI flags by the",
+        "`utils/dynconfig.py` layering (`DYN_<PROG>_<FLAG>` beats",
+        "`DYN_<FLAG>` beats the built-in default); the rest are read",
+        "directly by the owning subsystem at the moment listed in its doc.",
+        "",
+    ]
+    by_sub: Dict[str, List[Knob]] = {}
+    for k in KNOBS.values():
+        by_sub.setdefault(k.subsystem, []).append(k)
+    for sub in sorted(by_sub):
+        knobs = sorted(by_sub[sub], key=lambda k: k.name)
+        doc = _DOCS[sub]
+        out.append(f"## {sub} ([{doc.split('/')[-1]}]"
+                   f"({doc.split('/')[-1]}))")
+        out.append("")
+        out.append("| knob | type | default | description |")
+        out.append("|---|---|---|---|")
+        for k in knobs:
+            d = k.default if k.default != "" else "*(unset)*"
+            desc = k.description + (" *(derived)*" if k.derived else "")
+            out.append(f"| `{k.name}` | {k.type} | `{d}` | {desc} |")
+        out.append("")
+    out.append(f"{len(KNOBS)} knobs registered.")
+    out.append("")
+    return "\n".join(out)
+
+
+def _main(argv: List[str]) -> int:
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    target = os.path.join(repo, "docs", "configuration.md")
+    if "--write" in argv:
+        with open(target, "w", encoding="utf-8") as f:
+            f.write(render_markdown())
+        print(f"wrote {target} ({len(KNOBS)} knobs)")
+    else:
+        print(render_markdown())
+    return 0
+
+
+if __name__ == "__main__":          # pragma: no cover - trivial shell
+    import sys
+    sys.exit(_main(sys.argv[1:]))

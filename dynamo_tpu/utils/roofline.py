@@ -157,6 +157,8 @@ def dtype_size(dtype: Any) -> int:
         import jax.numpy as jnp
 
         return int(np.dtype(jnp.zeros((), dtype).dtype).itemsize)
+    # dynalint: ok(swallowed-exception) jax-dtype probe falling back to
+    # the numpy interpretation IS the handling; both paths return a size
     except Exception:
         return int(np.dtype(dtype).itemsize)
 

@@ -36,6 +36,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import json
+import logging
 import os
 import threading
 import time
@@ -43,6 +44,8 @@ import uuid
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("dynamo_tpu.tracing")
 
 TRACE_STORE_PREFIX = "traces/"
 
@@ -204,8 +207,11 @@ class Tracer:
         for sink in self._sinks:
             try:
                 sink(span)
+            # dynalint: ok(swallowed-exception) a broken sink must never
+            # break the request path; this runs per finished span, and the
+            # store sink has its own retrying flush loop that does log
             except Exception:
-                pass    # a broken sink must never break the request path
+                pass
 
     def span(self, name: str, **kw: Any) -> _SpanScope:
         """``with tracer.span("stage"): ...`` / ``async with ...`` sugar."""
@@ -399,6 +405,8 @@ class StoreSpanSink:
             except asyncio.CancelledError:
                 if not self._task.cancelled():
                     raise   # OUR task was cancelled, not the flush loop
+            # dynalint: ok(swallowed-exception) reaping our own cancelled
+            # flush loop; per-flush errors were logged as they happened
             except Exception:
                 pass
         # final drain: flush() caps at max_batch per call, so loop until
@@ -461,7 +469,10 @@ class StoreSpanSink:
             except asyncio.CancelledError:
                 raise
             except Exception:
-                pass    # store hiccups must not kill the process
+                # store hiccups must not kill the process; spans are
+                # retained and the next tick retries
+                log.debug("span flush failed; retrying next tick",
+                          exc_info=True)
             await asyncio.sleep(self.flush_interval)
 
 
@@ -473,5 +484,8 @@ async def fetch_trace_spans(store, trace_id: str) -> List[Span]:
         try:
             out.append(Span.from_dict(json.loads(value.decode())))
         except Exception:
+            # one corrupt span record must not hide the rest of the trace
+            log.debug("skipping undecodable span under trace %s",
+                      trace_id, exc_info=True)
             continue
     return out

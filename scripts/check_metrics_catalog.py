@@ -1,119 +1,72 @@
 #!/usr/bin/env python
 """Static check: the metrics catalog in docs/observability.md cannot rot.
 
-Walks every Python file under ``dynamo_tpu/`` and collects metric names
-registered through the in-tree registry (``.counter("name", ...)``,
-``.gauge(...)``, ``.histogram(...)`` calls with a literal first argument),
-then cross-checks them against ``docs/observability.md``:
-
-- every REGISTERED metric name must appear in the doc (inside backticks or
-  a table cell — anywhere, literally);
-- every metric-shaped token in the doc (``dyn_*`` / ``llm_*`` lowercase
-  identifiers, ignoring ``*`` wildcards and the ``_bucket``/``_sum``/
-  ``_count`` exposition suffixes of a registered histogram) must be a
-  registered metric — documented metrics that no code exports are exactly
-  how operators end up alerting on series that never appear.
-
-Runnable standalone (exit 1 on findings) and as a tier-1 test
-(tests/test_goodput.py::test_metrics_catalog_in_sync).
+Standalone CLI for the ``metrics-catalog`` dynalint rule (the logic lives
+in ``dynamo_tpu/analysis/rules/metrics_catalog.py`` since the gates were
+generalized into a framework — see docs/static_analysis.md). Kept as a
+thin wrapper so existing CI wiring and ``tests/test_goodput.py::
+test_metrics_catalog_in_sync`` keep working unchanged.
 
     python scripts/check_metrics_catalog.py
+
+Exit 1 on findings: registered-but-undocumented metrics, and documented-
+but-unregistered catalog entries (operators alerting on series that never
+appear).
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
-from typing import Dict, List, Set, Tuple
+from typing import Dict, List, Set
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from dynamo_tpu.analysis.core import Module, iter_python_files  # noqa: E402
+from dynamo_tpu.analysis.rules import metrics_catalog as _rule  # noqa: E402
+
+__all__ = ["CODE_ROOT", "DOC", "registered_metrics", "documented_tokens",
+           "run", "main"]
+
 CODE_ROOT = os.path.join(REPO, "dynamo_tpu")
 DOC = os.path.join(REPO, "docs", "observability.md")
-
-REGISTER_METHODS = {"counter", "gauge", "histogram"}
-# doc tokens that look like metrics: lowercase dyn_/llm_ identifiers
-DOC_TOKEN = re.compile(r"\b(?:dyn|llm)_[a-z0-9_]+\b")
-# names that appear in docs as env/config rather than metrics never match
-# DOC_TOKEN (env knobs are uppercase), so no allowlist is needed today.
 
 
 def registered_metrics(root: str = CODE_ROOT) -> Dict[str, List[str]]:
     """{metric_name: [file:line, ...]} for every literal registration."""
     out: Dict[str, List[str]] = {}
-    for dirpath, _dirs, files in os.walk(root):
-        if "__pycache__" in dirpath:
+    for path in iter_python_files([root]):
+        try:
+            mod = Module(path, repo=REPO)
+        except SyntaxError:
             continue
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, "r", encoding="utf-8") as f:
-                src = f.read()
-            try:
-                tree = ast.parse(src, filename=path)
-            except SyntaxError:
-                continue
-            # local aliases of a register method (`g = registry.gauge`)
-            # register through a bare Name call — resolve them too
-            aliases: Set[str] = set()
-            for node in ast.walk(tree):
-                if (isinstance(node, ast.Assign)
-                        and isinstance(node.value, ast.Attribute)
-                        and node.value.attr in REGISTER_METHODS):
-                    for t in node.targets:
-                        if isinstance(t, ast.Name):
-                            aliases.add(t.id)
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                func = node.func
-                name = func.attr if isinstance(func, ast.Attribute) else (
-                    func.id if isinstance(func, ast.Name) else "")
-                if (name not in REGISTER_METHODS and name not in aliases) \
-                        or not node.args:
-                    continue
-                arg0 = node.args[0]
-                if isinstance(arg0, ast.Constant) and isinstance(
-                        arg0.value, str) and DOC_TOKEN.fullmatch(arg0.value):
-                    rel = os.path.relpath(path, REPO)
-                    out.setdefault(arg0.value, []).append(
-                        f"{rel}:{node.lineno}")
+        for name, sites in _rule.registered_in_module(mod).items():
+            out.setdefault(name, []).extend(sites)
     return out
 
 
 def documented_tokens(doc: str = DOC) -> Set[str]:
-    with open(doc, "r", encoding="utf-8") as f:
-        text = f.read()
-    # drop wildcard families like `llm_kv_blocks_*`: they are prose
-    # shorthand, not catalog entries (the expanded names must still appear)
-    text = re.sub(r"\b(?:dyn|llm)_[a-z0-9_]+\*", " ", text)
-    return set(DOC_TOKEN.findall(text))
+    return _rule.documented_tokens(doc)
 
 
 def run() -> List[str]:
-    registered = registered_metrics()
-    documented = documented_tokens()
-    findings: List[str] = []
-    for name in sorted(registered):
-        if name not in documented:
-            where = registered[name][0]
-            findings.append(
-                f"undocumented metric {name!r} (registered at {where}) — "
-                f"add it to docs/observability.md")
-    # exposition-format suffixes of registered histograms/counters are
-    # legitimate doc tokens (e.g. `llm_ttft_seconds_bucket`)
-    expanded = set(registered)
-    for name in registered:
-        for sfx in ("_bucket", "_sum", "_count", "_total"):
-            expanded.add(name + sfx)
-    for token in sorted(documented):
-        if token not in expanded:
-            findings.append(
-                f"documented metric {token!r} is not registered anywhere "
+    findings = _rule.catalog_findings(registered_metrics(),
+                                      documented_tokens())
+    out: List[str] = []
+    for f in findings:
+        if f.key.startswith("undocumented:"):
+            name = f.key.split(":", 1)[1]
+            out.append(
+                f"undocumented metric {name!r} (registered at "
+                f"{f.path}:{f.line}) — add it to docs/observability.md")
+        else:
+            name = f.key.split(":", 1)[1]
+            out.append(
+                f"documented metric {name!r} is not registered anywhere "
                 f"under dynamo_tpu/ — stale catalog entry (or a typo)")
-    return findings
+    return out
 
 
 def main(_argv: List[str]) -> int:

@@ -1,125 +1,56 @@
 #!/usr/bin/env python
-"""Static check: network awaits in ``dynamo_tpu/runtime/`` must be bounded.
+"""Static check: network awaits in standing async code must be bounded.
 
-Every ``await`` of a network primitive (``asyncio.open_connection``, frame/
-stream ``read``/``readexactly``, writer ``drain``, queue ``q_pull``) is a
-potential hang: if the peer stalls without closing the socket, the coroutine
-parks forever and the request above it never reaches a terminal state. This
-check walks the runtime layer's ASTs and flags any such await that is
-
-- not wrapped in a ``wait_for`` (``asyncio.wait_for`` or the deadline
-  layer's ``deadline.wait_for``), and
-- not annotated ``# unbounded-ok`` on the await's line or a contiguous
-  comment block directly above it (the annotation asserts the await's
-  lifetime is bounded by something else — e.g. an rx loop that lives
-  exactly as long as its connection and has a loss path).
-
-Runnable standalone (exit 1 on findings) and as a tier-1 test
-(tests/test_churn.py::test_no_unbounded_network_awaits).
+Standalone CLI for the ``unbounded-await`` dynalint rule (the logic lives
+in ``dynamo_tpu/analysis/rules/unbounded_await.py`` since the gates were
+generalized into a framework — see docs/static_analysis.md). Kept as a
+thin wrapper so existing muscle memory, CI wiring, and
+``tests/test_churn.py::test_no_unbounded_network_awaits`` keep working
+unchanged.
 
     python scripts/check_unbounded_awaits.py [paths...]
+
+Exit 1 on findings. ``# unbounded-ok`` annotations are honored as before
+(as is the framework's ``# dynalint: ok(unbounded-await) <reason>``).
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import List, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-# the planner is a standing control loop over the same store primitives —
-# an unbounded await there parks the whole autoscaler, so it is gated too.
-# engine/spec.py is gated because it runs ON the engine thread: any await
-# (or blocking network read) sneaking into a proposer would stall every
-# request in the batch, so the file must stay visibly clean under this gate
-DEFAULT_PATHS = [os.path.join(REPO, "dynamo_tpu", "runtime"),
-                 os.path.join(REPO, "dynamo_tpu", "planner"),
-                 os.path.join(REPO, "dynamo_tpu", "engine", "spec.py"),
-                 # goodput plane: roofline runs on the engine thread, the
-                 # SLO monitor inside standing daemons (planner, dyntop),
-                 # and dyntop itself is a standing store-polling loop —
-                 # an unbounded await in any of them parks its owner
-                 os.path.join(REPO, "dynamo_tpu", "utils", "roofline.py"),
-                 os.path.join(REPO, "dynamo_tpu", "utils", "slo.py"),
-                 os.path.join(REPO, "dynamo_tpu", "cli", "dyntop.py"),
-                 # overload plane: the admission gate runs inside every
-                 # request, the brownout controller inside standing
-                 # daemons, and the soak is the harness that must itself
-                 # never hang while proving nothing else does
-                 os.path.join(REPO, "dynamo_tpu", "utils", "overload.py"),
-                 os.path.join(REPO, "scripts", "overload_soak.py")]
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-# method/function names whose await parks on the network
-NETWORK_CALLS = {"open_connection", "readexactly", "read", "drain",
-                 "q_pull"}
-# enclosing call names that bound the await
-GUARD_CALLS = {"wait_for"}
+from dynamo_tpu.analysis.core import Module                    # noqa: E402
+from dynamo_tpu.analysis.core import iter_python_files         # noqa: E402
+from dynamo_tpu.analysis.rules.unbounded_await import (        # noqa: E402
+    GUARD_CALLS, LEGACY_SCOPE, NETWORK_CALLS, unbounded_awaits)
+
+__all__ = ["DEFAULT_PATHS", "NETWORK_CALLS", "GUARD_CALLS", "ANNOTATION",
+           "check_file", "run", "main"]
+
+DEFAULT_PATHS = [os.path.join(REPO, *rel.split("/")) for rel in LEGACY_SCOPE]
 ANNOTATION = "unbounded-ok"
 
 
-def _call_name(node: ast.AST) -> str:
-    if isinstance(node, ast.Call):
-        f = node.func
-        if isinstance(f, ast.Attribute):
-            return f.attr
-        if isinstance(f, ast.Name):
-            return f.id
-    return ""
-
-
-def _annotated(lines: List[str], lineno: int) -> bool:
-    """True when the await's own line, or the contiguous comment block
-    directly above it, carries the ``# unbounded-ok`` annotation."""
-    if ANNOTATION in lines[lineno - 1]:
-        return True
-    i = lineno - 2
-    while i >= 0 and lines[i].strip().startswith("#"):
-        if ANNOTATION in lines[i]:
-            return True
-        i -= 1
-    return False
-
-
 def check_file(path: str) -> List[Tuple[int, str]]:
-    with open(path, "r", encoding="utf-8") as f:
-        src = f.read()
-    lines = src.splitlines()
-    tree = ast.parse(src, filename=path)
-    # parent links, to detect an enclosing wait_for(...) call
-    parents = {}
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            parents[child] = node
-    findings: List[Tuple[int, str]] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Await):
-            continue
-        name = _call_name(node.value)
-        if name not in NETWORK_CALLS:
-            continue
-        # guarded: some ancestor expression is a wait_for(...) call
-        cur, guarded = node, False
-        while cur in parents:
-            cur = parents[cur]
-            if _call_name(cur) in GUARD_CALLS:
-                guarded = True
-                break
-            if isinstance(cur, (ast.AsyncFunctionDef, ast.FunctionDef)):
-                break
-        if guarded or _annotated(lines, node.lineno):
-            continue
-        findings.append((node.lineno, name))
-    return findings
+    """Legacy per-file API: [(lineno, primitive name), ...]."""
+    mod = Module(path, repo=REPO)
+    # the framework's generic suppression also mutes here, matching what
+    # `scripts/dynalint.py` would report
+    return [(lineno, name)
+            for lineno, name, _fn in unbounded_awaits(mod)
+            if not any(r == "unbounded-await"
+                       for r, _reason, _l in mod.suppressions_at(lineno))]
 
 
 def run(paths: List[str]) -> List[str]:
     out: List[str] = []
     for root in paths:
-        files = [root] if root.endswith(".py") else [
-            os.path.join(dp, fn) for dp, _, fns in os.walk(root)
-            for fn in sorted(fns) if fn.endswith(".py")]
-        for path in sorted(files):
+        for path in iter_python_files([root]):
             for lineno, name in check_file(path):
                 rel = os.path.relpath(path, REPO)
                 out.append(

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""dynalint — run the repo's static analysis suite.
+
+    python scripts/dynalint.py                     # all rules, full tree
+    python scripts/dynalint.py dynamo_tpu/llm/     # per-file rules, subset
+    python scripts/dynalint.py --rule lock-discipline --json
+    python scripts/dynalint.py --list-rules
+    python scripts/dynalint.py --write-baseline    # grandfather current
+
+Exit 1 when any unsuppressed, non-baselined finding (or stale baseline
+entry) remains. Suppress inline with ``# dynalint: ok(<rule>) <reason>``;
+grandfather pre-existing findings in ``scripts/dynalint_baseline.json``
+(every entry needs a one-line justification). See docs/static_analysis.md.
+
+Whole-repo rules (knob-drift, metrics-catalog) reason about two-way sync,
+so they always analyze the full default tree; when explicit paths narrow
+the scan they are skipped by default (name them with ``--rule`` to run
+them anyway — still against the full tree).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from dynamo_tpu.analysis import all_rules, run_lint          # noqa: E402
+from dynamo_tpu.analysis import baseline as baseline_mod     # noqa: E402
+from dynamo_tpu.analysis.core import Rule                    # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(REPO, "scripts", "dynalint_baseline.json")
+
+
+def _is_repo_rule(cls) -> bool:
+    return cls.check_repo is not Rule.check_repo
+
+
+def main(argv: List[str]) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to scan (default: dynamo_tpu/ "
+                        "+ scripts/)")
+    p.add_argument("--rule", action="append", default=None,
+                   metavar="NAME", help="run only these rules")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE)
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report grandfathered findings as failures too")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from current findings "
+                        "(preserves existing reasons)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--verbose", action="store_true",
+                   help="also list suppressed/baselined findings")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for name in sorted(rules):
+            kind = "repo" if _is_repo_rule(rules[name]) else "file"
+            print(f"{name:22s} [{kind}] {rules[name].description}")
+        return 0
+
+    names = args.rule
+    if names:
+        unknown = [n for n in names if n not in rules]
+        if unknown:
+            p.error(f"unknown rule(s): {', '.join(unknown)} "
+                    f"(--list-rules shows the registry)")
+    elif args.paths:
+        # narrowed scan: whole-repo rules would misreport two-way sync
+        names = sorted(n for n, c in rules.items() if not _is_repo_rule(c))
+    else:
+        names = sorted(rules)
+
+    # a typo'd path silently green-lighting every violation is the worst
+    # possible CI outcome — reject missing paths and empty scans loudly
+    for path in args.paths:
+        if not os.path.exists(path):
+            p.error(f"path does not exist: {path}")
+        if os.path.isfile(path) and not path.endswith(".py"):
+            p.error(f"not a Python file: {path}")
+    if args.write_baseline and args.paths:
+        # a subset rewrite would silently delete every entry (and its
+        # hand-written reason) for files outside the subset
+        p.error("--write-baseline requires a full-tree scan "
+                "(drop the explicit paths)")
+
+    baseline_path = None if (args.no_baseline or args.write_baseline) \
+        else args.baseline
+    result = run_lint(paths=[os.path.abspath(x) for x in args.paths] or None,
+                      rule_names=names, baseline_path=baseline_path)
+    if result.files == 0:
+        print(f"error: no Python files found under: "
+              f"{', '.join(args.paths)}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        # meta findings (reason-less suppressions) are never grandfathered
+        real = [f for f in result.findings if f.rule != "suppression"]
+        baseline_mod.save(args.baseline, real)
+        print(f"wrote {os.path.relpath(args.baseline, REPO)} "
+              f"({len(real)} entries) — now justify every reason field")
+        return 0
+
+    print(result.to_json() if args.json else
+          result.to_text(verbose=args.verbose))
+    return 1 if result.failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -362,6 +362,8 @@ async def run_soak(a, logdir: str):
         try:
             if svc is not None:
                 await svc.stop()
+        # dynalint: ok(swallowed-exception) harness teardown after the
+        # verdicts dict is already built; procs.stop() below reaps anyway
         except Exception:
             pass
         if not verdicts or not all(verdicts.values()):

@@ -83,6 +83,9 @@ def main() -> int:
                                             timeout=2) as r:
                     models = json.load(r)["data"]
                     break
+            # dynalint: ok(swallowed-exception) connection refused IS the
+            # polled-for condition while the server boots; the enclosing
+            # loop times out loudly
             except Exception:
                 time.sleep(2)
         model_id = models[0]["id"]

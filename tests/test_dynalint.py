@@ -1,0 +1,554 @@
+"""dynalint framework + rules, on synthetic snippets, plus the repo gate.
+
+AST-only by design: nothing here imports the engine, jax, or the runtime —
+the framework is stdlib-only, so this whole file stays cheap inside the
+tight tier-1 budget. Layout:
+
+- framework: suppression scanning, reason-less-suppression meta finding,
+  baseline save/load/split/stale, runner wiring on a temp tree;
+- one test class per rule, each on purpose-built snippets (positive +
+  negative cases);
+- the repo gate: ``scripts/dynalint.py`` over the real tree must be clean
+  (zero unsuppressed, non-baselined findings — the acceptance criterion).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from dynamo_tpu.analysis import baseline as baseline_mod  # noqa: E402
+from dynamo_tpu.analysis.core import Finding, Module      # noqa: E402
+from dynamo_tpu.analysis.runner import run_lint           # noqa: E402
+from dynamo_tpu.analysis.rules.blocking_async import \
+    BlockingAsyncRule                                     # noqa: E402
+from dynamo_tpu.analysis.rules.fire_forget import \
+    FireForgetRule                                        # noqa: E402
+from dynamo_tpu.analysis.rules.knob_drift import \
+    KnobDriftRule                                         # noqa: E402
+from dynamo_tpu.analysis.rules.lock_discipline import \
+    LockDisciplineRule                                    # noqa: E402
+from dynamo_tpu.analysis.rules.metrics_catalog import \
+    catalog_findings, registered_in_module                # noqa: E402
+from dynamo_tpu.analysis.rules.silent_except import \
+    SilentExceptRule                                      # noqa: E402
+from dynamo_tpu.analysis.rules.unbounded_await import \
+    UnboundedAwaitRule                                    # noqa: E402
+
+
+def mod_from(tmp_path, src, name="m.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return Module(str(p), repo=str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# framework: suppressions
+# ---------------------------------------------------------------------------
+
+def test_suppression_on_line_and_comment_block(tmp_path):
+    m = mod_from(tmp_path, """\
+        x = 1   # dynalint: ok(some-rule) inline reason
+        # a leading comment
+        # dynalint: ok(other-rule) block reason
+        y = 2
+        z = 3
+    """)
+    assert m.suppressions_at(1) == [("some-rule", "inline reason", 1)]
+    assert ("other-rule", "block reason", 3) in m.suppressions_at(4)
+    # the comment block does not leak past the statement it precedes
+    assert m.suppressions_at(5) == []
+
+
+def test_reasonless_suppression_raises_meta_finding(tmp_path):
+    mod_from(tmp_path, """\
+        async def f():
+            try:
+                pass
+            except Exception:   # dynalint: ok(swallowed-exception)
+                pass
+    """)
+    res = run_lint(paths=[str(tmp_path)],
+                   rule_names=["swallowed-exception"], repo=str(tmp_path))
+    assert res.failed
+    assert [f.rule for f in res.findings] == ["suppression"]
+    assert "no reason" in res.findings[0].message
+    # the same suppression WITH a reason silences everything
+    mod_from(tmp_path, """\
+        async def f():
+            try:
+                pass
+            except Exception:   # dynalint: ok(swallowed-exception) why not
+                pass
+    """)
+    res = run_lint(paths=[str(tmp_path)],
+                   rule_names=["swallowed-exception"], repo=str(tmp_path))
+    assert not res.failed and len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# framework: baseline
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip_and_stale(tmp_path):
+    f1 = Finding("r", "a.py", 3, "msg", "k1")
+    f2 = Finding("r", "a.py", 9, "msg", "k2")
+    path = str(tmp_path / "base.json")
+    baseline_mod.save(path, [f1, f2])
+    base = baseline_mod.load(path)
+    assert set(base) == {("r", "a.py", "k1"), ("r", "a.py", "k2")}
+    new, old, stale = baseline_mod.split([f1], base)
+    assert new == [] and old == [f1]
+    assert stale == [("r", "a.py", "k2")]    # k2 fixed -> entry must go
+    # a brand-new finding is NOT absorbed
+    f3 = Finding("r", "a.py", 5, "msg", "k3")
+    new, _old, _ = baseline_mod.split([f1, f3], base)
+    assert new == [f3]
+
+
+def test_baseline_entry_without_reason_rejected(tmp_path):
+    path = tmp_path / "base.json"
+    path.write_text(json.dumps(
+        {"r": [{"path": "a.py", "key": "k", "reason": ""}]}))
+    with pytest.raises(ValueError, match="reason"):
+        baseline_mod.load(str(path))
+
+
+def test_runner_grandfathers_then_fails_stale(tmp_path):
+    mod_from(tmp_path, """\
+        def f():
+            try:
+                pass
+            except Exception:
+                pass
+    """)
+    bp = str(tmp_path / "base.json")
+    res = run_lint(paths=[str(tmp_path)],
+                   rule_names=["swallowed-exception"], repo=str(tmp_path))
+    assert res.failed and len(res.findings) == 1
+    baseline_mod.save(bp, res.findings, default_reason="grandfathered")
+    res = run_lint(paths=[str(tmp_path)], baseline_path=bp,
+                   rule_names=["swallowed-exception"], repo=str(tmp_path))
+    assert not res.failed and len(res.grandfathered) == 1
+    # fixing the finding makes the baseline entry stale -> run fails again
+    mod_from(tmp_path, "def f():\n    pass\n")
+    res = run_lint(paths=[str(tmp_path)], baseline_path=bp,
+                   rule_names=["swallowed-exception"], repo=str(tmp_path))
+    assert res.failed and res.findings == [] and len(res.stale_baseline) == 1
+
+
+def test_subset_scan_keeps_unscanned_baseline_entries(tmp_path):
+    """A narrowed scan must not report baseline entries for files it never
+    parsed as stale — only a scan that could reproduce the finding may
+    retire its entry."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    silent = ("def g():\n    try:\n        x()\n"
+              "    except Exception:\n        pass\n")
+    (pkg / "a.py").write_text(silent)
+    (pkg / "b.py").write_text(silent.replace("g()", "h()"))
+    bp = str(tmp_path / "base.json")
+    res = run_lint(paths=[str(pkg)],
+                   rule_names=["swallowed-exception"], repo=str(tmp_path))
+    assert len(res.findings) == 2
+    baseline_mod.save(bp, res.findings, default_reason="grandfathered")
+    # scan ONLY a.py: b.py's entry is out of scope, not stale
+    res = run_lint(paths=[str(pkg / "a.py")], baseline_path=bp,
+                   rule_names=["swallowed-exception"], repo=str(tmp_path))
+    assert not res.failed and res.stale_baseline == []
+    # full scan with a.py fixed: exactly a.py's entry goes stale
+    (pkg / "a.py").write_text("def g():\n    pass\n")
+    res = run_lint(paths=[str(pkg)], baseline_path=bp,
+                   rule_names=["swallowed-exception"], repo=str(tmp_path))
+    assert res.failed and len(res.stale_baseline) == 1
+    assert res.stale_baseline[0][1] == "pkg/a.py"
+
+
+def test_repo_rule_forced_on_subset_sees_full_tree():
+    """Forcing knob-drift with a narrowed path set must not misreport
+    every knob read outside the subset as a stale registry entry."""
+    res = run_lint(paths=[os.path.join(REPO, "dynamo_tpu", "llm")],
+                   rule_names=["knob-drift"])
+    assert not any(f.key.startswith("stale:") for f in res.findings), \
+        [f.key for f in res.findings][:5]
+
+
+def test_cli_rejects_missing_and_empty_paths(tmp_path, capsys):
+    path = os.path.join(REPO, "scripts", "dynalint.py")
+    spec = importlib.util.spec_from_file_location("dynalint_cli2", path)
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+    with pytest.raises(SystemExit):         # typo'd path: argparse error
+        cli.main([str(tmp_path / "no_such_dir")])
+    md = tmp_path / "notes.md"
+    md.write_text("# not python")
+    with pytest.raises(SystemExit):         # existing non-.py file
+        cli.main([str(md)])
+    empty = tmp_path / "empty"
+    empty.mkdir()                           # exists but no .py files
+    assert cli.main([str(empty)]) == 2
+    # subset --write-baseline would silently drop out-of-subset entries
+    py = tmp_path / "ok.py"
+    py.write_text("x = 1\n")
+    with pytest.raises(SystemExit):
+        cli.main([str(py), "--write-baseline"])
+    capsys.readouterr()
+
+
+def test_syntax_error_reported_once_with_repo_rule(tmp_path):
+    """A broken file inside a narrowed scan + a forced repo rule (which
+    reparses the full default tree) must yield ONE parse finding, not
+    two — the file sits under a default root so both passes see it."""
+    pkg = tmp_path / "dynamo_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text("def f(:\n")
+    res = run_lint(paths=[str(pkg)],
+                   rule_names=["swallowed-exception", "metrics-catalog"],
+                   repo=str(tmp_path))
+    parse = [f for f in res.findings if f.rule == "parse"]
+    assert len(parse) == 1 and parse[0].path == "dynamo_tpu/bad.py"
+
+
+# ---------------------------------------------------------------------------
+# rule: blocking-async
+# ---------------------------------------------------------------------------
+
+def test_blocking_async_flags_aliased_sleep(tmp_path):
+    m = mod_from(tmp_path, """\
+        import time as _t
+        from subprocess import check_output
+        import asyncio
+
+        async def bad():
+            _t.sleep(1)
+            check_output(["ls"])
+
+        async def good():
+            await asyncio.sleep(1)
+
+        def sync_ok():
+            _t.sleep(1)
+    """)
+    found = {(f.key) for f in BlockingAsyncRule().check_module(m)}
+    assert found == {"bad:time.sleep", "bad:subprocess.check_output"}
+
+
+def test_blocking_async_resolves_dotted_imports(tmp_path):
+    """``import urllib.request`` binds only ``urllib`` — the resolver must
+    canonicalize ``urllib.request.urlopen`` without doubling the submodule
+    (regression: it produced 'urllib.request.request.urlopen' and the
+    blocking call slipped through)."""
+    m = mod_from(tmp_path, """\
+        import urllib.request
+
+        async def bad(url):
+            urllib.request.urlopen(url)
+    """)
+    assert [f.key for f in BlockingAsyncRule().check_module(m)] \
+        == ["bad:urllib.request.urlopen"]
+
+
+def test_blocking_async_discriminates_repeat_keys(tmp_path):
+    m = mod_from(tmp_path, """\
+        import time
+
+        async def f():
+            time.sleep(1)
+            time.sleep(2)
+    """)
+    assert [f.key for f in BlockingAsyncRule().check_module(m)] \
+        == ["f:time.sleep", "f:time.sleep#2"]
+
+
+def test_blocking_async_ignores_local_shadows(tmp_path):
+    m = mod_from(tmp_path, """\
+        async def f():
+            async def run():
+                return 1
+            await run()
+    """)
+    assert BlockingAsyncRule().check_module(m) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: fire-and-forget
+# ---------------------------------------------------------------------------
+
+def test_fire_forget_flags_only_dropped_handles(tmp_path):
+    m = mod_from(tmp_path, """\
+        import asyncio
+
+        async def bad(loop):
+            asyncio.create_task(work())
+            asyncio.ensure_future(work())
+            loop.create_task(work())
+
+        async def good(loop):
+            t = asyncio.create_task(work())
+            tasks.append(asyncio.ensure_future(work()))
+            asyncio.ensure_future(work()).cancel()
+            await asyncio.create_task(work())
+            return t
+    """)
+    fs = FireForgetRule().check_module(m)
+    # the second same-shape drop gets a discriminated key: one baseline
+    # entry can never grandfather a newly added drop of the same shape
+    assert sorted(f.key for f in fs) == [
+        "bad:create_task", "bad:create_task#2", "bad:ensure_future"]
+
+
+def test_fire_forget_resolves_renamed_from_import(tmp_path):
+    """`from asyncio import ensure_future as bg; bg(coro)` is the same
+    dropped handle under an alias — regression: raw name matching let it
+    ship undetected."""
+    m = mod_from(tmp_path, """\
+        from asyncio import ensure_future as bg
+
+        async def f():
+            bg(work())
+    """)
+    assert [f.key for f in FireForgetRule().check_module(m)] \
+        == ["f:ensure_future"]
+
+
+def test_fire_forget_ignores_unrelated_bare_names(tmp_path):
+    m = mod_from(tmp_path, """\
+        def create_task(x):
+            return x
+
+        def f():
+            create_task(1)   # local helper, not asyncio
+    """)
+    assert FireForgetRule().check_module(m) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: swallowed-exception
+# ---------------------------------------------------------------------------
+
+def test_silent_except_positive_and_negative(tmp_path):
+    m = mod_from(tmp_path, """\
+        import logging
+        log = logging.getLogger(__name__)
+
+        def silent():
+            try:
+                x()
+            except Exception:
+                pass
+
+        def bare_silent():
+            try:
+                x()
+            except:
+                pass
+
+        def narrow_ok():
+            try:
+                x()
+            except ValueError:
+                pass
+
+        def logged():
+            try:
+                x()
+            except Exception:
+                log.warning("boom", exc_info=True)
+
+        def reraised():
+            try:
+                x()
+            except Exception:
+                raise
+
+        def uses_bound():
+            try:
+                x()
+            except Exception as e:
+                last_error = str(e)
+
+        def counted(c):
+            try:
+                x()
+            except Exception:
+                c.inc()
+
+        def legacy_noqa():
+            try:
+                x()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+    """)
+    fs = SilentExceptRule().check_module(m)
+    assert sorted(f.key for f in fs) == ["bare_silent:bare",
+                                         "silent:Exception"]
+
+
+def test_silent_except_nested_def_does_not_count(tmp_path):
+    # a handler that only DEFINES a logging closure never runs it
+    m = mod_from(tmp_path, """\
+        def f():
+            try:
+                x()
+            except Exception:
+                def later():
+                    log.warning("never called here")
+    """)
+    assert len(SilentExceptRule().check_module(m)) == 1
+
+
+# ---------------------------------------------------------------------------
+# rule: lock-discipline
+# ---------------------------------------------------------------------------
+
+def test_lock_discipline_flags_unguarded_write(tmp_path):
+    m = mod_from(tmp_path, """\
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0          # constructor writes are exempt
+
+            def inc(self):
+                with self._lock:
+                    self.n += 1
+
+            def reset(self):
+                self.n = 0          # RACE: guarded attr, no lock
+
+        class Unrelated:
+            def set(self):
+                self.n = 5          # different class: not guarded here
+    """)
+    fs = LockDisciplineRule().check_module(m)
+    assert [f.key for f in fs] == ["Counter.n@reset"]
+    assert fs[0].line == 13
+
+
+def test_lock_discipline_closure_write_is_unguarded(tmp_path):
+    m = mod_from(tmp_path, """\
+        class C:
+            def locked(self):
+                with self._lock:
+                    self.v = 1
+                    def cb():
+                        self.v = 2   # runs later, lock long gone
+                    return cb
+    """)
+    assert [f.key for f in LockDisciplineRule().check_module(m)] \
+        == ["C.v@locked"]
+
+
+def test_lock_discipline_clean_class_passes(tmp_path):
+    m = mod_from(tmp_path, """\
+        class C:
+            def __init__(self):
+                self.v = 0
+
+            def a(self):
+                with self._state_lock:
+                    self.v = 1
+
+            def b(self):
+                with self._state_lock:
+                    self.v += 2
+    """)
+    assert LockDisciplineRule().check_module(m) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: unbounded-await (legacy gate, re-homed)
+# ---------------------------------------------------------------------------
+
+def test_unbounded_await_synthetic(tmp_path):
+    m = mod_from(tmp_path, """\
+        import asyncio
+
+        async def bad(reader):
+            data = await reader.readexactly(4)
+
+        async def guarded(reader):
+            data = await asyncio.wait_for(reader.readexactly(4), 5)
+
+        async def annotated(reader):
+            data = await reader.read(4)   # unbounded-ok: rx loop lifetime
+    """)
+    fs = UnboundedAwaitRule().check_module(m)
+    assert [f.key for f in fs] == ["bad:readexactly"]
+
+
+def test_unbounded_await_scope_pins_legacy_paths():
+    scope = UnboundedAwaitRule.scope
+    assert "dynamo_tpu/runtime" in scope
+    assert "dynamo_tpu/planner" in scope
+    assert "dynamo_tpu/utils/overload.py" in scope
+
+
+# ---------------------------------------------------------------------------
+# rule: knob-drift
+# ---------------------------------------------------------------------------
+
+def test_knob_drift_unregistered_literal(tmp_path):
+    m = mod_from(tmp_path, """\
+        import os
+        a = os.environ.get("DYN_LEASE_TTL", "10")      # registered
+        b = os.environ.get("DYN_TOTALLY_BOGUS", "")    # not registered
+        doc = "prose mentioning DYN_ families is ignored"
+        prefix = "DYN_PLANNER_"                        # fragment ignored
+    """)
+    fs = KnobDriftRule().check_repo([m], REPO)
+    bogus = [f for f in fs if "BOGUS" in f.key]
+    assert len(bogus) == 1 and bogus[0].key == "unregistered:DYN_TOTALLY_BOGUS"
+    assert not any("DYN_LEASE_TTL" in f.key and "unregistered" in f.key
+                   for f in fs)
+
+
+def test_knob_registry_covers_repo_and_docs_in_sync():
+    """The acceptance criterion: 60+ knobs, all read, docs generated."""
+    from dynamo_tpu.utils.knobs import KNOBS, render_markdown
+    assert len(KNOBS) >= 60
+    with open(os.path.join(REPO, "docs", "configuration.md")) as f:
+        assert f.read() == render_markdown()
+
+
+# ---------------------------------------------------------------------------
+# rule: metrics-catalog (legacy gate, re-homed)
+# ---------------------------------------------------------------------------
+
+def test_metrics_catalog_synthetic(tmp_path):
+    m = mod_from(tmp_path, """\
+        reg.counter("dyn_things_total", "help")
+        g = registry.gauge
+        g("llm_stuff_bytes", "help")
+        reg.histogram(dynamic_name, "not a literal: ignored")
+    """)
+    registered = registered_in_module(m)
+    assert set(registered) == {"dyn_things_total", "llm_stuff_bytes"}
+    fs = catalog_findings(registered, {"dyn_things_total", "dyn_ghost"})
+    assert sorted(f.key for f in fs) == ["stale:dyn_ghost",
+                                         "undocumented:llm_stuff_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# the repo gate
+# ---------------------------------------------------------------------------
+
+def test_repo_is_dynalint_clean(capsys):
+    """Zero unsuppressed, non-baselined findings over dynamo_tpu/ +
+    scripts/ — through the real entrypoint, baseline file included."""
+    path = os.path.join(REPO, "scripts", "dynalint.py")
+    spec = importlib.util.spec_from_file_location("dynalint_cli", path)
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+    rc = cli.main([])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "ok:" in out

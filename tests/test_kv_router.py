@@ -371,6 +371,25 @@ async def test_recorder_pause_filter_bounds_and_indexer_feed(tmp_path):
     rec.close()
 
 
+async def test_recorder_async_replay_paces_on_the_loop(tmp_path):
+    """areplay/replay_into_async: paced replay from a running event loop
+    uses asyncio.sleep (the sync replay's time.sleep would park every
+    coroutine sharing the loop — the dynalint blocking-async hazard)."""
+    from dynamo_tpu.llm.recorder import KvRecorder, areplay
+
+    rec = KvRecorder(str(tmp_path / "cap.jsonl"))
+    for i in range(3):
+        await rec.publish("kv_events", {"i": i})
+    rec.close()
+    got = [ev["payload"]["i"]
+           async for ev in areplay(rec.path, speed=10000.0)]
+    assert got == [0, 1, 2]
+    seen = []
+    n = await KvRecorder(rec.path).replay_into_async(
+        lambda p: seen.append(p["i"]), speed=10000.0)
+    assert n == 3 and seen == [0, 1, 2]
+
+
 async def test_recorder_attach_taps_live_event_plane(tmp_path):
     """KvRecorder.attach subscribes the component's kv_events subject: the
     real publisher->event-plane->recorder path, then replay into an indexer
