@@ -63,12 +63,22 @@ class Module:
         self.tree = ast.parse(self.src, filename=self.path)
         self._parents: Optional[Dict[ast.AST, ast.AST]] = None
         self._imports: Optional[Dict[str, str]] = None
+        self._nodes: Optional[List[ast.AST]] = None
 
     # -- structure helpers ------------------------------------------------
+    def nodes(self) -> List[ast.AST]:
+        """Flat cached list of every AST node (``ast.walk`` order). Rules
+        that scan the whole module iterate this instead of re-walking the
+        tree — with a dozen rules over a hundred files, the repeated
+        ``ast.walk`` traversals were the suite's dominant cost."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
     def parents(self) -> Dict[ast.AST, ast.AST]:
         if self._parents is None:
             self._parents = {}
-            for node in ast.walk(self.tree):
+            for node in self.nodes():
                 for child in ast.iter_child_nodes(node):
                     self._parents[child] = node
         return self._parents
@@ -134,7 +144,10 @@ class Module:
     # -- suppressions -----------------------------------------------------
     def suppressions_at(self, lineno: int) -> List[Tuple[str, str, int]]:
         """``(rule, reason, comment_line)`` annotations covering ``lineno``:
-        on the line itself or in the contiguous comment block above."""
+        on the line itself or in the contiguous comment block above. A
+        block annotation's reason continues across the following comment
+        lines (until another annotation or the end of the block), so
+        reasons can be written out in full."""
         out: List[Tuple[str, str, int]] = []
         if 1 <= lineno <= len(self.lines):
             m = SUPPRESS_RE.search(self.lines[lineno - 1])
@@ -144,7 +157,16 @@ class Module:
         while i >= 0 and self.lines[i].strip().startswith("#"):
             m = SUPPRESS_RE.search(self.lines[i])
             if m:
-                out.append((m.group(1), m.group(2).strip(), i + 1))
+                reason = [m.group(2).strip()]
+                j = i + 1
+                while j < lineno - 1:
+                    cont = self.lines[j].strip()
+                    if not cont.startswith("#") or SUPPRESS_RE.search(cont):
+                        break
+                    reason.append(cont.lstrip("#").strip())
+                    j += 1
+                out.append((m.group(1), " ".join(r for r in reason if r),
+                            i + 1))
             i -= 1
         return out
 

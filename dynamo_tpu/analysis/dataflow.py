@@ -1,0 +1,681 @@
+"""dynalint dataflow: intra-procedural def-use chains + a pluggable taint
+lattice, built on the parse-once :class:`~.core.Module` cache.
+
+Two layers, both AST-only (no jax import — the analysis must run in the
+tier-1 budget on machines with no accelerator stack):
+
+1. **Def-use chains** (:func:`scope_bindings`, :func:`class_attr_bindings`):
+   every binding of a local name / ``self.<attr>`` inside one function or
+   class scope, in source order. Rules use these to resolve "where did this
+   value come from" questions — e.g. the store-key-drift gate resolving an
+   f-string key back to its keyspace helper.
+
+2. **Device taint** (:class:`DeviceTaint`): a three-point lattice
+   ``host < jitfn < device`` seeded by "this expression produces a JAX
+   device array" — results of jit-compiled callables, ``jnp.*`` / ``jax.*``
+   constructors, and known engine pool/state attributes — and propagated
+   through assignments, arithmetic, subscripts, containers, loops and
+   comprehension targets until fixpoint. The seeds are pluggable per rule
+   via options (``device_attrs``, ``jit_wrappers``), which is what makes
+   the lattice reusable for the three JAX dispatch-hygiene rules.
+
+The analysis is **flow-insensitive within a function** (a name tainted by
+ANY binding stays tainted) and uses a one-level module summary: a function
+whose return value is device-tainted taints its call sites, a function
+returning a jit callable makes ``fn = self._prefill_fn(...); fn(...)``
+device-tainted. That is exactly deep enough for the engine's
+stage-dispatch-fetch idiom without whole-program analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import Module
+
+# lattice points (host is represented as None)
+DEVICE = "device"    # a jax.Array living on an accelerator
+JITFN = "jitfn"      # a jit-compiled callable: calling it yields DEVICE
+DEVBOX = "devbox"    # host container HOLDING device values: its truthiness
+#                      and len() are host metadata (no sync), but
+#                      subscripting it hands back a DEVICE value and
+#                      converting it wholesale (np.asarray) syncs
+
+#: attribute loads that read host-side metadata off a device array —
+#: following them does NOT transfer the buffer
+HOST_META_ATTRS = {
+    "shape", "dtype", "ndim", "size", "nbytes", "itemsize", "sharding",
+    "device", "devices", "aval", "weak_type",
+}
+
+#: method calls on a device array whose RESULT lives on host (they are
+#: sync sinks; the host-sync rule reports them, the lattice drops taint)
+HOST_RESULT_METHODS = {"item", "tolist"}
+
+#: resolved call prefixes that construct/transform device arrays
+DEVICE_CALL_PREFIXES = (
+    "jax.numpy.", "jax.lax.", "jax.random.", "jax.nn.", "jax.scipy.",
+    "jax.image.", "jax.ops.",
+)
+
+#: resolved calls producing device arrays (beyond the prefixes above)
+DEVICE_PRODUCERS = {
+    "jax.device_put", "jax.make_array_from_callback", "jax.vmap",
+    "jax.pmap", "jax.checkpoint",
+}
+
+#: resolved calls whose result is a HOST value even with device args
+HOST_RESULT_CALLS = {"jax.device_get"}
+
+#: default jit-wrapper spellings: a call to one of these produces a JITFN.
+#: ``instrument_compile`` is the repo's roofline wrapper around jitted
+#: programs (utils/roofline.py) — its result dispatches like the jit fn.
+DEFAULT_JIT_WRAPPERS = {"jax.jit", "jax.pmap", "instrument_compile"}
+
+
+# ---------------------------------------------------------------------------
+# def-use chains
+# ---------------------------------------------------------------------------
+
+def iter_scope_statements(body: List[ast.stmt]) -> Iterator[ast.stmt]:
+    """Statements of one function/class scope, recursing into compound
+    statements but NOT into nested function/class definitions (those are
+    their own scopes). The nested def/class statement itself IS yielded."""
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for _f, value in ast.iter_fields(stmt):
+            if isinstance(value, list) and value \
+                    and isinstance(value[0], ast.stmt):
+                yield from iter_scope_statements(value)
+            elif isinstance(value, list) and value \
+                    and isinstance(value[0], ast.excepthandler):
+                for h in value:
+                    yield from iter_scope_statements(h.body)
+
+
+def iter_scope_nodes(body: List[ast.stmt]) -> Iterator[ast.AST]:
+    """Every AST node of one scope, visited exactly once, with nested
+    function/class/lambda BODIES pruned (the scope-introducing node itself
+    is yielded — its name binding is visible here — but nothing inside
+    it). This is the walker scope-sensitive rules need: ``ast.walk`` over
+    statements double-visits compound-statement bodies and leaks into
+    nested scopes."""
+    stack: List[ast.AST] = list(reversed(body))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue        # the binding is visible; the body is not
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _binding_pairs(stmt: ast.stmt) -> List[Tuple[ast.expr, ast.expr, str]]:
+    """(target, value, via) bindings introduced by one statement. ``via``
+    is 'assign' | 'aug' | 'for' | 'with' — loop/with bindings bind each
+    ELEMENT of the iterable, which taint consumers treat differently."""
+    out: List[Tuple[ast.expr, ast.expr, str]] = []
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            out.append((t, stmt.value, "assign"))
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        out.append((stmt.target, stmt.value, "assign"))
+    elif isinstance(stmt, ast.AugAssign):
+        out.append((stmt.target, stmt.value, "aug"))
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        out.append((stmt.target, stmt.iter, "for"))
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                out.append((item.optional_vars, item.context_expr, "with"))
+    return out
+
+
+def scope_bindings(func: ast.AST) -> Dict[str, List[Tuple[ast.expr, str]]]:
+    """{local name: [(value_expr, via), ...]} for one function scope, in
+    source order. Tuple targets bind every name to the whole value (the
+    consumer decides how to project). Walrus (:=) bindings included."""
+    out: Dict[str, List[Tuple[ast.expr, str]]] = {}
+
+    def bind(target: ast.expr, value: ast.expr, via: str) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Store):
+                out.setdefault(node.id, []).append((value, via))
+
+    body = func.body if hasattr(func, "body") else []
+    for stmt in iter_scope_statements(body):
+        for target, value, via in _binding_pairs(stmt):
+            bind(target, value, via)
+        # walrus anywhere inside the statement's expressions
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.NamedExpr):
+                bind(node.target, node.value, "assign")
+    return out
+
+
+def class_attr_bindings(cls: ast.ClassDef
+                        ) -> Dict[str, List[Tuple[ast.expr, str]]]:
+    """{attr: [(value_expr, via), ...]} for every ``self.<attr> = ...``
+    across all methods of one class (plus class-level assignments)."""
+    out: Dict[str, List[Tuple[ast.expr, str]]] = {}
+
+    def scan(body: List[ast.stmt]) -> None:
+        for stmt in iter_scope_statements(body):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan(stmt.body)
+                continue
+            for target, value, via in _binding_pairs(stmt):
+                for node in ast.walk(target):
+                    if isinstance(node, ast.Attribute) and isinstance(
+                            node.value, ast.Name) \
+                            and node.value.id == "self" \
+                            and isinstance(node.ctx, ast.Store):
+                        out.setdefault(node.attr, []).append((value, via))
+
+    scan(cls.body)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# device taint
+# ---------------------------------------------------------------------------
+
+class SinkHit:
+    """One device→host synchronization point found by the taint sweep."""
+
+    __slots__ = ("node", "label", "func_name")
+
+    def __init__(self, node: ast.Call, label: str, func_name: str):
+        self.node = node
+        self.label = label          # e.g. "np.asarray", ".item()"
+        self.func_name = func_name  # qualified enclosing function
+
+
+def _last_segment(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def get_device_taint(mod: Module, options: Optional[dict] = None
+                     ) -> "DeviceTaint":
+    """Per-module DeviceTaint, cached on the Module (three rules share the
+    same index; options only vary the seeds, so they key the cache)."""
+    key = tuple(sorted(
+        (k, tuple(v) if isinstance(v, (list, set, tuple)) else v)
+        for k, v in (options or {}).items()
+        if k in ("device_attrs", "jit_wrappers")))
+    cache = getattr(mod, "_taint_cache", None)
+    if cache is None:
+        cache = mod._taint_cache = {}
+    if key not in cache:
+        cache[key] = DeviceTaint(mod, options)
+    return cache[key]
+
+
+class DeviceTaint:
+    """Module-wide device-taint index + per-function analysis.
+
+    Construction walks the module once to build:
+
+    - ``traced``: every function/lambda whose body runs under jax tracing
+      (``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated, wrapped by name
+      in a jit call, or a lambda argument of one);
+    - ``attr_tags``: attribute names assigned a DEVICE/JITFN value anywhere
+      in the module (``self.k_pool``, ``self._prefill_fns``, ``s.key``);
+    - ``summaries``: function name -> lattice tag of its return value,
+      iterated to fixpoint so methods that return jitted-call results
+      (``_run_prefill_program``) taint their own call sites.
+
+    Options (all additive, so rules can plug extra lattice seeds):
+    ``device_attrs`` — attribute names assumed device-resident;
+    ``jit_wrappers`` — extra callables whose result is a jit callable.
+    """
+
+    MAX_PASSES = 4
+
+    def __init__(self, mod: Module, options: Optional[dict] = None):
+        options = options or {}
+        self.mod = mod
+        self.jit_wrappers = (set(DEFAULT_JIT_WRAPPERS)
+                             | set(options.get("jit_wrappers", ())))
+        self.attr_tags: Dict[str, str] = {
+            a: DEVICE for a in options.get("device_attrs", ())}
+        self.global_tags: Dict[str, str] = {}
+        self.summaries: Dict[str, Optional[str]] = {}
+        self.traced: Set[ast.AST] = set()
+        self._env_cache: Dict[int, Dict[str, str]] = {}
+        self._prog_cache: Dict[int, dict] = {}
+        self._shim_cache: Dict[int, ast.AST] = {}
+        self._functions: List[ast.AST] = [
+            n for n in mod.nodes()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        self._collect_traced()
+        self._module_fixpoint()
+
+    # -- jit wrapping detection -------------------------------------------
+    def is_jit_wrap_call(self, call: ast.Call) -> bool:
+        """``jax.jit(...)`` / ``partial(jax.jit, ...)`` / instrument_compile
+        — a call whose RESULT is a jit-compiled callable."""
+        resolved = self.mod.resolve_call(call)
+        if resolved in self.jit_wrappers \
+                or _last_segment(resolved) in self.jit_wrappers:
+            return True
+        if _last_segment(resolved) == "partial" and call.args:
+            first = call.args[0]
+            if isinstance(first, ast.Call):
+                first = first.func  # partial(jax.jit(...), ...) — unusual
+            if isinstance(first, (ast.Name, ast.Attribute)):
+                probe = ast.Call(func=first, args=[], keywords=[])
+                inner = self.mod.resolve_call(probe)
+                if inner in self.jit_wrappers \
+                        or _last_segment(inner) in self.jit_wrappers:
+                    return True
+        return False
+
+    def _jit_decorated(self, func: ast.AST) -> bool:
+        for dec in getattr(func, "decorator_list", []):
+            if isinstance(dec, ast.Call) and self.is_jit_wrap_call(dec):
+                return True
+            if isinstance(dec, (ast.Name, ast.Attribute)):
+                probe = ast.Call(func=dec, args=[], keywords=[])
+                name = self.mod.resolve_call(probe)
+                if name in self.jit_wrappers \
+                        or _last_segment(name) in self.jit_wrappers:
+                    return True
+        return False
+
+    def _collect_traced(self) -> None:
+        by_name = {f.name: f for f in self._functions}
+        for f in self._functions:
+            if self._jit_decorated(f):
+                self.traced.add(f)
+        for node in self.mod.nodes():
+            if not (isinstance(node, ast.Call)
+                    and self.is_jit_wrap_call(node)):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    self.traced.add(arg)
+                elif isinstance(arg, ast.Name) and arg.id in by_name:
+                    self.traced.add(by_name[arg.id])
+        # a named traced def IS a jit callable under its own name
+        for f in self.traced:
+            if hasattr(f, "name"):
+                self.global_tags.setdefault(f.name, JITFN)
+
+    # -- per-scope program cache -------------------------------------------
+    def _prog(self, scope: ast.AST) -> dict:
+        """One-time extraction of everything the fixpoint passes consume
+        from a scope: bindings, attr stores, container appends, walrus +
+        comprehension targets, nested traced defs, return exprs. The
+        fixpoint then iterates these flat lists instead of re-walking the
+        AST on every pass (the suite's dominant cost before this cache)."""
+        prog = self._prog_cache.get(id(scope))
+        if prog is not None:
+            return prog
+        binds: List[Tuple[List[str], List[str], ast.expr]] = []
+        named: List[Tuple[str, ast.expr]] = []
+        comps: List[Tuple[List[str], ast.expr]] = []
+        appends: List[ast.Call] = []
+        nested_jit: List[str] = []
+        returns: List[ast.expr] = []
+        for stmt in iter_scope_statements(scope.body):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if stmt in self.traced:
+                    nested_jit.append(stmt.name)
+                continue
+            if isinstance(stmt, ast.Return) and stmt.value is not None:
+                returns.append(stmt.value)
+            for target, value, _via in _binding_pairs(stmt):
+                names, attrs = [], []
+                for t in ast.walk(target):
+                    if not isinstance(getattr(t, "ctx", None), ast.Store):
+                        continue
+                    if isinstance(t, ast.Name):
+                        names.append(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        attrs.append(t.attr)
+                binds.append((names, attrs, value))
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.NamedExpr):
+                    named.append((node.target.id, node.value))
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.GeneratorExp, ast.DictComp)):
+                    for comp in node.generators:
+                        tnames = [t.id for t in ast.walk(comp.target)
+                                  if isinstance(t, ast.Name)]
+                        comps.append((tnames, comp.iter))
+                elif isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) \
+                        and node.func.attr in ("append", "appendleft",
+                                               "add") \
+                        and isinstance(node.func.value, ast.Attribute) \
+                        and node.args:
+                    appends.append(node)
+        prog = {"binds": binds, "named": named, "comps": comps,
+                "appends": appends, "nested_jit": nested_jit,
+                "returns": returns}
+        self._prog_cache[id(scope)] = prog
+        return prog
+
+    # -- module fixpoint ---------------------------------------------------
+    def _module_fixpoint(self) -> None:
+        module_scope = ast.Module(body=self.mod.tree.body, type_ignores=[])
+        rank = {DEVICE: 3, DEVBOX: 2, JITFN: 1}
+        for _ in range(self.MAX_PASSES):
+            changed = False
+            # per-pass cache only: envs depend on attr_tags/summaries,
+            # which this pass may still be growing
+            self._env_cache.clear()
+            for scope in [module_scope] + self._functions:
+                if scope is module_scope:
+                    env = dict(self.global_tags)
+                else:
+                    env = self._function_env(scope)
+                changed |= self._scan_stores(scope, env,
+                                             scope is module_scope)
+                # function summaries (DEVICE beats DEVBOX beats JITFN)
+                if scope is not module_scope:
+                    tag = None
+                    for value in self._prog(scope)["returns"]:
+                        t = self.evaluate(value, env)
+                        if t is not None and rank[t] > rank.get(tag, 0):
+                            tag = t
+                    if tag is not None \
+                            and self.summaries.get(scope.name) != tag:
+                        self.summaries[scope.name] = tag
+                        changed = True
+            if not changed:
+                break
+
+    def _scan_stores(self, scope: ast.AST, env: Dict[str, str],
+                     module_level: bool) -> bool:
+        """Record attr/global tags from one scope's stores + appends."""
+        changed = False
+        prog = self._prog(scope)
+        for names, attrs, value in prog["binds"]:
+            if not attrs and not (module_level and names):
+                continue
+            tag = self.evaluate(value, env)
+            if tag is None:
+                continue
+            for attr in attrs:
+                if self.attr_tags.get(attr) not in (tag, DEVICE):
+                    self.attr_tags[attr] = tag
+                    changed = True
+            if module_level:
+                for name in names:
+                    if self.global_tags.get(name) != tag:
+                        self.global_tags[name] = tag
+                        changed = True
+        # device containers filled via .append/.appendleft/.add
+        for node in prog["appends"]:
+            if self.evaluate(node.args[0], env) in (DEVICE, DEVBOX):
+                holder = node.func.value.attr
+                if self.attr_tags.get(holder) not in (DEVICE, DEVBOX):
+                    self.attr_tags[holder] = DEVBOX
+                    changed = True
+        return changed
+
+    # -- per-function analysis --------------------------------------------
+    def _function_env(self, func: ast.AST,
+                      outer: Optional[Dict[str, str]] = None
+                      ) -> Dict[str, str]:
+        """Union (flow-insensitive) taint env for one function scope,
+        iterated to local fixpoint."""
+        if outer is None and id(func) in self._env_cache:
+            return self._env_cache[id(func)]
+        env: Dict[str, str] = dict(outer or {})
+        # parameters are fresh local bindings: they SHADOW any same-named
+        # device value inherited from an enclosing scope
+        args = getattr(func, "args", None)
+        if args is not None:
+            for a in (list(args.posonlyargs) + list(args.args)
+                      + list(args.kwonlyargs)
+                      + [x for x in (args.vararg, args.kwarg) if x]):
+                env.pop(a.arg, None)
+        prog = self._prog(func)
+        for name in prog["nested_jit"]:
+            env[name] = JITFN
+        for _ in range(self.MAX_PASSES):
+            changed = False
+            for names, _attrs, value in prog["binds"]:
+                if not names:
+                    continue
+                tag = self.evaluate(value, env)
+                if tag is None:
+                    continue
+                for name in names:
+                    if env.get(name) not in (tag, DEVICE):
+                        env[name] = tag
+                        changed = True
+            for name, value in prog["named"]:
+                tag = self.evaluate(value, env)
+                if tag and env.get(name) not in (tag, DEVICE):
+                    env[name] = tag
+                    changed = True
+            for tnames, it in prog["comps"]:
+                tag = self.evaluate(it, env)
+                if tag is None:
+                    continue
+                for name in tnames:
+                    if env.get(name) not in (tag, DEVICE):
+                        env[name] = tag
+                        changed = True
+            if not changed:
+                break
+        if outer is None:
+            self._env_cache[id(func)] = env
+        return env
+
+    def evaluate(self, expr: ast.expr, env: Dict[str, str]
+                 ) -> Optional[str]:
+        """Lattice tag of an expression under ``env`` (None = host)."""
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id) or self.global_tags.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in HOST_META_ATTRS:
+                return None
+            base = self.evaluate(expr.value, env)
+            if base is not None:
+                return base
+            return self.attr_tags.get(expr.attr)
+        if isinstance(expr, ast.Subscript):
+            base = self.evaluate(expr.value, env)
+            if base == DEVBOX:
+                return DEVICE      # an element handed out of the container
+            return base
+        if isinstance(expr, ast.Await):
+            return self.evaluate(expr.value, env)
+        if isinstance(expr, ast.BinOp):
+            return (self.evaluate(expr.left, env)
+                    or self.evaluate(expr.right, env))
+        if isinstance(expr, ast.UnaryOp):
+            return self.evaluate(expr.operand, env)
+        if isinstance(expr, ast.Compare):
+            for e in [expr.left] + list(expr.comparators):
+                if self.evaluate(e, env) == DEVICE:
+                    return DEVICE
+            return None
+        if isinstance(expr, ast.BoolOp):
+            for e in expr.values:
+                t = self.evaluate(e, env)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(expr, ast.IfExp):
+            return (self.evaluate(expr.body, env)
+                    or self.evaluate(expr.orelse, env))
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            for e in expr.elts:
+                if self.evaluate(e, env) in (DEVICE, DEVBOX):
+                    return DEVBOX
+            return None
+        if isinstance(expr, ast.Dict):
+            for e in expr.values:
+                if e is not None and self.evaluate(e, env) in (DEVICE,
+                                                               DEVBOX):
+                    return DEVBOX
+            return None
+        if isinstance(expr, ast.Starred):
+            return self.evaluate(expr.value, env)
+        if isinstance(expr, ast.Call):
+            return self._call_tag(expr, env)
+        return None
+
+    def _call_tag(self, call: ast.Call, env: Dict[str, str]
+                  ) -> Optional[str]:
+        resolved = self.mod.resolve_call(call)
+        if self.is_jit_wrap_call(call):
+            return JITFN
+        if resolved in HOST_RESULT_CALLS:
+            return None
+        if resolved.startswith(DEVICE_CALL_PREFIXES) \
+                or resolved in DEVICE_PRODUCERS:
+            return DEVICE
+        f = call.func
+        # sinks produce host values (np.asarray result is a numpy array);
+        # block_until_ready returns the same device array
+        if isinstance(f, ast.Attribute):
+            if f.attr in HOST_RESULT_METHODS:
+                return None
+            # the attribute itself may BE a jit callable (self._gather_fn)
+            if self.evaluate(f, env) == JITFN:
+                return DEVICE
+            base = self.evaluate(f.value, env)
+            if base == JITFN:
+                return DEVICE        # calling a jit-compiled callable
+            if base == DEVICE:
+                # method on a device array (.astype, .at[i].set, ...)
+                return DEVICE
+            if base == DEVBOX:
+                # .popleft()/.pop()/.get() hand out container contents —
+                # which may themselves be containers (dicts of arrays)
+                return DEVBOX
+        elif isinstance(f, (ast.Name, ast.Subscript)):
+            if self.evaluate(f, env) == JITFN:
+                return DEVICE
+        elif isinstance(f, ast.Call):
+            # immediate application: jax.jit(lambda: ...)()
+            if self.evaluate(f, env) == JITFN:
+                return DEVICE
+        if resolved in ("numpy.asarray", "numpy.array", "int", "float",
+                        "bool"):
+            return None              # host result regardless of args
+        if resolved in ("dict", "list", "tuple", "deque",
+                        "collections.deque"):
+            for a in list(call.args) + [kw.value for kw in call.keywords]:
+                if self.evaluate(a, env) in (DEVICE, DEVBOX):
+                    return DEVBOX
+            return None
+        summary = self.summaries.get(_last_segment(resolved))
+        if summary is not None:
+            return summary
+        return None
+
+    # -- sink sweep --------------------------------------------------------
+    def sink_hits(self, func: ast.AST, qualname: str,
+                  outer_env: Optional[Dict[str, str]] = None
+                  ) -> List[SinkHit]:
+        """Device→host sync points inside one function scope (nested defs
+        are visited with the enclosing env inherited, attributed to the
+        same qualname — a closure fetching device state is still a sync)."""
+        env = self._function_env(func, outer_env)
+        hits: List[SinkHit] = []
+        nested: List[ast.AST] = []
+
+        def walk(node: ast.AST) -> Iterator[ast.AST]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    nested.append(child)
+                    continue
+                yield child
+                yield from walk(child)
+
+        for stmt in func.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a statement-level closure is a nested scope like any
+                # other — its body must NOT be scanned under this env
+                nested.append(stmt)
+                continue
+            for node in [stmt] + list(walk(stmt)):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = self._sink_label(node, env)
+                if hit:
+                    hits.append(SinkHit(node, hit, qualname))
+        for nfunc in nested:
+            if nfunc in self.traced:
+                continue             # traced bodies never sync at runtime
+            # shims are cached by the ORIGINAL node (which the Module
+            # keeps alive): a transient shim freed between sweeps could
+            # otherwise recycle its id() into a stale _prog/_env entry
+            shim = self._shim_cache.get(id(nfunc))
+            if shim is None:
+                body = nfunc.body if isinstance(nfunc.body, list) \
+                    else [ast.Expr(nfunc.body)]
+                shim = ast.FunctionDef(
+                    name=getattr(nfunc, "name", "<lambda>"), body=body,
+                    args=nfunc.args, decorator_list=[], returns=None)
+                self._shim_cache[id(nfunc)] = shim
+            hits.extend(self.sink_hits(shim, qualname, env))
+        return hits
+
+    def _sink_label(self, call: ast.Call, env: Dict[str, str]
+                    ) -> Optional[str]:
+        resolved = self.mod.resolve_call(call)
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in (
+                HOST_RESULT_METHODS | {"block_until_ready"}):
+            if self.evaluate(f.value, env) == DEVICE:
+                return f".{f.attr}()"
+        if not call.args:
+            return None
+        arg0 = call.args[0]
+        if resolved in ("int", "float", "bool"):
+            # container truthiness/len is host metadata — only a DEVICE
+            # array here forces the sync
+            if self.evaluate(arg0, env) == DEVICE:
+                return f"{resolved}()"
+        elif resolved in ("numpy.asarray", "numpy.array"):
+            if self.evaluate(arg0, env) in (DEVICE, DEVBOX):
+                return f"np.{_last_segment(resolved)}"
+        elif resolved in ("jax.device_get", "jax.block_until_ready"):
+            if self.evaluate(arg0, env) in (DEVICE, DEVBOX):
+                return f"jax.{_last_segment(resolved)}"
+        return None
+
+    # -- helpers for rules -------------------------------------------------
+    def qualname(self, func: ast.AST) -> str:
+        parts = [getattr(func, "name", "<lambda>")]
+        parents = self.mod.parents()
+        cur = func
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, ast.ClassDef):
+                parts.append(cur.name)
+            elif isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                parts.append(cur.name)
+        return ".".join(reversed(parts))
+
+    def top_level_functions(self) -> List[ast.AST]:
+        """Functions that are not nested inside another function (methods
+        count as top-level; their nested defs are swept by sink_hits)."""
+        parents = self.mod.parents()
+        out = []
+        for f in self._functions:
+            cur = parents.get(f)
+            nested = False
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nested = True
+                    break
+                cur = parents.get(cur)
+            if not nested:
+                out.append(f)
+        return out

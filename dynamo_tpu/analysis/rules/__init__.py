@@ -6,10 +6,16 @@ lives in its own module; adding a rule = adding a module here with a
 ``@register``-decorated ``Rule`` subclass and importing it below.
 """
 
+from . import await_lock          # noqa: F401
 from . import blocking_async      # noqa: F401
 from . import fire_forget         # noqa: F401
+from . import host_sync           # noqa: F401
 from . import knob_drift          # noqa: F401
 from . import lock_discipline     # noqa: F401
 from . import metrics_catalog     # noqa: F401
+from . import recompile_hazard    # noqa: F401
 from . import silent_except       # noqa: F401
+from . import store_key_drift     # noqa: F401
+from . import tracer_leak         # noqa: F401
 from . import unbounded_await     # noqa: F401
+from . import wire_field_drift    # noqa: F401

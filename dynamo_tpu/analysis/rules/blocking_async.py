@@ -50,7 +50,7 @@ class BlockingAsyncRule(Rule):
         blocking = BLOCKING_CALLS | extra
         out: List[Finding] = []
         dup: dict = {}
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes():
             if not isinstance(node, ast.Call):
                 continue
             canonical = mod.resolve_call(node)

@@ -41,7 +41,7 @@ class FireForgetRule(Rule):
         parents = mod.parents()
         out: List[Finding] = []
         dup: dict = {}
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes():
             if not isinstance(node, ast.Call):
                 continue
             f = node.func

@@ -1,0 +1,69 @@
+"""Rule ``host-sync``: implicit device→host transfers on dispatch paths.
+
+Every ``int()`` / ``float()`` / ``bool()`` / ``np.asarray()`` / ``.item()``
+/ ``.tolist()`` / ``jax.device_get()`` / ``block_until_ready()`` applied to
+a JAX device array blocks the host until the device flushes its dispatch
+queue and ships the buffer — on the decode path that is a per-token host
+round-trip, the exact cost the ROADMAP blames for decode sitting below
+baseline. The legacy statement-matching dynalint could not see these: the
+sync is a property of *where the value came from*, not of the statement.
+
+This rule runs the :mod:`..dataflow` device-taint lattice per module:
+taint seeds are jitted-call results (including one-level function
+summaries, so ``packed = self._run_decode_program(...)`` is tainted),
+``jnp.*``/``jax.*`` constructors, and device-resident attributes
+(``self.k_pool``, ``s.key``, anything assigned a device value anywhere in
+the module — extendable via the ``device_attrs`` option). A flagged site
+is either a bug (hoist/batch the fetch) or a *designed* transfer, which
+gets a ``# dynalint: ok(host-sync) <why>`` suppression; the suppressed
+inventory doubles as the decode path's documented transfer budget
+(``python scripts/dynalint.py --report host-sync``).
+
+Scoped to the JAX dirs (engine/ops/parallel/models): host-side numpy code
+elsewhere would only produce noise.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..core import Finding, Module, Rule, register
+from ..dataflow import get_device_taint
+
+SCOPE = [
+    "dynamo_tpu/engine",
+    "dynamo_tpu/ops",
+    "dynamo_tpu/parallel",
+    "dynamo_tpu/models",
+]
+
+
+@register
+class HostSyncRule(Rule):
+    name = "host-sync"
+    description = ("device-tainted value flows into int/float/bool/"
+                   "np.asarray/.item/.tolist/device_get/block_until_ready "
+                   "— an implicit device->host sync")
+    scope = list(SCOPE)
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        taint = get_device_taint(mod, self.options)
+        out: List[Finding] = []
+        dup: Dict[str, int] = {}
+        for func in taint.top_level_functions():
+            qual = taint.qualname(func)
+            for hit in taint.sink_hits(func, qual):
+                key = f"{qual}:{hit.label}"
+                n = dup.get(key, 0) + 1
+                dup[key] = n
+                if n > 1:
+                    key = f"{key}#{n}"
+                out.append(Finding(
+                    rule=self.name, path=mod.rel, line=hit.node.lineno,
+                    message=(f"{hit.label} on a device array in {qual}() "
+                             f"forces a device->host sync — batch/hoist "
+                             f"the fetch, or suppress with the reason it "
+                             f"is a designed transfer"),
+                    key=key))
+        out.sort(key=lambda f: f.line)
+        return out

@@ -43,7 +43,7 @@ def _literal_reads(modules: List[Module]) -> Dict[str, List[Tuple[str, int]]]:
     for mod in modules:
         if mod.rel == REGISTRY_REL:
             continue
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes():
             if isinstance(node, ast.Constant) and isinstance(
                     node.value, str) and KNOB_RE.fullmatch(node.value):
                 out.setdefault(node.value, []).append(

@@ -73,7 +73,7 @@ class LockDisciplineRule(Rule):
     def check_module(self, mod: Module) -> List[Finding]:
         pattern = self.options.get("lock_attr_pattern", "lock")
         out: List[Finding] = []
-        for cls in ast.walk(mod.tree):
+        for cls in mod.nodes():
             if isinstance(cls, ast.ClassDef):
                 out.extend(self._check_class(mod, cls, pattern))
         out.sort(key=lambda f: f.line)

@@ -39,14 +39,14 @@ def registered_in_module(mod: Module) -> Dict[str, List[str]]:
     # local aliases of a register method (`g = registry.gauge`) register
     # through a bare Name call — resolve them too
     aliases: Set[str] = set()
-    for node in ast.walk(mod.tree):
+    for node in mod.nodes():
         if (isinstance(node, ast.Assign)
                 and isinstance(node.value, ast.Attribute)
                 and node.value.attr in REGISTER_METHODS):
             for t in node.targets:
                 if isinstance(t, ast.Name):
                     aliases.add(t.id)
-    for node in ast.walk(mod.tree):
+    for node in mod.nodes():
         if not isinstance(node, ast.Call):
             continue
         func = node.func

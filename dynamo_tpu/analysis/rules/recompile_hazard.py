@@ -1,0 +1,283 @@
+"""Rule ``recompile-hazard``: per-request shapes/values reaching jit.
+
+XLA compiles one program per (shape, dtype, static-arg value) signature.
+The engine's defense is the power-of-two bucketing discipline
+(``_buckets`` / ``_bucket`` in engine.py, ``SpecConfig.bucket`` in
+spec.py): every per-request length is rounded to a bucket before it can
+shape a dispatch. Two hazard classes slip past review:
+
+1. **unbucketed length** — a value derived from ``len(...)`` or
+   ``x.shape[i]`` that reaches a jitted call without passing through a
+   bucketing helper, either by sizing an array constructor's shape
+   (``np.zeros((n, ...))``) or by landing in a ``static_argnums`` /
+   ``static_argnames`` position. Each distinct length is a fresh XLA
+   compile mid-serving.
+2. **config-like traced arg** — a jit def taking ``cfg`` / ``mesh`` /
+   ``*_impl``-style parameters without declaring them static: configs are
+   unhashable (trace error at best) and every distinct value recompiles.
+   The engine's idiom is closing over config instead of passing it.
+
+Both checks are heuristic by design (AST-only, intra-procedural): they
+encode the repo's bucketing contract, not the full JAX semantics. A
+flagged site that is deliberately per-value compiled (e.g. a per-layer
+``static_argnums`` gather, bounded by the layer count) carries a
+``# dynalint: ok(recompile-hazard) <why>`` suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, Module, Rule, register
+from ..dataflow import (JITFN, DeviceTaint, _binding_pairs,
+                        get_device_taint, iter_scope_nodes,
+                        iter_scope_statements)
+
+SCOPE = [
+    "dynamo_tpu/engine",
+    "dynamo_tpu/ops",
+    "dynamo_tpu/parallel",
+    "dynamo_tpu/models",
+]
+
+#: parameter names that smell like configuration, not array data
+CONFIG_PARAM_NAMES = {"cfg", "config", "mesh", "spec", "impl", "mode"}
+CONFIG_PARAM_SUFFIXES = ("_cfg", "_config", "_impl", "_mode")
+
+#: array constructors whose first argument is a shape
+SHAPE_CTORS = {
+    "numpy.zeros", "numpy.ones", "numpy.empty", "numpy.full",
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.empty",
+    "jax.numpy.full",
+}
+
+RAW = "rawlen"        # local tag: unbucketed per-request length
+RAWSHAPED = "rawarr"  # array whose shape was built from a RAW length
+
+
+def _is_config_param(name: str) -> bool:
+    return name in CONFIG_PARAM_NAMES or name.endswith(CONFIG_PARAM_SUFFIXES)
+
+
+class _RawLen:
+    """Mini-lattice over one function: which locals hold raw lengths."""
+
+    def __init__(self, mod: Module, func: ast.AST, bucket_helpers: Set[str]):
+        self.mod = mod
+        self.bucket_helpers = bucket_helpers
+        self.env: Dict[str, str] = {}
+        for _ in range(3):
+            changed = False
+            for stmt in iter_scope_statements(func.body):
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                for target, value, _via in _binding_pairs(stmt):
+                    tag = self.tag(value)
+                    if tag is None:
+                        continue
+                    for t in ast.walk(target):
+                        if isinstance(t, ast.Name) \
+                                and self.env.get(t.id) != tag:
+                            self.env[t.id] = tag
+                            changed = True
+            if not changed:
+                break
+
+    def _sanitized(self, call: ast.Call) -> bool:
+        name = self.mod.resolve_call(call)
+        last = name.rsplit(".", 1)[-1]
+        return "bucket" in last or last in self.bucket_helpers
+
+    def tag(self, expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            if self._sanitized(expr):
+                return None
+            resolved = self.mod.resolve_call(expr)
+            if resolved == "len":
+                return RAW
+            if resolved in SHAPE_CTORS and expr.args:
+                if self.tag(expr.args[0]) == RAW:
+                    return RAWSHAPED
+            if resolved in ("max", "min", "sum", "int", "abs"):
+                for a in expr.args:
+                    if self.tag(a) == RAW:
+                        return RAW
+            return None
+        if isinstance(expr, ast.Name):
+            return self.env.get(expr.id)
+        if isinstance(expr, ast.Subscript):
+            # x.shape[i] is a raw per-request dimension
+            v = expr.value
+            if isinstance(v, ast.Attribute) and v.attr == "shape":
+                return RAW
+            return None
+        if isinstance(expr, ast.BinOp):
+            lt, rt = self.tag(expr.left), self.tag(expr.right)
+            if RAW in (lt, rt):
+                return RAW
+            return None
+        if isinstance(expr, ast.UnaryOp):
+            return self.tag(expr.operand)
+        if isinstance(expr, ast.IfExp):
+            return self.tag(expr.body) or self.tag(expr.orelse)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            for e in expr.elts:
+                if self.tag(e) == RAW:
+                    return RAW
+            return None
+        if isinstance(expr, (ast.GeneratorExp, ast.ListComp)):
+            return self.tag(expr.elt)
+        return None
+
+
+@register
+class RecompileHazardRule(Rule):
+    name = "recompile-hazard"
+    description = ("per-request length reaches a jitted call unbucketed, "
+                   "or a jit def takes config-like args without "
+                   "static_argnums/static_argnames")
+    scope = list(SCOPE)
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        taint = get_device_taint(mod, self.options)
+        bucket_helpers = set(self.options.get("bucket_helpers", ()))
+        out: List[Finding] = []
+        dup: Dict[str, int] = {}
+        statics = self._jit_static_map(mod, taint)
+        for func, argnums, argnames, wrapper_line in statics["defs"]:
+            self._check_config_args(mod, func, argnums, argnames,
+                                    wrapper_line, taint, out, dup)
+        # EVERY function scope — closures included (the nested-def idiom
+        # is exactly where per-request staging code lives) — each with its
+        # own raw-length env, via a visit-once scope-pruned walk
+        for func in taint._functions:
+            qual = taint.qualname(func)
+            raw = _RawLen(mod, func, bucket_helpers)
+            env = taint._function_env(func)
+            for node in iter_scope_nodes(func.body):
+                if isinstance(node, ast.Call):
+                    self._check_call(mod, node, env, raw, statics,
+                                     taint, qual, out, dup)
+        out.sort(key=lambda f: f.line)
+        return out
+
+    # -- jit def discovery -------------------------------------------------
+    def _jit_static_map(self, mod: Module, taint: DeviceTaint) -> dict:
+        """Traced defs with their static_argnums/static_argnames, plus the
+        name->def map for call-site static matching."""
+        defs = []
+        by_name = {}
+        parents = mod.parents()
+        for func in taint.traced:
+            if not hasattr(func, "name"):
+                continue
+            wrapper = None
+            for dec in getattr(func, "decorator_list", []):
+                if isinstance(dec, ast.Call) and taint.is_jit_wrap_call(dec):
+                    wrapper = dec
+            if wrapper is None:
+                # wrapped by name: find jax.jit(f, ...) call
+                for node in mod.nodes():
+                    if isinstance(node, ast.Call) \
+                            and taint.is_jit_wrap_call(node) and node.args \
+                            and isinstance(node.args[0], ast.Name) \
+                            and node.args[0].id == func.name:
+                        wrapper = node
+                        break
+            argnums, argnames = self._statics_of(wrapper)
+            line = wrapper.lineno if wrapper is not None else func.lineno
+            defs.append((func, argnums, argnames, line))
+            by_name[func.name] = (func, argnums, argnames)
+            _ = parents
+        return {"defs": defs, "by_name": by_name}
+
+    @staticmethod
+    def _statics_of(wrapper: Optional[ast.Call]):
+        argnums: Set[int] = set()
+        argnames: Set[str] = set()
+        if wrapper is not None:
+            for kw in wrapper.keywords:
+                if kw.arg == "static_argnums":
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Constant) \
+                                and isinstance(n.value, int):
+                            argnums.add(n.value)
+                elif kw.arg == "static_argnames":
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Constant) \
+                                and isinstance(n.value, str):
+                            argnames.add(n.value)
+        return argnums, argnames
+
+    # -- check 2: config-like traced args ----------------------------------
+    def _check_config_args(self, mod: Module, func: ast.AST, argnums,
+                           argnames, line: int, taint: DeviceTaint,
+                           out: List[Finding], dup: Dict[str, int]) -> None:
+        params = [a.arg for a in func.args.args]
+        qual = taint.qualname(func)
+        for i, p in enumerate(params):
+            if not _is_config_param(p):
+                continue
+            if i in argnums or p in argnames:
+                continue
+            key = f"{qual}:config-arg:{p}"
+            if key in dup:
+                continue
+            dup[key] = 1
+            out.append(Finding(
+                rule=self.name, path=mod.rel, line=func.lineno,
+                message=(f"jit-traced {qual}() takes config-like arg "
+                         f"{p!r} as a TRACED value — every distinct "
+                         f"config recompiles (or fails to hash); mark it "
+                         f"static_argnums/static_argnames or close over "
+                         f"it"),
+                key=key))
+
+    # -- check 1: unbucketed lengths at jit call sites ---------------------
+    def _check_call(self, mod: Module, call: ast.Call, env, raw: _RawLen,
+                    statics: dict, taint: DeviceTaint, qual: str,
+                    out: List[Finding], dup: Dict[str, int]) -> None:
+        f = call.func
+        is_jit_call = False
+        callee = None
+        if isinstance(f, (ast.Name, ast.Attribute, ast.Subscript)):
+            if taint.evaluate(f, env) == JITFN:
+                is_jit_call = True
+            if isinstance(f, ast.Name):
+                callee = f.id
+            elif isinstance(f, ast.Attribute):
+                callee = f.attr
+        if not is_jit_call:
+            return
+        known = statics["by_name"].get(callee)
+        for i, arg in enumerate(call.args):
+            t = raw.tag(arg)
+            if t == RAWSHAPED:
+                self._emit(mod, call.lineno, qual, callee or "<jit>",
+                           "array shaped by an unbucketed length", out,
+                           dup)
+            elif t == RAW and known is not None:
+                _func, argnums, argnames = known
+                params = [a.arg for a in _func.args.args]
+                pname = params[i] if i < len(params) else None
+                if i in argnums or (pname and pname in argnames):
+                    self._emit(mod, call.lineno, qual, callee or "<jit>",
+                               f"unbucketed length in static arg "
+                               f"position {i}", out, dup)
+
+    def _emit(self, mod: Module, line: int, qual: str, callee: str,
+              why: str, out: List[Finding], dup: Dict[str, int]) -> None:
+        key = f"{qual}:{callee}:{why.split()[0]}"
+        n = dup.get(key, 0) + 1
+        dup[key] = n
+        if n > 1:
+            key = f"{key}#{n}"
+        out.append(Finding(
+            rule=self.name, path=mod.rel, line=line,
+            message=(f"call to jitted {callee}() in {qual}() passes "
+                     f"{why} — every distinct size compiles a fresh XLA "
+                     f"program; round through the power-of-two bucket "
+                     f"helpers first"),
+            key=key))

@@ -90,7 +90,7 @@ class SilentExceptRule(Rule):
     def check_module(self, mod: Module) -> List[Finding]:
         out: List[Finding] = []
         seen_keys: dict = {}
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes():
             if not isinstance(node, ast.ExceptHandler) or not _is_broad(node):
                 continue
             if _observes(node):

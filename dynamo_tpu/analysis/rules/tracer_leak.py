@@ -1,0 +1,140 @@
+"""Rule ``tracer-leak``: side effects escaping a jit-traced function.
+
+A write to ``self.*``, a global, a nonlocal of an enclosing scope, or a
+subscript of a closed-over object from inside a jit-traced function runs
+ONCE at trace time with a tracer value, not on every call: the stored
+tracer either poisons later host code with a ``TracerLeakError`` deep in
+unrelated stacks, or silently freezes the first call's abstract value.
+The engine's discipline is that traced code is pure — persistent state
+(pools, PRNG keys, counts) is threaded through arguments and results.
+
+Traced functions are discovered by the dataflow layer: ``@jax.jit`` /
+``@partial(jax.jit, ...)`` decorated defs, defs wrapped by name in a
+``jax.jit(f)`` call, and lambdas passed to jit wrappers. Nested defs
+inside a traced body trace too (scan/vmap bodies) and are scanned with
+the traced scope's locals visible — writes targeting names bound *within*
+the traced region are fine; only stores escaping it are flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import Finding, Module, Rule, register
+from ..dataflow import get_device_taint, iter_scope_nodes
+
+SCOPE = [
+    "dynamo_tpu/engine",
+    "dynamo_tpu/ops",
+    "dynamo_tpu/parallel",
+    "dynamo_tpu/models",
+]
+
+
+def _bound_names(func: ast.AST) -> Set[str]:
+    """Names bound inside one function scope: params + assignments +
+    loop/with/comprehension targets + nested def names."""
+    out: Set[str] = set()
+    args = getattr(func, "args", None)
+    if args is not None:
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            out.add(a.arg)
+        if args.vararg:
+            out.add(args.vararg.arg)
+        if args.kwarg:
+            out.add(args.kwarg.arg)
+    body = func.body if isinstance(func.body, list) else [ast.Expr(func.body)]
+    # scope-pruned walk: a name bound only INSIDE a nested def is not
+    # bound here (treating it as local would mask a leak through it)
+    for node in iter_scope_nodes(body):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out.add(node.name)
+        elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store):
+            out.add(node.id)
+        elif isinstance(node, ast.NamedExpr):
+            out.add(node.target.id)
+    return out
+
+
+@register
+class TracerLeakRule(Rule):
+    name = "tracer-leak"
+    description = ("write to self.*/globals/nonlocals (or a closed-over "
+                   "object) from inside a jit-traced function — the "
+                   "stored tracer escapes the trace")
+    scope = list(SCOPE)
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        taint = get_device_taint(mod, self.options)
+        out: List[Finding] = []
+        dup: Dict[str, int] = {}
+        parents = mod.parents()
+        # only analyze OUTERMOST traced functions: nested traced defs are
+        # covered by their enclosing traced scope's scan
+        for func in sorted(taint.traced, key=lambda f: f.lineno):
+            enclosing = parents.get(func)
+            inside_traced = False
+            while enclosing is not None:
+                if enclosing in taint.traced:
+                    inside_traced = True
+                    break
+                enclosing = parents.get(enclosing)
+            if inside_traced:
+                continue
+            qual = taint.qualname(func) if hasattr(func, "name") \
+                else f"<lambda>@{func.lineno}"
+            self._scan(mod, func, [_bound_names(func)], qual, out, dup)
+        out.sort(key=lambda f: f.line)
+        return out
+
+    def _scan(self, mod: Module, func: ast.AST, bound_stack: List[Set[str]],
+              qual: str, out: List[Finding], dup: Dict[str, int]) -> None:
+        body = func.body if isinstance(func.body, list) \
+            else [ast.Expr(func.body)]
+        local = set().union(*bound_stack)
+        # scope-pruned, visit-once walk: nested defs recurse with their own
+        # frame (ast.walk would re-scan their bodies under the OUTER frame
+        # and double-report every leak found by the recursion)
+        for node in iter_scope_nodes(body):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan(mod, node, bound_stack + [_bound_names(node)],
+                           qual, out, dup)
+            elif isinstance(node, ast.Global):
+                for name in node.names:
+                    self._emit(mod, node.lineno, qual, f"global {name}",
+                               out, dup)
+            elif isinstance(node, ast.Nonlocal):
+                # nonlocal binding INSIDE the traced region is pure wrt the
+                # trace boundary; one reaching past it escapes
+                for name in node.names:
+                    if not any(name in frame for frame in bound_stack[:-1]):
+                        self._emit(mod, node.lineno, qual,
+                                   f"nonlocal {name}", out, dup)
+            elif isinstance(node, (ast.Attribute, ast.Subscript)) \
+                    and isinstance(node.ctx, ast.Store):
+                base = node
+                while isinstance(base, (ast.Attribute, ast.Subscript)):
+                    base = base.value
+                if isinstance(base, ast.Name) and base.id not in local:
+                    what = (f"{base.id}.{node.attr}"
+                            if isinstance(node, ast.Attribute)
+                            else f"{base.id}[...]")
+                    self._emit(mod, node.lineno, qual, what, out, dup)
+
+    def _emit(self, mod: Module, line: int, qual: str, what: str,
+              out: List[Finding], dup: Dict[str, int]) -> None:
+        key = f"{qual}:{what}"
+        n = dup.get(key, 0) + 1
+        dup[key] = n
+        if n > 1:
+            key = f"{key}#{n}"
+        out.append(Finding(
+            rule=self.name, path=mod.rel, line=line,
+            message=(f"write to {what} inside jit-traced {qual} runs at "
+                     f"TRACE time and leaks the tracer — thread state "
+                     f"through arguments/results instead"),
+            key=key))

@@ -81,7 +81,7 @@ def unbounded_awaits(mod: Module) -> List["tuple"]:
     human-readable message)."""
     parents = mod.parents()
     out: List[tuple] = []
-    for node in ast.walk(mod.tree):
+    for node in mod.nodes():
         if not isinstance(node, ast.Await):
             continue
         name = _call_name(node.value)

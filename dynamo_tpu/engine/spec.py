@@ -345,6 +345,8 @@ class DraftModelProposer:
             self.params, self.k_pool, self.v_pool,
             jnp.asarray([ctx[-1]], jnp.int32), pt,
             np.asarray([len(ctx)], np.int32))
+        # dynalint: ok(host-sync) draft-chain fetch: k drafted tokens in
+        # one array per proposal round (the proposer is host-side by design)
         return [int(t) for t in np.asarray(toks)[:k]]
 
     def _sync_chunk(self, seq_id: str, ctx: List[int], start: int,

@@ -34,8 +34,11 @@ from . import deadline as dl
 from .circuit_breaker import InstanceBreaker
 from .engine import AsyncEngine, Context, EngineError
 from .store_client import StoreClient
-from .wire import (PRIORITY_KEY, FrameReader, attach_trace, extract_trace,
-                   write_frame)
+from .wire import (CODE_KEY, CONTEXT_ID_KEY, CTYPE_KEY, ENDPOINT_KEY,
+                   KIND_KEY, MESSAGE_KEY, PRIORITY_KEY, REASON_KEY,
+                   RETRY_AFTER_KEY, STAGE_KEY, STREAMING_KEY, TRACE_KEY,
+                   FrameReader, attach_trace, extract_trace,
+                   unpack_two_part, write_frame)
 
 log = logging.getLogger("dynamo_tpu.runtime")
 
@@ -47,10 +50,10 @@ def error_control(e: Exception, code: Optional[int] = None) -> dict:
     their http-ish code AND their overload/deadline fields (stage, reason,
     retry_after) so the far end re-raises an equally typed error — a remote
     shed/expiry must reach the frontend's error body naming its stage."""
-    c: dict = {"kind": "error", "message": str(e),
-               "code": code if code is not None else (
+    c: dict = {KIND_KEY: "error", MESSAGE_KEY: str(e),
+               CODE_KEY: code if code is not None else (
                    e.code if isinstance(e, EngineError) else 500)}
-    for k in ("stage", "reason", "retry_after"):
+    for k in (STAGE_KEY, REASON_KEY, RETRY_AFTER_KEY):
         v = getattr(e, k, None)
         if v is not None:
             c[k] = v
@@ -59,11 +62,11 @@ def error_control(e: Exception, code: Optional[int] = None) -> dict:
 
 def error_from_control(control: dict) -> EngineError:
     """The inverse: re-raise a wire error frame as a typed EngineError."""
-    return EngineError(control.get("message", "remote error"),
-                       control.get("code", 500),
-                       stage=control.get("stage"),
-                       reason=control.get("reason"),
-                       retry_after=control.get("retry_after"))
+    return EngineError(control.get(MESSAGE_KEY, "remote error"),
+                       control.get(CODE_KEY, 500),
+                       stage=control.get(STAGE_KEY),
+                       reason=control.get(REASON_KEY),
+                       retry_after=control.get(RETRY_AFTER_KEY))
 
 
 async def drive_handler_stream(stream, send) -> bool:
@@ -83,21 +86,22 @@ async def drive_handler_stream(stream, send) -> bool:
         await send(error_control(e), None)
         return False
     except Exception as e:  # noqa: BLE001
-        await send({"kind": "error", "message": str(e), "code": 500}, None)
+        await send({KIND_KEY: "error", MESSAGE_KEY: str(e),
+                    CODE_KEY: 500}, None)
         return False
-    await send({"kind": "prologue"}, None)
+    await send({KIND_KEY: "prologue"}, None)
 
     def enc(item):
         if isinstance(item, (bytes, bytearray)):
-            return {"kind": "data", "ctype": "bin"}, bytes(item)
-        return {"kind": "data"}, json.dumps(item).encode()
+            return {KIND_KEY: "data", CTYPE_KEY: "bin"}, bytes(item)
+        return {KIND_KEY: "data"}, json.dumps(item).encode()
 
     try:
         if have_first:
             await send(*enc(first))
             async for item in stream:
                 await send(*enc(item))
-        await send({"kind": "sentinel"}, None)
+        await send({KIND_KEY: "sentinel"}, None)
     except (ConnectionResetError, BrokenPipeError):
         raise
     except Exception as e:  # noqa: BLE001 - mid-stream failure
@@ -290,8 +294,8 @@ class DistributedRuntime:
                 # request; lives exactly as long as the client keeps it
                 frame = pending if pending is not None else await fr.read()
                 pending = None
-                control, payload = frame
-                kind = control.get("kind")
+                control, payload = unpack_two_part(frame)
+                kind = control.get(KIND_KEY)
                 if kind == "request":
                     # one stream at a time per connection; clients pool and
                     # reuse connections for SEQUENTIAL requests. The control
@@ -303,6 +307,10 @@ class DistributedRuntime:
                     break
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
+        except ValueError as e:
+            # malformed frame (typed by wire.unpack_two_part / MAX_FRAME):
+            # this peer speaks a broken protocol — drop the connection
+            log.warning("closing data-plane connection: %s", e)
         finally:
             self._conn_writers.discard(writer)
             writer.close()
@@ -312,15 +320,15 @@ class DistributedRuntime:
                            writer: asyncio.StreamWriter):
         """Serve one request stream. Returns a leftover frame if the control
         watcher consumed the NEXT pipelined request off the socket."""
-        ep = control.get("endpoint")
-        ctx_id = control.get("context_id") or None
+        ep = control.get(ENDPOINT_KEY)
+        ctx_id = control.get(CONTEXT_ID_KEY) or None
         handler = self._handlers.get(ep)
         if handler is None:
-            await write_frame(writer, [{"kind": "error",
-                                        "message": f"no endpoint {ep!r}",
-                                        "code": 404}, None])
+            await write_frame(writer, [{KIND_KEY: "error",
+                                        MESSAGE_KEY: f"no endpoint {ep!r}",
+                                        CODE_KEY: 404}, None])
             return None
-        if control.get("ctype") == "bin":
+        if control.get(CTYPE_KEY) == "bin":
             request = payload  # raw bytes pass through untouched (KV plane)
         else:
             request = json.loads(payload.decode()) if payload else None
@@ -330,9 +338,9 @@ class DistributedRuntime:
             # connection died mid-request) — fail cleanly instead of
             # double-executing a non-idempotent handler
             await write_frame(writer, [{
-                "kind": "error", "code": 409,
-                "message": f"context {ctx_id} is already executing "
-                           f"(duplicate delivery)"}, None])
+                KIND_KEY: "error", CODE_KEY: 409,
+                MESSAGE_KEY: f"context {ctx_id} is already executing "
+                             f"(duplicate delivery)"}, None])
             return None
         req_deadline = control.get(dl.DEADLINE_KEY)
         if dl.expired(req_deadline):
@@ -367,19 +375,28 @@ class DistributedRuntime:
                     # unbounded-ok: control watcher is cancelled when the
                     # request finishes; disconnects stop the context below
                     frame = await fr.read()
-                    c, _ = frame
-                    if c.get("kind") == "stop":
+                    ctrl, _ = unpack_two_part(frame)
+                    if ctrl.get(KIND_KEY) == "stop":
                         ctx.stop_generating()
-                    elif c.get("kind") == "kill":
+                    elif ctrl.get(KIND_KEY) == "kill":
                         ctx.kill()
                     else:
                         leftover.append(frame)
                         return
             except (asyncio.IncompleteReadError, ConnectionResetError):
                 ctx.stop_generating()
+            except ValueError as e:
+                # malformed frame mid-request: same broken-protocol policy
+                # as _serve_conn — without this, the watcher would die
+                # silently in the reap below and stop/kill frames for the
+                # rest of the request would be ignored
+                log.warning("closing data-plane connection mid-request: %s",
+                            e)
+                ctx.stop_generating()
+                writer.close()
 
         watcher = None
-        if control.get("streaming"):
+        if control.get(STREAMING_KEY):
             # the connection keeps carrying request parts; stop/kill frames
             # interleave on the same stream until the "end" marker, after
             # which the normal control watcher takes over the socket
@@ -388,8 +405,8 @@ class DistributedRuntime:
                 while True:
                     # unbounded-ok: client-streamed body; a disconnect
                     # raises into the handler, which owns the request
-                    c, p = await fr.read()
-                    kind = c.get("kind")
+                    ctrl, p = unpack_two_part(await fr.read())
+                    kind = ctrl.get(KIND_KEY)
                     if kind == "part":
                         yield p
                     elif kind == "end":
@@ -647,11 +664,11 @@ class Client:
         # serialize BEFORE any socket exists: a non-serializable request
         # must not leak a freshly opened connection
         if isinstance(request, (bytes, bytearray)):
-            base_control = {"kind": "request", "context_id": ctx.id,
-                            "ctype": "bin"}
+            base_control = {KIND_KEY: "request", CONTEXT_ID_KEY: ctx.id,
+                            CTYPE_KEY: "bin"}
             req_payload = bytes(request)
         else:
-            base_control = {"kind": "request", "context_id": ctx.id}
+            base_control = {KIND_KEY: "request", CONTEXT_ID_KEY: ctx.id}
             req_payload = json.dumps(request).encode()
         if ctx.deadline is not None:
             # the deadline rides the envelope next to context_id/trace so
@@ -663,7 +680,7 @@ class Client:
             # interactive, the protective default)
             base_control[PRIORITY_KEY] = ctx.priority
         if parts is not None:
-            base_control["streaming"] = True
+            base_control[STREAMING_KEY] = True
         # client span around the whole exchange; its context rides the wire
         # so the server's rpc span parents under it. No ambient span (bare
         # client) => the request id becomes the trace id, matching the
@@ -676,7 +693,7 @@ class Client:
             trace_id=None if amb is not None else ctx.id,
             context_id=ctx.id)
         if call_span is not None:
-            base_control["trace"] = call_span.context().to_wire()
+            base_control[TRACE_KEY] = call_span.context().to_wire()
         else:
             attach_trace(base_control)
 
@@ -697,7 +714,7 @@ class Client:
                 w = live["writer"]
                 if w is not None and not w.is_closing():
                     try:
-                        await write_frame(w, [{"kind": "stop"}, None])
+                        await write_frame(w, [{KIND_KEY: "stop"}, None])
                         return
                     # dynalint: ok(swallowed-exception) the exception IS
                     # the retried condition: writer died mid-send, loop
@@ -749,7 +766,7 @@ class Client:
                     fr = FrameReader(reader)
                 live["writer"] = writer
 
-                req_control = {**base_control, "endpoint": info.endpoint}
+                req_control = {**base_control, ENDPOINT_KEY: info.endpoint}
                 # First exchange (request out, first frame back). Failures
                 # here — before ANY response frame was consumed — get one
                 # same-instance retry on a fresh connection: a pooled socket
@@ -774,9 +791,10 @@ class Client:
                             async for chunk in parts:
                                 await write_frame(
                                     writer,
-                                    [{"kind": "part", "ctype": "bin"},
+                                    [{KIND_KEY: "part", CTYPE_KEY: "bin"},
                                      bytes(chunk)])
-                            await write_frame(writer, [{"kind": "end"}, None])
+                            await write_frame(writer,
+                                              [{KIND_KEY: "end"}, None])
                         first = await dl.wait_for(
                             fr.read(), ctx.deadline,
                             f"rpc_first_frame:{info.endpoint}", slack=0.25)
@@ -839,17 +857,25 @@ class Client:
         clean = False
         try:
             try:
-                control, payload = first
-                if control.get("kind") == "error":
+                try:
+                    control, payload = unpack_two_part(first)
+                except ValueError as e:
+                    # broken protocol, not a broken transport: typed 502,
+                    # and the instance takes the breaker hit
+                    self.breaker.record_failure(iid)
+                    raise EngineError(
+                        f"instance {iid:x} sent a malformed frame: {e}",
+                        502) from e
+                if control.get(KIND_KEY) == "error":
                     raise error_from_control(control)
                 # else: prologue
                 while True:
                     # inter-frame timeout: a worker that stalls mid-stream
                     # (or dies without RST) becomes a clean 504, not a hang
                     try:
-                        control, payload = await dl.wait_for(
+                        control, payload = unpack_two_part(await dl.wait_for(
                             fr.read(), ctx.deadline,
-                            f"rpc_stream:{info.endpoint}", slack=0.25)
+                            f"rpc_stream:{info.endpoint}", slack=0.25))
                     except (asyncio.IncompleteReadError,
                             ConnectionResetError) as e:
                         # worker died mid-stream: a typed 503, never a raw
@@ -858,9 +884,16 @@ class Client:
                         raise EngineError(
                             f"instance {iid:x} dropped the stream "
                             f"mid-response: {type(e).__name__}", 503) from e
-                    kind = control.get("kind")
+                    except ValueError as e:
+                        # malformed mid-stream frame: typed 502 + breaker
+                        # hit, same policy as the server-side rx loops
+                        self.breaker.record_failure(iid)
+                        raise EngineError(
+                            f"instance {iid:x} sent a malformed frame "
+                            f"mid-response: {e}", 502) from e
+                    kind = control.get(KIND_KEY)
                     if kind == "data":
-                        if control.get("ctype") == "bin":
+                        if control.get(CTYPE_KEY) == "bin":
                             yield payload
                         else:
                             yield json.loads(payload.decode())

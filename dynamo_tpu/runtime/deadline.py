@@ -21,12 +21,13 @@ import time
 from typing import Any, AsyncIterator, Awaitable, Optional, TypeVar
 
 from .engine import EngineError
+# wire-envelope field (request control header / queue job) carrying the
+# absolute deadline; planes that drop unknown fields degrade to no
+# deadline. Declared in the wire-field registry, re-exported here because
+# every enforcement point already spells it ``dl.DEADLINE_KEY``.
+from .wire import DEADLINE_KEY  # noqa: F401  (re-export)
 
 T = TypeVar("T")
-
-# wire-envelope field (request control header / queue job) carrying the
-# absolute deadline; planes that drop unknown fields degrade to no deadline
-DEADLINE_KEY = "deadline"
 
 
 class DeadlineExceeded(EngineError):

@@ -1,0 +1,271 @@
+"""Central registry of the dynstore keyspace.
+
+Every key the system puts/watches in dynstore belongs to exactly one
+prefix family registered here: its owner subsystem, its lifecycle
+(lease-bound liveness state vs persistent config/log vs TTL tombstone vs
+work queue), the module that defines its helper/constant, and a one-line
+description. The ``store-key-drift`` dynalint rule gates this two-way —
+every store API call site must resolve (through the def-use layer) to a
+registered family, and every registered family must still have call
+sites — and ``docs/keyspace.md`` is generated from it::
+
+    python -m dynamo_tpu.runtime.keyspace --write
+
+This mirrors the knob registry (`utils/knobs.py` -> docs/configuration.md)
+and the reference's single-file wire/etcd-path constant modules: the
+keyspace IS an API between processes that can restart independently, so
+drift between a producer's f-string and a consumer's prefix watch is a
+silent cross-version outage, not a local bug.
+
+Key families whose *literal* prefix starts with a placeholder (endpoint
+registrations live under ``{namespace}/components/...``) cannot be
+grepped; they are resolvable only through their registered helpers, which
+is exactly why the gate is dataflow-based.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+#: lifecycle classes (how a key leaves the store)
+LEASE = "lease"            # bound to a session lease: vanishes with owner
+PERSISTENT = "persistent"  # lives until an explicit delete
+TTL = "ttl"                # bound to a short no-keepalive lease
+QUEUE = "queue"            # dynstore work queue (q_push/q_pull namespace)
+
+
+@dataclass(frozen=True)
+class KeyFamily:
+    """One registered store-key prefix family."""
+
+    name: str                 # short id used in findings/docs
+    pattern: str              # full key pattern, for humans
+    owner: str                # owning subsystem (module path)
+    lifecycle: str            # LEASE | PERSISTENT | TTL | QUEUE
+    description: str
+    #: literal prefix a key string starts with (None when the pattern
+    #: starts with a placeholder and only helpers can build it)
+    prefix: Optional[str] = None
+    #: helper functions that build/parse keys of this family
+    helpers: Tuple[str, ...] = ()
+    #: module-level constants naming the prefix
+    constants: Tuple[str, ...] = ()
+
+
+_ALL: List[KeyFamily] = [
+    KeyFamily(
+        name="endpoints",
+        pattern="{ns}/components/{component}/{endpoint}:{lease:x}",
+        owner="runtime/component.py", lifecycle=LEASE,
+        description="endpoint instance registrations — the service "
+                    "discovery plane; key suffix is the worker's lease id "
+                    "(= worker_id), so instances vanish with their lease",
+        helpers=("endpoint_key", "endpoint_prefix")),
+    KeyFamily(
+        name="models",
+        pattern="models/{model_type}/{name}[:i-{instance}]",
+        owner="llm/remote.py", lifecycle=LEASE,
+        description="model cards published by workers (chat template, "
+                    "context length, runtime config) for frontends",
+        prefix="models/", helpers=("model_key", "split_model_key"),
+        constants=("MODEL_PREFIX",)),
+    KeyFamily(
+        name="metrics",
+        pattern="metrics/{ns}/{component}/{worker_id:x}",
+        owner="llm/metrics_aggregator.py", lifecycle=LEASE,
+        description="per-worker ForwardPassMetrics snapshots (slots, KV "
+                    "occupancy, hit rate) scraped by router/planner",
+        prefix="metrics/", helpers=("metrics_key",),
+        constants=("METRICS_PREFIX",)),
+    KeyFamily(
+        name="metrics-stage",
+        pattern="metrics_stage/{ns}/{component}/{worker_id:x}",
+        owner="llm/metrics_aggregator.py", lifecycle=LEASE,
+        description="per-stage Prometheus registry snapshots merged "
+                    "cluster-wide by the metrics aggregator",
+        prefix="metrics_stage/", helpers=("stage_key",),
+        constants=("STAGE_PREFIX",)),
+    KeyFamily(
+        name="faults",
+        pattern="faults/{point}",
+        owner="utils/faults.py", lifecycle=PERSISTENT,
+        description="live fault-injection points (operator-written; value "
+                    "is the fault spec) watched by every process",
+        prefix="faults/", constants=("FAULTS_PREFIX",)),
+    KeyFamily(
+        name="overload",
+        pattern="overload/{ns}/brownout",
+        owner="utils/overload.py", lifecycle=LEASE,
+        description="fleet-wide brownout level published by the brownout "
+                    "controller, watched by frontends + routers",
+        prefix="overload/", helpers=("brownout_key",),
+        constants=("BROWNOUT_PREFIX",)),
+    KeyFamily(
+        name="traces",
+        pattern="traces/{trace_id}/{span_id}",
+        owner="utils/tracing.py", lifecycle=TTL,
+        description="cross-process span sink (TTL-leased, rotated at "
+                    "ttl/2) read by GET /v1/traces/{request_id}",
+        prefix="traces/", helpers=("trace_store_key",),
+        constants=("TRACE_STORE_PREFIX",)),
+    KeyFamily(
+        name="planner",
+        pattern="planner/{ns}/(state|override|decisions/{seq:016d})",
+        owner="planner/loop.py", lifecycle=PERSISTENT,
+        description="autoscaler plane: lease-bound liveness state, "
+                    "operator override/pause, decision audit log "
+                    "(pruned by the loop itself)",
+        prefix="planner/",
+        helpers=("planner_prefix", "state_key", "override_key",
+                 "decisions_prefix")),
+    KeyFamily(
+        name="disagg-config",
+        pattern="disagg/{ns}/{model}",
+        owner="llm/disagg.py", lifecycle=PERSISTENT,
+        description="disaggregation router thresholds, watched live by "
+                    "decode workers (etcd-watched config in the "
+                    "reference)",
+        prefix="disagg/", helpers=("disagg_config_key",),
+        constants=("DISAGG_CONFIG_PREFIX",)),
+    KeyFamily(
+        name="prefill-queue",
+        pattern="{ns}.prefill[.batch]",
+        owner="llm/disagg.py", lifecycle=QUEUE,
+        description="per-priority remote-prefill work queues (interactive "
+                    "keeps the legacy unsuffixed name)",
+        helpers=("prefill_queue_name", "prefill_queue_names")),
+    KeyFamily(
+        name="prefill-cancel",
+        pattern="{ns}.prefill/cancelled/{request_id}",
+        owner="llm/disagg.py", lifecycle=TTL,
+        description="cancellation tombstones letting prefill workers drop "
+                    "dequeued jobs nobody waits for (TTL-leased)",
+        helpers=("_cancel_key",)),
+    KeyFamily(
+        name="deployments",
+        pattern="deploy/deployments/{ns}/{name}",
+        owner="deploy/crd.py", lifecycle=PERSISTENT,
+        description="DynamoDeployment specs (the CRD store), watched by "
+                    "the operator reconcile loop",
+        prefix="deploy/deployments/", helpers=("deploy_key",),
+        constants=("DEPLOY_PREFIX",)),
+    KeyFamily(
+        name="deploy-status",
+        pattern="deploy/status/{ns}/{name}",
+        owner="deploy/operator.py", lifecycle=PERSISTENT,
+        description="observed deployment state written back by the "
+                    "operator (deleted when the deployment goes)",
+        prefix="deploy/status/", helpers=("status_key",),
+        constants=("STATUS_PREFIX",)),
+    KeyFamily(
+        name="deploy-artifacts",
+        pattern="deploy/artifacts/{name}/{version:08d}[.json]",
+        owner="deploy/artifacts.py", lifecycle=PERSISTENT,
+        description="artifact descriptors (image digests, object-store "
+                    "pointers) versioned per name",
+        prefix="deploy/artifacts/", helpers=("descriptor_key",),
+        constants=("ARTIFACT_PREFIX",)),
+]
+
+KEYSPACE: Dict[str, KeyFamily] = {f.name: f for f in _ALL}
+if len(KEYSPACE) != len(_ALL):
+    raise RuntimeError("duplicate keyspace family registration")
+
+#: literal prefixes, longest first (so deploy/status/ wins over deploy/)
+PREFIXES: List[Tuple[str, KeyFamily]] = sorted(
+    ((f.prefix, f) for f in _ALL if f.prefix),
+    key=lambda p: -len(p[0]))
+
+HELPER_INDEX: Dict[str, KeyFamily] = {
+    h: f for f in _ALL for h in f.helpers}
+CONSTANT_INDEX: Dict[str, KeyFamily] = {
+    c: f for f in _ALL for c in f.constants}
+
+
+def family_for_literal(head: str) -> Optional[KeyFamily]:
+    """The registered family a literal key head belongs to, if any."""
+    for prefix, fam in PREFIXES:
+        if head.startswith(prefix) or prefix.startswith(head):
+            return fam
+    return None
+
+
+def render_markdown(wire_fields: Optional[Dict[str, str]] = None) -> str:
+    """The generated body of docs/keyspace.md (store families + the wire
+    control-header field registry — the two distributed-protocol
+    surfaces gated by dynalint).
+
+    ``wire_fields`` defaults to importing ``wire.WIRE_FIELDS`` — the lint
+    rule passes its AST-extracted copy instead, so a full dynalint run
+    never imports wire.py (and thus msgpack) on analysis-only machines."""
+    if wire_fields is None:
+        from .wire import WIRE_FIELDS as wire_fields
+
+    out = [
+        "# Keyspace & wire protocol registry",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand. "
+        "Regenerate: python -m dynamo_tpu.runtime.keyspace --write -->",
+        "",
+        "The two cross-process protocol surfaces, generated from their",
+        "central registries and gated two-way by dynalint "
+        "(`store-key-drift`,",
+        "`wire-field-drift` — see [static analysis](static_analysis.md)):",
+        "every producer/consumer call site must resolve to a registered",
+        "entry, every entry must still be used, and this file must match",
+        "the registries byte-for-byte.",
+        "",
+        "## Store keyspace (`dynamo_tpu/runtime/keyspace.py`)",
+        "",
+        "Lifecycle: **lease** keys vanish with their owner's session "
+        "lease;",
+        "**persistent** keys live until an explicit delete; **ttl** keys "
+        "ride",
+        "a short no-keepalive lease; **queue** names address dynstore "
+        "work",
+        "queues rather than KV keys.",
+        "",
+        "| family | key pattern | owner | lifecycle | description |",
+        "|---|---|---|---|---|",
+    ]
+    for f in sorted(_ALL, key=lambda f: f.name):
+        out.append(f"| `{f.name}` | `{f.pattern}` | {f.owner} | "
+                   f"{f.lifecycle} | {f.description} |")
+    out.extend([
+        "",
+        f"{len(_ALL)} key families registered.",
+        "",
+        "## Wire control-header fields (`dynamo_tpu/runtime/wire.py`)",
+        "",
+        "Every field name that may appear in a two-part frame's control",
+        "header. Producers/consumers must spell these through the",
+        "registry constants — planes that drop unknown fields degrade",
+        "gracefully, but a misspelled field is a silent protocol fork.",
+        "",
+        "| field | description |",
+        "|---|---|",
+    ])
+    for name in sorted(wire_fields):
+        out.append(f"| `{name}` | {wire_fields[name]} |")
+    out.extend(["", f"{len(wire_fields)} wire fields registered.", ""])
+    return "\n".join(out)
+
+
+def _main(argv: List[str]) -> int:
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    target = os.path.join(repo, "docs", "keyspace.md")
+    if "--write" in argv:
+        with open(target, "w", encoding="utf-8") as f:
+            f.write(render_markdown())
+        print(f"wrote {target} ({len(KEYSPACE)} key families)")
+    else:
+        print(render_markdown())
+    return 0
+
+
+if __name__ == "__main__":          # pragma: no cover - trivial shell
+    import sys
+    sys.exit(_main(sys.argv[1:]))

@@ -24,7 +24,7 @@ import logging
 import os
 from typing import Any, Dict, Optional
 
-from .wire import pack
+from .wire import CODE_KEY, KIND_KEY, MESSAGE_KEY, pack
 from .engine import Context, EngineError
 from ..utils.aiotasks import spawn
 
@@ -175,8 +175,8 @@ class NativeDataPlane:
         def reject(code, message):
             self._part_queues.pop(sid, None)
             self._contexts.pop(sid, None)
-            self._send(sid, {"kind": "error", "code": code,
-                             "message": message}, None)
+            self._send(sid, {KIND_KEY: "error", CODE_KEY: code,
+                             MESSAGE_KEY: message}, None)
             self._end(sid)
 
         handler = drt._handlers.get(endpoint)
@@ -248,8 +248,8 @@ class NativeDataPlane:
                 srv_status = "ok"
         except Exception as e:  # noqa: BLE001 - transport-level failure
             try:
-                self._send(sid, {"kind": "error", "message": str(e),
-                                 "code": 500}, None)
+                self._send(sid, {KIND_KEY: "error", MESSAGE_KEY: str(e),
+                                 CODE_KEY: 500}, None)
             except Exception:
                 # stream already torn down native-side: the error frame
                 # has no one to reach

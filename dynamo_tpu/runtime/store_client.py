@@ -427,6 +427,10 @@ class StoreClient:
             async with self._send_lock:
                 # unbounded-ok: drain stalls only on TCP backpressure from
                 # the store; bounded by the connection's own lifetime
+                # dynalint: ok(await-holding-lock) the send lock EXISTS to
+                # serialize request frames on the one store socket; a stall
+                # is TCP backpressure from the store, and connection loss
+                # rejects every waiter via _fail_pending
                 await write_frame(self._writer, {"op": op, "id": rid, **kw})
         except (ConnectionResetError, BrokenPipeError, OSError) as e:
             self._pending.pop(rid, None)

@@ -90,6 +90,9 @@ class _Conn:
 
     async def push(self, obj: Any) -> None:
         async with self._send_lock:
+            # dynalint: ok(await-holding-lock) per-connection frame
+            # serialization is the lock's purpose; a consumer that stops
+            # reading hits the OUTBOX_LIMIT path and is dropped
             await write_frame(self.writer, obj)
 
     def push_nowait(self, obj: Any) -> None:
@@ -108,6 +111,10 @@ class _Conn:
             while not self._outbox.empty():
                 obj = self._outbox.get_nowait()
                 async with self._send_lock:
+                    # dynalint: ok(await-holding-lock) the pump contends
+                    # only with reply writes on THIS connection; a stalled
+                    # socket blocks its own pump, and the defunct-consumer
+                    # limit closes the connection
                     await write_frame(self.writer, obj)
         # dynalint: ok(swallowed-exception) broken pipe: the reader loop
         # reaps the connection, and logging per lost frame would spam on

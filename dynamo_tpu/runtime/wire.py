@@ -21,6 +21,32 @@ import msgpack
 
 MAX_FRAME = 256 * 1024 * 1024  # 256 MB: KV block transfers ride this plane
 
+# ---------------------------------------------------------------------------
+# control-header field registry
+#
+# Every field name that may appear in a two-part frame's control header is
+# declared HERE and spelled through these constants everywhere else — the
+# ``wire-field-drift`` dynalint rule gates it two-way (a literal spelling
+# in dataplane code fails the run, a constant nobody reads is stale) and
+# docs/keyspace.md renders the table. One misspelled field between a
+# producer and a consumer that "drops unknown fields gracefully" is a
+# silent protocol fork; the registry makes the field surface reviewable.
+# ---------------------------------------------------------------------------
+
+# frame discriminator: request | prologue | data | part | end | sentinel |
+# stop | kill | error
+KIND_KEY = "kind"
+# target endpoint name on request frames
+ENDPOINT_KEY = "endpoint"
+# request identity, stable across hops (trace_id defaults to it)
+CONTEXT_ID_KEY = "context_id"
+# payload content type: "bin" passes raw bytes through untouched
+CTYPE_KEY = "ctype"
+# request body arrives as a client-side stream of "part" frames
+STREAMING_KEY = "streaming"
+# absolute deadline (unix seconds) riding the envelope (runtime/deadline.py)
+DEADLINE_KEY = "deadline"
+
 # Optional span-context field on request control headers: [trace_id,
 # parent_span_id]. Rides next to ``context_id`` so one request's spans
 # stitch across processes (utils/tracing.py). Planes that drop unknown
@@ -31,6 +57,36 @@ TRACE_KEY = "trace"
 # ("interactive" | "batch", utils/overload.py). Absent => interactive —
 # planes that drop unknown fields degrade to the protective default.
 PRIORITY_KEY = "priority"
+
+# error-frame fields (runtime/component.py error_control/error_from_control)
+MESSAGE_KEY = "message"          # human-readable error text
+CODE_KEY = "code"                # http-ish status carried by EngineError
+STAGE_KEY = "stage"              # pipeline stage that shed/expired
+REASON_KEY = "reason"            # machine reason (overload shed class etc.)
+RETRY_AFTER_KEY = "retry_after"  # client backoff hint, seconds
+
+#: field name -> description; the registry the drift gate + docs render.
+#: (Plain literal dict on purpose: the lint rule reads it via AST, no
+#: import of this module — and thus msgpack — at analysis time.)
+WIRE_FIELDS = {
+    "kind": "frame discriminator: request | prologue | data | part | end "
+            "| sentinel | stop | kill | error",
+    "endpoint": "target endpoint name on request frames",
+    "context_id": "request identity, stable across hops; trace_id "
+                  "defaults to it",
+    "ctype": "payload content type ('bin' = raw bytes pass-through)",
+    "streaming": "request body arrives as a stream of 'part' frames",
+    "deadline": "absolute end-to-end deadline, unix seconds",
+    "trace": "span context [trace_id, parent_span_id] for cross-process "
+             "stitching",
+    "priority": "overload class: interactive | batch (absent => "
+                "interactive)",
+    "message": "error frame: human-readable text",
+    "code": "error frame: http-ish status code",
+    "stage": "error frame: pipeline stage that shed/expired the request",
+    "reason": "error frame: machine-readable reason",
+    "retry_after": "error frame: client backoff hint, seconds",
+}
 
 
 def attach_trace(control: dict) -> dict:
@@ -61,7 +117,22 @@ def pack_two_part(control: dict, payload: Optional[bytes] = None) -> bytes:
 
 
 def unpack_two_part(obj: Any) -> Tuple[dict, Optional[bytes]]:
+    """Split a decoded two-part frame into (control, payload).
+
+    Raises a typed ``ValueError`` on malformed frames (wrong arity, or a
+    non-dict control header) instead of leaking a bare unpack
+    ``TypeError`` into rx loops — a corrupt or hostile peer must surface
+    as a protocol error the connection handlers already classify."""
+    if not isinstance(obj, (list, tuple)) or len(obj) != 2:
+        raise ValueError(
+            f"malformed two-part frame: expected [control, payload], "
+            f"got {type(obj).__name__}"
+            + (f" of length {len(obj)}"
+               if isinstance(obj, (list, tuple)) else ""))
     control, payload = obj
+    if not isinstance(control, dict):
+        raise ValueError(f"malformed two-part frame: control header is "
+                         f"{type(control).__name__}, expected dict")
     return control, payload
 
 

@@ -3,6 +3,8 @@
 
     python scripts/dynalint.py                     # all rules, full tree
     python scripts/dynalint.py dynamo_tpu/llm/     # per-file rules, subset
+    python scripts/dynalint.py --changed           # pre-commit: git diff
+    python scripts/dynalint.py --report host-sync  # transfer inventory
     python scripts/dynalint.py --rule lock-discipline --json
     python scripts/dynalint.py --list-rules
     python scripts/dynalint.py --write-baseline    # grandfather current
@@ -12,18 +14,28 @@ entry) remains. Suppress inline with ``# dynalint: ok(<rule>) <reason>``;
 grandfather pre-existing findings in ``scripts/dynalint_baseline.json``
 (every entry needs a one-line justification). See docs/static_analysis.md.
 
-Whole-repo rules (knob-drift, metrics-catalog) reason about two-way sync,
-so they always analyze the full default tree; when explicit paths narrow
-the scan they are skipped by default (name them with ``--rule`` to run
-them anyway — still against the full tree).
+Whole-repo rules (knob-drift, metrics-catalog, store-key-drift,
+wire-field-drift) reason about two-way sync, so they always analyze the
+full default tree; when explicit paths narrow the scan they are skipped
+by default (name them with ``--rule`` to run them anyway — still against
+the full tree). ``--changed`` keeps them: per-file rules see only the
+files ``git diff`` names (merge-base vs HEAD + worktree), whole-repo
+rules keep full-tree semantics — sub-second pre-commit runs with the
+drift gates intact.
+
+``--report <rule>`` inventories EVERY site the rule knows — open findings
+first, then suppressed (with their reasons) and baselined ones — and
+exits 0: for ``host-sync`` this is the documented device->host transfer
+budget of the dispatch paths.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
-from typing import List
+from typing import List, Optional
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -40,6 +52,74 @@ def _is_repo_rule(cls) -> bool:
     return cls.check_repo is not Rule.check_repo
 
 
+def _git(args: List[str]) -> List[str]:
+    try:
+        out = subprocess.run(["git"] + args, cwd=REPO, check=True,
+                             capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return []
+    return [ln.strip() for ln in out.stdout.splitlines() if ln.strip()]
+
+
+def changed_files() -> Optional[List[str]]:
+    """Changed ``.py`` files under the default roots: merge-base vs HEAD
+    plus worktree/index plus untracked. None when git is unavailable."""
+    if not _git(["rev-parse", "--is-inside-work-tree"]):
+        return None
+    base = "HEAD"
+    for upstream in ("@{upstream}", "origin/main", "origin/master"):
+        mb = _git(["merge-base", "HEAD", upstream])
+        if mb:
+            base = mb[0]
+            break
+    names = set(_git(["diff", "--name-only", base, "HEAD"]))
+    names |= set(_git(["diff", "--name-only", "HEAD"]))
+    names |= set(_git(["ls-files", "--others", "--exclude-standard"]))
+    from dynamo_tpu.analysis.runner import DEFAULT_ROOTS
+    roots = tuple(r.rstrip("/") + "/" for r in DEFAULT_ROOTS)
+    out = []
+    for rel in sorted(names):
+        if not rel.endswith(".py") or not rel.startswith(roots):
+            continue
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):       # deleted files can't be parsed
+            out.append(path)
+    return out
+
+
+def _report(rule_name: str, result) -> int:
+    """Inventory mode: every site the rule knows, ranked — open findings
+    first, then suppressed/baselined dispatch-path sites before the rest."""
+    def disp_rank(key: str) -> int:
+        low = key.lower()
+        for rank, tokens in enumerate((("decode",), ("verify", "spec"),
+                                       ("prefill",))):
+            if any(t in low for t in tokens):
+                return rank
+        return 3
+
+    rows = []   # (status_rank, disp_rank, path, line, text)
+    for f in result.findings:
+        rows.append((0, disp_rank(f.key), f.path, f.line,
+                     f"OPEN       {f.location()}: {f.message}"))
+    for f, reason in result.suppressed:
+        rows.append((1, disp_rank(f.key), f.path, f.line,
+                     f"suppressed {f.location()} [{f.key}] — {reason}"))
+    for f in result.grandfathered:
+        rows.append((2, disp_rank(f.key), f.path, f.line,
+                     f"baselined  {f.location()} [{f.key}]"))
+    try:
+        print(f"{rule_name} inventory — {len(rows)} site(s) "
+              f"({len(result.findings)} open, {len(result.suppressed)} "
+              f"suppressed, {len(result.grandfathered)} baselined)")
+        for _s, _d, _p, _l, text in sorted(rows):
+            print(text)
+    except BrokenPipeError:
+        # `--report x | head` closing the pipe early is a fine way to read
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
 def main(argv: List[str]) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("paths", nargs="*",
@@ -47,6 +127,13 @@ def main(argv: List[str]) -> int:
                         "+ scripts/)")
     p.add_argument("--rule", action="append", default=None,
                    metavar="NAME", help="run only these rules")
+    p.add_argument("--changed", action="store_true",
+                   help="per-file rules over `git diff` files only "
+                        "(merge-base vs HEAD + worktree); whole-repo "
+                        "rules keep full-tree semantics")
+    p.add_argument("--report", metavar="RULE", default=None,
+                   help="inventory mode: print every site RULE knows "
+                        "(open + suppressed + baselined), exit 0")
     p.add_argument("--baseline", default=DEFAULT_BASELINE)
     p.add_argument("--no-baseline", action="store_true",
                    help="report grandfathered findings as failures too")
@@ -66,6 +153,14 @@ def main(argv: List[str]) -> int:
             print(f"{name:22s} [{kind}] {rules[name].description}")
         return 0
 
+    if args.report is not None:
+        if args.report not in rules:
+            p.error(f"unknown rule {args.report!r} "
+                    f"(--list-rules shows the registry)")
+        result = run_lint(rule_names=[args.report],
+                          baseline_path=args.baseline)
+        return _report(args.report, result)
+
     names = args.rule
     if names:
         unknown = [n for n in names if n not in rules]
@@ -77,6 +172,21 @@ def main(argv: List[str]) -> int:
         names = sorted(n for n, c in rules.items() if not _is_repo_rule(c))
     else:
         names = sorted(rules)
+
+    if args.changed:
+        if args.paths:
+            p.error("--changed and explicit paths are mutually exclusive")
+        changed = changed_files()
+        if changed is None:
+            p.error("--changed requires a git checkout")
+        if not changed:
+            print("ok: no changed Python files under dynamo_tpu/ + "
+                  "scripts/")
+            return 0
+        # unlike an explicit path subset, --changed KEEPS the whole-repo
+        # rules: the runner feeds them the full default tree anyway, so
+        # the drift gates stay sound while per-file rules run sub-second
+        args.paths = changed
 
     # a typo'd path silently green-lighting every violation is the worst
     # possible CI outcome — reject missing paths and empty scans loudly

@@ -518,3 +518,163 @@ async def test_list_models_manual_entry_not_counted_as_replica():
         await c.close()
     finally:
         await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# wire framing: cancellation safety + malformed-frame hardening
+# ---------------------------------------------------------------------------
+
+async def test_frame_reader_cancellation_resumes_mid_frame():
+    """The FrameReader docstring promises frame-level cancellation safety:
+    a read() cancelled BETWEEN the length header and the body leaves the
+    parsed length in _pending_len, and the next read() resumes with the
+    body instead of desynchronizing the stream. Nothing pinned it."""
+    from dynamo_tpu.runtime import wire
+
+    r = asyncio.StreamReader()
+    fr = wire.FrameReader(r)
+    frame1 = wire.pack({"op": "a"})
+    frame2 = wire.pack({"op": "b", "payload": b"x" * 100})
+
+    # feed ONLY the 4-byte length header: the reader parses it, then parks
+    # awaiting the body
+    r.feed_data(frame1[:4])
+    task = asyncio.create_task(fr.read())
+    for _ in range(10):          # let the task consume the header
+        await asyncio.sleep(0)
+        if fr._pending_len is not None:
+            break
+    assert fr._pending_len == len(frame1) - 4
+    task.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await task
+    # the parsed length survives the cancellation
+    assert fr._pending_len == len(frame1) - 4
+
+    # body arrives later (plus a second frame): the next read() resumes
+    # MID-FRAME and both frames decode cleanly — no desync
+    r.feed_data(frame1[4:])
+    r.feed_data(frame2)
+    assert await fr.read() == {"op": "a"}
+    assert await fr.read() == {"op": "b", "payload": b"x" * 100}
+
+
+async def test_frame_reader_cancellation_mid_header_is_safe():
+    """Cancelling while the 4-byte header is still incomplete must not
+    consume the partial bytes (readexactly only consumes once all n are
+    buffered): the next read() sees the whole header."""
+    from dynamo_tpu.runtime import wire
+
+    r = asyncio.StreamReader()
+    fr = wire.FrameReader(r)
+    frame = wire.pack([1, 2, 3])
+    r.feed_data(frame[:2])       # half a header
+    task = asyncio.create_task(fr.read())
+    for _ in range(5):
+        await asyncio.sleep(0)
+    assert fr._pending_len is None
+    task.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await task
+    r.feed_data(frame[2:])
+    assert await fr.read() == [1, 2, 3]
+
+
+async def test_unpack_two_part_rejects_malformed_frames():
+    """Typed ValueError (not a bare unpack TypeError) on wrong arity or a
+    non-dict control header — rx loops classify protocol errors, they must
+    never die on a TypeError from tuple unpacking."""
+    from dynamo_tpu.runtime.wire import unpack_two_part
+
+    control, payload = unpack_two_part([{"kind": "data"}, b"x"])
+    assert control == {"kind": "data"} and payload == b"x"
+    assert unpack_two_part(({"kind": "end"}, None)) == ({"kind": "end"},
+                                                        None)
+    with pytest.raises(ValueError, match="malformed two-part frame"):
+        unpack_two_part([{"kind": "data"}])          # wrong arity
+    with pytest.raises(ValueError, match="malformed two-part frame"):
+        unpack_two_part("not-a-frame")               # wrong type
+    with pytest.raises(ValueError, match="malformed two-part frame"):
+        unpack_two_part(42)                          # msgpack scalar
+    with pytest.raises(ValueError, match="control header"):
+        unpack_two_part([b"not-a-dict", None])       # non-dict control
+
+
+async def test_malformed_frame_drops_connection_server_stays_up():
+    """A peer speaking a broken protocol (non-two-part frames) is dropped
+    with a warning; the data-plane server keeps serving well-formed
+    clients on fresh connections."""
+    from dynamo_tpu.runtime import wire
+
+    srv, port = await start_store()
+    try:
+        worker = await DistributedRuntime(store_port=port,
+                                          advertise_host="127.0.0.1"
+                                          ).connect()
+        ep = worker.namespace("test").component("echo").endpoint("generate")
+        await ep.serve(echo_handler)
+
+        # raw garbage straight at the data plane
+        reader, writer = await asyncio.open_connection(worker.dp_host,
+                                                       worker.dp_port)
+        writer.write(wire.pack(["only-one-element"]))
+        await writer.drain()
+        assert await reader.read() == b""     # server hung up on us
+        writer.close()
+
+        # a well-formed client is unaffected
+        caller = await DistributedRuntime(store_port=port).connect()
+        cl = await caller.namespace("test").component("echo") \
+            .endpoint("generate").client().start()
+        await cl.wait_for_instances(1)
+        items = [x async for x in cl.generate({"text": "ok"})]
+        assert items == [{"word": "ok"}]
+        await caller.close()
+        await worker.close()
+    finally:
+        await srv.stop()
+
+
+async def test_malformed_frame_mid_request_drops_connection():
+    """Regression: a malformed frame arriving WHILE a response streams
+    (the control-watcher path) must apply the same broken-protocol policy
+    as between requests — stop the context and drop the connection — not
+    die silently in the watcher reap."""
+    from dynamo_tpu.runtime import wire
+
+    srv, port = await start_store()
+    try:
+        worker = await DistributedRuntime(store_port=port,
+                                          advertise_host="127.0.0.1"
+                                          ).connect()
+        stopped = asyncio.Event()
+
+        async def slow_handler(request, ctx: Context):
+            for i in range(1000):
+                if ctx.is_stopped:
+                    stopped.set()
+                    return
+                yield {"i": i}
+                await asyncio.sleep(0.01)
+
+        ep = worker.namespace("test").component("slow").endpoint("gen")
+        await ep.serve(slow_handler)
+
+        # speak the wire protocol by hand so we can inject garbage
+        reader, writer = await asyncio.open_connection(worker.dp_host,
+                                                       worker.dp_port)
+        writer.write(wire.pack_two_part(
+            {"kind": "request", "endpoint": "gen", "context_id": "mal-1"},
+            json.dumps({}).encode()))
+        await writer.drain()
+        fr = wire.FrameReader(reader)
+        assert (await fr.read())[0]["kind"] == "prologue"
+        assert (await fr.read())[0]["kind"] == "data"
+        # now a malformed frame mid-stream
+        writer.write(wire.pack(["not-two-part"]))
+        await writer.drain()
+        await asyncio.wait_for(stopped.wait(), 5.0)   # handler was stopped
+        writer.close()
+        await worker.close()
+    finally:
+        await srv.stop()
