@@ -48,6 +48,7 @@ LEGACY_SCOPE = [
     "dynamo_tpu/cli/dyntop.py",
     "dynamo_tpu/utils/overload.py",
     "scripts/overload_soak.py",
+    "scripts/fleet_soak.py",
 ]
 
 

@@ -11,6 +11,10 @@ renders, per worker: active/total slots, KV occupancy, prefix hit rate,
 MFU / MBU / achieved HBM GB/s, spec accept rate, and circuit-breaker
 state; plus cluster-level TTFT/ITL p90, prefill queue depth, compile
 counters, and SLO burn rates (when ``DYN_SLO_*`` objectives are set).
+The coordination store renders as its own ``store:`` line (op/s, p99 of
+the hottest keyspace family, watches/leases/conns, watch fan-out/s,
+telemetry drops) from the dump it publishes about itself;
+``--store-detail`` expands it into a per-family table.
 
 Renders with curses when stdout is a TTY (plain ANSI-refresh otherwise or
 with ``--plain``); ``--once`` prints a single snapshot and exits (what the
@@ -26,6 +30,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..utils.dynconfig import EnvDefaultsParser
+from ..utils.prometheus import hist_quantile
 
 
 def parse_args(argv=None) -> argparse.Namespace:
@@ -40,6 +45,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="print one snapshot and exit")
     p.add_argument("--plain", action="store_true",
                    help="force plain-refresh output (no curses)")
+    p.add_argument("--store-detail", action="store_true",
+                   help="expand the store: line into a per-keyspace-"
+                        "family table (ops, p99, resident keys/bytes, "
+                        "queue depth)")
     return p.parse_args(argv)
 
 
@@ -59,6 +68,10 @@ class ClusterSnapshotter:
         self.components = list(components)
         # gauge=None: dyntop observes, it does not export
         self.slo = SloMonitor(registry_gauge=None)
+        # previous refresh's store totals (monotonic, ops_total,
+        # fanout_total, per-family bucket counts): differentiated into
+        # the store line's op/s, fan-out/s, and windowed hot-family p99
+        self._store_prev: Optional[Dict] = None
 
     async def collect(self) -> Dict:
         from ..llm.disagg import prefill_queue_names
@@ -80,6 +93,48 @@ class ClusterSnapshotter:
                 q_depth += await self.store.q_len(qname)
             except Exception:  # noqa: BLE001 - queue plane optional
                 pass
+        store_stats = store_stats_from_states(states)
+        if store_stats is not None:
+            # fleet-side telemetry-pipeline losses ride the same dumps
+            for name, key in (("dyn_spans_dropped_total", "span_drops"),
+                              ("dyn_spans_sampled_out_total",
+                               "spans_sampled_out")):
+                tot = 0.0
+                for _comp, dump in states:
+                    st = dump.get(name) or {}
+                    tot += sum((st.get("series") or {}).values())
+                store_stats[key] = tot
+            now = time.monotonic()
+            prev = self._store_prev
+            fam_counts = store_stats.pop("_fam_counts", {})
+            buckets = store_stats.pop("_buckets", None)
+            if prev is not None and now > prev["t"]:
+                dt = now - prev["t"]
+                store_stats["op_rate"] = max(
+                    store_stats["ops_total"] - prev["ops"], 0.0) / dt
+                store_stats["fanout_rate"] = max(
+                    store_stats["fanout_total"] - prev["fanout"], 0.0) / dt
+                # windowed per-family view (this refresh only): an
+                # incident-slow store must move the rendered hot/p99
+                # immediately, not after it outweighs the lifetime counts
+                window: Dict[str, Dict] = {}
+                for fam, cur in fam_counts.items():
+                    base = prev["fams"].get(fam)
+                    d_ops = cur["ops"] - (base["ops"] if base else 0)
+                    if d_ops <= 0:
+                        continue
+                    d_counts = [x - y for x, y in zip(
+                        cur["counts"] or [], base["counts"] or [])] \
+                        if base else cur["counts"]
+                    window[fam] = {
+                        "ops": d_ops,
+                        "p99_s": hist_quantile(buckets, d_counts,
+                                               d_ops, 0.99)}
+                store_stats["families_window"] = window
+            self._store_prev = {"t": now,
+                                "ops": store_stats["ops_total"],
+                                "fanout": store_stats["fanout_total"],
+                                "fams": fam_counts}
         burn = self.slo.observe(states) if self.slo.objectives else {}
         overload = {
             "brownout": brownout_level_from_states(states),
@@ -89,6 +144,7 @@ class ClusterSnapshotter:
         return {
             "at": time.time(),
             "namespace": self.namespace,
+            "store": store_stats,
             "workers": workers,
             "breaker_open": open_instance_ids(states),
             "ttft_p90": quantile_from_states(states, "llm_ttft_seconds",
@@ -100,6 +156,67 @@ class ClusterSnapshotter:
             "slo_burn": burn,
             "overload": overload,
         }
+
+
+def store_stats_from_states(states) -> Optional[Dict]:
+    """The store server's self-telemetry, extracted from one
+    ``fetch_stage_states`` result (the ``component="store"`` dump the
+    server writes into its own KV). Returns cumulative totals; the
+    snapshotter differentiates successive calls into op/s and fan-out/s.
+    None when no store dump is being published (old store, or
+    ``DYN_STORE_METRICS_INTERVAL=0``)."""
+    dump = next((d for comp, d in states
+                 if comp == "store" and "dyn_store_op_seconds" in d), None)
+    if dump is None:
+        return None
+
+    def gauge(name: str) -> float:
+        st = dump.get(name) or {}
+        return float(sum((st.get("series") or {}).values()) or 0.0)
+
+    ops = dump["dyn_store_op_seconds"]
+    fams: Dict[str, Dict] = {}
+    for skey, val in (ops.get("series") or {}).items():
+        parts = skey.split("\x1f")
+        fam = parts[1] if len(parts) > 1 else "?"
+        agg = fams.setdefault(fam, {"ops": 0, "counts": None})
+        agg["ops"] += val.get("total", 0)
+        counts = val.get("counts") or []
+        if agg["counts"] is None:
+            agg["counts"] = list(counts)
+        else:
+            agg["counts"] = [a + b for a, b in zip(agg["counts"], counts)]
+    families = {
+        fam: {"ops": a["ops"],
+              "p99_s": hist_quantile(ops.get("buckets"), a["counts"],
+                                     a["ops"], 0.99)}
+        for fam, a in fams.items()}
+    per_fam_gauges = {}
+    for name, field in (("dyn_store_keys", "keys"),
+                        ("dyn_store_bytes", "bytes"),
+                        ("dyn_store_queue_depth", "queue_depth")):
+        st = dump.get(name) or {}
+        for skey, val in (st.get("series") or {}).items():
+            fam = skey.split("\x1f")[0] if skey else "?"
+            per_fam_gauges.setdefault(fam, {})[field] = val
+    return {
+        "ops_total": sum(f["ops"] for f in families.values()),
+        "families": families,
+        # raw per-family bucket counts + edges: the snapshotter diffs
+        # successive refreshes into the windowed hot-family/p99 the
+        # store: line shows (cumulative p99 barely moves in an incident)
+        "_fam_counts": {fam: {"ops": a["ops"], "counts": a["counts"]}
+                        for fam, a in fams.items()},
+        "_buckets": ops.get("buckets"),
+        "family_gauges": per_fam_gauges,
+        "watches": gauge("dyn_store_watches"),
+        "leases": gauge("dyn_store_leases"),
+        "conns": gauge("dyn_store_conns"),
+        "keys_total": gauge("dyn_store_keys"),
+        "bytes_total": gauge("dyn_store_bytes"),
+        "fanout_total": gauge("dyn_store_watch_fanout_total"),
+        "drops": gauge("dyn_store_fanout_drops_total"),
+    }
 
 
 def _compile_totals(states) -> Dict[str, Tuple[float, float]]:
@@ -126,13 +243,57 @@ def _fmt(v: Optional[float], spec: str = "5.3f", na: str = "    -") -> str:
     return na if v is None else format(v, spec)
 
 
-def render(snap: Dict) -> str:
+def _fmt_ms(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v == float("inf"):
+        return ">tail"
+    return f"{v * 1e3:.1f}ms"
+
+
+def render(snap: Dict, store_detail: bool = False) -> str:
     lines: List[str] = []
     hdr = (f"dyntop — ns={snap['namespace']}  "
            f"ttft_p90={_fmt(snap.get('ttft_p90'))}s  "
            f"itl_p90={_fmt(snap.get('itl_p90'))}s  "
            f"prefill_q={snap.get('prefill_queue', 0)}")
     lines.append(hdr)
+    st = snap.get("store")
+    if st:
+        # hot family + p99 from the last refresh window when available
+        # (lifetime-cumulative counts barely move during an incident);
+        # the --store-detail table below stays lifetime-cumulative
+        fams = st.get("families_window") or st.get("families") or {}
+        hot = max(fams, key=lambda f: fams[f]["ops"]) if fams else None
+        rate = st.get("op_rate")
+        rate_s = f"{rate:.0f}/s" if rate is not None \
+            else f"{int(st['ops_total'])} ops"
+        fan = st.get("fanout_rate")
+        fan_s = f"{fan:.0f}/s" if fan is not None \
+            else f"{int(st['fanout_total'])}"
+        drops = int(st.get("drops", 0) + st.get("span_drops", 0))
+        lines.append(
+            f"store: ops={rate_s}"
+            + (f"  p99[{hot}]={_fmt_ms(fams[hot]['p99_s'])}" if hot else "")
+            + f"  watches={int(st['watches'])}"
+            f"  leases={int(st['leases'])}  conns={int(st['conns'])}"
+            f"  fanout={fan_s}  drops={drops}"
+            f"  sampled_out={int(st.get('spans_sampled_out', 0))}")
+        if store_detail:
+            lines.append(
+                f"  {'family':<16} {'ops':>9} {'p99':>8} {'keys':>7} "
+                f"{'MiB':>8} {'qdepth':>6}")
+            life = st.get("families") or {}   # lifetime totals here
+            gauges = st.get("family_gauges") or {}
+            for fam in sorted(set(life) | set(gauges)):
+                f_ops = life.get(fam, {})
+                g = gauges.get(fam, {})
+                lines.append(
+                    f"  {fam:<16} {int(f_ops.get('ops', 0)):>9} "
+                    f"{_fmt_ms(f_ops.get('p99_s')):>8} "
+                    f"{int(g.get('keys', 0)):>7} "
+                    f"{g.get('bytes', 0) / 2**20:>8.2f} "
+                    f"{int(g.get('queue_depth', 0)):>6}")
     comps = snap.get("compiles") or {}
     if comps:
         lines.append("compiles: " + "  ".join(
@@ -189,7 +350,7 @@ async def run_once(args) -> str:
         snap = await ClusterSnapshotter(
             store, args.namespace,
             args.component or ["backend", "prefill"]).collect()
-        return render(snap)
+        return render(snap, args.store_detail)
     finally:
         await store.close()
 
@@ -204,7 +365,7 @@ async def _loop_plain(args) -> None:
                                  args.component or ["backend", "prefill"])
     try:
         while True:
-            text = render(await snapper.collect())
+            text = render(await snapper.collect(), args.store_detail)
             if sys.stdout.isatty():
                 sys.stdout.write("\x1b[H\x1b[2J")   # home + clear
             sys.stdout.write(text + "\n")
@@ -230,7 +391,7 @@ async def _loop_curses(args) -> None:
     scr.nodelay(True)
     try:
         while True:
-            text = render(await snapper.collect())
+            text = render(await snapper.collect(), args.store_detail)
             scr.erase()
             maxy, maxx = scr.getmaxyx()
             for i, line in enumerate(text.splitlines()[:maxy - 1]):

@@ -185,7 +185,7 @@ async def run_http(args, *, ready_event=None,
     # the planner's ttft_p90 signal, the SLO monitor's latency AND
     # availability objectives, and dyntop all read metrics_stage/; a
     # frontend that only *served* /metrics would keep those planes blind
-    from ..llm.metrics_aggregator import publish_stage_metrics
+    from ..llm.metrics_aggregator import StagePublisher
 
     svc.stage_worker_id = drt.worker_id   # /metrics skips our own dump
     pub_ns = getattr(args, "namespace", None) or "dynamo"
@@ -198,11 +198,13 @@ async def run_http(args, *, ready_event=None,
         log.warning("brownout watch failed; serving at level 0",
                     exc_info=True)
 
+    publisher = StagePublisher(drt.store, pub_ns, "http", drt.worker_id,
+                               drt.lease)
+
     async def stage_publish_loop():
         while True:
             try:
-                await publish_stage_metrics(
-                    drt.store, pub_ns, "http", drt.worker_id, drt.lease,
+                await publisher.publish(
                     extra_metrics=svc.registry.state_dump())
             except Exception:
                 log.debug("frontend stage publish skipped", exc_info=True)

@@ -75,14 +75,15 @@ async def run_prefill_worker(args, *,
     # /v1/traces stitches them); histogram dumps refresh under our lease
     tracing.configure(component="prefill_worker")
     span_sink = await tracing.StoreSpanSink(drt.store).start()
-    from ..llm.metrics_aggregator import publish_stage_metrics
+    from ..llm.metrics_aggregator import StagePublisher
+
+    publisher = StagePublisher(drt.store, args.namespace,
+                               PREFILL_COMPONENT, drt.worker_id, drt.lease)
 
     async def stage_metrics_loop():
         while True:
             try:
-                await publish_stage_metrics(
-                    drt.store, args.namespace, PREFILL_COMPONENT,
-                    drt.worker_id, drt.lease)
+                await publisher.publish()
             except Exception:
                 log.exception("stage metrics publish failed")
             await asyncio.sleep(1.0)

@@ -311,7 +311,10 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                              model_type="completion", lease=drt.lease)
 
     # --- metrics loop -------------------------------------------------
-    from ..llm.metrics_aggregator import publish_stage_metrics
+    from ..llm.metrics_aggregator import StagePublisher
+
+    stage_pub = StagePublisher(drt.store, args.namespace, args.component,
+                               drt.worker_id, drt.lease)
 
     async def metrics_loop():
         key = metrics_key(args.namespace, args.component, drt.worker_id)
@@ -327,9 +330,7 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
             try:
                 await drt.store.put(key, json.dumps(m.to_dict()).encode(),
                                     lease=drt.lease)
-                await publish_stage_metrics(
-                    drt.store, args.namespace, args.component,
-                    drt.worker_id, drt.lease)
+                await stage_pub.publish()
             except StoreError:
                 # store mid-outage (reconnect in flight): skip the beat —
                 # the session replay re-puts the last snapshot anyway

@@ -22,16 +22,28 @@ import asyncio
 import json
 import logging
 import math
+import os
+import time
 from typing import Dict, List, Optional, Sequence
 
 from ..runtime.component import DistributedRuntime
-from ..utils.prometheus import Registry, render_states
+from ..utils.prometheus import Registry, diff_states, render_states
 from .kv_router.protocols import ForwardPassMetrics, KVHitRateEvent
 
 log = logging.getLogger("dynamo_tpu.metrics")
 
 METRICS_PREFIX = "metrics/"
 STAGE_PREFIX = "metrics_stage/"
+#: the store server's own telemetry dump (runtime/store_server.py writes
+#: it into its KV under the ``metrics-store`` keyspace family); fetched
+#: alongside every namespace's worker dumps so the store shows up on the
+#: same merge path as any component
+STORE_STAGE_PREFIX = "metrics_stage/_store/"
+
+#: publisher self-accounting excluded from delta change-detection (its
+#: own counters change on every push — including them would turn every
+#: idle interval into a delta); full snapshots still carry them
+_SELF_METRICS = ("dyn_metrics_pushes_total",)
 
 
 def metrics_key(namespace: str, component: str, worker_id: int) -> str:
@@ -46,14 +58,174 @@ def stage_key(namespace: str, component: str, worker_id: int) -> str:
     return f"{STAGE_PREFIX}{namespace}/{component}/{worker_id:x}"
 
 
+def stage_delta_key(namespace: str, component: str, worker_id: int) -> str:
+    """Sibling key carrying the coalesced since-last-full delta batch
+    (see :class:`StagePublisher`); lease-bound like the full snapshot."""
+    return stage_key(namespace, component, worker_id) + "/delta"
+
+
+def stage_base_key(key: str) -> str:
+    """The full-snapshot key a stage-KV key belongs to (its own key, or
+    the ``/delta``-stripped sibling)."""
+    return key[:-len("/delta")] if key.endswith("/delta") else key
+
+
+def merge_stage_items(items) -> Dict[str, tuple]:
+    """Group raw stage-KV ``(key, value)`` pairs by publisher and apply
+    the delta overlay: ``{base_key: (full_doc, merged_metrics)}``.
+
+    THE one implementation of the full+delta read protocol (see
+    :class:`StagePublisher`) — :func:`fetch_stage_states` and the
+    planner's ``SignalCollector`` both read through it. A delta overlays
+    its full iff its ``base_seq`` matches the full's ``seq`` (stale
+    deltas from before a newer full are dropped, never mis-merged);
+    legacy seq-less fulls pass through unchanged; malformed payloads are
+    logged and skipped."""
+    fulls: Dict[str, Dict] = {}
+    deltas: Dict[str, Dict] = {}
+    for key, value in items:
+        try:
+            d = json.loads(value.decode())
+        except Exception:
+            log.warning("malformed stage metrics at %s", key)
+            continue
+        (deltas if key.endswith("/delta") else fulls)[
+            stage_base_key(key)] = d
+    out: Dict[str, tuple] = {}
+    for key, d in fulls.items():
+        metrics = d.get("metrics") or {}
+        delta = deltas.get(key)
+        if delta and d.get("seq") is not None \
+                and delta.get("base_seq") == d.get("seq"):
+            metrics = {**metrics, **(delta.get("metrics") or {})}
+        out[key] = (d, metrics)
+    return out
+
+
+class StagePublisher:
+    """Delta-batched stage-metrics publishing: O(1) store writes per
+    worker per interval, O(changed) bytes instead of O(metrics).
+
+    Protocol (stateless-reader safe):
+
+    - every ``full_every``-th push writes the **full** registry image to
+      ``stage_key`` as ``{"component", "seq", "metrics"}``;
+    - pushes in between write ONE **cumulative delta** — every metric
+      whose state changed since the last full — to ``stage_delta_key`` as
+      ``{"component", "base_seq", "metrics"}``. Cumulative (not chained)
+      means a reader needs only the (full, delta) pair it can always
+      fetch in one ``get_prefix``: overlay delta iff ``base_seq`` matches
+      the full's ``seq`` (a stale delta from before a newer full is
+      ignored, never mis-merged);
+    - an interval where nothing changed writes **nothing**.
+
+    Pushes are additionally rate-limited to one store write per
+    ``DYN_METRICS_PUSH_INTERVAL`` seconds (0 = every call), so a worker
+    with a fast metrics loop still costs the store one write per
+    interval. Outcomes are counted in ``dyn_metrics_pushes_total{kind}``.
+    """
+
+    def __init__(self, store, namespace: str, component: str,
+                 worker_id: int, lease: int,
+                 dump_fn=None, push_interval: Optional[float] = None,
+                 full_every: Optional[int] = None):
+        self.store = store
+        self.namespace = namespace
+        self.component = component
+        self.worker_id = worker_id
+        self.lease = lease
+        self._dump_fn = dump_fn
+        if push_interval is None:
+            try:
+                push_interval = float(
+                    os.environ.get("DYN_METRICS_PUSH_INTERVAL", "0") or 0)
+            except ValueError:
+                push_interval = 0.0
+        self.push_interval = max(push_interval, 0.0)
+        if full_every is None:
+            try:
+                full_every = int(
+                    os.environ.get("DYN_METRICS_FULL_EVERY", "10") or 10)
+            except ValueError:
+                full_every = 10
+        self.full_every = max(full_every, 1)
+        self._last_full: Optional[Dict[str, Dict]] = None
+        self._last_delta: Optional[Dict[str, Dict]] = None
+        self._seq = 0             # seq of the last full snapshot
+        self._pushes_since_full = 0
+        self._last_push_t = 0.0
+
+    def _dump(self) -> Dict[str, Dict]:
+        if self._dump_fn is not None:
+            return self._dump_fn()
+        from ..utils.prometheus import stage_metrics
+
+        return stage_metrics().registry.state_dump()
+
+    async def publish(self, extra_metrics: Optional[Dict] = None,
+                      force_full: bool = False) -> str:
+        """One publish beat; returns what happened: ``"full"``,
+        ``"delta"``, ``"skipped"`` (no change — no write) or
+        ``"throttled"`` (inside the push interval — no work done)."""
+        from ..utils.prometheus import stage_metrics
+
+        now = time.monotonic()
+        if self._last_full is not None and self.push_interval > 0 \
+                and now - self._last_push_t < self.push_interval:
+            return "throttled"
+        cur = self._dump()
+        if extra_metrics:
+            cur = {**cur, **extra_metrics}
+        if self._last_full is None or force_full \
+                or self._pushes_since_full >= self.full_every - 1:
+            self._seq += 1
+            payload = json.dumps({"component": self.component,
+                                  "seq": self._seq,
+                                  "metrics": cur}).encode()
+            await self.store.put(
+                stage_key(self.namespace, self.component, self.worker_id),
+                payload, lease=self.lease)
+            self._last_full = cur
+            self._last_delta = None
+            self._pushes_since_full = 0
+            self._last_push_t = now
+            stage_metrics().metrics_pushes.inc("full")
+            return "full"
+        delta = diff_states(self._last_full, cur, ignore=_SELF_METRICS)
+        # skip only when the delta key's content would be unchanged: an
+        # EMPTY delta after a non-empty one must still be written, or a
+        # metric that reverted to its full-snapshot value (e.g. a queue
+        # depth back to 0) would keep reading as the stale delta value
+        if delta == (self._last_delta or {}):
+            stage_metrics().metrics_pushes.inc("skipped")
+            return "skipped"
+        # only WRITES advance the full rollover — an idle worker must
+        # stay genuinely silent, not re-publish an unchanged full every
+        # full_every beats
+        self._pushes_since_full += 1
+        payload = json.dumps({"component": self.component,
+                              "base_seq": self._seq,
+                              "metrics": delta}).encode()
+        await self.store.put(
+            stage_delta_key(self.namespace, self.component,
+                            self.worker_id),
+            payload, lease=self.lease)
+        self._last_delta = delta
+        self._last_push_t = now
+        stage_metrics().metrics_pushes.inc("delta")
+        return "delta"
+
+
 async def publish_stage_metrics(store, namespace: str, component: str,
                                 worker_id: int, lease: int,
                                 extra_metrics: Optional[Dict] = None) -> None:
-    """One refresh of this process's stage-histogram dump (workers call
-    this from their metrics loop). ``extra_metrics`` merges additional
-    registry ``state_dump()``s into the payload — the HTTP frontend ships
-    its request counters (`dyn_http_*`) this way so availability SLOs can
-    be evaluated cluster-wide."""
+    """One full-snapshot refresh of this process's stage-histogram dump.
+    Long-running workers should hold a :class:`StagePublisher` instead
+    (delta batching); this one-shot form is kept for callers that publish
+    once or rarely. ``extra_metrics`` merges additional registry
+    ``state_dump()``s into the payload — the HTTP frontend ships its
+    request counters (`dyn_http_*`) this way so availability SLOs can be
+    evaluated cluster-wide."""
     from ..utils.prometheus import stage_metrics
 
     metrics = stage_metrics().registry.state_dump()
@@ -77,7 +249,8 @@ async def clear_worker_keys(store, namespace: str, component: str,
     exporting ghost occupancy/MFU until the process dies. Best-effort: a
     store mid-outage just leaves the lease TTL to do the same job later."""
     for key in (metrics_key(namespace, component, worker_id),
-                stage_key(namespace, component, worker_id)):
+                stage_key(namespace, component, worker_id),
+                stage_delta_key(namespace, component, worker_id)):
         try:
             await store.delete(key)
         except Exception:  # noqa: BLE001 - cleanup must never mask exit
@@ -106,22 +279,27 @@ async def fetch_stage_states(store, namespace: Optional[str] = None,
                              ) -> List[tuple]:
     """All published stage dumps as ``(component, state_dump)`` pairs, ready
     for :func:`dynamo_tpu.utils.prometheus.render_states`.
+
+    Delta-aware: a worker's ``.../delta`` batch (see
+    :class:`StagePublisher`) is overlaid onto its full snapshot when the
+    delta's ``base_seq`` matches the snapshot's ``seq`` — stale deltas
+    (from before a newer full) are dropped, and legacy seq-less full
+    dumps pass through unchanged. A namespace-scoped fetch also includes
+    the store server's own telemetry dump (``metrics_stage/_store/``),
+    so the coordination plane itself renders on every merge surface.
     ``exclude_worker`` skips one publisher's dump — a frontend that both
     publishes and scrapes must not merge its own counters twice."""
     prefix = STAGE_PREFIX + (f"{namespace}/" if namespace else "")
-    states: List[tuple] = []
-    for key, value in await store.get_prefix(prefix):
-        if exclude_worker is not None and key.rsplit("/", 1)[-1] == \
-                f"{exclude_worker:x}":
-            continue
-        try:
-            d = json.loads(value.decode())
-            states.append((d.get("component")
-                           or key[len(STAGE_PREFIX):].split("/")[1],
-                           d["metrics"]))
-        except Exception:
-            log.warning("malformed stage metrics at %s", key)
-    return states
+    items = list(await store.get_prefix(prefix))
+    if namespace:
+        items.extend(await store.get_prefix(STORE_STAGE_PREFIX))
+    if exclude_worker is not None:
+        items = [(k, v) for k, v in items
+                 if stage_base_key(k).rsplit("/", 1)[-1]
+                 != f"{exclude_worker:x}"]
+    return [(doc.get("component") or key[len(STAGE_PREFIX):].split("/")[1],
+             metrics)
+            for key, (doc, metrics) in merge_stage_items(items).items()]
 
 
 class ClusterMetricsAggregator:

@@ -205,21 +205,32 @@ class SignalCollector:
         and the per-component worker-id sets (liveness) — the dumps are
         multi-KB, so fetching them once per tick instead of 1+P times
         matters on a standing daemon."""
+        from ..llm.metrics_aggregator import (merge_stage_items,
+                                              stage_base_key)
+
         states: List[Tuple[str, Dict]] = []
         ids: Dict[str, Set[int]] = {}
         prefix = f"{STAGE_PREFIX}{self.namespace}/"
-        for key, value in await self.store.get_prefix(prefix):
-            comp, _, widhex = key[len(prefix):].partition("/")
+        items = list(await self.store.get_prefix(prefix))
+        valid: Dict[str, str] = {}   # base_key -> component
+        for key, _value in items:
+            base = stage_base_key(key)
+            comp, _, widhex = base[len(prefix):].partition("/")
             try:
-                ids.setdefault(comp, set()).add(int(widhex, 16))
+                wid = int(widhex, 16)
             except ValueError:
                 log.warning("malformed stage key %s", key)
                 continue
-            try:
-                d = json.loads(value.decode())
-                states.append((d.get("component") or comp, d["metrics"]))
-            except Exception:
-                log.warning("malformed stage metrics at %s", key)
+            valid[base] = comp
+            if not key.endswith("/delta"):
+                # count the replica even if its payload is corrupt — a
+                # live worker mid-write must not read as a missing one
+                ids.setdefault(comp, set()).add(wid)
+        # full+delta overlay: the ONE protocol implementation lives in
+        # metrics_aggregator.merge_stage_items
+        for base, (d, metrics) in merge_stage_items(items).items():
+            if base in valid:
+                states.append((d.get("component") or valid[base], metrics))
         return states, ids
 
     def _shed_rate(self, stage_states) -> float:

@@ -80,12 +80,31 @@ _ALL: List[KeyFamily] = [
         constants=("METRICS_PREFIX",)),
     KeyFamily(
         name="metrics-stage",
-        pattern="metrics_stage/{ns}/{component}/{worker_id:x}",
+        pattern="metrics_stage/{ns}/{component}/{worker_id:x}[/delta]",
         owner="llm/metrics_aggregator.py", lifecycle=LEASE,
         description="per-stage Prometheus registry snapshots merged "
-                    "cluster-wide by the metrics aggregator",
-        prefix="metrics_stage/", helpers=("stage_key",),
+                    "cluster-wide by the metrics aggregator (full "
+                    "snapshot + coalesced since-last-full delta key)",
+        prefix="metrics_stage/", helpers=("stage_key", "stage_delta_key"),
         constants=("STAGE_PREFIX",)),
+    KeyFamily(
+        name="metrics-store",
+        pattern="metrics_stage/_store/store/0",
+        owner="runtime/store_server.py", lifecycle=PERSISTENT,
+        description="the store's OWN telemetry dump (per-op latency by "
+                    "keyspace family, watch/lease/key gauges), written "
+                    "into its KV by the server itself; dies with the "
+                    "store process",
+        prefix="metrics_stage/_store/", constants=("STORE_STAGE_PREFIX",)),
+    KeyFamily(
+        name="fleet-soak",
+        pattern="fleet/{ns}/beacon",
+        owner="scripts/fleet_soak.py", lifecycle=PERSISTENT,
+        description="fleet-soak watch fan-out beacon: the driver puts a "
+                    "timestamped payload, every synthetic worker watches "
+                    "the prefix and reports delivery lag",
+        prefix="fleet/", helpers=("fleet_beacon_key",
+                                  "fleet_beacon_prefix")),
     KeyFamily(
         name="faults",
         pattern="faults/{point}",
@@ -189,6 +208,29 @@ def family_for_literal(head: str) -> Optional[KeyFamily]:
         if head.startswith(prefix) or prefix.startswith(head):
             return fam
     return None
+
+
+def classify_key(key: str) -> str:
+    """Family name for a FULL key/queue name (the store's own per-op
+    telemetry labels every ``dyn_store_op_seconds`` series with this).
+
+    Unlike :func:`family_for_literal` (which accepts partial heads for the
+    lint resolver), this requires a real prefix match, then falls back to
+    the placeholder-led patterns the registry cannot express as literals:
+    endpoint registrations (``{ns}/components/...``) and the per-namespace
+    prefill queue/cancel names. Everything else is ``"other"`` — a growing
+    ``other`` rate in the store dump means an unregistered keyspace.
+    """
+    for prefix, fam in PREFIXES:
+        if key.startswith(prefix):
+            return fam.name
+    if "/components/" in key:
+        return "endpoints"
+    if ".prefill/cancelled/" in key:
+        return "prefill-cancel"
+    if key.endswith(".prefill") or key.endswith(".prefill.batch"):
+        return "prefill-queue"
+    return "other"
 
 
 def render_markdown(wire_fields: Optional[Dict[str, str]] = None) -> str:

@@ -29,11 +29,15 @@ from __future__ import annotations
 import asyncio
 import collections
 import itertools
+import json
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
+from ..utils.prometheus import LATENCY_BUCKETS_FAST, Registry
+from .keyspace import classify_key
 from .wire import FrameReader, write_frame
 
 log = logging.getLogger("dynamo_tpu.store")
@@ -43,11 +47,62 @@ DEFAULT_TTL = 5.0
 # sentinel: an op handler parked the request; the reply is pushed later
 DEFER = object()
 
+#: where the server publishes its own telemetry dump (into its own KV —
+#: the one store key no client writes; family ``metrics-store`` in
+#: runtime/keyspace.py, fetched by metrics_aggregator.fetch_stage_states)
+SELF_STAGE_KEY = "metrics_stage/_store/store/0"
+
+
+class StoreStats:
+    """The store's self-observability registry: per-op latency labeled by
+    keyspace *family* (via :func:`~.keyspace.classify_key`, so the series
+    vocabulary is drift-gated with the keyspace registry for free), plus
+    watch/lease/connection gauges, per-family resident keys/bytes, queue
+    depths, and watch fan-out volume. Published on the ordinary
+    stage-metrics merge path every ``DYN_STORE_METRICS_INTERVAL`` seconds
+    so ``/metrics``, the aggregator and ``dyntop`` see the store like any
+    other component."""
+
+    def __init__(self) -> None:
+        r = Registry()
+        self.registry = r
+        self.op_seconds = r.histogram(
+            "dyn_store_op_seconds",
+            "Store op handler latency by op and keyspace family "
+            "(q_pull measures the immediate-dequeue path; parked pulls "
+            "are not ops, they are waits)", ("op", "family"),
+            buckets=LATENCY_BUCKETS_FAST)
+        self.watches = r.gauge(
+            "dyn_store_watches", "Registered prefix watches", ())
+        self.leases = r.gauge(
+            "dyn_store_leases", "Live leases", ())
+        self.conns = r.gauge(
+            "dyn_store_conns", "Open client connections", ())
+        self.keys = r.gauge(
+            "dyn_store_keys", "Resident keys by keyspace family",
+            ("family",))
+        self.bytes = r.gauge(
+            "dyn_store_bytes", "Resident value bytes by keyspace family",
+            ("family",))
+        self.queue_depth = r.gauge(
+            "dyn_store_queue_depth",
+            "Undelivered work-queue messages by queue family", ("family",))
+        self.watch_fanout = r.counter(
+            "dyn_store_watch_fanout_total",
+            "Watch events pushed to watchers (one put/delete fans out to "
+            "every matching watch)", ())
+        self.fanout_drops = r.counter(
+            "dyn_store_fanout_drops_total",
+            "Connections dropped because their push outbox overflowed "
+            "(defunct consumer — the fan-out they missed died with them)",
+            ())
+
 
 @dataclass
 class _KeyVal:
     value: bytes
     lease: Optional[int] = None
+    family: str = "other"
 
 
 @dataclass
@@ -71,9 +126,11 @@ class _QueueMsg:
 class _Conn:
     _ids = itertools.count(1)
 
-    def __init__(self, writer: asyncio.StreamWriter):
+    def __init__(self, writer: asyncio.StreamWriter,
+                 stats: Optional[StoreStats] = None):
         self.id = next(_Conn._ids)
         self.writer = writer
+        self.stats = stats
         self.watches: Dict[int, str] = {}          # watch_id -> prefix
         self.subs: Dict[int, str] = {}             # sub_id -> subject
         self.leases: Set[int] = set()
@@ -99,6 +156,8 @@ class _Conn:
         """Enqueue a push frame, preserving per-connection order, without
         awaiting the (possibly stalled) socket."""
         if self._outbox.qsize() >= self.OUTBOX_LIMIT:
+            if self.stats is not None:
+                self.stats.fanout_drops.inc()
             self.writer.close()   # defunct consumer: drop the connection
             return
         self._outbox.put_nowait(obj)
@@ -145,6 +204,21 @@ class StoreServer:
         self._server: Optional[asyncio.base_events.Server] = None
         self._reaper: Optional[asyncio.Task] = None
         self._conns: set = set()
+        # self-observability: per-op latency/family accounting + the
+        # periodic dump into our own KV (0 = keep recording, never publish)
+        self.stats = StoreStats()
+        raw_interval = os.environ.get("DYN_STORE_METRICS_INTERVAL", "")
+        try:
+            self._stats_interval = float(raw_interval) if raw_interval \
+                else 2.0
+        except ValueError:
+            log.warning("ignoring malformed DYN_STORE_METRICS_INTERVAL=%r",
+                        raw_interval)
+            self._stats_interval = 2.0
+        self._stats_task: Optional[asyncio.Task] = None
+        self._fam_keys: Dict[str, int] = {}
+        self._fam_bytes: Dict[str, int] = {}
+        self._fam_cache: Dict[str, str] = {}   # key -> family (bounded)
 
     # ------------------------------------------------------------------
     async def start(self) -> int:
@@ -152,9 +226,13 @@ class StoreServer:
                                                   self.port)
         self.port = self._server.sockets[0].getsockname()[1]
         self._reaper = asyncio.create_task(self._reap_leases())
+        if self._stats_interval > 0:
+            self._stats_task = asyncio.create_task(self._publish_stats())
         return self.port
 
     async def stop(self) -> None:
+        if self._stats_task:
+            self._stats_task.cancel()
         if self._reaper:
             self._reaper.cancel()
         if self._server:
@@ -186,13 +264,42 @@ class StoreServer:
             return
         for key in list(lease.keys):
             if key in self._kv and self._kv[key].lease == lid:
-                del self._kv[key]
+                self._kv_del(key)
                 await self._notify_watchers(key, None)
+
+    # -- per-family residency accounting --------------------------------
+    def _family(self, key: str) -> str:
+        fam = self._fam_cache.get(key)
+        if fam is None:
+            if len(self._fam_cache) >= 65536:
+                self._fam_cache.clear()
+            fam = self._fam_cache[key] = classify_key(key)
+        return fam
+
+    def _kv_set(self, key: str, value: bytes,
+                lease: Optional[int]) -> None:
+        old = self._kv.get(key)
+        fam = old.family if old is not None else self._family(key)
+        if old is None:
+            self._fam_keys[fam] = self._fam_keys.get(fam, 0) + 1
+        else:
+            self._fam_bytes[fam] = self._fam_bytes.get(fam, 0) \
+                - len(old.value)
+        self._fam_bytes[fam] = self._fam_bytes.get(fam, 0) + len(value)
+        self._kv[key] = _KeyVal(value, lease, fam)
+
+    def _kv_del(self, key: str) -> Optional[_KeyVal]:
+        kv = self._kv.pop(key, None)
+        if kv is not None:
+            self._fam_keys[kv.family] = self._fam_keys.get(kv.family, 1) - 1
+            self._fam_bytes[kv.family] = self._fam_bytes.get(
+                kv.family, len(kv.value)) - len(kv.value)
+        return kv
 
     # ------------------------------------------------------------------
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
-        conn = _Conn(writer)
+        conn = _Conn(writer, self.stats)
         self._conns.add(conn)
         fr = FrameReader(reader)
         try:
@@ -244,9 +351,16 @@ class StoreServer:
         fn = getattr(self, f"_op_{op}", None)
         if fn is None:
             return {"id": rid, "ok": False, "error": f"unknown op {op!r}"}
+        key = m.get("key") or m.get("prefix") or m.get("queue")
+        t0 = time.perf_counter()
         out = await fn(conn, m)
         if out is DEFER:
+            # a parked pull is a wait, not an op — recording its setup
+            # time would drown the real dequeue-path latency
             return None
+        self.stats.op_seconds.observe(
+            op, self._family(key) if key else "none",
+            value=time.perf_counter() - t0)
         if out is None:
             out = {}
         out.setdefault("id", rid)
@@ -260,7 +374,7 @@ class StoreServer:
         if lease is not None and lease not in self._leases:
             return {"ok": False, "error": "lease not found",
                     "code": "lease_not_found"}
-        self._kv[key] = _KeyVal(value, lease)
+        self._kv_set(key, value, lease)
         if lease is not None:
             self._leases[lease].keys.add(key)
         await self._notify_watchers(key, value)
@@ -287,7 +401,7 @@ class StoreServer:
 
     async def _op_delete(self, conn, m):
         key = m["key"]
-        kv = self._kv.pop(key, None)
+        kv = self._kv_del(key)
         if kv is not None:
             if kv.lease in self._leases:
                 self._leases[kv.lease].keys.discard(key)
@@ -297,11 +411,15 @@ class StoreServer:
     async def _notify_watchers(self, key: str, value: Optional[bytes]) -> None:
         # detached delivery: the put/delete must not block on any watcher's
         # socket; per-connection order is preserved by the outbox pump
+        fanned = 0
         for conn, wid, prefix in list(self._watchers.values()):
             if key.startswith(prefix):
+                fanned += 1
                 conn.push_nowait({"push": "watch", "watch_id": wid,
                                   "key": key, "value": value,
                                   "deleted": value is None})
+        if fanned:
+            self.stats.watch_fanout.inc(amount=fanned)
 
     # -- leases ----------------------------------------------------------
     async def _op_lease_grant(self, conn, m):
@@ -429,6 +547,43 @@ class StoreServer:
     # -- misc -------------------------------------------------------------
     async def _op_ping(self, conn, m):
         return {"pong": True}
+
+    # -- self-observability ------------------------------------------------
+    def _refresh_gauges(self) -> None:
+        s = self.stats
+        s.watches.set(value=len(self._watchers))
+        s.leases.set(value=len(self._leases))
+        s.conns.set(value=len(self._conns))
+        for fam, n in self._fam_keys.items():
+            s.keys.set(fam, value=n)
+            s.bytes.set(fam, value=self._fam_bytes.get(fam, 0))
+        depths: Dict[str, int] = {}
+        for qname, q in self._queues.items():
+            fam = self._family(qname)
+            depths[fam] = depths.get(fam, 0) + len(q)
+        for fam, d in depths.items():
+            s.queue_depth.set(fam, value=d)
+
+    async def _publish_stats(self) -> None:
+        """Refresh the self-telemetry dump under :data:`SELF_STAGE_KEY` —
+        a direct write into our own KV (with ordinary watch fan-out), so
+        the stage-metrics merge path picks the store up like any worker.
+        The key dies with the process; a restarted store republishes
+        within one interval."""
+        while True:
+            await asyncio.sleep(self._stats_interval)
+            try:
+                self._refresh_gauges()
+                payload = json.dumps({
+                    "component": "store",
+                    "metrics": self.stats.registry.state_dump(),
+                }).encode()
+                self._kv_set(SELF_STAGE_KEY, payload, None)
+                await self._notify_watchers(SELF_STAGE_KEY, payload)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("store self-metrics publish failed")
 
 
 # ----------------------------------------------------------------------

@@ -36,6 +36,9 @@ _DOCS = {
     "spec": "docs/speculative.md",
     "engine": "docs/observability.md",
     "tracing": "docs/observability.md",
+    "metrics": "docs/observability.md",
+    "store": "docs/observability.md",
+    "fleet": "docs/observability.md",
     "logging": "docs/observability.md",
     "slo": "docs/observability.md",
     "roofline": "docs/observability.md",
@@ -150,6 +153,21 @@ _ALL: List[Knob] = [
        "request span tracing (0 disables recording entirely)"),
     _k("DYN_TRACE_BUFFER", "int", "4096", "tracing",
        "per-process span ring-buffer capacity"),
+    _k("DYN_TRACE_SAMPLE", "float", "1.0", "tracing",
+       "trace-id-consistent head-sampling fraction exported to the store "
+       "span sink; error/deadline/breaker traces are always kept"),
+    # ------------------------------------------------------------- metrics
+    _k("DYN_METRICS_PUSH_INTERVAL", "float", "0", "metrics",
+       "min seconds between a worker's stage-metrics store writes "
+       "(0 = every metrics-loop beat); writes are delta-coalesced either "
+       "way"),
+    _k("DYN_METRICS_FULL_EVERY", "int", "10", "metrics",
+       "stage-metrics pushes per full snapshot (the rest ship only "
+       "changed metrics)"),
+    # --------------------------------------------------------------- store
+    _k("DYN_STORE_METRICS_INTERVAL", "float", "2.0", "store",
+       "seconds between the store server's self-telemetry dumps into its "
+       "own KV (0 = record but never publish)"),
     _k("DYN_LOG", "str", "info", "logging",
        "root log level, with per-target overrides "
        "('info,dynamo_tpu.runtime=debug')"),
@@ -257,6 +275,35 @@ _PLANNER = [
 _ALL.extend(
     _k(f"DYN_PLANNER_{flag}", typ, default, "planner", desc, derived=True)
     for flag, typ, default, desc in _PLANNER)
+
+# The fleet-soak rig (scripts/fleet_soak.py) resolves its flags through
+# the same dynconfig layering as DYN_FLEET_SOAK_<FLAG>.
+_FLEET_SOAK = [
+    ("WORKERS", "int", "600", "final synthetic-worker count of the ramp"),
+    ("STEPS", "int", "4", "ramp steps (worker counts spaced evenly up to "
+                          "--workers)"),
+    ("STEP_DURATION", "float", "8.0", "measured seconds per ramp step"),
+    ("BEAT_INTERVAL", "float", "2.0", "synthetic worker metrics/span "
+                                      "beat period"),
+    ("BEACON_INTERVAL", "float", "0.5", "seconds between fan-out beacon "
+                                        "puts"),
+    ("SPANS_PER_BEAT", "int", "4", "spans each synthetic worker emits "
+                                   "per beat"),
+    ("TRACE_SAMPLE", "float", "0.01", "DYN_TRACE_SAMPLE armed fleet-wide "
+                                      "for the soak"),
+    ("TRAFFIC_RPS", "float", "4.0", "real replayed-traffic rate through "
+                                    "router+frontend (0 = store-only "
+                                    "soak, no serving procs)"),
+    ("REAL_WORKERS", "int", "2", "echo workers actually serving the "
+                                 "replayed traffic"),
+    ("KNEE_MULT", "float", "4.0", "saturation-knee threshold: first step "
+                                  "whose store op p99 exceeds this "
+                                  "multiple of the first step's"),
+    ("OUT", "str", "bench_points/fleet_soak.json", "artifact path"),
+]
+_ALL.extend(
+    _k(f"DYN_FLEET_SOAK_{flag}", typ, default, "fleet", desc, derived=True)
+    for flag, typ, default, desc in _FLEET_SOAK)
 
 KNOBS: Dict[str, Knob] = {k.name: k for k in _ALL}
 if len(KNOBS) != len(_ALL):

@@ -191,6 +191,40 @@ class Registry:
         return {m.name: m.state() for m in self._metrics}
 
 
+def diff_states(base: Dict[str, Dict], cur: Dict[str, Dict],
+                ignore: Sequence[str] = ()) -> Dict[str, Dict]:
+    """The metrics of ``cur`` whose state changed vs ``base`` — the
+    coalesced **delta batch** a worker publishes between full snapshots.
+
+    Granularity is the whole metric (a changed metric ships all its
+    series), so applying a delta onto the full image it was diffed
+    against is a plain dict overlay — no per-series merge semantics to
+    get wrong across process restarts. ``ignore`` names metrics excluded
+    from change detection (the publisher's own push counters would
+    otherwise make every interval a delta)."""
+    skip = set(ignore)
+    return {name: st for name, st in cur.items()
+            if name not in skip and base.get(name) != st}
+
+
+def hist_quantile(buckets, counts, total, q: float) -> Optional[float]:
+    """Bucket upper edge covering quantile ``q`` of a state-dump
+    histogram (conservative: the true value is <= the returned edge).
+    ``inf`` when the quantile falls in the overflow bucket, ``None`` on
+    an empty histogram. The shared bucket-walk for every consumer of
+    ``state_dump()`` histograms (dyntop's store line, the fleet-soak
+    scaling curve)."""
+    if not total:
+        return None
+    target = q * total
+    cum = 0
+    for edge, c in zip(buckets or (), counts or ()):
+        cum += c
+        if cum >= target:
+            return float(edge)
+    return float("inf")
+
+
 # ---------------------------------------------------------------------------
 # cross-process merge + render of state dumps
 # ---------------------------------------------------------------------------
@@ -386,6 +420,25 @@ class StageMetrics:
             "dyn_admission_queue_depth",
             "In-flight requests currently held by the admission "
             "controller", ())
+        # fleet-safe telemetry pipelines (utils/tracing.py head sampling +
+        # the span sink's bounded retain-on-outage buffer, and the stage
+        # publisher's delta batching): the pressure-relief valves must be
+        # as observable as the planes they protect
+        self.spans_sampled_out = r.counter(
+            "dyn_spans_sampled_out_total",
+            "Finished spans withheld from the store sink by trace-id "
+            "head sampling (DYN_TRACE_SAMPLE); error traces are never "
+            "sampled away", ())
+        self.spans_dropped = r.counter(
+            "dyn_spans_dropped_total",
+            "Spans evicted from the span sink's bounded retain-on-outage "
+            "buffer (oldest first) — nonzero means a store outage "
+            "outlasted the buffer", ())
+        self.metrics_pushes = r.counter(
+            "dyn_metrics_pushes_total",
+            "Stage-metrics publishes by kind: full snapshot, coalesced "
+            "delta, or skipped (nothing changed — no store write)",
+            ("kind",))   # full|delta|skipped
         self.stage_service = r.histogram(
             "dyn_stage_service_seconds",
             "Observed per-item service time of a bounded stage (the "

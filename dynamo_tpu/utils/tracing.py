@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import hashlib
 import json
 import logging
 import os
@@ -43,7 +44,7 @@ import time
 import uuid
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 log = logging.getLogger("dynamo_tpu.tracing")
 
@@ -114,6 +115,48 @@ class Span:
 
 def _new_span_id() -> str:
     return uuid.uuid4().hex[:16]
+
+
+# ---------------------------------------------------------------------------
+# head sampling (fleet-scale pressure relief for the store span sink)
+# ---------------------------------------------------------------------------
+def sample_rate() -> float:
+    """``DYN_TRACE_SAMPLE``: fraction of traces exported to the store
+    sink (1.0 = everything, the default). Clamped to [0, 1]; malformed
+    values read as 1.0 — misconfiguration must not silence tracing."""
+    raw = os.environ.get("DYN_TRACE_SAMPLE", "")
+    if not raw:
+        return 1.0
+    try:
+        return min(max(float(raw), 0.0), 1.0)
+    except ValueError:
+        log.warning("ignoring malformed DYN_TRACE_SAMPLE=%r", raw)
+        return 1.0
+
+
+def trace_sampled(trace_id: str, rate: float) -> bool:
+    """Trace-id-consistent head-sampling decision: a deterministic hash of
+    the trace id (NOT Python's randomized ``hash``), so every process a
+    request touches makes the SAME keep/drop call with no coordination —
+    a sampled trace keeps all its spans, an unsampled one keeps none."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    h = int.from_bytes(
+        hashlib.sha1(trace_id.encode("utf-8", "replace")).digest()[:8],
+        "big")
+    return h < rate * 2.0 ** 64
+
+
+def force_keep(span: "Span") -> bool:
+    """Spans head sampling must NEVER drop: anything that finished in a
+    non-ok status (errors, deadline expiries, breaker-driven failovers —
+    all recorded as ``status="error"``) and fault-injection markers. The
+    whole surrounding trace is then retained best-effort (see
+    :class:`StoreSpanSink`)."""
+    return (span.status != "ok" or span.name.startswith("fault:")
+            or bool(span.attrs.get("force_trace")))
 
 
 class _SpanScope:
@@ -367,28 +410,50 @@ class StoreSpanSink:
     """Batches finished spans and writes them to the store under
     ``traces/{trace_id}/{span_id}``, bound to a fresh no-keepalive TTL lease
     per flush — traces expire after ``ttl`` seconds instead of accumulating,
-    and survive the producing worker's death until then."""
+    and survive the producing worker's death until then.
+
+    Fleet-safe: ``sample`` (default ``DYN_TRACE_SAMPLE``) applies
+    trace-id-consistent **head sampling** to what reaches the store —
+    at 1000 workers an unsampled span plane is a write-rate DDoS on the
+    coordination store. Error/deadline/breaker spans (:func:`force_keep`)
+    are exported regardless, and force-retain the rest of their trace:
+    spans of that trace still in the local ring are retro-enqueued and
+    later spans of it are kept, so ``GET /v1/traces/{id}`` shows the whole
+    picture for every failed request. Sampled-out spans stay in the local
+    ring (``dyn_spans_sampled_out_total`` counts them); the retain-on-
+    outage buffer is bounded drop-oldest with ``dyn_spans_dropped_total``
+    counting evictions."""
 
     def __init__(self, store, ttl: float = 600.0,
                  flush_interval: float = 0.25, max_batch: int = 256,
-                 max_pending: int = 8192):
+                 max_pending: int = 8192, sample: Optional[float] = None):
         self.store = store
         self.ttl = ttl
         self.flush_interval = flush_interval
         self.max_batch = max_batch
+        self.sample = sample_rate() if sample is None else \
+            min(max(float(sample), 0.0), 1.0)
         # bounded, drop-oldest: a store outage must not grow memory forever
         self._pending: deque = deque(maxlen=max_pending)
+        # traces force-retained by an error span (bounded FIFO of ids)
+        self._forced: Set[str] = set()
+        self._forced_order: deque = deque()
         self._task = None
         self._tracer: Optional[Tracer] = None
         self._loop = None
         self._lease: Optional[int] = None
         self._lease_born = 0.0
 
+    FORCED_LIMIT = 1024   # remembered force-retained trace ids
+
     async def start(self, tracer: Optional[Tracer] = None) -> "StoreSpanSink":
         import asyncio
 
         self._loop = asyncio.get_running_loop()
-        self._tracer = tracer or get_tracer()
+        # NOT `tracer or get_tracer()`: Tracer defines __len__, so a
+        # tracer with zero recorded spans is falsy and would silently
+        # bind the sink to the process-global tracer instead
+        self._tracer = tracer if tracer is not None else get_tracer()
         self._tracer.add_sink(self._on_finish)
         self._task = asyncio.create_task(self._flush_loop())
         return self
@@ -417,6 +482,38 @@ class StoreSpanSink:
     def _on_finish(self, span: Span) -> None:
         # may fire on the engine thread: deque.append is atomic, the flush
         # loop drains from the asyncio side
+        from .prometheus import stage_metrics
+
+        if not trace_sampled(span.trace_id, self.sample) \
+                and span.trace_id not in self._forced:
+            if not force_keep(span):
+                stage_metrics().spans_sampled_out.inc()
+                return
+            # an error span in an unsampled trace: retain the WHOLE trace
+            # from here on, and retro-enqueue what the local ring still
+            # holds of it (store writes are keyed by span id — re-sends
+            # after a later error are idempotent overwrites, not dupes)
+            self._force_trace(span.trace_id, exclude=span.span_id)
+        self._enqueue(span)
+
+    def _force_trace(self, trace_id: str, exclude: str = "") -> None:
+        self._forced.add(trace_id)
+        self._forced_order.append(trace_id)
+        while len(self._forced_order) > self.FORCED_LIMIT:
+            self._forced.discard(self._forced_order.popleft())
+        if self._tracer is not None:
+            for prior in self._tracer.spans_for(trace_id):
+                if prior.span_id != exclude:
+                    self._enqueue(prior)
+
+    def _enqueue(self, span: Span) -> None:
+        from .prometheus import stage_metrics
+
+        if self._pending.maxlen is not None \
+                and len(self._pending) >= self._pending.maxlen:
+            # deque drop-oldest is about to evict: a store outage has
+            # outlasted the retain buffer — count the loss
+            stage_metrics().spans_dropped.inc()
         self._pending.append(span)
 
     async def flush(self) -> int:
@@ -449,9 +546,22 @@ class StoreSpanSink:
                 written += 1
         except BaseException as e:
             # transient store failure: put the unwritten tail back at the
-            # front (original order) so the next flush retries it — the
-            # deque's drop-oldest bound still caps memory during an outage
-            self._pending.extendleft(reversed(batch[written:]))
+            # front (original order) so the next flush retries it. If new
+            # spans refilled the deque meanwhile, extendleft on a full
+            # deque would silently evict the NEWEST from the right —
+            # inverted policy, uncounted loss. Keep drop-oldest instead:
+            # shed the head of the tail (the oldest spans overall) and
+            # count them.
+            from .prometheus import stage_metrics
+
+            tail = batch[written:]
+            if self._pending.maxlen is not None:
+                overflow = len(tail) - (self._pending.maxlen
+                                        - len(self._pending))
+                if overflow > 0:
+                    stage_metrics().spans_dropped.inc(amount=overflow)
+                    tail = tail[overflow:]
+            self._pending.extendleft(reversed(tail))
             # a restarted (empty) store no longer knows our no-keepalive
             # lease: drop it so the next flush re-grants instead of
             # stalling spans until the ttl/2 rotation

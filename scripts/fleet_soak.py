@@ -1,0 +1,691 @@
+#!/usr/bin/env python
+"""Fleet soak: ramp a synthetic worker fleet against ONE store and record
+where the coordination plane saturates.
+
+Everything in this system converges on one store process — leases,
+endpoint registrations, watch fan-out, metrics dumps, the span sink,
+router reads, planner scrapes. Before that store can be sharded we need
+to SEE it saturate. This rig ramps a synthetic fleet (default 600
+workers, in steps) where each synthetic worker is a *real* store session:
+
+- its own TCP connection, lease (with keepalives) and endpoint
+  registration — the discovery/liveness load of a worker, without an
+  engine;
+- a delta-batched :class:`StagePublisher` + ForwardPassMetrics refresh
+  per beat — the metrics-plane load;
+- a head-sampled :class:`StoreSpanSink` emitting spans per beat (a
+  configurable fraction finish as errors, which sampling must never
+  drop) — the span-plane load;
+- a prefix watch on the fan-out beacon the driver puts every half
+  second — one put must fan out to the WHOLE fleet, and each worker
+  records the delivery lag.
+
+Riding alongside at every step: the planner's signal collector and the
+dyntop/SLO snapshotter (their scrape latency over N workers is part of
+the curve), and — unless ``--traffic-rps 0`` — real replayed traffic
+through store → kv-router process → HTTP frontend → echo workers, with
+client-measured TTFT and forced-deadline requests whose error traces
+must stay retrievable via ``GET /v1/traces/{id}`` at any sample rate.
+
+Per step the store's own telemetry (``dyn_store_op_seconds{op,family}``
+et al., PR 9) is differenced into the scaling curve: store op p99 by
+keyspace family, watch fan-out lag p50/p99, span/metric write+drop
+rates, router TTFT. The curve lands in ``bench_points/fleet_soak.json``
+together with the detected **saturation knee** (first step whose store
+op p99 exceeds ``--knee-mult``× the first step's, above a noise floor)
+— the worklist the store-sharding refactor burns down.
+
+    JAX_PLATFORMS=cpu python scripts/fleet_soak.py            # full ramp
+    ... --workers 8 --steps 2 --step-duration 2 --traffic-rps 0   # mini
+
+CPU-only, no model weights. The pytest mini run is tier-1; the full ramp
+is marked ``chaos`` + ``slow``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from overload_soak import _percentile as _soak_percentile  # noqa: E402
+
+log = logging.getLogger("fleet_soak")
+
+# NOT "fleet": endpoint keys are "{ns}/components/..." and a namespace
+# of "fleet" would put them under the registered "fleet/" beacon prefix,
+# classifying the whole discovery plane as family=fleet-soak in the very
+# per-family curve this rig exists to record
+NAMESPACE = "soak"
+FLEET_COMPONENT = "fleet"
+
+
+def fleet_beacon_key(namespace: str) -> str:
+    """The fan-out beacon key (keyspace family ``fleet-soak``)."""
+    return f"fleet/{namespace}/beacon"
+
+
+def fleet_beacon_prefix(namespace: str) -> str:
+    return f"fleet/{namespace}/"
+
+
+def _percentile(values: List[float], q: float) -> Optional[float]:
+    """overload_soak's percentile, with ``None`` (JSON null) for an empty
+    series — an absent signal must not masquerade as a 0.0 latency."""
+    if not values:
+        return None
+    return _soak_percentile(values, q)
+
+
+# ---------------------------------------------------------------------------
+# synthetic worker: a real store session without an engine
+# ---------------------------------------------------------------------------
+class SyntheticWorker:
+    """One synthetic fleet member; see the module docstring for what it
+    loads the store with. All loops are owned tasks, stopped in
+    :meth:`stop`."""
+
+    def __init__(self, idx: int, host: str, port: int, namespace: str,
+                 lag_sink: List[float], beat_interval: float = 2.0,
+                 spans_per_beat: int = 4, error_every: int = 25):
+        self.idx = idx
+        self.host, self.port = host, port
+        self.namespace = namespace
+        self.lag_sink = lag_sink
+        self.beat_interval = beat_interval
+        self.spans_per_beat = spans_per_beat
+        self.error_every = error_every
+        self.store = None
+        self.lease: Optional[int] = None
+        self.error_trace_ids: List[str] = []
+        self.spans_emitted = 0
+        self._tasks: List[asyncio.Task] = []
+        self._sink = None
+        self._span_n = 0
+
+    async def start(self) -> "SyntheticWorker":
+        from dynamo_tpu.llm.metrics_aggregator import (StagePublisher,
+                                                       metrics_key)
+        from dynamo_tpu.runtime.component import EndpointInfo, endpoint_key
+        from dynamo_tpu.runtime.store_client import StoreClient
+        from dynamo_tpu.utils import tracing
+        from dynamo_tpu.utils.prometheus import Registry
+
+        self.store = await StoreClient(self.host, self.port).connect()
+        self.lease = await self.store.lease_grant(ttl=8.0)
+        await self.store.put(
+            endpoint_key(self.namespace, FLEET_COMPONENT, "generate",
+                         self.lease),
+            EndpointInfo("127.0.0.1", 0, "generate", self.lease,
+                         self.lease).to_bytes(),
+            lease=self.lease)
+        # a private registry with real churn so delta batches carry signal
+        r = Registry()
+        self._beats = r.counter("dyn_fleet_heartbeats_total",
+                                "synthetic worker beats", ())
+        self._beat_s = r.histogram("dyn_fleet_beat_seconds",
+                                   "synthetic beat duration", ())
+        self._registry = r
+        self._metrics_key = metrics_key(self.namespace, FLEET_COMPONENT,
+                                        self.lease)
+        self.publisher = StagePublisher(
+            self.store, self.namespace, FLEET_COMPONENT, self.lease,
+            self.lease, dump_fn=r.state_dump)
+        self.tracer = tracing.Tracer(component="fleet", capacity=64)
+        self._sink = await tracing.StoreSpanSink(
+            self.store, flush_interval=1.0).start(tracer=self.tracer)
+        await self.store.watch_prefix(
+            fleet_beacon_prefix(self.namespace), self._on_beacon)
+        self._tasks.append(asyncio.create_task(self._beat_loop()))
+        return self
+
+    async def _on_beacon(self, key: str, value: Optional[bytes],
+                         deleted: bool) -> None:
+        if deleted or value is None:
+            return
+        try:
+            t_put = json.loads(value.decode())["t"]
+        except (ValueError, KeyError):
+            return   # foreign key under the prefix: not a beacon
+        self.lag_sink.append(time.monotonic() - t_put)
+
+    def _emit_spans(self) -> None:
+        now = time.time()
+        for _ in range(self.spans_per_beat):
+            self._span_n += 1
+            # first span of every worker is an error (so even a short
+            # mini ramp exercises forced retention), then every Nth
+            is_err = self.error_every \
+                and self._span_n % self.error_every == 1
+            tid = f"synt-{self.idx}-{self._span_n}"
+            self.tracer.record("fleet.op", now - 0.002, now, trace_id=tid,
+                               status="error" if is_err else "ok")
+            self.spans_emitted += 1
+            if is_err:
+                self.error_trace_ids.append(tid)
+
+    async def _beat_loop(self) -> None:
+        from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+        from dynamo_tpu.runtime.store_client import StoreError
+
+        while True:
+            t0 = time.monotonic()
+            try:
+                self._beats.inc()
+                fpm = ForwardPassMetrics(
+                    request_active_slots=(self.idx + self._span_n) % 4,
+                    request_total_slots=4)
+                await self.store.put(
+                    self._metrics_key,
+                    json.dumps(fpm.to_dict()).encode(), lease=self.lease)
+                await self.publisher.publish()
+                self._emit_spans()
+                self._beat_s.observe(value=time.monotonic() - t0)
+            except asyncio.CancelledError:
+                raise
+            except StoreError:
+                log.debug("worker %d beat skipped (store unreachable)",
+                          self.idx)
+            except Exception:
+                log.exception("worker %d beat failed", self.idx)
+            await asyncio.sleep(self.beat_interval)
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        try:
+            if self._sink is not None:
+                await asyncio.wait_for(self._sink.stop(), 5.0)
+        except (Exception, asyncio.TimeoutError):
+            log.debug("worker %d sink drain failed", self.idx)
+        try:
+            await self.store.close()
+        except Exception:
+            log.debug("worker %d store close failed", self.idx)
+
+
+# ---------------------------------------------------------------------------
+# store-telemetry differencing (per-step scaling-curve rows)
+# ---------------------------------------------------------------------------
+async def read_store_dump(store) -> Optional[Dict]:
+    from dynamo_tpu.llm.metrics_aggregator import STORE_STAGE_PREFIX
+
+    for _key, value in await store.get_prefix(STORE_STAGE_PREFIX):
+        try:
+            return json.loads(value.decode())["metrics"]
+        except (ValueError, KeyError):
+            log.warning("malformed store self-dump")
+    return None
+
+
+def _json_p99(p99: Optional[float], buckets) -> Optional[float]:
+    """JSON-safe p99: an overflow-bucket quantile clamps to the largest
+    finite edge (read as ">= that edge") — ``json.dump`` would otherwise
+    emit the non-standard ``Infinity`` literal and break strict parsers
+    at exactly the saturated data points the rig targets."""
+    if p99 == float("inf"):
+        return float(buckets[-1]) if buckets else None
+    return p99
+
+
+def diff_op_families(start: Optional[Dict], end: Optional[Dict]
+                     ) -> Tuple[Dict[str, Dict[str, Any]], Dict[str, Any]]:
+    """``(families, overall)`` op count + p99 over ONE step, from the
+    bucket deltas of two ``dyn_store_op_seconds`` snapshots — the one
+    series walk serves both the per-family rows and the step's overall
+    p99."""
+    from dynamo_tpu.utils.prometheus import hist_quantile
+
+    if not end or "dyn_store_op_seconds" not in end:
+        return {}, {"ops": 0, "p99_s": None}
+    st_end = end["dyn_store_op_seconds"]
+    st_start = (start or {}).get("dyn_store_op_seconds") or {}
+    start_series = st_start.get("series") or {}
+    buckets = st_end.get("buckets")
+    fams: Dict[str, Dict[str, Any]] = {}
+    all_counts: Optional[List[float]] = None
+    for skey, val in (st_end.get("series") or {}).items():
+        parts = skey.split("\x1f")
+        fam = parts[1] if len(parts) > 1 else "?"
+        base = start_series.get(skey) or {"counts": [0] * len(
+            val.get("counts") or []), "total": 0}
+        counts = [a - b for a, b in zip(val.get("counts") or [],
+                                        base.get("counts") or [])]
+        agg = fams.setdefault(fam, {"ops": 0, "counts": None})
+        agg["ops"] += val.get("total", 0) - base.get("total", 0)
+        if agg["counts"] is None:
+            agg["counts"] = counts
+        else:
+            agg["counts"] = [a + b for a, b in zip(agg["counts"], counts)]
+        all_counts = counts if all_counts is None else [
+            a + b for a, b in zip(all_counts, counts)]
+    total_ops = sum(a["ops"] for a in fams.values())
+    overall = {"ops": total_ops,
+               "p99_s": _json_p99(
+                   hist_quantile(buckets, all_counts or [],
+                                 total_ops, 0.99), buckets)}
+    return ({fam: {"ops": a["ops"],
+                   "p99_s": _json_p99(
+                       hist_quantile(buckets, a["counts"],
+                                     a["ops"], 0.99), buckets)}
+             for fam, a in fams.items() if a["ops"] > 0},
+            overall)
+
+
+def _counter_total(dump: Optional[Dict], name: str) -> float:
+    st = (dump or {}).get(name) or {}
+    return float(sum((st.get("series") or {}).values()) or 0.0)
+
+
+def find_knee(steps: List[Dict], knee_mult: float,
+              floor_s: float = 0.002) -> Dict[str, Any]:
+    """First step whose overall store-op p99 exceeds ``knee_mult`` x the
+    first step's (and an absolute noise floor) — the saturation knee."""
+    curve = [(s["workers"], (s["store"].get("p99_s") or 0.0))
+             for s in steps if s.get("store")]
+    if not curve:
+        return {"workers": None, "note": "no store telemetry"}
+    baseline = curve[0][1]
+    for workers, p99 in curve:
+        if p99 >= max(knee_mult * baseline, floor_s):
+            return {"workers": workers, "p99_s": round(p99, 6),
+                    "baseline_p99_s": round(baseline, 6),
+                    "mult": knee_mult}
+    return {"workers": None, "baseline_p99_s": round(baseline, 6),
+            "note": f"no knee <= {curve[-1][0]} workers"}
+
+
+# ---------------------------------------------------------------------------
+# the ramp
+# ---------------------------------------------------------------------------
+async def run_soak(a, logdir: str) -> Dict[str, Any]:
+    from chaos_soak import Procs, _free_port
+
+    from dynamo_tpu.cli.dyntop import ClusterSnapshotter
+    from dynamo_tpu.planner.signals import SignalCollector
+    from dynamo_tpu.runtime.store_client import StoreClient
+    from dynamo_tpu.utils.prometheus import stage_metrics
+
+    os.environ["DYN_TRACE_SAMPLE"] = str(a.trace_sample)
+    os.environ["DYN_METRICS_PUSH_INTERVAL"] = "0"
+    os.environ["DYN_SLO_TTFT_P90"] = "0.5"
+    store_port = _free_port()
+    procs = Procs(logdir, store_port, namespace=NAMESPACE,
+                  worker_extra=["--echo-slots", "8", "--register-model"],
+                  env_extra={"DYN_TOKEN_ECHO_DELAY_MS": "10",
+                             "DYN_TRACE_SAMPLE": str(a.trace_sample)})
+    await asyncio.to_thread(procs.start_store)
+
+    svc = None
+    session = None
+    fleet: List[SyntheticWorker] = []
+    lag_sink: List[float] = []
+    ttfts: List[float] = []
+    error_req_ids: List[str] = []
+    traffic_stats = {"submitted": 0, "ok": 0, "failed": 0}
+    tasks: List[asyncio.Task] = []
+    pending: set = set()
+    steps_out: List[Dict[str, Any]] = []
+
+    store = await StoreClient("127.0.0.1", store_port).connect()
+
+    try:
+        base = None
+        if a.traffic_rps > 0:
+            import aiohttp
+
+            from dynamo_tpu.cli.http import run_http
+
+            for _ in range(a.real_workers):
+                await asyncio.to_thread(procs.start_worker)
+            # the kv-router as its own process: routed traffic crosses it
+            procs.workers["router"] = procs._spawn(
+                "router", "dynamo_tpu.cli.router",
+                "--store", f"127.0.0.1:{store_port}",
+                "--namespace", NAMESPACE,
+                "--worker-component", "backend")
+            await asyncio.to_thread(
+                procs._wait_log, procs.workers["router"][1],
+                "kv router serving", 30, procs.workers["router"][0])
+            http_args = argparse.Namespace(
+                store=f"127.0.0.1:{store_port}", host="127.0.0.1", port=0,
+                router_component="router", namespace=NAMESPACE)
+            svc = await run_http(http_args)
+            base = f"http://127.0.0.1:{svc.port}"
+            session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=0))
+            for _ in range(100):
+                async with session.get(f"{base}/v1/models") as r:
+                    d = await r.json()
+                if any(m["id"] == "echo" for m in d.get("data", [])):
+                    break
+                await asyncio.sleep(0.2)
+            else:
+                raise RuntimeError("echo model never appeared")
+
+        # observers: the planner signal collector and the dyntop/SLO
+        # snapshotter scrape the whole fleet; their latency is data
+        collector = SignalCollector(store, NAMESPACE,
+                                    {"fleet": FLEET_COMPONENT})
+        snapper = ClusterSnapshotter(store, NAMESPACE,
+                                     ["backend", FLEET_COMPONENT])
+        observer_lat = {"planner": [], "snapshot": []}
+
+        async def observer_loop():
+            while True:
+                for name, coro in (("planner", collector.collect),
+                                   ("snapshot", snapper.collect)):
+                    t0 = time.monotonic()
+                    try:
+                        await coro()
+                        observer_lat[name].append(
+                            time.monotonic() - t0)
+                    except Exception:
+                        log.debug("%s observer tick failed", name,
+                                  exc_info=True)
+                await asyncio.sleep(2.0)
+
+        beacon_seq = {"n": 0}
+
+        async def beacon_loop():
+            while True:
+                beacon_seq["n"] += 1
+                try:
+                    await store.put(
+                        fleet_beacon_key(NAMESPACE),
+                        json.dumps({"seq": beacon_seq["n"],
+                                    "t": time.monotonic()}).encode())
+                except Exception:
+                    log.debug("beacon put failed", exc_info=True)
+                await asyncio.sleep(a.beacon_interval)
+
+        async def one_request(error: bool = False) -> None:
+            traffic_stats["submitted"] += 1
+            body = {"model": "echo", "prompt": "fleet soak replay",
+                    "max_tokens": 64 if error else 8, "stream": True}
+            headers = {"x-request-timeout": "0.05"} if error \
+                else {"x-request-timeout": "10"}
+            t0 = time.monotonic()
+            try:
+                async def call():
+                    async with session.post(f"{base}/v1/completions",
+                                            json=body,
+                                            headers=headers) as r:
+                        rid = r.headers.get("x-request-id", "")
+                        async for _chunk in r.content.iter_any():
+                            if not error:
+                                ttfts.append(time.monotonic() - t0)
+                            break
+                        async for _chunk in r.content.iter_any():
+                            pass
+                        return r.status, rid
+                status, rid = await asyncio.wait_for(call(), 15.0)
+                if error:
+                    if rid:
+                        error_req_ids.append(rid)
+                elif status == 200:
+                    traffic_stats["ok"] += 1
+                else:
+                    traffic_stats["failed"] += 1
+            except asyncio.TimeoutError:
+                traffic_stats["failed"] += 1
+            except Exception:  # noqa: BLE001 - transport error == failed
+                traffic_stats["failed"] += 1
+
+        async def traffic_loop():
+            i = 0
+            while True:
+                i += 1
+                t = asyncio.create_task(one_request(error=(i % 20 == 0)))
+                pending.add(t)
+                t.add_done_callback(pending.discard)
+                await asyncio.sleep(1.0 / a.traffic_rps)
+
+        tasks.append(asyncio.create_task(observer_loop()))
+        tasks.append(asyncio.create_task(beacon_loop()))
+        if base is not None:
+            tasks.append(asyncio.create_task(traffic_loop()))
+
+        stage = stage_metrics()
+
+        def pipeline_counters() -> Dict[str, float]:
+            return {
+                "pushes_full": stage.metrics_pushes.get("full"),
+                "pushes_delta": stage.metrics_pushes.get("delta"),
+                "pushes_skipped": stage.metrics_pushes.get("skipped"),
+                "spans_sampled_out": stage.spans_sampled_out.get(),
+                "spans_dropped": stage.spans_dropped.get(),
+            }
+
+        targets = [max(1, round(a.workers * (i + 1) / a.steps))
+                   for i in range(a.steps)]
+        print(f"fleet soak: ramp {targets} synthetic workers, "
+              f"{a.step_duration}s/step, trace_sample={a.trace_sample}, "
+              f"logs {logdir}", flush=True)
+
+        for target in targets:
+            # spawn up to the target in connect bursts of 50
+            while len(fleet) < target:
+                burst = [SyntheticWorker(
+                    len(fleet) + j, "127.0.0.1", store_port, NAMESPACE,
+                    lag_sink, beat_interval=a.beat_interval,
+                    spans_per_beat=a.spans_per_beat)
+                    for j in range(min(50, target - len(fleet)))]
+                started = await asyncio.gather(
+                    *(w.start() for w in burst), return_exceptions=True)
+                for w, r in zip(burst, started):
+                    if isinstance(r, BaseException):
+                        log.warning("synthetic worker failed to start: "
+                                    "%r", r)
+                    else:
+                        fleet.append(w)
+                await asyncio.sleep(0.05)
+            await asyncio.sleep(1.0)   # settle: first beats land
+
+            dump0 = await read_store_dump(store)
+            pipe0 = pipeline_counters()
+            lag_mark = len(lag_sink)
+            ttft_mark = len(ttfts)
+            obs_marks = {k: len(v) for k, v in observer_lat.items()}
+            spans_mark = sum(w.spans_emitted for w in fleet)
+            t_step = time.monotonic()
+            await asyncio.sleep(a.step_duration)
+            dt = time.monotonic() - t_step
+            dump1 = await read_store_dump(store)
+            pipe1 = pipeline_counters()
+
+            fams, overall = diff_op_families(dump0, dump1)
+            total_ops = overall["ops"]
+            overall_p99 = overall["p99_s"]
+            lags = lag_sink[lag_mark:]
+            step_ttfts = ttfts[ttft_mark:]
+            traces_fam = fams.get("traces") or {}
+            row = {
+                "workers": len(fleet),
+                "duration_s": round(dt, 2),
+                "store": {
+                    "ops": total_ops,
+                    "op_rate": round(total_ops / dt, 1),
+                    "p99_s": overall_p99,
+                    "families": fams,
+                    "watches": _counter_total(dump1, "dyn_store_watches"),
+                    "leases": _counter_total(dump1, "dyn_store_leases"),
+                    "fanout_total": _counter_total(
+                        dump1, "dyn_store_watch_fanout_total"),
+                    "fanout_drops": _counter_total(
+                        dump1, "dyn_store_fanout_drops_total"),
+                },
+                "beacon_lag": {
+                    "events": len(lags),
+                    "p50_s": _percentile(lags, 0.50),
+                    "p99_s": _percentile(lags, 0.99),
+                },
+                "spans": {
+                    "emitted": sum(w.spans_emitted
+                                   for w in fleet) - spans_mark,
+                    "sampled_out": pipe1["spans_sampled_out"]
+                    - pipe0["spans_sampled_out"],
+                    "dropped": pipe1["spans_dropped"]
+                    - pipe0["spans_dropped"],
+                    "store_writes": traces_fam.get("ops", 0),
+                    "write_rate": round(
+                        traces_fam.get("ops", 0) / dt, 2),
+                },
+                "metrics": {
+                    k: pipe1[k] - pipe0[k]
+                    for k in ("pushes_full", "pushes_delta",
+                              "pushes_skipped")},
+                # per-step slices (like lags/ttfts/spans): cumulative
+                # history would let the fast early-step samples mask an
+                # observer that slowed down at fleet size
+                "observer": {
+                    "planner_collect_p50_s": _percentile(
+                        observer_lat["planner"][obs_marks["planner"]:],
+                        0.50),
+                    "snapshot_p50_s": _percentile(
+                        observer_lat["snapshot"][obs_marks["snapshot"]:],
+                        0.50),
+                },
+                "traffic": {
+                    "ttft_p50_s": _percentile(step_ttfts, 0.50),
+                    "ttft_p99_s": _percentile(step_ttfts, 0.99),
+                    "requests": len(step_ttfts),
+                },
+            }
+            steps_out.append(row)
+            print(f"step {len(fleet):>5} workers: "
+                  f"store {row['store']['op_rate']:.0f} op/s "
+                  f"p99={row['store']['p99_s']} "
+                  f"lag_p99={row['beacon_lag']['p99_s']} "
+                  f"span_writes/s={row['spans']['write_rate']}",
+                  flush=True)
+
+        # error-trace retrievability at the active sample rate
+        retr = {"checked": 0, "found": 0}
+        sample_ids = [tid for w in fleet[:200]
+                      for tid in w.error_trace_ids[:1]][:50]
+        from dynamo_tpu.utils.tracing import TRACE_STORE_PREFIX
+        for tid in sample_ids:
+            retr["checked"] += 1
+            if await store.get_prefix(f"{TRACE_STORE_PREFIX}{tid}/"):
+                retr["found"] += 1
+        http_retr = {"checked": 0, "found": 0}
+        if session is not None and base is not None:
+            # let the sinks flush the tail
+            await asyncio.sleep(1.5)
+            for rid in error_req_ids[-20:]:
+                http_retr["checked"] += 1
+                async with session.get(f"{base}/v1/traces/{rid}") as r:
+                    if r.status == 200:
+                        d = await r.json()
+                        if d.get("spans"):
+                            http_retr["found"] += 1
+
+        knee = find_knee(steps_out, a.knee_mult)
+        verdicts = {
+            "completed": len(steps_out) == a.steps,
+            "curve_non_empty": all(
+                s["store"]["ops"] > 0 and s["beacon_lag"]["events"] > 0
+                for s in steps_out),
+            "error_traces_retrievable": (
+                retr["checked"] == 0 or retr["found"] == retr["checked"]),
+            "http_error_traces": (
+                http_retr["checked"] == 0
+                or http_retr["found"] == http_retr["checked"]),
+        }
+        return {
+            "config": {k: getattr(a, k) for k in vars(a)},
+            "steps": steps_out,
+            "knee": knee,
+            "error_traces": retr,
+            "http_error_traces": http_retr,
+            "traffic": traffic_stats,
+            "verdicts": verdicts,
+        }
+    finally:
+        for t in tasks:
+            t.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        if pending:
+            # let in-flight replay requests reach a terminal state before
+            # the frontend goes away (half-written streams just log noise)
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*list(pending), return_exceptions=True),
+                    20.0)
+            except asyncio.TimeoutError:
+                for p in list(pending):
+                    p.cancel()
+        if fleet:
+            await asyncio.gather(*(w.stop() for w in fleet),
+                                 return_exceptions=True)
+        try:
+            if session is not None:
+                await session.close()
+            if svc is not None:
+                await svc.stop()
+        except Exception:
+            log.debug("frontend teardown failed", exc_info=True)
+        try:
+            await store.close()
+        except Exception:
+            log.debug("driver store close failed", exc_info=True)
+        procs.stop()
+
+
+def main(argv=None) -> int:
+    from dynamo_tpu.utils.dynconfig import EnvDefaultsParser
+
+    ap = EnvDefaultsParser(prog="fleet_soak")
+    ap.add_argument("--workers", type=int, default=600,
+                    help="final synthetic-worker count")
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--step-duration", type=float, default=8.0)
+    ap.add_argument("--beat-interval", type=float, default=2.0,
+                    help="synthetic worker metrics/span beat period")
+    ap.add_argument("--beacon-interval", type=float, default=0.5)
+    ap.add_argument("--spans-per-beat", type=int, default=4)
+    ap.add_argument("--trace-sample", type=float, default=0.01,
+                    help="DYN_TRACE_SAMPLE armed fleet-wide")
+    ap.add_argument("--traffic-rps", type=float, default=4.0,
+                    help="replayed traffic through router+frontend "
+                         "(0 = store-only soak)")
+    ap.add_argument("--real-workers", type=int, default=2,
+                    help="echo workers actually serving the traffic")
+    ap.add_argument("--knee-mult", type=float, default=4.0)
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "bench_points", "fleet_soak.json"))
+    a = ap.parse_args(argv)
+    logdir = tempfile.mkdtemp(prefix="fleet_soak_")
+    result = asyncio.run(run_soak(a, logdir))
+    os.makedirs(os.path.dirname(a.out), exist_ok=True)
+    with open(a.out, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+    print(json.dumps({"knee": result["knee"],
+                      "error_traces": result["error_traces"],
+                      "http_error_traces": result["http_error_traces"],
+                      "verdicts": result["verdicts"]},
+                     indent=2, sort_keys=True), flush=True)
+    print(f"artifact: {a.out}", flush=True)
+    failed = [k for k, ok in result["verdicts"].items() if not ok]
+    if failed:
+        print(f"FAIL: {failed}", flush=True)
+        return 1
+    print("PASS: ramp completed, curve recorded, error traces "
+          "retrievable", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,446 @@
+"""Fleet-scale telemetry (PR 9): store self-observability, span head
+sampling, metrics delta batching, and the fleet-soak rig.
+
+Covers the tentpole's four contracts:
+
+- trace-id-consistent head sampling — all spans of a sampled request kept
+  together, error traces NEVER sampled away (forced whole-trace
+  retention), bounded retain-on-outage buffer with a drop counter;
+- delta-batch publishing merges back to exactly the full per-metric dump
+  (stateless readers, stale deltas ignored);
+- the store classifies every registered keyspace family and publishes
+  its own telemetry on the ordinary stage-metrics merge path;
+- a mini fleet soak (tier-1) emits the artifact schema; the full
+  >=500-worker ramp is chaos+slow.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+class FakeStore:
+    """put/get_prefix/lease_grant enough for the publisher and span sink."""
+
+    def __init__(self, fail=False):
+        self.kv = {}
+        self.puts = []              # (key, value) log, every write
+        self.fail = fail
+        self._leases = 0
+
+    async def put(self, key, value, lease=None):
+        if self.fail:
+            raise ConnectionError("store down")
+        self.kv[key] = value
+        self.puts.append((key, value))
+
+    async def get_prefix(self, prefix):
+        return sorted((k, v) for k, v in self.kv.items()
+                      if k.startswith(prefix))
+
+    async def lease_grant(self, ttl=5.0, auto_keepalive=True):
+        if self.fail:
+            raise ConnectionError("store down")
+        self._leases += 1
+        return self._leases
+
+
+def _span_writes(store):
+    return [k for k, _ in store.puts if k.startswith("traces/")]
+
+
+# ---------------------------------------------------------------------------
+# head sampling
+# ---------------------------------------------------------------------------
+def test_trace_sampling_deterministic_and_clamped():
+    from dynamo_tpu.utils.tracing import trace_sampled
+
+    # deterministic: the same trace id always gets the same decision
+    for tid in ("req-1", "req-2", "abcdef"):
+        assert trace_sampled(tid, 0.5) == trace_sampled(tid, 0.5)
+    assert trace_sampled("anything", 1.0)
+    assert not trace_sampled("anything", 0.0)
+    # at rate r, roughly r of many ids survive
+    kept = sum(trace_sampled(f"t{i}", 0.1) for i in range(2000))
+    assert 100 < kept < 320
+
+
+async def test_sink_samples_out_whole_traces_but_keeps_errors():
+    from dynamo_tpu.utils import tracing
+    from dynamo_tpu.utils.prometheus import stage_metrics
+
+    store = FakeStore()
+    tracer = tracing.Tracer(component="t", capacity=64)
+    sink = await tracing.StoreSpanSink(store, flush_interval=0.02,
+                                       sample=0.0).start(tracer=tracer)
+    sampled0 = stage_metrics().spans_sampled_out.get()
+    try:
+        now = time.time()
+        # an all-ok trace at sample=0: nothing reaches the store
+        for i in range(3):
+            tracer.record(f"ok{i}", now, now + 0.01, trace_id="trace-ok")
+        await asyncio.sleep(0.1)
+        assert _span_writes(store) == []
+        assert stage_metrics().spans_sampled_out.get() - sampled0 == 3
+
+        # an error span forces its WHOLE trace through: the prior ring
+        # spans of that trace retro-flush, and later spans stay kept
+        tracer.record("step1", now, now + 0.01, trace_id="trace-err")
+        tracer.record("boom", now, now + 0.02, trace_id="trace-err",
+                      status="error")
+        tracer.record("after", now, now + 0.03, trace_id="trace-err")
+        await asyncio.sleep(0.1)
+        writes = _span_writes(store)
+        assert len(writes) == 3
+        assert all(k.startswith("traces/trace-err/") for k in writes)
+        # ... while unrelated unsampled traffic stays sampled out
+        tracer.record("ok9", now, now + 0.01, trace_id="trace-ok2")
+        await asyncio.sleep(0.06)
+        assert len(_span_writes(store)) == 3
+    finally:
+        await sink.stop()
+
+
+async def test_sink_sampled_trace_keeps_all_spans():
+    from dynamo_tpu.utils import tracing
+
+    store = FakeStore()
+    tracer = tracing.Tracer(component="t", capacity=64)
+    sink = await tracing.StoreSpanSink(store, flush_interval=0.02,
+                                       sample=1.0).start(tracer=tracer)
+    try:
+        now = time.time()
+        for i in range(4):
+            tracer.record(f"s{i}", now, now + 0.01, trace_id="req-42")
+        await asyncio.sleep(0.1)
+        assert len(_span_writes(store)) == 4
+    finally:
+        await sink.stop()
+
+
+async def test_sink_retain_buffer_bounded_with_drop_counter():
+    from dynamo_tpu.utils import tracing
+    from dynamo_tpu.utils.prometheus import stage_metrics
+
+    store = FakeStore(fail=True)        # permanent outage
+    tracer = tracing.Tracer(component="t", capacity=8)
+    sink = tracing.StoreSpanSink(store, flush_interval=30.0,
+                                 max_pending=4, sample=1.0)
+    await sink.start(tracer=tracer)
+    dropped0 = stage_metrics().spans_dropped.get()
+    try:
+        now = time.time()
+        for i in range(10):
+            tracer.record(f"s{i}", now, now + 0.01, trace_id=f"t{i}")
+        assert len(sink._pending) == 4                   # bounded
+        assert stage_metrics().spans_dropped.get() - dropped0 == 6
+    finally:
+        store.fail = False
+        await sink.stop()
+
+
+# ---------------------------------------------------------------------------
+# metrics delta batching
+# ---------------------------------------------------------------------------
+async def test_delta_batch_merge_equivalence():
+    """Reading the (full, delta) pair must equal the plain per-metric
+    full dump at every point of the publish sequence."""
+    from dynamo_tpu.llm.metrics_aggregator import (StagePublisher,
+                                                   fetch_stage_states)
+    from dynamo_tpu.utils.prometheus import Registry, render_states
+
+    store = FakeStore()
+    r = Registry()
+    c = r.counter("t_requests_total", "t", ("code",))
+    h = r.histogram("t_latency_seconds", "t", (), buckets=(0.1, 1.0))
+    g = r.gauge("t_depth", "t", ())
+    pub = StagePublisher(store, "ns", "comp", 0xab, lease=1,
+                         dump_fn=r.state_dump, push_interval=0,
+                         full_every=3)
+
+    async def assert_merged_equals_full():
+        states = await fetch_stage_states(store, "ns")
+        assert len(states) == 1 and states[0][0] == "comp"
+        direct = render_states([("comp", r.state_dump())])
+        assert render_states(states) == direct
+
+    c.inc("200")
+    h.observe(value=0.05)
+    assert await pub.publish() == "full"
+    await assert_merged_equals_full()
+
+    c.inc("200")
+    g.set(value=7)
+    assert await pub.publish() == "delta"
+    await assert_merged_equals_full()
+
+    # nothing changed: no store write at all
+    writes_before = len(store.puts)
+    assert await pub.publish() == "skipped"
+    assert len(store.puts) == writes_before
+    await assert_merged_equals_full()
+
+    # full_every counts WRITES — the skip above must not advance the
+    # rollover (an idle worker stays silent instead of re-publishing
+    # unchanged fulls), so one more delta write precedes the next full
+    c.inc("500")
+    assert await pub.publish() == "delta"
+    await assert_merged_equals_full()
+    c.inc("500")
+    assert await pub.publish() == "full"
+    await assert_merged_equals_full()
+
+    # delta payloads really are deltas: only the changed metric ships
+    c.inc("500")
+    assert await pub.publish() == "delta"
+    delta_doc = json.loads(store.kv["metrics_stage/ns/comp/ab/delta"])
+    assert set(delta_doc["metrics"]) == {"t_requests_total"}
+    await assert_merged_equals_full()
+
+
+async def test_reverted_metric_truncates_stale_delta():
+    """A metric that returns to its full-snapshot value must overwrite
+    the previously written delta (an empty delta is still a write) —
+    otherwise readers overlay the stale value until the next full."""
+    from dynamo_tpu.llm.metrics_aggregator import (StagePublisher,
+                                                   fetch_stage_states)
+    from dynamo_tpu.utils.prometheus import Registry, render_states
+
+    store = FakeStore()
+    r = Registry()
+    g = r.gauge("t_depth", "t", ())
+    pub = StagePublisher(store, "ns", "comp", 0xab, lease=1,
+                         dump_fn=r.state_dump, push_interval=0,
+                         full_every=10)
+    g.set(value=3)
+    assert await pub.publish() == "full"
+    g.set(value=7)
+    assert await pub.publish() == "delta"
+    g.set(value=3)                       # back to the snapshot value
+    assert await pub.publish() == "delta"   # truncating write, not a skip
+    states = await fetch_stage_states(store, "ns")
+    assert render_states(states) == render_states([("comp",
+                                                    r.state_dump())])
+    # and once truncated, steady state goes back to writing nothing
+    assert await pub.publish() == "skipped"
+
+
+async def test_stale_delta_is_ignored():
+    from dynamo_tpu.llm.metrics_aggregator import (fetch_stage_states,
+                                                   stage_delta_key,
+                                                   stage_key)
+
+    store = FakeStore()
+    full = {"component": "c", "seq": 5,
+            "metrics": {"m": {"kind": "gauge", "help": "", "labels": [],
+                              "series": {"": 1.0}}}}
+    stale = {"component": "c", "base_seq": 4,
+             "metrics": {"m": {"kind": "gauge", "help": "", "labels": [],
+                               "series": {"": 99.0}}}}
+    await store.put(stage_key("ns", "c", 1), json.dumps(full).encode())
+    await store.put(stage_delta_key("ns", "c", 1),
+                    json.dumps(stale).encode())
+    states = await fetch_stage_states(store, "ns")
+    assert states[0][1]["m"]["series"][""] == 1.0   # stale delta dropped
+
+
+def test_publisher_throttles_to_push_interval():
+    from dynamo_tpu.llm.metrics_aggregator import StagePublisher
+    from dynamo_tpu.utils.prometheus import Registry
+
+    store = FakeStore()
+    r = Registry()
+    c = r.counter("t_total", "t", ())
+    pub = StagePublisher(store, "ns", "comp", 1, lease=1,
+                         dump_fn=r.state_dump, push_interval=60.0)
+
+    async def run():
+        assert await pub.publish() == "full"     # first is never throttled
+        c.inc()
+        assert await pub.publish() == "throttled"
+        assert len(store.puts) == 1
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# keyspace classification + store self-observability
+# ---------------------------------------------------------------------------
+def test_classify_key_covers_every_registered_family():
+    from dynamo_tpu.runtime.keyspace import KEYSPACE, classify_key
+
+    examples = {
+        "endpoints": "dynamo/components/backend/generate:1a2b",
+        "models": "models/chat/echo",
+        "metrics": "metrics/dynamo/backend/1a2b",
+        "metrics-stage": "metrics_stage/dynamo/backend/1a2b",
+        "metrics-store": "metrics_stage/_store/store/0",
+        "faults": "faults/store.connect",
+        "overload": "overload/dynamo/brownout",
+        "traces": "traces/req-1/span-2",
+        "planner": "planner/dynamo/state",
+        "disagg-config": "disagg/dynamo/echo",
+        "prefill-queue": "dynamo.prefill",
+        "prefill-cancel": "dynamo.prefill/cancelled/req-1",
+        "deployments": "deploy/deployments/ns/app",
+        "deploy-status": "deploy/status/ns/app",
+        "deploy-artifacts": "deploy/artifacts/app/00000001",
+        "fleet-soak": "fleet/fleet/beacon",
+    }
+    # every registered family must have a classified example here — a new
+    # family without classification coverage fails this test
+    assert set(examples) == set(KEYSPACE)
+    for family, key in examples.items():
+        assert classify_key(key) == family, (family, key)
+    assert classify_key("dynamo.prefill.batch") == "prefill-queue"
+    assert classify_key("unregistered/key") == "other"
+
+
+async def test_store_publishes_self_telemetry(monkeypatch):
+    from dynamo_tpu.llm.metrics_aggregator import fetch_stage_states
+    from dynamo_tpu.runtime.store_client import StoreClient
+    from dynamo_tpu.runtime.store_server import PyStoreServer
+
+    monkeypatch.setenv("DYN_STORE_METRICS_INTERVAL", "0.1")
+    srv = PyStoreServer()
+    port = await srv.start()
+    client = await StoreClient("127.0.0.1", port).connect()
+    try:
+        await client.put("models/chat/echo", b"{}")
+        assert await client.get("models/chat/echo") == b"{}"
+        await client.watch_prefix("faults/", lambda k, v, d: None)
+        lease = await client.lease_grant(ttl=5.0, auto_keepalive=False)
+        await client.put("metrics/ns/backend/1", b"{}", lease=lease)
+        await asyncio.sleep(0.3)
+
+        states = await fetch_stage_states(client, "ns")
+        store_dump = next(d for comp, d in states if comp == "store")
+        ops = store_dump["dyn_store_op_seconds"]
+        series = set(ops["series"])
+        assert "put\x1fmodels" in series
+        assert "get\x1fmodels" in series
+        assert "watch\x1ffaults" in series
+        assert "put\x1fmetrics" in series
+        # gauges: the lease, our two connections' watches, resident keys
+        assert sum(store_dump["dyn_store_leases"]["series"].values()) >= 1
+        assert sum(store_dump["dyn_store_watches"]["series"].values()) >= 1
+        fam_keys = store_dump["dyn_store_keys"]["series"]
+        assert fam_keys.get("models") == 1.0
+        assert store_dump["dyn_store_bytes"]["series"]["models"] == 2.0
+
+        # ... and dyntop's store line renders from the same states
+        from dynamo_tpu.cli.dyntop import render, store_stats_from_states
+        st = store_stats_from_states(states)
+        assert st is not None and st["ops_total"] > 0
+        text = render({"namespace": "ns", "store": st, "workers": {}},
+                      store_detail=True)
+        assert "store: ops=" in text
+        assert "models" in text
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_store_key_deletion_keeps_residency_accounting(monkeypatch):
+    monkeypatch.setenv("DYN_STORE_METRICS_INTERVAL", "0")   # no publisher
+    from dynamo_tpu.runtime.store_client import StoreClient
+    from dynamo_tpu.runtime.store_server import PyStoreServer
+
+    srv = PyStoreServer()
+    port = await srv.start()
+    client = await StoreClient("127.0.0.1", port).connect()
+    try:
+        await client.put("models/chat/a", b"xxxx")
+        await client.put("models/chat/a", b"yy")      # overwrite, not +1
+        await client.put("models/chat/b", b"zz")
+        assert srv._fam_keys["models"] == 2
+        assert srv._fam_bytes["models"] == 4
+        await client.delete("models/chat/a")
+        assert srv._fam_keys["models"] == 1
+        assert srv._fam_bytes["models"] == 2
+        # lease expiry decrements like an explicit delete
+        lease = await client.lease_grant(ttl=5.0, auto_keepalive=False)
+        await client.put("faults/x", b"f", lease=lease)
+        assert srv._fam_keys["faults"] == 1
+        await client.lease_revoke(lease)
+        assert srv._fam_keys["faults"] == 0
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the rig
+# ---------------------------------------------------------------------------
+def _run_fleet_soak(args, timeout):
+    out = os.path.join(args[args.index("--out") + 1])
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fleet_soak.py"),
+         *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    with open(out) as f:
+        return json.load(f)
+
+
+def _assert_artifact_schema(art, expect_steps):
+    assert len(art["steps"]) == expect_steps
+    for step in art["steps"]:
+        assert step["workers"] > 0
+        assert step["store"]["ops"] > 0
+        assert step["store"]["p99_s"] is not None
+        assert step["store"]["families"]
+        assert step["beacon_lag"]["events"] > 0
+        assert step["beacon_lag"]["p99_s"] is not None
+        assert step["spans"]["emitted"] > 0
+        assert {"pushes_full", "pushes_delta",
+                "pushes_skipped"} <= set(step["metrics"])
+    assert "workers" in art["knee"]
+    assert art["verdicts"]["completed"]
+    assert art["verdicts"]["curve_non_empty"]
+    # forced error traces are retrievable at sample=0.01
+    assert art["error_traces"]["checked"] > 0
+    assert art["error_traces"]["found"] == art["error_traces"]["checked"]
+
+
+def test_fleet_soak_mini(tmp_path):
+    """Tier-1: 8 synthetic workers, 2 steps, store-only — the artifact
+    schema and the forced-error-trace guarantee, in seconds."""
+    art = _run_fleet_soak(
+        ["--workers", "8", "--steps", "2", "--step-duration", "2",
+         "--traffic-rps", "0", "--trace-sample", "0.01",
+         "--beat-interval", "1", "--out", str(tmp_path / "mini.json")],
+        timeout=180)
+    _assert_artifact_schema(art, expect_steps=2)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_soak_full_ramp(tmp_path):
+    """The acceptance ramp: >=500 synthetic workers through router +
+    planner + SLO monitor with replayed traffic; curve + knee recorded;
+    span-sink write rate at sample=0.01 must sit far below the emit
+    rate."""
+    art = _run_fleet_soak(
+        ["--workers", "500", "--steps", "3", "--step-duration", "6",
+         "--traffic-rps", "4", "--out", str(tmp_path / "full.json")],
+        timeout=900)
+    _assert_artifact_schema(art, expect_steps=3)
+    assert art["steps"][-1]["workers"] >= 500
+    last = art["steps"][-1]
+    # >=10x write-rate relief: emitted spans vs store span writes
+    assert last["spans"]["emitted"] >= 10 * max(
+        last["spans"]["store_writes"], 1)
+    assert art["verdicts"]["http_error_traces"]
+    assert art["traffic"]["ok"] > 0
