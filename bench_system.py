@@ -25,6 +25,12 @@ A/B pairs:
 - disagg: agg vs disagg_router on long cold prompts fired while decode-heavy
   background requests occupy the worker. The dedicated prefill worker keeps
   TTFT flat where the aggregated worker serializes prefill behind decode.
+- kv_cluster: agg_router with DYN_KV_CLUSTER on vs off. Per shared-prefix
+  family, one worker is made the owner (two long decodes saturate it), then
+  a fresh-suffix request is forced onto the SECOND worker: with cluster
+  sharing on it arrives donor-stamped and fetches the prefix from the
+  owner's host tier (llm/kv_cluster/); off, it recomputes. The A/B is the
+  second worker's tier-hit TTFT vs recompute TTFT.
 """
 
 from __future__ import annotations
@@ -461,12 +467,252 @@ def disagg_ab(long_prompts: int = 6, prefix_len: int = 512,
     return out
 
 
+async def _decisions(session, base: str) -> List[Dict[str, Any]]:
+    async with session.get(f"{base}/v1/router/decisions") as resp:
+        if resp.status != 200:
+            return []
+        return (await resp.json()).get("decisions", [])
+
+
+async def _hold_one(session, base: str, prompt: str, max_tokens: int,
+                    first_token: asyncio.Event) -> None:
+    """Stream a completion, set ``first_token`` at the first text chunk,
+    and keep the stream open (occupying its worker slot) until cancelled —
+    the saturation arm of the kv_cluster A/B."""
+    payload = {"model": "demo", "prompt": prompt, "max_tokens": max_tokens,
+               "stream": True}
+    try:
+        async with session.post(f"{base}/v1/completions",
+                                json=payload) as resp:
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if line.startswith("data:") and line[5:].strip() != "[DONE]":
+                    ch = json.loads(line[5:].strip())
+                    if ch.get("choices") and ch["choices"][0].get("text"):
+                        first_token.set()
+    except Exception:
+        pass   # cancelled / connection closed: the hold simply ends
+
+
+async def _cluster_counters(store: str,
+                            namespace: str = "dynamo") -> Dict[str, float]:
+    """Fleet totals of the cluster-plane counters from the stage dumps."""
+    from dynamo_tpu.cli.dyntop import cluster_kv_totals
+    from dynamo_tpu.llm.metrics_aggregator import fetch_stage_states
+    from dynamo_tpu.runtime.component import DistributedRuntime
+
+    host, port = store.split(":")
+    drt = await DistributedRuntime(store_host=host,
+                                   store_port=int(port)).connect()
+    try:
+        states = await fetch_stage_states(drt.store, namespace)
+    finally:
+        await drt.close()
+    # one summing walk, shared with dyntop's cluster: line — only the
+    # artifact spells the full metric names
+    totals = cluster_kv_totals(states)
+    out: Dict[str, float] = {
+        "dyn_kv_cluster_fetches_total": totals["fetches"],
+        "dyn_kv_cluster_fallbacks_total": totals["fallbacks"],
+        "dyn_kv_cluster_hits_total": totals["hits"],
+        "dyn_kv_tier_hits_total": totals["tier_hits"],
+    }
+    # the fetch-latency histogram, folded to mean seconds: the direct
+    # answer to "was the peer fetch itself the slow part?"
+    secs = cnt = 0.0
+    for _component, dump in states:
+        for val in ((dump.get("dyn_kv_cluster_fetch_seconds") or {})
+                    .get("series") or {}).values():
+            secs += float(val.get("sum", 0.0))
+            cnt += float(val.get("total", 0.0))
+    out["fetch_seconds_mean"] = round(secs / cnt, 4) if cnt else None
+    return out
+
+
+def kv_cluster_ab(families: int = 10, prefix_len: int = 1536,
+                  suffix_len: int = 16, bg_tokens: int = 1200,
+                  max_tokens: int = 4,
+                  engine_args: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+    """Cluster KV sharing on/off: second-worker tier-hit TTFT vs recompute.
+
+    Routing cannot be pinned from HTTP, so the harness FORCES the
+    second-worker case per family: two concurrent long decodes of the
+    family prefix land (by cold tie-break) on one worker — when both hit
+    the same worker it is the OWNER, saturated by construction
+    (max_batch=1: one active + one waiting => the scheduler's
+    ``saturated`` flag), so the measured fresh-suffix request routes to
+    the other worker in BOTH arms. Families whose two seeds split across
+    workers prove nothing and are skipped (~half, by the 50/50
+    tie-break). With DYN_KV_CLUSTER=1 the measured request arrives
+    donor-stamped and fetches the prefix from the owner's host tier
+    (write-through mirrors sealed blocks there); off, it recomputes the
+    identical prefill. Same prompts, same saturation, same contention —
+    the delta is fetch vs recompute."""
+    pages_per_family = prefix_len // ENGINE_ARGS["page_size"]
+    # a hold's full context: family prefix + suffix + its decode run
+    hold_ctx = prefix_len + suffix_len + bg_tokens
+    ea = {
+        "max_batch": 1,                    # one decode saturates a worker
+        # rounded up to the bucket grid so the holds' decodes never hit
+        # the context cap mid-saturation
+        "max_context": -(-(hold_ctx + 64) // 1024) * 1024,
+        # capacity errors are not the phenomenon under test: room for a
+        # full hold plus the measured request with slack
+        "num_pages": 2 * (hold_ctx // ENGINE_ARGS["page_size"]) + 32,
+        # the owner accrues every family's write-through mirrors
+        "host_cache_blocks": families * pages_per_family + 64,
+        **(engine_args or {}),
+    }
+
+    async def scenario(base, store):
+        import aiohttp
+
+        rng = random.Random(77)
+        alphabet = string.ascii_letters + string.digits + " "
+
+        def text(n):
+            return "".join(rng.choice(alphabet) for _ in range(n))
+
+        samples: List[Dict[str, Any]] = []
+        split_skipped = 0
+        routed_to_owner = 0
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=600)) as session:
+            # compile warmup: prefill buckets + decode, both workers
+            warm = make_workload(2, 4, prefix_len, suffix_len, seed=5)
+            await replay(base, warm, 8, concurrency=2)
+
+            for fam in range(families):
+                prefix = text(prefix_len)
+                pre = await _decisions(session, base)
+                seq0 = max((d.get("seq", 0) for d in pre), default=0)
+                evs = [asyncio.Event(), asyncio.Event()]
+                holds = [asyncio.create_task(_hold_one(
+                    session, base, prefix + text(suffix_len), bg_tokens,
+                    ev)) for ev in evs]
+                try:
+                    # wait until one seed is decoding (prefill done) and
+                    # both routing decisions are in the audit ring
+                    _done, pending = await asyncio.wait(
+                        [asyncio.ensure_future(e.wait()) for e in evs],
+                        timeout=30.0,
+                        return_when=asyncio.FIRST_COMPLETED)
+                    for w in pending:
+                        # events of cancelled holds never set: reap the
+                        # waiters or they warn at asyncio.run teardown
+                        w.cancel()
+                    seeds: List[Dict[str, Any]] = []
+                    for _ in range(100):
+                        seeds = [d for d in await _decisions(session, base)
+                                 if d.get("seq", 0) > seq0
+                                 and d.get("worker_id") is not None]
+                        if len(seeds) >= 2:
+                            break
+                        await asyncio.sleep(0.1)
+                    owners = {d["worker_id"] for d in seeds[:2]}
+                    if len(seeds) < 2 or len(owners) != 1:
+                        split_skipped += 1
+                        continue
+                    owner = owners.pop()
+                    # registry publish + two metrics-scrape beats, so the
+                    # router sees owner saturated (and, ON, the record)
+                    await asyncio.sleep(2.0)
+                    seq1 = max((d.get("seq", 0) for d in seeds),
+                               default=seq0)
+                    tt, _tot, _n = await _stream_one(
+                        session, base, prefix + text(suffix_len),
+                        max_tokens)
+                    dec = [d for d in await _decisions(session, base)
+                           if d.get("seq", 0) > seq1
+                           and d.get("worker_id") is not None]
+                    if not dec:
+                        continue
+                    d = dec[-1]
+                    if d["worker_id"] == owner:
+                        routed_to_owner += 1   # stale metrics: excluded
+                        continue
+                    chosen = next((c for c in d.get("candidates", [])
+                                   if c["worker_id"] == d["worker_id"]),
+                                  {})
+                    samples.append({
+                        "family": fam,
+                        "ttft": round(tt, 4),
+                        "donor_stamped": bool(chosen.get("kv_donor")),
+                        "donor_blocks": chosen.get("kv_donor_blocks", 0),
+                    })
+                finally:
+                    for h in holds:
+                        h.cancel()
+                    await asyncio.gather(*holds, return_exceptions=True)
+                    await asyncio.sleep(1.2)   # drain the cancelled holds
+
+        ttfts = [s["ttft"] for s in samples]
+        return {
+            "usable_families": len(samples),
+            "split_skipped": split_skipped,
+            "routed_to_owner": routed_to_owner,
+            "second_worker_ttft": _pcts(ttfts),
+            "donor_stamped": sum(1 for s in samples if s["donor_stamped"]),
+            "samples": samples,
+            "cluster_counters": await _cluster_counters(store),
+        }
+
+    def run_arm(on: bool) -> Dict[str, Any]:
+        env = {"DYN_KV_CLUSTER": "1" if on else "0",
+               "DYN_KV_CLUSTER_PUBLISH_INTERVAL": "0.3"}
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            return run_topology("agg_router", scenario, engine_args=ea)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    out: Dict[str, Any] = {
+        "workload": {"families": families, "prefix_tokens": prefix_len,
+                     "suffix_tokens": suffix_len, "bg_tokens": bg_tokens,
+                     "pages_per_family": pages_per_family,
+                     "engine": ea},
+        "cluster_off": run_arm(False),
+        "cluster_on": run_arm(True),
+    }
+    on, off = out["cluster_on"], out["cluster_off"]
+    on_p50 = (on["second_worker_ttft"] or {}).get("p50")
+    off_p50 = (off["second_worker_ttft"] or {}).get("p50")
+    speedup = (round(off_p50 / on_p50, 2)
+               if on_p50 and off_p50 else None)
+    out["ttft_p50_speedup"] = speedup
+    out["checks"] = {
+        # the claim under test: the second worker's donor-fetched
+        # tier-hit TTFT beats recomputing the identical prefix
+        "cluster_win": bool(speedup and speedup > 1.0),
+        "on_samples": on["usable_families"],
+        "off_samples": off["usable_families"],
+        "on_donor_stamped": on["donor_stamped"],
+        "on_fetches": on["cluster_counters"][
+            "dyn_kv_cluster_fetches_total"],
+        "on_fallbacks": on["cluster_counters"][
+            "dyn_kv_cluster_fallbacks_total"],
+        "off_fetches": off["cluster_counters"][
+            "dyn_kv_cluster_fetches_total"],
+    }
+    os.makedirs("bench_points", exist_ok=True)
+    with open(os.path.join("bench_points", "kv_cluster_ab.json"),
+              "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
 # ---------------------------------------------------------------------------
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--pairs", default="routing,disagg",
-                    help="comma list: routing, disagg")
+    ap.add_argument("--pairs", default="routing,disagg,kv_cluster",
+                    help="comma list: routing, disagg, kv_cluster")
     ap.add_argument("--requests", type=int, default=100)
     ap.add_argument("--json", dest="json_out", default=None)
     args = ap.parse_args()
@@ -482,6 +728,8 @@ def main() -> None:
                    if a["ttft"][pct] and b["ttft"][pct] else None)
             out["routing"][f"ttft_{pct}_speedup"] = spd
             out["routing"]["checks"][f"{pct}_win"] = bool(spd and spd > 1.0)
+    if "kv_cluster" in pairs:
+        out["kv_cluster"] = kv_cluster_ab()
     if "disagg" in pairs:
         out["disagg"] = disagg_ab()
         if "skipped" not in out["disagg"]:

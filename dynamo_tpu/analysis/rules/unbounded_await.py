@@ -47,6 +47,7 @@ LEGACY_SCOPE = [
     "dynamo_tpu/utils/slo.py",
     "dynamo_tpu/cli/dyntop.py",
     "dynamo_tpu/utils/overload.py",
+    "dynamo_tpu/llm/kv_cluster",
     "scripts/overload_soak.py",
     "scripts/fleet_soak.py",
 ]

@@ -142,6 +142,7 @@ class ClusterSnapshotter:
             "admission_depth": admission_depth_total(states),
         }
         return {
+            "cluster": cluster_kv_totals(states),
             "at": time.time(),
             "namespace": self.namespace,
             "store": store_stats,
@@ -219,6 +220,28 @@ def store_stats_from_states(states) -> Optional[Dict]:
     }
 
 
+def cluster_kv_totals(states) -> Dict[str, float]:
+    """Fleet-summed KV tier + cluster-sharing counters from one
+    ``fetch_stage_states`` result — the ``cluster:`` line's numbers.
+    All-zero when the plane is off (nothing rendered then)."""
+    names = {
+        "dyn_kv_tier_hits_total": "tier_hits",
+        "dyn_kv_tier_misses_total": "tier_misses",
+        "dyn_kv_cluster_hits_total": "hits",
+        "dyn_kv_cluster_fetches_total": "fetches",
+        "dyn_kv_cluster_fallbacks_total": "fallbacks",
+    }
+    out = {v: 0.0 for v in names.values()}
+    out["tier_blocks"] = 0.0
+    for _component, dump in states:
+        for metric, field in names.items():
+            st = dump.get(metric) or {}
+            out[field] += sum((st.get("series") or {}).values())
+        st = dump.get("dyn_kv_tier_blocks") or {}
+        out["tier_blocks"] += sum((st.get("series") or {}).values())
+    return out
+
+
 def _compile_totals(states) -> Dict[str, Tuple[float, float]]:
     """{kind: (programs, seconds)} summed across every published dump."""
     progs: Dict[str, float] = {}
@@ -294,6 +317,16 @@ def render(snap: Dict, store_detail: bool = False) -> str:
                     f"{int(g.get('keys', 0)):>7} "
                     f"{g.get('bytes', 0) / 2**20:>8.2f} "
                     f"{int(g.get('queue_depth', 0)):>6}")
+    cl = snap.get("cluster") or {}
+    if any(cl.values()):
+        th, tm = cl.get("tier_hits", 0), cl.get("tier_misses", 0)
+        hit_pct = 100.0 * th / (th + tm) if (th + tm) else 0.0
+        lines.append(
+            f"cluster: tier_blocks={int(cl.get('tier_blocks', 0))}  "
+            f"tier_hit%={hit_pct:.1f}  "
+            f"peer_hits={int(cl.get('hits', 0))}  "
+            f"fetches={int(cl.get('fetches', 0))}  "
+            f"fallbacks={int(cl.get('fallbacks', 0))}")
     comps = snap.get("compiles") or {}
     if comps:
         lines.append("compiles: " + "  ".join(

@@ -52,6 +52,24 @@ async def run_router(args, *, ready_event=None,
         log.warning("brownout watch failed; router stays in wait mode",
                     exc_info=True)
     await svc.serve(drt.namespace(args.namespace).component(args.component))
+    # publish this process's stage registry (dyn_kv_cluster_hits_total,
+    # histogram series the audit plane reads) onto the standard
+    # metrics_stage/ merge path — a router that only *made* decisions
+    # would keep its cluster-hit counter invisible to /metrics and dyntop
+    from ..llm.metrics_aggregator import StagePublisher
+
+    stage_pub = StagePublisher(drt.store, args.namespace, args.component,
+                               drt.worker_id, drt.lease)
+
+    async def stage_publish_loop():
+        while True:
+            try:
+                await stage_pub.publish()
+            except Exception:
+                log.debug("router stage publish skipped", exc_info=True)
+            await asyncio.sleep(2.0)
+
+    stage_task = asyncio.create_task(stage_publish_loop())
     print(f"kv router serving {args.namespace}.{args.component}.route "
           f"(workers: {args.worker_component})", flush=True)
     if ready_event is not None:
@@ -60,6 +78,7 @@ async def run_router(args, *, ready_event=None,
         while True:
             await asyncio.sleep(3600)
     finally:
+        stage_task.cancel()
         await svc.stop()
         if own:
             await drt.close()

@@ -82,6 +82,13 @@ def _engine_cfg(args, card: Optional[ModelDeploymentCard] = None):
         extra["enable_prefix_reuse"] = False
         extra["host_cache_blocks"] = 0
         extra["disk_cache_blocks"] = 0
+    from ..llm import kv_cluster
+
+    if kv_cluster.enabled():
+        # cluster sharing needs sealed blocks mirrored to the host tier
+        # (write-through) so peers can fetch prefixes that never saw
+        # device eviction pressure; a no-op when host_cache_blocks=0
+        extra.setdefault("cluster_writethrough", True)
     return JaxEngineConfig.from_card(card, tensor_parallel=args.tp, **extra)
 
 
@@ -175,6 +182,17 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
         core.pool.on_block_sealed = pub.block_stored
         core.pool.on_blocks_removed = pub.blocks_removed
 
+    # --- cluster KV sharing (DYN_KV_CLUSTER=1) -----------------------
+    # serve the kv_fetch donor endpoint over the host tier, publish this
+    # worker's sealed-block registry record (lease-bound), and prefetch
+    # donor-stamped prefixes before requests enter the engine
+    from ..llm import kv_cluster
+
+    cluster = None
+    if core is not None and kv_cluster.enabled():
+        cluster = await kv_cluster.KvClusterWorker.attach(
+            component, drt, args.namespace, core)
+
     # --- serve endpoint ----------------------------------------------
     # worker-ingress overload gate (DYN_WORKER_SLOTS / DYN_WORKER_QUEUE_
     # DEPTH, unset = off): bounded, priority-ordered slot queue with
@@ -207,20 +225,30 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                                    remote_timeout)
 
         async def generate_handler(request, ctx):
+            bi = BackendInput.from_dict(request)
+            if cluster is not None:
+                # donor-stamped prefix fetch BEFORE the slot gate and the
+                # local probe: the peer fetch overlaps the queue wait
+                # instead of holding a bounded slot through up to the
+                # fetch timeout of network I/O (same invariant as the
+                # non-disagg path's prefetch-outside-the-gate wrap), and
+                # the deposited blocks count as local prefix hits, so a
+                # cluster-warm prompt prefills locally instead of paying
+                # the remote-prefill queue for KV a peer already holds
+                await cluster.fetcher.ensure_prefix(bi, ctx)
             if gate is not None:
                 await gate.acquire(ctx.priority, ctx.deadline)
                 svc_started = time.monotonic()
                 try:
-                    async for item in _generate_disagg(request, ctx):
+                    async for item in _generate_disagg(bi, request, ctx):
                         yield item
                 finally:
                     gate.release(time.monotonic() - svc_started)
             else:
-                async for item in _generate_disagg(request, ctx):
+                async for item in _generate_disagg(bi, request, ctx):
                     yield item
 
-        async def _generate_disagg(request, ctx):
-            bi = BackendInput.from_dict(request)
+        async def _generate_disagg(bi, request, ctx):
             # local prefix-cache hits count against remoting: a prompt we
             # mostly have cached prefills locally regardless of length.
             # CROSS-THREAD CONTRACT: this runs on the asyncio thread while
@@ -300,10 +328,13 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
 
         await endpoint.serve(generate_handler)
     else:
-        await serve_core_engine(
-            endpoint,
-            engine if gate is None
-            else overload.SlotGatedEngine(engine, gate))
+        served = (engine if gate is None
+                  else overload.SlotGatedEngine(engine, gate))
+        if cluster is not None:
+            # prefetch wraps OUTSIDE the slot gate: the peer fetch overlaps
+            # the queue wait instead of holding a slot while blocks stream
+            served = cluster.wrap(served)
+        await serve_core_engine(endpoint, served)
     if args.register_model:
         await register_model(drt.store, card, endpoint.path,
                              model_type="chat", lease=drt.lease)
@@ -369,6 +400,11 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
 
         await clear_worker_keys(drt.store, args.namespace, args.component,
                                 drt.worker_id)
+        if cluster is not None:
+            try:
+                await cluster.stop()   # cancel publisher, drop registry key
+            except Exception:
+                log.warning("kv-cluster detach failed", exc_info=True)
         if core is not None:
             try:
                 engine.shutdown()   # joins the engine thread, clears gauges

@@ -61,8 +61,11 @@ class PagePool:
         self.seqs: Dict[str, SeqCache] = {}
         # hook: (seq_id, sealed TokenBlock, page, lora_id) when a page
         # fills — feeds the KV event publisher ("stored") for the router
-        # index; lora_id is the adapter the sequence was created under
+        # index; lora_id is the adapter the sequence was created under.
+        # add_seal_hook registers ADDITIONAL listeners (the engine's
+        # cluster write-through) without displacing this primary slot.
         self.on_block_sealed: Optional[Callable] = None
+        self._seal_hooks: List[Callable] = []
         # hook: (seq_hashes: List[int]) when sealed blocks leave the device
         # pool — the router "removed" event
         self.on_blocks_removed: Optional[Callable] = None
@@ -70,6 +73,18 @@ class PagePool:
         # engine offloads the page to the host tier here
         self.on_block_evicted: Optional[Callable] = None
         self._removed_buf: List[int] = []
+
+    def add_seal_hook(self, cb: Callable) -> None:
+        """Subscribe an extra (seq_id, TokenBlock, page, lora_id) listener
+        for newly-registered sealed blocks (fires after on_block_sealed)."""
+        self._seal_hooks.append(cb)
+
+    def _fire_sealed(self, seq_id: str, sealed, page: int,
+                     lora_id: int) -> None:
+        if self.on_block_sealed:
+            self.on_block_sealed(seq_id, sealed, page, lora_id)
+        for cb in self._seal_hooks:
+            cb(seq_id, sealed, page, lora_id)
 
     def _evicted(self, seq_hash: int, page: int) -> None:
         if self.on_block_evicted:
@@ -144,9 +159,9 @@ class PagePool:
                     # stored events only for newly-registered blocks, so the
                     # router's per-worker refcount balances the single
                     # removed event fired at eviction
-                    if registered and self.on_block_sealed:
-                        self.on_block_sealed(sc.seq_id, sealed, page,
-                                             sc.hashes.lora_id)
+                    if registered:
+                        self._fire_sealed(sc.seq_id, sealed, page,
+                                          sc.hashes.lora_id)
         sc.num_tokens += len(tokens)
 
     def extend(self, seq_id: str, tokens: Sequence[int]) -> None:
@@ -246,9 +261,8 @@ class PagePool:
             for t in tokens:
                 sealed = sc.hashes.append(int(t))
         sc.num_tokens += len(tokens)
-        if fire_stored and sealed is not None and self.on_block_sealed:
-            self.on_block_sealed(sc.seq_id, sealed, page,
-                                 sc.hashes.lora_id)
+        if fire_stored and sealed is not None:
+            self._fire_sealed(sc.seq_id, sealed, page, sc.hashes.lora_id)
 
     # ------------------------------------------------------------------
     # index computation for the jitted forward

@@ -112,6 +112,12 @@ class JaxEngineConfig:
     host_cache_blocks: int = 0          # host-DRAM KV tier capacity (0 = off)
     disk_cache_blocks: int = 0          # mmap spill tier capacity (0 = off)
     disk_cache_path: Optional[str] = None
+    # cluster KV sharing (llm/kv_cluster/): mirror every newly sealed
+    # block to the host tier write-through, so peers can fetch hot
+    # prefixes that never saw device-pool eviction pressure. Requires
+    # host_cache_blocks > 0; the worker CLI turns it on with
+    # DYN_KV_CLUSTER=1.
+    cluster_writethrough: bool = False
     # speculative decoding (engine/spec.py). None => consult the DYN_SPEC*
     # env knobs; "" / "off" force-disables regardless of env. Off by
     # default: zero extra compiled programs, decode path untouched.
@@ -401,6 +407,17 @@ class EngineCore:
             self.tiered = TieredKvCache(host, disk)
         self._evict_buf: List[Tuple[int, int]] = []
         self.pool.on_block_evicted = self._offload_evicted
+        # cluster write-through: newly sealed blocks queue for a host-tier
+        # mirror copy. A block SEALS before the dispatch that writes its
+        # KV is issued (extend/account run pre-dispatch), so entries
+        # ratchet through two step boundaries (pending -> armed -> buf)
+        # before the d2h: by then the writing dispatch has been issued and
+        # JAX sequences the copy after it by data dependency.
+        self._writethrough_buf: List[Tuple[int, int]] = []
+        self._writethrough_armed: List[Tuple[int, int]] = []
+        self._writethrough_pending: List[Tuple[int, int]] = []
+        if self.tiered is not None and cfg.cluster_writethrough:
+            self.pool.add_seal_hook(self._writethrough_sealed)
 
         # prefix-cache accounting (feeds ForwardPassMetrics + disagg router)
         self.last_prefix_hit = 0
@@ -800,6 +817,12 @@ class EngineCore:
     # ------------------------------------------------------------------
     # public API (engine thread)
     # ------------------------------------------------------------------
+    def close(self) -> None:
+        """Release host-side cache resources (the disk tier's spill
+        memmaps + files). Idempotent; called from JaxEngine.shutdown."""
+        if self.tiered is not None:
+            self.tiered.close()
+
     def submit(self, seq_id: str, request: BackendInput) -> None:
         self.waiting.append((seq_id, request))
 
@@ -994,6 +1017,7 @@ class EngineCore:
         fresh first tokens are flushed to callers immediately rather than
         held through a decode dispatch (TTFT)."""
         out: List[StepOutput] = []
+        self._advance_writethrough()
         out.extend(self._reap_cancelled())
         n_reaped = len(out)
 
@@ -1084,12 +1108,53 @@ class EngineCore:
         before any dispatch that could overwrite pool pages."""
         if self.tiered is None:
             return
-        self._evict_buf.append((seq_hash, page))
+        # an evicted page's slot can be rewritten by the very next
+        # dispatch: deferred write-through entries for it would mirror the
+        # new owner's data under the old hash. Drop them — this eviction
+        # entry offloads the same block with still-valid data.
+        entry = (seq_hash, page)
+        for buf in (self._writethrough_buf, self._writethrough_armed,
+                    self._writethrough_pending):
+            if entry in buf:
+                buf.remove(entry)
+        self._evict_buf.append(entry)
+
+    def _writethrough_sealed(self, seq_id: str, block, page: int,
+                             lora_id: int) -> None:
+        """Seal hook (cluster sharing): mirror the block to the host tier
+        so peers can fetch it while it is still hot on device. The KV for
+        a freshly sealed block is NOT on device yet — see the ratchet in
+        :meth:`_advance_writethrough`. Host-tier restores also seal
+        (``fire_stored``) — those blocks came FROM the tier, so mirroring
+        them back would be a wasted d2h exactly on the cluster-warm path."""
+        if block.sequence_hash in self.tiered:
+            return
+        self._writethrough_pending.append((block.sequence_hash, page))
+
+    def _advance_writethrough(self) -> None:
+        """Step-boundary ratchet for cluster write-through mirrors: a
+        block sealed during step N has its KV written by a dispatch issued
+        no later than step N+1 (pipelined decode chains one step behind
+        the seal), so entries become d2h-safe at the top of step N+2 —
+        the copy then reads the post-dispatch pool binding. Also drains
+        the ready batch on decode-only steps, which never hit the
+        extend-path flush sites."""
+        if (not self._writethrough_pending and not self._writethrough_armed
+                and not self._writethrough_buf):
+            return
+        self._writethrough_buf.extend(self._writethrough_armed)
+        self._writethrough_armed = self._writethrough_pending
+        self._writethrough_pending = []
+        if self._writethrough_buf:
+            self._flush_evictions()
 
     def _flush_evictions(self) -> None:
-        if not self._evict_buf:
+        if not self._evict_buf and not self._writethrough_buf:
             return
-        buf, self._evict_buf = self._evict_buf, []
+        # evictions + write-through mirrors share one batched d2h; dedupe
+        # (a written-through block can also be in the eviction batch)
+        buf = list(dict.fromkeys(self._evict_buf + self._writethrough_buf))
+        self._evict_buf, self._writethrough_buf = [], []
         pages = [p for _, p in buf]
         k, v = self.copy_stream.d2h_pages(self.k_pool, self.v_pool, pages,
                                           pipeline=len(pages) > 4)
@@ -2135,6 +2200,10 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
         self._running = False
         self._wake.set()
         self._thread.join(timeout=5)
+        # disk-tier spill files are scratch state: flush + unlink them
+        # with the engine (next to the metrics-key cleanup) instead of
+        # leaking two pool-sized memmaps per engine lifetime
+        self.core.close()
         # the engine's per-worker gauge series must die with it: a process
         # that outlives its engine (model remove/re-add, shared-runtime
         # tests) would otherwise export ghost occupancy/MFU forever

@@ -20,6 +20,7 @@ from typing import Dict, Optional
 
 from ...runtime.component import Client, Component, DistributedRuntime
 from ...utils.aiotasks import cancel_all, spawn
+from ..tokens import compute_seq_hashes
 from .indexer import KvIndexer
 from .protocols import KV_EVENT_SUBJECT, ForwardPassMetrics, RouterEvent
 from .scheduler import KvScheduler
@@ -46,6 +47,11 @@ class KvRouterService:
         # router binary): any level above normal turns on scheduler
         # fast-fail — under declared overload, capacity-waiting is doomed
         self.brownout = None
+        # cluster KV sharing (DYN_KV_CLUSTER=1): registry reader + transfer
+        # cost model; when armed, route() scores cluster hits and stamps
+        # the elected donor on the response
+        self.cluster_index = None
+        self.cost_model = None
 
     def _emit_hit_rate(self, ev) -> None:
         self._hit_events += 1
@@ -75,6 +81,13 @@ class KvRouterService:
             i for i in self.worker_client.instances
             if self.worker_client.breaker.state(i) == OPEN}
 
+        from .. import kv_cluster
+
+        if kv_cluster.enabled():
+            self.cluster_index = await kv_cluster.KvClusterIndex().start(
+                self.drt.store, self.namespace)
+            self.cost_model = kv_cluster.TransferCostModel()
+
         def on_change():
             live = set(self.worker_client.instances)
             for w in self.indexer.tree.workers() - live:
@@ -82,6 +95,13 @@ class KvRouterService:
             for w in list(self.scheduler.endpoints.workers) :
                 if w not in live:
                     self.scheduler.remove_worker(w)
+            if self.cluster_index is not None:
+                # belt over the lease-bound suspenders: a donor whose
+                # endpoint registration vanished must stop being scored
+                # immediately, even if its registry delete is in flight
+                for w in list(self.cluster_index.records):
+                    if w not in live:
+                        self.cluster_index.remove_worker(w)
 
         self.worker_client.on_instances_changed = on_change
         self._scrape_task = asyncio.create_task(self._scrape_loop())
@@ -96,6 +116,7 @@ class KvRouterService:
         from ..metrics_aggregator import METRICS_PREFIX
 
         prefix = f"{METRICS_PREFIX}{self.namespace}/{self.worker_component}/"
+        beat = 0
         while True:
             try:
                 items = await self.drt.store.get_prefix(prefix)
@@ -109,25 +130,69 @@ class KvRouterService:
                     workers[wid] = ForwardPassMetrics.from_dict(
                         json.loads(value.decode()))
                 self.scheduler.update_endpoints(workers)
+                if self.cost_model is not None and beat % 10 == 0:
+                    # refresh the peer-fetch bandwidth estimate from the
+                    # merged llm_kv_transfer histograms — every ~10 beats,
+                    # the stage merge is heavier than the metrics scrape
+                    from ..metrics_aggregator import fetch_stage_states
+
+                    self.cost_model.update_from_states(
+                        await fetch_stage_states(self.drt.store,
+                                                 self.namespace))
             except asyncio.CancelledError:
                 raise
             except Exception:
                 log.exception("metrics scrape failed")
+            beat += 1
             await asyncio.sleep(self.scrape_interval)
 
     # ------------------------------------------------------------------
+    def _cluster_overlap(self, seq_hashes):
+        """Cluster-wide prefix availability of a request's hash chain
+        (None when cluster sharing is off or the registry is empty)."""
+        if (self.cluster_index is None or not self.cluster_index.records
+                or not seq_hashes):
+            return None
+        weight = self.cost_model.weight(
+            len(seq_hashes), self.cluster_index.any_block_bytes())
+        # only owners of the routed component: a foreign component's
+        # record (disagg prefill pool, another model) is unreachable
+        # through the worker's fetch client
+        return self.cluster_index.find(seq_hashes, weight=weight,
+                                       component=self.worker_component)
+
     async def route(self, token_ids, lora_id: int = 0) -> Dict:
-        overlaps = self.indexer.find_matches_for_tokens(token_ids,
-                                                        lora_id=lora_id)
+        # hash the prompt chain ONCE; the indexer and the cluster index
+        # query the same salted chain
+        hashes = compute_seq_hashes(token_ids, self.indexer.block_size,
+                                    lora_id=lora_id)
+        overlaps = self.indexer.find_matches(hashes)
+        cluster = self._cluster_overlap(hashes)
         # brownout level > 0 forces fast-fail regardless of the env knob;
         # None defers to DYN_ROUTER_FAST_FAIL
         fast_fail = True if (self.brownout is not None
                              and self.brownout.level > 0) else None
         wid = await self.scheduler.schedule_or_wait(token_ids, overlaps,
                                                     salt=lora_id,
-                                                    fast_fail=fast_fail)
-        return {"worker_id": wid,
+                                                    fast_fail=fast_fail,
+                                                    cluster=cluster)
+        resp = {"worker_id": wid,
                 "overlap_blocks": overlaps.scores.get(wid, 0)}
+        # stamp the donor score_candidates elected for the chosen worker
+        # (scheduler.last_choice is this decision's: schedule_or_wait
+        # returns synchronously after its final schedule()) — the worker
+        # fetches without a registry round-trip, and the stamp is exactly
+        # what the audit ring recorded
+        chosen = self.scheduler.last_choice
+        if (cluster is not None and chosen is not None
+                and chosen["worker_id"] == wid
+                and chosen.get("kv_donor") is not None):
+            from ...utils.prometheus import stage_metrics
+
+            stage_metrics().kv_cluster_hits.inc()
+            resp["kv_donor"] = chosen["kv_donor"]
+            resp["kv_donor_blocks"] = chosen["kv_donor_blocks"]
+        return resp
 
     def decisions(self, limit: int = 0):
         """The audit ring: every routed request's full score breakdown."""

@@ -69,12 +69,20 @@ WorkerSelector = Callable[
 
 def score_candidates(tokens: Sequence[int], block_size: int,
                      overlaps: OverlapScores,
-                     endpoints: ProcessedEndpoints) -> List[Dict[str, Any]]:
+                     endpoints: ProcessedEndpoints,
+                     cluster=None) -> List[Dict[str, Any]]:
     """The full per-candidate score breakdown of the default cost — one
     dict per live worker with every term the logit is built from, so a
     routing decision is auditable after the fact instead of being a bare
     worker id (the decision-audit ring and ``/v1/router/decisions`` expose
-    exactly this)."""
+    exactly this).
+
+    ``cluster`` (a :class:`~..kv_cluster.registry.ClusterOverlap`, None
+    when cluster KV sharing is off) folds fleet-wide prefix availability
+    into the overlap term: a candidate's OWN host/disk-tier coverage
+    counts like a device hit (admission restores it locally), and the
+    best prefix some *other* worker holds counts at the transfer-cost
+    weight — so local hit > peer hit > miss, by construction."""
     isl_blocks = max(1, len(tokens) // block_size)
     out: List[Dict[str, Any]] = []
     for wid, m in endpoints.workers.items():
@@ -83,7 +91,18 @@ def score_candidates(tokens: Sequence[int], block_size: int,
             and m.request_active_slots >= m.request_total_slots
             and m.num_requests_waiting > 0)
         overlap = overlaps.scores.get(wid, 0)
-        overlap_norm = overlap / isl_blocks
+        donor = None
+        donor_blocks = 0
+        local_eq = overlap
+        if cluster is not None:
+            # the worker's own tier residency is a local hit: restore is
+            # a host->device upload, no network
+            local_eq = max(overlap, cluster.owners.get(wid, 0))
+            donor, donor_blocks = cluster.donor_for(wid, local_eq)
+        extra = max(0, donor_blocks - local_eq) if donor is not None else 0
+        eff = min(local_eq + (cluster.weight if cluster else 0.0) * extra,
+                  float(isl_blocks))
+        overlap_norm = eff / isl_blocks
         load = (m.request_active_slots / m.request_total_slots
                 if m.request_total_slots else 0.0)
         # full precision: the selector's tie-break compares these — the
@@ -91,6 +110,9 @@ def score_candidates(tokens: Sequence[int], block_size: int,
         out.append({
             "worker_id": wid,
             "overlap_blocks": overlap,
+            "cluster_local_blocks": local_eq,
+            "kv_donor": donor,
+            "kv_donor_blocks": donor_blocks,
             "overlap_norm": overlap_norm,
             "cache_usage": m.cache_usage,
             "load": load,
@@ -156,6 +178,12 @@ class KvScheduler:
         self.decisions: collections.deque = collections.deque(
             maxlen=_audit_ring_size())
         self._seq = 0
+        # the chosen candidate's full score breakdown from the most recent
+        # successful schedule() — incl. the kv_donor election, so route()
+        # stamps exactly what was scored instead of re-deriving it. Only
+        # meaningful synchronously after schedule() returns (no await in
+        # between); None when the last decision found no capacity.
+        self.last_choice: Optional[Dict[str, Any]] = None
 
     def update_endpoints(self, workers: Dict[int, ForwardPassMetrics]) -> None:
         self.endpoints = ProcessedEndpoints(dict(workers))
@@ -208,14 +236,18 @@ class KvScheduler:
         })
 
     def schedule(self, tokens: Sequence[int],
-                 overlaps: OverlapScores, salt: int = 0) -> Optional[int]:
+                 overlaps: OverlapScores, salt: int = 0,
+                 cluster=None) -> Optional[int]:
         candidates = score_candidates(tokens, self.block_size, overlaps,
-                                      self.endpoints)
+                                      self.endpoints, cluster=cluster)
         if self.selector is not None:
             wid = self.selector(tokens, self.block_size, overlaps, self.endpoints)
         else:
             wid = default_selector(tokens, self.block_size, overlaps,
                                    self.endpoints, candidates=candidates)
+        self.last_choice = next(
+            (c for c in candidates if c["worker_id"] == wid), None) \
+            if wid is not None else None
         self._record(tokens, salt, candidates, wid)
         if wid is not None and self.on_hit_rate:
             self.on_hit_rate(KVHitRateEvent(
@@ -248,7 +280,8 @@ class KvScheduler:
                                poll_s: float = 0.05,
                                timeout_s: float = 30.0,
                                salt: int = 0,
-                               fast_fail: Optional[bool] = None) -> int:
+                               fast_fail: Optional[bool] = None,
+                               cluster=None) -> int:
         """Wait for capacity when all workers are saturated — unless
         ``fast_fail`` (param, or ``DYN_ROUTER_FAST_FAIL``, or a brownout
         level above normal at the router service) is active: then a fully
@@ -259,7 +292,8 @@ class KvScheduler:
             fast_fail = _fast_fail_enabled()
         deadline = asyncio.get_event_loop().time() + timeout_s
         while True:
-            wid = self.schedule(tokens, overlaps, salt=salt)
+            wid = self.schedule(tokens, overlaps, salt=salt,
+                                cluster=cluster)
             if fast_fail:
                 why = self._all_unavailable(tokens, overlaps, wid)
                 if why is not None:

@@ -6,6 +6,15 @@ with the same prefix re-uploads instead of recomputing. Capacity is
 fixed-slot: each tier is one preallocated array of block slots + an LRU map,
 so steady-state serving does zero host allocation.
 
+Cross-thread contract: the engine thread owns all tier mutation on the
+serving path (offload at eviction flush, lookup at admission), but the
+cluster-sharing plane (``llm/kv_cluster/``) reads AND deposits blocks from
+the asyncio thread — peer fetches land fetched prefixes here, and the
+``kv_fetch`` endpoint serves blocks out. :class:`TieredKvCache` therefore
+guards every access with one internal lock; ``peek`` reads a block without
+perturbing LRU order (safe for probes and peer serving), and ``hashes``
+snapshots the resident hash sets for the cluster registry publisher.
+
 Reference capability: the multi-tier KV manager design HBM->CPU->SSD
 (docs/kv_cache_manager.md:5-15,39-71, lib/llm/src/kv/storage.rs pinned/system
 tiers) — host-staged rather than GPUDirect, which is the TPU reality.
@@ -14,9 +23,16 @@ tiers) — host-staged rather than GPUDirect, which is the TPU reality.
 from __future__ import annotations
 
 import collections
-from typing import Dict, Optional, Tuple
+import logging
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ...utils.prometheus import stage_metrics
+
+log = logging.getLogger("dynamo_tpu.kvbm")
 
 
 class _SlotCache:
@@ -65,6 +81,14 @@ class _SlotCache:
         self._slot_of.move_to_end(seq_hash)
         return self._k[slot], self._v[slot]
 
+    def peek(self, seq_hash: int
+             ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Read WITHOUT touching LRU order (probes, peer serving)."""
+        slot = self._slot_of.get(seq_hash)
+        if slot is None:
+            return None
+        return self._k[slot], self._v[slot]
+
     def pop(self, seq_hash: int) -> None:
         slot = self._slot_of.pop(seq_hash, None)
         if slot is not None:
@@ -86,9 +110,38 @@ class DiskKvTier(_SlotCache):
     def __init__(self, num_blocks: int, block_shape: Tuple[int, ...], dtype,
                  path: str):
         shape = (num_blocks, *block_shape)
+        self.path = path
         k = np.memmap(path + ".k", dtype=dtype, mode="w+", shape=shape)
         v = np.memmap(path + ".v", dtype=dtype, mode="w+", shape=shape)
         super().__init__(num_blocks, block_shape, dtype, k, v)
+        self._closed = False
+
+    def close(self) -> None:
+        """Flush and remove the spill files. ``mode="w+"`` memmaps are
+        scratch state: a worker that exits without this leaks two
+        block-pool-sized files in the spill directory per engine."""
+        if self._closed:
+            return
+        self._closed = True
+        for arr in (self._k, self._v):
+            try:
+                arr.flush()
+            except (OSError, ValueError):
+                log.warning("disk tier flush failed for %s", self.path,
+                            exc_info=True)
+        # drop the memmap references before unlinking so the interpreter
+        # can release the mappings promptly
+        self._k = self._v = None
+        self._slot_of.clear()
+        self._free.clear()
+        for suffix in (".k", ".v"):
+            try:
+                os.unlink(self.path + suffix)
+            except FileNotFoundError:
+                pass
+            except OSError:
+                log.warning("could not remove KV spill file %s%s",
+                            self.path, suffix, exc_info=True)
 
 
 class TieredKvCache:
@@ -96,7 +149,12 @@ class TieredKvCache:
 
     ``offload`` inserts at the host tier and cascades host-LRU evictions to
     disk; ``lookup`` checks host then disk (promoting disk hits back to
-    host). All arrays are [L, Hkv, page, Dh] per block.
+    host). All arrays are [L, Hkv, page, Dh] per block. Thread-safe: every
+    method takes the internal lock, so the engine thread and the cluster
+    data plane (peer fetch deposit/serve on the asyncio thread) can share
+    one instance. ``on_change`` fires (outside the lock) whenever the
+    resident hash sets changed — the cluster registry publisher's dirty
+    signal.
     """
 
     def __init__(self, host: HostKvTier, disk: Optional[DiskKvTier] = None):
@@ -104,36 +162,102 @@ class TieredKvCache:
         self.disk = disk
         self.hits = 0
         self.misses = 0
+        # one lock shared by the engine thread and the asyncio data plane
+        self._lock = threading.RLock()
+        self.on_change: Optional[Callable[[], None]] = None
+        self._worker = str(os.getpid())
 
     def __contains__(self, seq_hash: int) -> bool:
-        return seq_hash in self.host or (
-            self.disk is not None and seq_hash in self.disk)
+        with self._lock:
+            return seq_hash in self.host or (
+                self.disk is not None and seq_hash in self.disk)
+
+    def _set_block_gauges(self) -> None:
+        g = stage_metrics().kv_tier_blocks
+        g.set("host", self._worker, value=float(len(self.host)))
+        if self.disk is not None:
+            g.set("disk", self._worker, value=float(len(self.disk)))
 
     def offload(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+        with self._lock:
+            self._offload_locked(seq_hash, k, v)
+        self._fire_change()
+
+    def _offload_locked(self, seq_hash: int, k: np.ndarray,
+                        v: np.ndarray) -> None:
+        """Insert + cascade under the already-held lock, WITHOUT firing
+        ``on_change`` — public entry points fire exactly once after the
+        lock drops (a callback that needs the lock must not deadlock)."""
         spilled = self.host.put(seq_hash, k, v)
         if spilled is not None and self.disk is not None:
             self.disk.put(*spilled)
+        self._set_block_gauges()
 
     def lookup(self, seq_hash: int
                ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-        got = self.host.get(seq_hash)
-        if got is None and self.disk is not None:
-            got = self.disk.get(seq_hash)
-            if got is not None:       # promote to host (may spill another)
-                k, v = got[0].copy(), got[1].copy()
-                self.disk.pop(seq_hash)
-                self.offload(seq_hash, k, v)
-                got = (k, v)
-        if got is None:
-            self.misses += 1
-        else:
-            self.hits += 1
+        stage = stage_metrics()
+        promoted = False
+        with self._lock:
+            got = self.host.get(seq_hash)
+            tier = "host" if got is not None else None
+            if got is None and self.disk is not None:
+                got = self.disk.get(seq_hash)
+                if got is not None:   # promote to host (may spill another)
+                    tier = "disk"
+                    k, v = got[0].copy(), got[1].copy()
+                    self.disk.pop(seq_hash)
+                    self._offload_locked(seq_hash, k, v)
+                    got = (k, v)
+                    promoted = True
+            if got is None:
+                self.misses += 1
+                stage.kv_tier_misses.inc()
+            else:
+                self.hits += 1
+                stage.kv_tier_hits.inc(tier)
+        if promoted:
+            self._fire_change()
         return got
 
+    def peek(self, seq_hash: int
+             ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Copy a resident block without promoting/LRU-touching it — what
+        the ``kv_fetch`` donor endpoint serves peers from. Returns fresh
+        copies (the slot may be recycled the moment the lock drops)."""
+        with self._lock:
+            got = self.host.peek(seq_hash)
+            if got is None and self.disk is not None:
+                got = self.disk.peek(seq_hash)
+            if got is None:
+                return None
+            return got[0].copy(), got[1].copy()
+
+    def hashes(self) -> Tuple[List[int], List[int]]:
+        """Snapshot of the resident (host, disk) sequence hashes — the
+        cluster registry publisher's record body."""
+        with self._lock:
+            return (list(self.host._slot_of),
+                    list(self.disk._slot_of) if self.disk is not None
+                    else [])
+
     def stats(self) -> Dict[str, int]:
-        return {
-            "host_blocks": len(self.host),
-            "disk_blocks": len(self.disk) if self.disk is not None else 0,
-            "hits": self.hits,
-            "misses": self.misses,
-        }
+        with self._lock:
+            return {
+                "host_blocks": len(self.host),
+                "disk_blocks": len(self.disk) if self.disk is not None
+                else 0,
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+    def close(self) -> None:
+        """Release the disk tier's spill files (engine shutdown)."""
+        with self._lock:
+            if self.disk is not None:
+                self.disk.close()
+                self.disk = None
+
+    def _fire_change(self) -> None:
+        cb = self.on_change
+        if cb is not None:
+            cb()

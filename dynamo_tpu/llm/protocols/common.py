@@ -85,6 +85,14 @@ class BackendInput:
     # this request (its decode degenerates to plain single-token steps
     # inside the verify dispatch).
     no_spec: bool = False
+    # cluster KV sharing (llm/kv_cluster/): the donor worker the router
+    # elected for this request's prefix (0 = none). The receiving worker
+    # fetches the blocks it lacks from this peer's host tier BEFORE the
+    # request enters the engine — no registry round-trip on the worker.
+    # kv_donor_blocks bounds the fetch to the consecutive prefix length
+    # the router actually scored (the donor may have sealed more since).
+    kv_donor: int = 0
+    kv_donor_blocks: int = 0
     # VLM: normalized pixel arrays ([3, H, W]; the engine's vision tower
     # encodes them at prefill). On the wire each image travels as
     # {"b64": base64 raw bytes, "shape": [...], "dtype": "..."} — nested
@@ -144,6 +152,8 @@ class BackendInput:
             lora_id=int(d.get("lora_id", 0)),
             kv_salt=int(d.get("kv_salt", 0)),
             no_spec=bool(d.get("no_spec", False)),
+            kv_donor=int(d.get("kv_donor", 0)),
+            kv_donor_blocks=int(d.get("kv_donor_blocks", 0)),
             images=images,
         )
 

@@ -78,6 +78,13 @@ class RemoteCoreEngine(AsyncEngine[BackendInput, EngineOutput]):
                     wid = resp.get("worker_id")
                     if wid is not None and wid in self.worker_client.instances:
                         mode, instance_id = "direct", wid
+                        # cluster KV sharing: carry the router's donor
+                        # election to the worker, which fetches the prefix
+                        # peer-to-peer before the request enters its engine
+                        if resp.get("kv_donor"):
+                            request.kv_donor = int(resp["kv_donor"])
+                            request.kv_donor_blocks = int(
+                                resp.get("kv_donor_blocks", 0))
                     break
             except EngineError:
                 log.warning("router unavailable; falling back to random")

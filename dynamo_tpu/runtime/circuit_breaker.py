@@ -29,18 +29,12 @@ import os
 import time
 from typing import Dict, List
 
+from ..utils.knobs import env_float as _env_float
+
 log = logging.getLogger("dynamo_tpu.circuit")
 
 CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
 _STATE_VALUE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        log.warning("ignoring malformed %s=%r", name, os.environ.get(name))
-        return default
 
 
 class _Entry:

@@ -139,6 +139,16 @@ _ALL: List[KeyFamily] = [
         helpers=("planner_prefix", "state_key", "override_key",
                  "decisions_prefix")),
     KeyFamily(
+        name="kv-cluster",
+        pattern="kv_cluster/{ns}/{component}/{worker_id:x}",
+        owner="llm/kv_cluster/registry.py", lifecycle=LEASE,
+        description="cluster-wide sealed-block registry: one lease-bound "
+                    "record per worker (tier geometry + resident host/disk "
+                    "hashes) watched by routers for cluster-hit scoring; "
+                    "dead owners' records vanish with their lease",
+        prefix="kv_cluster/", helpers=("cluster_key", "cluster_prefix"),
+        constants=("KV_CLUSTER_PREFIX",)),
+    KeyFamily(
         name="disagg-config",
         pattern="disagg/{ns}/{model}",
         owner="llm/disagg.py", lifecycle=PERSISTENT,

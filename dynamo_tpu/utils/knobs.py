@@ -25,8 +25,35 @@ framework and tier-1 tests import it without touching jax or the runtime.
 
 from __future__ import annotations
 
+import logging
+import os
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Mapping, Optional
+
+log = logging.getLogger("dynamo_tpu.knobs")
+
+
+def env_float(name: str, default: float,
+              env: Optional[Mapping[str, str]] = None,
+              minimum: Optional[float] = None) -> float:
+    """Parse a float knob, warning and falling back to ``default`` on a
+    malformed (or, with ``minimum``, out-of-range) value — a bad env var
+    must never crash a component at startup. This is the one shared copy
+    of the parse policy, next to the registry the values are declared in.
+    ``env`` overrides ``os.environ`` (tests pass a plain dict)."""
+    raw = (os.environ if env is None else env).get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        log.warning("ignoring malformed %s=%r", name, raw)
+        return default
+    if minimum is not None and val < minimum:
+        log.warning("ignoring out-of-range %s=%r (minimum %s)",
+                    name, raw, minimum)
+        return default
+    return val
 
 #: doc shorthand per subsystem (keeps the table rows terse)
 _DOCS = {
@@ -199,6 +226,21 @@ _ALL: List[Knob] = [
        "capacity-waiting"),
     _k("DYN_ROUTER_AUDIT", "int", "512", "router",
        "router decision audit ring capacity"),
+    _k("DYN_KV_CLUSTER", "bool", "0", "router",
+       "cluster-wide KV sharing: workers publish sealed-block registry "
+       "records + serve/consume kv_fetch, routers stamp donors"),
+    _k("DYN_KV_CLUSTER_PUBLISH_INTERVAL", "float", "1.0", "router",
+       "min seconds between a worker's registry record writes "
+       "(seal/evict-driven, write-coalesced)"),
+    _k("DYN_KV_CLUSTER_FETCH_TIMEOUT", "float", "5.0", "router",
+       "peer prefix fetch budget, seconds; expiry falls back to local "
+       "prefill recompute"),
+    _k("DYN_KV_CLUSTER_MAX_BLOCKS", "int", "0", "router",
+       "cap on KV blocks per peer fetch, donor and receiver side "
+       "(0 = unlimited)"),
+    _k("DYN_KV_CLUSTER_PEER_WEIGHT", "float", "0.5", "router",
+       "score value of a free peer-held block relative to a local block "
+       "(discounted further by estimated transfer time)"),
     # ----------------------------------------------------------------- llm
     _k("DYN_TOKEN_ECHO_DELAY_MS", "float", "10", "llm",
        "echo-engine per-token pacing, milliseconds (0 = as fast as "

@@ -42,12 +42,12 @@ import asyncio
 import collections
 import json
 import logging
-import os
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from ..runtime.engine import EngineError
+from .knobs import env_float as _env_float
 
 log = logging.getLogger("dynamo_tpu.overload")
 
@@ -83,18 +83,6 @@ class OverloadError(EngineError):
                  retry_after: Optional[float] = None, code: int = 429):
         super().__init__(message, code, stage=stage, reason=reason,
                          retry_after=retry_after)
-
-
-def _env_float(name: str, default: float,
-               env: Optional[Dict[str, str]] = None) -> float:
-    raw = (os.environ if env is None else env).get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        log.warning("ignoring malformed %s=%r", name, raw)
-        return default
 
 
 # ---------------------------------------------------------------------------

@@ -444,6 +444,36 @@ class StageMetrics:
             "Observed per-item service time of a bounded stage (the "
             "predictive shed's wait estimate input)", ("stage",),
             buckets=LATENCY_BUCKETS_FAST + (2.5, 10.0, 60.0))
+        # KV tier + cluster-sharing plane (llm/kvbm/tiers.py and
+        # llm/kv_cluster/): host/disk tier effectiveness was previously a
+        # dict nobody scraped; cluster sharing makes the tiers a fleet
+        # resource, so their hit economics must be first-class series
+        self.kv_tier_hits = r.counter(
+            "dyn_kv_tier_hits_total",
+            "KV tier lookups served from a tier (admission restores and "
+            "disk promotions)", ("tier",))   # host|disk
+        self.kv_tier_misses = r.counter(
+            "dyn_kv_tier_misses_total",
+            "KV tier lookups that missed every local tier", ())
+        self.kv_tier_blocks = r.gauge(
+            "dyn_kv_tier_blocks",
+            "Sealed KV blocks resident per tier", ("tier", "worker"))
+        self.kv_cluster_hits = r.counter(
+            "dyn_kv_cluster_hits_total",
+            "Routed requests whose cluster-registry match exceeded the "
+            "chosen worker's local overlap (a donor was stamped)", ())
+        self.kv_cluster_fetches = r.counter(
+            "dyn_kv_cluster_fetches_total",
+            "Peer prefix fetches that deposited blocks into the local "
+            "host tier", ())
+        self.kv_cluster_fallbacks = r.counter(
+            "dyn_kv_cluster_fallbacks_total",
+            "Cluster fetches abandoned (timeout / donor death / error) — "
+            "the request fell back to local prefill recompute", ())
+        self.kv_cluster_fetch_seconds = r.histogram(
+            "dyn_kv_cluster_fetch_seconds",
+            "Peer prefix fetch duration, request out to blocks deposited",
+            (), buckets=LATENCY_BUCKETS_FAST + (2.5, 10.0))
 
     def clear_worker(self, worker: str) -> None:
         """Drop every per-worker gauge series for ``worker`` (pid). Wired
@@ -452,6 +482,7 @@ class StageMetrics:
         ghost occupancy/MFU for an engine that no longer exists."""
         for g in (self.batch_occupancy, self.mfu, self.mbu, self.hbm_gbps):
             g.clear_label(0, worker)
+        self.kv_tier_blocks.clear_label(1, worker)   # (tier, worker)
 
 
 _stage: Optional[StageMetrics] = None

@@ -1,0 +1,560 @@
+"""Cluster-wide KV sharing (llm/kv_cluster/): registry records + index,
+transfer-cost model, router cluster-hit scoring, publisher lifecycle over a
+real store (publish / coalesce / lease-death expiry), the peer-fetch e2e
+loopback (worker B fetches worker A's host tier via the registry and serves
+with zero prefill recompute), and donor-death fallback (no hung request)."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+from dynamo_tpu.llm.kv_cluster import (
+    KV_FETCH_ENDPOINT,
+    ClusterFetcher,
+    ClusterOverlap,
+    ClusterRecord,
+    KvClusterIndex,
+    KvClusterPublisher,
+    TransferCostModel,
+    cluster_key,
+)
+from dynamo_tpu.llm.kv_cluster.fetch import make_kv_fetch_handler
+from dynamo_tpu.llm.kvbm.tiers import HostKvTier, TieredKvCache
+from dynamo_tpu.llm.protocols.common import BackendInput, StopConditions
+from dynamo_tpu.llm.tokens import compute_seq_hashes
+from dynamo_tpu.models import llama
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.store_client import StoreClient
+from dynamo_tpu.runtime.store_server import StoreServer
+from dynamo_tpu.utils.prometheus import stage_metrics
+
+BLOCK_SHAPE = (2, 2, 4, 8)
+
+
+def _blk(seed):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(BLOCK_SHAPE).astype(np.float32),
+            rng.standard_normal(BLOCK_SHAPE).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Registry records + index (pure)
+# ---------------------------------------------------------------------------
+
+def test_cluster_record_roundtrip_and_tiers():
+    rec = ClusterRecord(worker_id=0xab, component="backend",
+                        geometry={"layers": 2, "kv_heads": 2, "page": 4,
+                                  "head_dim": 8, "dtype": "float32"},
+                        host=[11, 22], disk=[33], seq=3)
+    back = ClusterRecord.from_bytes(rec.to_bytes())
+    assert back.worker_id == 0xab and back.seq == 3
+    assert back.holds(11) and back.holds(33) and not back.holds(44)
+    assert back.tier_of(22) == "host" and back.tier_of(33) == "disk"
+    assert back.tier_of(44) is None
+    assert back.block_count == 3
+    # 2 (k+v) * layers*heads*page*head_dim * 4 bytes
+    assert back.block_bytes() == 2 * 2 * 2 * 4 * 8 * 4
+    # unknown geometry -> 0, never a crash
+    assert ClusterRecord(worker_id=1).block_bytes() == 0
+
+
+async def test_index_find_consecutive_prefix_and_deletes():
+    idx = KvClusterIndex()
+    h = [101, 102, 103, 104]
+    a = ClusterRecord(worker_id=1, component="backend",
+                      host=[101, 102, 103], disk=[104])
+    b = ClusterRecord(worker_id=2, component="backend",
+                      host=[101, 103])                    # gap at 102
+    await idx._on_change("kv_cluster/dyn/backend/1", a.to_bytes(), False)
+    await idx._on_change("kv_cluster/dyn/backend/2", b.to_bytes(), False)
+    # malformed record is ignored, not fatal
+    await idx._on_change("kv_cluster/dyn/backend/ff", b"junk", False)
+    ov = idx.find(h)
+    assert ov.owners == {1: 4, 2: 1}          # consecutive prefix only
+    assert ov.blocks == 4
+    # component filter: foreign components are not fetchable donors
+    assert idx.find(h, component="backend").owners == {1: 4, 2: 1}
+    assert idx.find(h, component="prefill").owners == {}
+    # watch delete (lease death) removes the owner from scoring
+    await idx._on_change("kv_cluster/dyn/backend/1", None, True)
+    assert idx.find(h).owners == {2: 1}
+    # no owner holds the first block -> empty
+    assert idx.find([999]).owners == {}
+
+
+def test_donor_election_excludes_self_and_requires_extension():
+    ov = ClusterOverlap(owners={1: 4, 2: 2, 3: 6})
+    # worker 3 asking: nobody beats its own 6 blocks
+    assert ov.donor_for(3, 6) == (None, 0)
+    # worker 1 asking with 4 local-equivalent blocks: only 3 extends
+    assert ov.donor_for(1, 4) == (3, 6)
+    # an unknown worker with nothing local: best owner wins
+    assert ov.donor_for(99, 0) == (3, 6)
+    # a donor must strictly extend past what's already local
+    assert ov.donor_for(99, 6) == (None, 0)
+
+
+def test_transfer_cost_model_weight():
+    m = TransferCostModel(base_weight=0.5)
+    # nothing measured: default bandwidth, tiny fetch ~ free
+    assert m.weight(1, 1024) == pytest.approx(0.5, rel=1e-3)
+    # fold merged llm_kv_transfer series: 2 GB over 2 s -> 1 GB/s
+    m.update_from_states([
+        ("w", {"llm_kv_transfer_seconds":
+               {"series": {"('h2d',)": {"sum": 2.0}}},
+               "llm_kv_transfer_bytes_total":
+               {"series": {"('h2d',)": 2e9}}}),
+    ])
+    assert m.bytes_per_s == pytest.approx(1e9)
+    # a one-second fetch is worth half the base weight; never zero
+    assert m.weight(1000, 1_000_000) == pytest.approx(0.25, rel=1e-3)
+    assert m.weight(10_000, 1_000_000) > 0.0
+
+
+# ---------------------------------------------------------------------------
+# Router cluster-hit scoring: local hit > peer hit > miss
+# ---------------------------------------------------------------------------
+
+def _endpoints(*wids):
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.kv_router.scheduler import KvScheduler
+
+    sched = KvScheduler(block_size=8)
+    sched.update_endpoints({
+        w: ForwardPassMetrics(request_active_slots=0, request_total_slots=8,
+                              kv_active_blocks=0, kv_total_blocks=100,
+                              num_requests_waiting=0)
+        for w in wids})
+    return sched
+
+
+def _no_overlap():
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+
+    return OverlapScores()
+
+
+def test_score_candidates_cluster_ordering():
+    from dynamo_tpu.llm.kv_router.scheduler import score_candidates
+
+    sched = _endpoints(1, 2, 3)
+    tokens = list(range(32))                   # 4 blocks of 8
+    # worker 1 holds the full prefix in its own tiers (local-equivalent
+    # hit); 2 and 3 hold nothing -> they'd fetch from 1 at peer weight
+    cluster = ClusterOverlap(owners={1: 4}, weight=0.5)
+    cands = score_candidates(tokens, 8, _no_overlap(), sched.endpoints,
+                             cluster=cluster)
+    by = {c["worker_id"]: c for c in cands}
+    assert by[1]["overlap_norm"] == pytest.approx(1.0)
+    assert by[1]["kv_donor"] is None           # nothing to fetch
+    assert by[2]["kv_donor"] == 1 and by[2]["kv_donor_blocks"] == 4
+    assert by[2]["overlap_norm"] == pytest.approx(0.5)
+    # the ordering the tentpole promises: local hit > peer hit > miss
+    miss = score_candidates(tokens, 8, _no_overlap(), sched.endpoints,
+                            cluster=None)
+    assert (by[1]["overlap_norm"] > by[2]["overlap_norm"]
+            > miss[0]["overlap_norm"] == 0.0)
+    # and the scheduler routes to the tier-resident owner
+    assert sched.schedule(tokens, _no_overlap(), cluster=cluster) == 1
+
+
+def test_cluster_scoring_prefers_device_overlap_on_par():
+    """A candidate's own tier residency counts like a device hit — the
+    effective overlap is max(device, own-tier), not their sum."""
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+    from dynamo_tpu.llm.kv_router.scheduler import score_candidates
+
+    sched = _endpoints(1, 2)
+    tokens = list(range(32))
+    overlaps = OverlapScores()
+    overlaps.scores = {1: 4}                   # device blocks on 1
+    cluster = ClusterOverlap(owners={1: 4, 2: 2}, weight=0.5)
+    by = {c["worker_id"]: c for c in
+          score_candidates(tokens, 8, overlaps, sched.endpoints,
+                           cluster=cluster)}
+    assert by[1]["cluster_local_blocks"] == 4  # max, not 8
+    assert by[1]["overlap_norm"] == pytest.approx(1.0)
+    # 2 holds 2 locally, can fetch the other 2 from 1 at weight
+    assert by[2]["cluster_local_blocks"] == 2
+    assert by[2]["kv_donor"] == 1
+    assert by[2]["overlap_norm"] == pytest.approx((2 + 0.5 * 2) / 4)
+
+
+# ---------------------------------------------------------------------------
+# Publisher lifecycle over a real store
+# ---------------------------------------------------------------------------
+
+async def test_registry_publish_coalesce_and_lease_death():
+    store = StoreServer()
+    port = await store.start()
+    a = await StoreClient(port=port).connect()
+    b = await StoreClient(port=port).connect()
+    try:
+        lease = await a.lease_grant(ttl=30.0)
+        tiered = TieredKvCache(HostKvTier(4, BLOCK_SHAPE, np.float32))
+        tiered.offload(11, *_blk(1))
+        tiered.offload(22, *_blk(2))
+        pub = await KvClusterPublisher(a, "dyn", "backend", 7, lease,
+                                       tiered, interval=0.02).start()
+        idx = await KvClusterIndex().start(b, "dyn")
+        assert 7 in idx.records
+        rec = idx.records[7]
+        assert rec.holds(11) and rec.holds(22) and rec.component == "backend"
+        assert rec.geometry["page"] == BLOCK_SHAPE[2]
+
+        # seal-driven republish: a new offload marks dirty -> the watch
+        # delivers the updated record without any polling on our side
+        tiered.offload(33, *_blk(3))
+        for _ in range(100):
+            if 7 in idx.records and idx.records[7].holds(33):
+                break
+            await asyncio.sleep(0.02)
+        assert idx.records[7].holds(33)
+
+        # unchanged content is genuinely silent (no store write)
+        assert await pub.publish() == "skipped"
+        assert await pub.publish(force=True) == "put"
+
+        # lease death reaps the record: the watch delete drops the owner
+        await b.lease_revoke(lease)
+        for _ in range(100):
+            if 7 not in idx.records:
+                break
+            await asyncio.sleep(0.02)
+        assert 7 not in idx.records
+        assert idx.find([11]).owners == {}
+        await pub.stop()
+    finally:
+        await a.close()
+        await b.close()
+        await store.stop()
+
+
+async def test_publisher_stop_deletes_record_promptly():
+    store = StoreServer()
+    port = await store.start()
+    c = await StoreClient(port=port).connect()
+    try:
+        lease = await c.lease_grant(ttl=30.0)
+        tiered = TieredKvCache(HostKvTier(2, BLOCK_SHAPE, np.float32))
+        tiered.offload(5, *_blk(5))
+        pub = await KvClusterPublisher(c, "dyn", "backend", 9, lease,
+                                       tiered, interval=0.02).start()
+        key = cluster_key("dyn", "backend", 9)
+        assert await c.get(key) is not None
+        await pub.stop()
+        assert await c.get(key) is None        # no tombstone wait
+        assert tiered.on_change is None        # hook detached
+    finally:
+        await c.close()
+        await store.stop()
+
+
+# ---------------------------------------------------------------------------
+# Peer fetch: e2e loopback + donor death
+# ---------------------------------------------------------------------------
+
+def _cfg(**kw):
+    d = dict(model=llama.preset("tiny-byte"), tp=1, page_size=8, max_batch=2,
+             max_context=128, prefill_chunk=32)
+    d.update(kw)
+    return JaxEngineConfig(**d)
+
+
+def _run(core, seq_id, tokens, max_tokens=4):
+    core.submit(seq_id, BackendInput(
+        token_ids=list(tokens),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True)))
+    got = []
+    for _ in range(200):
+        for so in core.step():
+            if so.seq_id == seq_id:
+                got.append(so)
+                if so.finish is not None:
+                    return got
+    raise AssertionError("did not finish")
+
+
+async def test_peer_fetch_e2e_loopback():
+    """Worker B misses locally, fetches the shared prefix from worker A's
+    host tier via the registry, and serves it with zero prefill recompute
+    of the shared blocks."""
+    stage = stage_metrics()
+    fetched0 = stage.kv_cluster_fetches.get()
+    store = StoreServer()
+    port = await store.start()
+    drt_a = drt_b = None
+    try:
+        drt_a = await DistributedRuntime(
+            store_port=port, advertise_host="127.0.0.1").connect()
+        drt_b = await DistributedRuntime(
+            store_port=port, advertise_host="127.0.0.1").connect()
+        # worker A: real engine, write-through mirrors sealed blocks to
+        # the host tier while they are still hot on device. Compile + run
+        # in a thread: blocking the loop starves the DRT lease keepalive
+        # and the store expires the lease mid-test.
+        core_a = await asyncio.to_thread(
+            EngineCore, _cfg(host_cache_blocks=16,
+                             cluster_writethrough=True))
+        prompt = list(range(1, 41))            # 5 full pages of 8
+        first = [g.token
+                 for g in await asyncio.to_thread(_run, core_a, "a", prompt)]
+        assert core_a.tiered.stats()["host_blocks"] >= 4, \
+            "write-through did not mirror sealed prefill blocks"
+
+        comp_a = drt_a.namespace("dyn").component("backend")
+        await comp_a.endpoint(KV_FETCH_ENDPOINT).serve(
+            make_kv_fetch_handler(core_a.tiered))
+        pub = await KvClusterPublisher(
+            drt_a.store, "dyn", "backend", drt_a.worker_id, drt_a.lease,
+            core_a.tiered, interval=0.05).start()
+
+        # router side: the registry (not a worker round-trip) elects A
+        idx = await KvClusterIndex().start(drt_b.store, "dyn")
+        hashes = compute_seq_hashes(prompt, 8)
+        donor, blocks = idx.find(hashes).donor_for(drt_b.worker_id, 0)
+        assert donor == drt_a.worker_id and blocks >= 4
+
+        # worker B: no shared state with A beyond the store
+        core_b = await asyncio.to_thread(
+            EngineCore, _cfg(host_cache_blocks=16))
+        comp_b = drt_b.namespace("dyn").component("backend")
+        client = await comp_b.endpoint(KV_FETCH_ENDPOINT).client().start()
+        for _ in range(100):
+            if donor in client.instances:
+                break
+            await asyncio.sleep(0.05)
+        assert donor in client.instances
+        fetcher = ClusterFetcher(core_b, client, drt_b.worker_id,
+                                 timeout=10.0)
+        bi = BackendInput(
+            token_ids=prompt,
+            stop=StopConditions(max_tokens=4, ignore_eos=True),
+            kv_donor=donor, kv_donor_blocks=blocks)
+        n = await fetcher.ensure_prefix(bi, Context())
+        assert n == blocks
+        assert core_b.tiered.stats()["host_blocks"] >= blocks
+        assert stage.kv_cluster_fetches.get() == fetched0 + 1
+
+        # admission restores the deposited blocks: identical output,
+        # shared prefix served from cache instead of recomputed
+        again = [g.token
+                 for g in await asyncio.to_thread(_run, core_b, "b", prompt)]
+        assert again == first
+        assert core_b.last_prefix_hit >= 32    # >= 4 of 5 pages restored
+        assert core_b.tiered.stats()["hits"] >= 4
+
+        # re-probe: the blocks are local now, nothing left to fetch
+        assert await fetcher.ensure_prefix(bi, Context()) == 0
+        await pub.stop()
+    finally:
+        if drt_b is not None:
+            await drt_b.close()
+        if drt_a is not None:
+            await drt_a.close()
+        await store.stop()
+
+
+class _FakePool:
+    page_size = 8
+
+    def probe_prefix(self, tokens, host_lookup=None, lora_id=0):
+        return 0
+
+
+class _FakeCore:
+    def __init__(self, tiered):
+        self.tiered = tiered
+        self.pool = _FakePool()
+
+
+async def test_donor_death_mid_fetch_falls_back():
+    """Killing the donor mid-stream degrades to local prefill within the
+    fetch budget — the request is never hung and nothing is deposited."""
+    stage = stage_metrics()
+    fb0 = stage.kv_cluster_fallbacks.get()
+    store = StoreServer()
+    port = await store.start()
+    drt_a = drt_b = None
+    try:
+        drt_a = await DistributedRuntime(
+            store_port=port, advertise_host="127.0.0.1").connect()
+        drt_b = await DistributedRuntime(
+            store_port=port, advertise_host="127.0.0.1").connect()
+
+        async def stalling_handler(request, ctx):
+            # meta frame lands, then the donor "dies" mid-transfer
+            yield {"blocks": 2, "layers": 2, "kv_heads": 2, "page": 4,
+                   "head_dim": 8, "dtype": "float32",
+                   "hashes": [1, 2]}
+            await asyncio.sleep(60)            # unbounded-ok: test stub
+
+        comp_a = drt_a.namespace("dyn").component("backend")
+        await comp_a.endpoint(KV_FETCH_ENDPOINT).serve(stalling_handler)
+        comp_b = drt_b.namespace("dyn").component("backend")
+        client = await comp_b.endpoint(KV_FETCH_ENDPOINT).client().start()
+        donor = drt_a.worker_id
+        for _ in range(100):
+            if donor in client.instances:
+                break
+            await asyncio.sleep(0.05)
+
+        tiered = TieredKvCache(HostKvTier(4, BLOCK_SHAPE, np.float32))
+        fetcher = ClusterFetcher(_FakeCore(tiered), client, drt_b.worker_id,
+                                 timeout=2.0)
+        bi = BackendInput(
+            token_ids=list(range(16)),
+            stop=StopConditions(max_tokens=1, ignore_eos=True),
+            kv_donor=donor, kv_donor_blocks=2)
+        t0 = time.monotonic()
+        task = asyncio.create_task(fetcher.ensure_prefix(bi, Context()))
+        await asyncio.sleep(0.2)
+        await drt_a.close()                    # kill the donor mid-fetch
+        drt_a = None
+        n = await asyncio.wait_for(task, 10.0)
+        assert n == 0                          # fell back, nothing landed
+        assert time.monotonic() - t0 < 5.0     # bounded, not hung
+        assert tiered.stats()["host_blocks"] == 0
+        assert stage.kv_cluster_fallbacks.get() >= fb0 + 1
+    finally:
+        if drt_b is not None:
+            await drt_b.close()
+        if drt_a is not None:
+            await drt_a.close()
+        await store.stop()
+
+
+async def test_fetch_timeout_falls_back_without_donor_death():
+    """A donor that is alive but too slow trips the fetch budget: the
+    request proceeds with local prefill, no blocks deposited."""
+    stage = stage_metrics()
+    fb0 = stage.kv_cluster_fallbacks.get()
+    store = StoreServer()
+    port = await store.start()
+    drt_a = drt_b = None
+    try:
+        drt_a = await DistributedRuntime(
+            store_port=port, advertise_host="127.0.0.1").connect()
+        drt_b = await DistributedRuntime(
+            store_port=port, advertise_host="127.0.0.1").connect()
+
+        async def slow_handler(request, ctx):
+            await asyncio.sleep(60)            # unbounded-ok: test stub
+            yield {"blocks": 0}
+
+        comp_a = drt_a.namespace("dyn").component("backend")
+        await comp_a.endpoint(KV_FETCH_ENDPOINT).serve(slow_handler)
+        comp_b = drt_b.namespace("dyn").component("backend")
+        client = await comp_b.endpoint(KV_FETCH_ENDPOINT).client().start()
+        donor = drt_a.worker_id
+        for _ in range(100):
+            if donor in client.instances:
+                break
+            await asyncio.sleep(0.05)
+
+        tiered = TieredKvCache(HostKvTier(4, BLOCK_SHAPE, np.float32))
+        fetcher = ClusterFetcher(_FakeCore(tiered), client, drt_b.worker_id,
+                                 timeout=0.3)
+        bi = BackendInput(
+            token_ids=list(range(16)),
+            stop=StopConditions(max_tokens=1, ignore_eos=True),
+            kv_donor=donor, kv_donor_blocks=2)
+        t0 = time.monotonic()
+        n = await asyncio.wait_for(
+            fetcher.ensure_prefix(bi, Context()), 10.0)
+        assert n == 0
+        assert 0.2 < time.monotonic() - t0 < 5.0
+        assert stage.kv_cluster_fallbacks.get() >= fb0 + 1
+    finally:
+        if drt_b is not None:
+            await drt_b.close()
+        if drt_a is not None:
+            await drt_a.close()
+        await store.stop()
+
+
+def test_tier_metrics_series_and_dyntop_cluster_line():
+    """The tier/cluster planes are real Prometheus series (not a dict
+    nobody scrapes): lookups move the counters, occupancy rides the
+    per-worker gauge (cleared with the worker), and the fleet sums render
+    as dyntop's ``cluster:`` line."""
+    import os
+
+    from dynamo_tpu.cli.dyntop import cluster_kv_totals, render
+
+    stage = stage_metrics()
+    worker = str(os.getpid())
+    hits0 = stage.kv_tier_hits.get("host")
+    miss0 = stage.kv_tier_misses.get()
+    tiered = TieredKvCache(HostKvTier(2, BLOCK_SHAPE, np.float32))
+    tiered.offload(7, *_blk(7))
+    assert tiered.lookup(7) is not None
+    assert tiered.lookup(8) is None
+    assert stage.kv_tier_hits.get("host") == hits0 + 1
+    assert stage.kv_tier_misses.get() == miss0 + 1
+    assert stage.kv_tier_blocks.get("host", worker) == 1.0
+    # ghost-worker cleanup drops this worker's occupancy series
+    stage.clear_worker(worker)
+    assert stage.kv_tier_blocks.get("host", worker) == 0.0
+
+    states = [("backend", {
+        "dyn_kv_tier_hits_total": {"series": {"('host',)": 3.0,
+                                              "('disk',)": 1.0}},
+        "dyn_kv_tier_misses_total": {"series": {"()": 1.0}},
+        "dyn_kv_tier_blocks": {"series": {"('host', '1')": 5.0}},
+        "dyn_kv_cluster_hits_total": {"series": {"()": 2.0}},
+        "dyn_kv_cluster_fetches_total": {"series": {"()": 4.0}},
+        "dyn_kv_cluster_fallbacks_total": {"series": {"()": 1.0}},
+    })]
+    totals = cluster_kv_totals(states)
+    assert totals == {"tier_hits": 4.0, "tier_misses": 1.0, "hits": 2.0,
+                      "fetches": 4.0, "fallbacks": 1.0, "tier_blocks": 5.0}
+    text = render({"namespace": "x", "workers": {"backend": {}},
+                   "cluster": totals})
+    line = next(l for l in text.splitlines() if l.startswith("cluster:"))
+    assert "tier_blocks=5" in line and "tier_hit%=80.0" in line
+    assert "peer_hits=2" in line and "fetches=4" in line \
+        and "fallbacks=1" in line
+    # plane off (all-zero): no cluster line rendered
+    off = render({"namespace": "x", "workers": {"backend": {}},
+                  "cluster": {k: 0.0 for k in totals}})
+    assert "cluster:" not in off
+
+
+def test_kv_fetch_handler_serves_consecutive_and_caps(monkeypatch):
+    """The donor endpoint serves only the consecutive resident prefix and
+    honors DYN_KV_CLUSTER_MAX_BLOCKS on its side too."""
+    tiered = TieredKvCache(HostKvTier(8, BLOCK_SHAPE, np.float32))
+    blks = {h: _blk(h) for h in (1, 2, 4)}     # hole at 3
+    for h, (k, v) in blks.items():
+        tiered.offload(h, k, v)
+    handler = make_kv_fetch_handler(tiered)
+
+    async def drive(hashes):
+        items = []
+        async for item in handler({"hashes": hashes}, Context()):
+            items.append(item)
+        return items
+
+    items = asyncio.run(drive([1, 2, 3, 4]))
+    meta = items[0]
+    assert meta["blocks"] == 2                 # stops at the hole
+    assert meta["hashes"] == [1, 2]
+    L = meta["layers"]
+    assert len(items) - 1 == 2 * L             # layer-major k/v parts
+    # reconstruct block 2's layer-0 k from the concatenated part
+    part0 = np.frombuffer(items[1], np.float32).reshape(
+        meta["kv_heads"], 2 * meta["page"], meta["head_dim"])
+    np.testing.assert_array_equal(
+        part0[:, meta["page"]:, :], blks[2][0][0])
+
+    monkeypatch.setenv("DYN_KV_CLUSTER_MAX_BLOCKS", "1")
+    items = asyncio.run(drive([1, 2]))
+    assert items[0]["blocks"] == 1
+
+    empty = asyncio.run(drive([99]))
+    assert empty == [{"blocks": 0}]

@@ -110,6 +110,104 @@ def test_tiered_cascade_to_disk_and_promote(tmp_path):
     assert cache.stats()["hits"] == 1
 
 
+def test_tiered_promotion_triggers_secondary_spill(tmp_path):
+    """Promoting a disk hit back to a FULL host tier must spill the host
+    LRU to disk — the cascade the cluster fetch path leans on."""
+    host = HostKvTier(2, (2, 2, 4, 8), np.float32)
+    disk = DiskKvTier(3, (2, 2, 4, 8), np.float32, str(tmp_path / "s"))
+    cache = TieredKvCache(host, disk)
+    blks = {h: _blk(h) for h in (10, 20, 30)}
+    for h, (k, v) in blks.items():
+        cache.offload(h, k, v)          # host holds {20,30}; 10 on disk
+    assert 10 in cache.disk and 10 not in cache.host
+    got = cache.lookup(10)              # promote 10; host LRU (20) spills
+    np.testing.assert_array_equal(got[0], blks[10][0])
+    assert 10 in cache.host
+    assert 20 in cache.disk and 20 not in cache.host
+    # the secondary spill kept the data intact
+    np.testing.assert_array_equal(cache.lookup(20)[0], blks[20][0])
+
+
+def test_slot_cache_pop_reuses_slot():
+    """pop() returns the physical slot to the free list; the next put must
+    land in it instead of erroring out of capacity."""
+    host = HostKvTier(2, (2, 2, 4, 8), np.float32)
+    k1, v1 = _blk(1)
+    k2, v2 = _blk(2)
+    k3, v3 = _blk(3)
+    host.put(10, k1, v1)
+    host.put(20, k2, v2)
+    host.pop(10)
+    assert len(host) == 1
+    assert host.put(30, k3, v3) is None   # reused slot, no eviction
+    np.testing.assert_array_equal(host.get(30)[0], k3)
+    assert host.get(10) is None
+
+
+def test_tiered_peek_does_not_perturb_lru(tmp_path):
+    """peek (the kv_fetch donor read) must not reorder the LRU: the
+    peeked block still evicts first under pressure."""
+    host = HostKvTier(2, (2, 2, 4, 8), np.float32)
+    cache = TieredKvCache(host)
+    k1, v1 = _blk(1)
+    k2, v2 = _blk(2)
+    k3, v3 = _blk(3)
+    cache.offload(10, k1, v1)
+    cache.offload(20, k2, v2)
+    got = cache.peek(10)                 # LRU order must stay 10 < 20
+    np.testing.assert_array_equal(got[0], k1)
+    got[0][:] = 0                        # peek returns copies, not views
+    np.testing.assert_array_equal(cache.peek(10)[0], k1)
+    cache.offload(30, k3, v3)            # evicts 10 (peek didn't touch it)
+    assert cache.peek(10) is None and 20 in cache and 30 in cache
+
+
+def test_disk_tier_close_removes_spill_files(tmp_path):
+    path = str(tmp_path / "spill")
+    disk = DiskKvTier(2, (2, 2, 4, 8), np.float32, path)
+    k1, v1 = _blk(1)
+    disk.put(10, k1, v1)
+    assert (tmp_path / "spill.k").exists()
+    disk.close()
+    assert not (tmp_path / "spill.k").exists()
+    assert not (tmp_path / "spill.v").exists()
+    disk.close()                         # idempotent
+
+
+def test_tiered_close_and_hashes_snapshot(tmp_path):
+    host = HostKvTier(1, (2, 2, 4, 8), np.float32)
+    disk = DiskKvTier(2, (2, 2, 4, 8), np.float32, str(tmp_path / "s"))
+    cache = TieredKvCache(host, disk)
+    k1, v1 = _blk(1)
+    k2, v2 = _blk(2)
+    cache.offload(10, k1, v1)
+    cache.offload(20, k2, v2)            # 10 cascaded to disk
+    h, d = cache.hashes()
+    assert h == [20] and d == [10]
+    cache.close()
+    assert not (tmp_path / "s.k").exists()
+    assert cache.disk is None            # disk tier detached
+
+
+def test_tiered_on_change_fires_on_offload_and_promotion(tmp_path):
+    events = []
+    host = HostKvTier(1, (2, 2, 4, 8), np.float32)
+    disk = DiskKvTier(2, (2, 2, 4, 8), np.float32, str(tmp_path / "s"))
+    cache = TieredKvCache(host, disk)
+    cache.on_change = lambda: events.append(1)
+    k1, v1 = _blk(1)
+    k2, v2 = _blk(2)
+    cache.offload(10, k1, v1)
+    cache.offload(20, k2, v2)
+    assert len(events) == 2
+    cache.lookup(10)                     # disk promotion changes tier sets
+    assert len(events) >= 3
+    cache.peek(20)                       # peek must NOT fire
+    n = len(events)
+    assert cache.lookup(999) is None     # miss must NOT fire
+    assert len(events) == n
+
+
 # ---------------------------------------------------------------------------
 # Engine-level prefix reuse + offload
 # ---------------------------------------------------------------------------
