@@ -55,13 +55,117 @@ def parse_args(argv=None):
     dget = dsub.add_parser("get")
     dget.add_argument("--namespace", default="dynamo")
     dget.add_argument("--model", default="default")
+
+    # fleet plane: the desired-state model registry (fleet_models/)
+    fleet = sub.add_parser("fleet")
+    fsub = fleet.add_subparsers(dest="action", required=True)
+    fadd = fsub.add_parser("add")
+    fadd.add_argument("name")
+    fadd.add_argument("--namespace", default="dynamo")
+    fadd.add_argument("--component", default=None,
+                      help="worker component for this model's pool "
+                           "(default: backend-<name>)")
+    fadd.add_argument("--engine", default="jax")
+    fadd.add_argument("--model-path", default=None)
+    fadd.add_argument("--chips", type=int, default=1,
+                      help="chips per replica (0 = exempt from the "
+                           "global chip budget)")
+    fadd.add_argument("--min-replicas", type=int, default=0,
+                      help="replica floor (0 allows scale-to-zero)")
+    fadd.add_argument("--max-replicas", type=int, default=4)
+    fadd.add_argument("--priority", type=int, default=0,
+                      help="arbitration rank: higher takes chips first")
+    fadd.add_argument("--tenant", action="append", default=[],
+                      metavar="TENANT:rps=R,burst=B,concurrency=C",
+                      help="per-tenant quota entry (repeatable), e.g. "
+                           "--tenant acme:rps=5,burst=10,concurrency=8")
+    fadd.add_argument("--worker-args", default="",
+                      help="extra args for spawned workers, "
+                           "space-separated")
+    frem = fsub.add_parser("remove")
+    frem.add_argument("name")
+    frem.add_argument("--namespace", default="dynamo")
+    flist = fsub.add_parser("list")
+    flist.add_argument("--namespace", default="dynamo")
     return p.parse_args(argv)
+
+
+def parse_tenant_quota(entry: str):
+    """``acme:rps=5,burst=10,concurrency=8`` -> ("acme", TenantQuota)."""
+    from ..utils.overload import TenantQuota
+
+    tenant, _, rest = entry.partition(":")
+    if not tenant or not rest:
+        raise SystemExit(f"--tenant {entry!r}: expected "
+                         f"TENANT:rps=R[,burst=B][,concurrency=C]")
+    fields = {}
+    for part in rest.split(","):
+        key, _, val = part.partition("=")
+        if key not in ("rps", "burst", "concurrency") or not val:
+            raise SystemExit(f"--tenant {entry!r}: unknown field {part!r}")
+        try:
+            fields[key] = float(val)
+        except ValueError:
+            raise SystemExit(f"--tenant {entry!r}: {key}={val!r} is not "
+                             f"a number")
+    return tenant, TenantQuota(
+        rps=fields.get("rps", 0.0), burst=fields.get("burst", 0.0),
+        concurrency=int(fields.get("concurrency", 0)))
 
 
 async def run(args) -> int:
     host, port = args.store.split(":")
     store = await StoreClient(host, int(port)).connect()
     try:
+        if args.plane == "fleet":
+            from ..fleet.registry import (FleetModelSpec, fetch_fleet_status,
+                                          list_fleet_models,
+                                          put_fleet_model,
+                                          remove_fleet_model)
+
+            if args.action == "add":
+                card = None
+                if args.model_path:
+                    card = ModelDeploymentCard.resolve(
+                        args.model_path, args.name).to_dict()
+                spec = FleetModelSpec(
+                    name=args.name, component=args.component or "",
+                    engine=args.engine, model_path=args.model_path,
+                    chips_per_replica=args.chips,
+                    min_replicas=args.min_replicas,
+                    max_replicas=args.max_replicas,
+                    priority=args.priority,
+                    tenants=dict(parse_tenant_quota(t)
+                                 for t in args.tenant),
+                    card=card,
+                    extra_args=[a for a in args.worker_args.split() if a])
+                await put_fleet_model(store, args.namespace, spec)
+                print(f"fleet add {args.name}: component="
+                      f"{spec.component} chips/replica={spec.chips_per_replica} "
+                      f"replicas=[{spec.min_replicas},{spec.max_replicas}] "
+                      f"priority={spec.priority} "
+                      f"tenants={sorted(spec.tenants) or '-'}")
+            elif args.action == "remove":
+                await remove_fleet_model(store, args.namespace, args.name)
+                print(f"fleet remove {args.name}: the planner drains its "
+                      f"pool on the next tick")
+            elif args.action == "list":
+                specs = await list_fleet_models(store, args.namespace)
+                status = await fetch_fleet_status(store, args.namespace)
+                if not specs:
+                    print(f"(no fleet models registered in "
+                          f"{args.namespace!r})")
+                for s in specs:
+                    st = status.get(s.name, {})
+                    print(f"{s.name:<24} {s.component:<20} "
+                          f"state={st.get('state', 'unreconciled'):<10} "
+                          f"replicas={st.get('replicas', '?')}/"
+                          f"[{s.min_replicas},{s.max_replicas}] "
+                          f"chips={st.get('chips', '?')} "
+                          f"prio={s.priority} "
+                          f"burn={st.get('burn', '?')} "
+                          f"tenants={sorted(s.tenants) or '-'}")
+            return 0
         if args.plane == "disagg":
             from ..llm.disagg import (DisaggConfig, disagg_config_key,
                                       set_disagg_config)

@@ -83,8 +83,33 @@ class ClusterSnapshotter:
                                       shed_totals)
 
         states = await fetch_stage_states(self.store, self.namespace)
+        # fleet plane: per-model pool rows (and their components join the
+        # worker table automatically — a fleet's pools are per-model, so
+        # a static --component list would render an empty fleet)
+        from ..fleet.registry import fetch_fleet_status, list_fleet_models
+
+        fleet: Dict[str, Dict] = {}
+        try:
+            specs = await list_fleet_models(self.store, self.namespace)
+            if specs:
+                status = await fetch_fleet_status(self.store,
+                                                  self.namespace)
+                for s in specs:
+                    fleet[s.name] = {
+                        "component": s.component,
+                        "min": s.min_replicas, "max": s.max_replicas,
+                        "priority": s.priority,
+                        "chips_per_replica": s.chips_per_replica,
+                        **(status.get(s.name) or {"state": "unreconciled"}),
+                    }
+        except Exception:  # noqa: BLE001 - fleet plane optional
+            pass
+        components = list(self.components)
+        for f in fleet.values():
+            if f["component"] not in components:
+                components.append(f["component"])
         workers: Dict[str, Dict] = {}
-        for comp in self.components:
+        for comp in components:
             workers[comp] = await fetch_worker_metrics(
                 self.store, self.namespace, comp)
         q_depth = 0
@@ -143,6 +168,7 @@ class ClusterSnapshotter:
         }
         return {
             "cluster": cluster_kv_totals(states),
+            "fleet": fleet,
             "at": time.time(),
             "namespace": self.namespace,
             "store": store_stats,
@@ -317,6 +343,23 @@ def render(snap: Dict, store_detail: bool = False) -> str:
                     f"{int(g.get('keys', 0)):>7} "
                     f"{g.get('bytes', 0) / 2**20:>8.2f} "
                     f"{int(g.get('queue_depth', 0)):>6}")
+    fleet = snap.get("fleet") or {}
+    if fleet:
+        lines.append("fleet:")
+        lines.append(
+            f"  {'model':<20} {'comp':<18} {'state':<11} {'repl':>9} "
+            f"{'chips':>5} {'prio':>4} {'burn':>6} {'unsrv':>5}")
+        for name in sorted(fleet):
+            f = fleet[name]
+            repl = (f"{f.get('replicas', '?')}->{f.get('target', '?')}"
+                    if f.get("target") is not None
+                    else str(f.get("replicas", "?")))
+            lines.append(
+                f"  {name:<20} {f.get('component', '?'):<18} "
+                f"{f.get('state', '?'):<11} {repl:>9} "
+                f"{f.get('chips', 0):>5} {f.get('priority', 0):>4} "
+                f"{float(f.get('burn') or 0.0):>6.2f} "
+                f"{int(f.get('unserved') or 0):>5}")
     cl = snap.get("cluster") or {}
     if any(cl.values()):
         th, tm = cl.get("tier_hits", 0), cl.get("tier_misses", 0)

@@ -132,7 +132,7 @@ class DiscoveryFrontend:
             card = ModelDeploymentCard.from_dict(d["card"])
             worker = await self._client_for(d["endpoint"])
             router = await self._router_for(d["endpoint"].split(".")[0])
-            core = RemoteCoreEngine(worker, router)
+            core = RemoteCoreEngine(worker, router, model_name=name)
             served = self.manager.get(name) or ServedModel(card)
             if mtype == "chat":
                 served.chat_engine = OpenAIChatEngine(card, core)
@@ -197,6 +197,42 @@ async def run_http(args, *, ready_event=None,
     except Exception:
         log.warning("brownout watch failed; serving at level 0",
                     exc_info=True)
+
+    # fleet plane (multi-model registry): /v1/models reports per-model
+    # state, registered models' 404s get their own (bounded) metric label
+    # so the planner can scale them from zero, and the per-tenant quota
+    # table follows the registry's per-model tenant tables live
+    from ..fleet.registry import FleetRegistry, fetch_fleet_status
+    from ..utils.overload import tenant_quotas_from_env
+
+    try:
+        fleet_reg = await FleetRegistry(drt.store, pub_ns).start()
+    except Exception:
+        fleet_reg = None
+        log.warning("fleet registry watch failed; serving without the "
+                    "fleet view", exc_info=True)
+    if fleet_reg is not None:
+        svc.known_models = lambda: set(fleet_reg.models)
+
+        async def fleet_status():
+            status = await fetch_fleet_status(drt.store, pub_ns)
+            for name, spec in fleet_reg.snapshot().items():
+                # registered but never reconciled (no planner yet):
+                # still listed, state honest about the blind spot
+                status.setdefault(name, {"state": "unknown",
+                                         "component": spec.component})
+            return status
+
+        svc.fleet_status = fleet_status
+        env_quotas = tenant_quotas_from_env()
+
+        def refresh_quotas(*_):
+            table = dict(env_quotas)
+            table.update(fleet_reg.tenant_quotas())
+            svc.tenants.set_quotas(table)
+
+        fleet_reg.on_change = refresh_quotas
+        refresh_quotas()
 
     publisher = StagePublisher(drt.store, pub_ns, "http", drt.worker_id,
                                drt.lease)

@@ -51,6 +51,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--down-consensus", type=int, default=3)
     p.add_argument("--dry-run", action="store_true",
                    help="publish decisions but never actuate")
+    p.add_argument("--fleet", action="store_true",
+                   help="reconcile the multi-model fleet registry "
+                        "(fleet_models/): pool set follows `ctl fleet "
+                        "add/remove` live, targets pass through the "
+                        "chip arbiter under --total-chips, per-model "
+                        "status published to fleet_status/")
     p.add_argument("--brownout", action="store_true",
                    help="run the SLO-burn brownout controller on this "
                         "loop (publishes the fleet degradation level; "
@@ -141,9 +147,17 @@ def build_connector(args, pools):
 
 
 async def run_planner(args, *, ready_event=None, drt=None) -> None:
-    pools = {"decode": args.decode_component}
-    if args.prefill_component:
-        pools["prefill"] = args.prefill_component
+    # getattr: harnesses build the Namespace by hand (chaos/soak rigs)
+    fleet_mode = getattr(args, "fleet", False)
+    if fleet_mode:
+        # fleet mode: the pool set comes from the model registry, live —
+        # starting empty is normal (models `ctl fleet add`-ed later join
+        # on the next tick)
+        pools = {}
+    else:
+        pools = {"decode": args.decode_component}
+        if args.prefill_component:
+            pools["prefill"] = args.prefill_component
     own_drt = drt is None
     if own_drt:
         host, port = args.store.split(":")
@@ -159,13 +173,20 @@ async def run_planner(args, *, ready_event=None, drt=None) -> None:
         cooldown_down=args.cooldown_down,
         down_consensus=args.down_consensus, dry_run=args.dry_run,
         brownout=args.brownout)
+    fleet = None
+    if fleet_mode:
+        from ..fleet import FleetPlane
+
+        fleet = FleetPlane(drt.store, args.namespace,
+                           total_chips=args.total_chips)
     planner = await Planner(drt, args.namespace, pools, policy, connector,
-                            cfg).start()
+                            cfg, fleet=fleet).start()
     mode = "DRY-RUN" if args.dry_run else "live"
-    log.info("planner %s: pools=%s policy=%s connector=%s", mode, pools,
-             policy.name, connector.name)
+    log.info("planner %s: pools=%s policy=%s connector=%s fleet=%s", mode,
+             pools, policy.name, connector.name, bool(fleet))
     print(f"planner serving ({mode}, policy={policy.name}, "
-          f"connector={connector.name}, pools={pools})", flush=True)
+          f"connector={connector.name}, "
+          f"pools={'<fleet registry>' if fleet else pools})", flush=True)
     if ready_event is not None:
         ready_event.set()
     try:

@@ -71,18 +71,35 @@ async def run(args) -> int:
             flags = [mode, f"policy={st.get('policy')}",
                      f"connector={st.get('connector')}",
                      f"clamps={st.get('clamps')}"]
+            if st.get("fleet"):
+                flags.append("FLEET")
             if st.get("paused"):
                 flags.append("PAUSED")
             print(f"planner[{ns}] {' '.join(flags)} "
                   f"(state {age:.1f}s old)")
+            # fleet mode: per-model status records carry what the state
+            # doc cannot (target, lifecycle state, chips)
+            fstatus = {}
+            if st.get("fleet"):
+                from ..fleet.registry import fetch_fleet_status
+
+                fstatus = await fetch_fleet_status(store, ns)
             for pool, d in sorted((st.get("pools") or {}).items()):
                 ov = (st.get("overrides") or {}).get(pool)
+                fs = fstatus.get(pool, {})
+                fleet_cols = ""
+                if fs:
+                    fleet_cols = (f" state={fs.get('state')} "
+                                  f"target={fs.get('target')} "
+                                  f"chips={fs.get('chips')}")
                 print(f"  {pool:<8} component={d.get('component')} "
                       f"replicas={d.get('replicas')} "
                       f"occupancy={d.get('occupancy')} "
                       f"queue={d.get('queue_depth')} "
                       f"kv={d.get('kv_utilization')} "
+                      f"burn={d.get('slo_burn')} "
                       f"breaker_open={d.get('breaker_open')}"
+                      + fleet_cols
                       + (f" OVERRIDE->{ov}" if ov is not None else ""))
             return 0
         if args.action == "decisions":

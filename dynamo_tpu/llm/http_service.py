@@ -75,7 +75,7 @@ class HttpService:
     def __init__(self, manager: Optional[ModelManager] = None,
                  host: str = "0.0.0.0", port: int = 8080, store=None,
                  namespace: Optional[str] = None,
-                 router_decisions=None, admission=None):
+                 router_decisions=None, admission=None, tenants=None):
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
@@ -84,7 +84,21 @@ class HttpService:
         # fleet brownout level (armed against the store by cli/http)
         self.admission = admission if admission is not None \
             else overload.AdmissionController.from_env()
+        # per-tenant quotas (x-tenant header): DYN_TENANT_QUOTAS env table,
+        # refreshed live from the fleet registry's per-model tenant tables
+        # by cli/http. Inert when no tenant has a quota.
+        self.tenants = tenants if tenants is not None \
+            else overload.TenantAdmission.from_env()
         self.brownout = overload.BrownoutState()
+        # fleet plane hooks (cli/http wires both in discovery mode):
+        # async () -> {model: status_dict} merging fleet_models/ desired
+        # state with the planner's lease-bound fleet_status/ records —
+        # GET /v1/models reports per-model state instead of bare names
+        self.fleet_status = None
+        # () -> set of registry model names: a 404 for a REGISTERED model
+        # is labelled with its name (bounded set — the planner's
+        # scale-from-zero wake signal); everything else stays "unknown"
+        self.known_models = None
         # optional dynstore client: lets /v1/traces fetch spans published by
         # worker processes and /metrics merge their stage histograms —
         # scoped to ``namespace`` when set (a shared store may carry other
@@ -104,7 +118,7 @@ class HttpService:
         m = self.registry
         self.m_requests = m.counter(
             "dyn_http_requests_total", "HTTP requests",
-            ("model", "endpoint", "status"))
+            ("model", "endpoint", "status", "tenant"))
         self.m_inflight = m.gauge(
             "dyn_http_inflight_requests", "In-flight requests", ("model",))
         self.m_duration = m.histogram(
@@ -227,14 +241,34 @@ class HttpService:
 
     async def _models(self, _req: web.Request) -> web.Response:
         now = int(time.time())
+        rows = {
+            m.card.name: {"id": m.card.name, "object": "model",
+                          "created": now, "owned_by": "dynamo_tpu",
+                          "context_length": m.card.context_length}
+            for m in self.manager.list()
+        }
+        # fleet view: per-model state (ready/booting/draining/off),
+        # replica counts and targets from the registry + the planner's
+        # lease-bound status — including registered models with NO live
+        # replica (scaled to zero / still booting), which the discovery
+        # manager alone cannot see
+        if self.fleet_status is not None:
+            try:
+                for name, st in (await self.fleet_status()).items():
+                    row = rows.setdefault(name, {
+                        "id": name, "object": "model", "created": now,
+                        "owned_by": "dynamo_tpu"})
+                    row["state"] = st.get("state", "unknown")
+                    for fld in ("replicas", "target", "component",
+                                "chips", "priority"):
+                        if st.get(fld) is not None:
+                            row[fld] = st[fld]
+            except Exception:
+                log.exception("fleet status fetch failed; serving bare "
+                              "model list")
         return web.json_response({
             "object": "list",
-            "data": [
-                {"id": m.card.name, "object": "model", "created": now,
-                 "owned_by": "dynamo_tpu",
-                 "context_length": m.card.context_length}
-                for m in self.manager.list()
-            ],
+            "data": sorted(rows.values(), key=lambda r: r["id"]),
         })
 
     # ------------------------------------------------------------------
@@ -244,41 +278,67 @@ class HttpService:
     async def _completions(self, req: web.Request) -> web.StreamResponse:
         return await self._serve(req, "completions")
 
+    def _count(self, model: str, endpoint: str, status: str,
+               tenant: str) -> None:
+        """The one request-accounting path: the HTTP counter (tenant
+        label bounded to the quota table + 'other') and the per-tenant
+        stage counter the fleet-wide tenant burn is computed from."""
+        tlabel = self.tenants.label(tenant)
+        self.m_requests.inc(model, endpoint, status, tlabel)
+        self.stage.tenant_requests.inc(tlabel, status)
+
     async def _serve(self, req: web.Request, endpoint: str) -> web.StreamResponse:
         started = time.monotonic()
         # ---- overload admission: the cheapest possible shed, decided from
         # headers alone before the body is even read. A rejected request
         # costs microseconds and a 429 + Retry-After — never a queue slot,
-        # never a deadline burn.
+        # never a deadline burn. Order: brownout (fleet state), tenant
+        # quota (isolation — a hog is shed before it touches the shared
+        # caps), then the global admission gate.
+        tenant = overload.DEFAULT_TENANT
         try:
             priority = overload.parse_priority(
                 req.headers.get(overload.PRIORITY_HEADER))
+            tenant = overload.parse_tenant(
+                req.headers.get(overload.TENANT_HEADER))
         except ValueError as e:
-            self.m_requests.inc("unknown", endpoint, "400")
+            self._count("unknown", endpoint, "400", tenant)
             return _err(400, str(e))
         level = self.brownout.level
-        shed = overload.brownout_reject(priority, level) \
-            or self.admission.try_admit(priority)
+        tenant_held = False
+        shed = overload.brownout_reject(priority, level)
+        if shed is None:
+            shed = self.tenants.try_admit(tenant, priority)
+            tenant_held = shed is None
+        if shed is None:
+            shed = self.admission.try_admit(priority)
+            if shed is not None and tenant_held:
+                self.tenants.release(tenant)
+                tenant_held = False
         if shed is not None:
-            self.m_requests.inc("unknown", endpoint, str(shed.code))
+            self._count("unknown", endpoint, str(shed.code), tenant)
             return _err_engine(shed)
         try:
             return await self._serve_admitted(req, endpoint, started,
-                                              priority, level)
+                                              priority, level, tenant)
         finally:
             self.admission.release()
+            self.tenants.release(tenant)
 
     async def _serve_admitted(self, req: web.Request, endpoint: str,
-                              started: float, priority: str,
-                              level: int) -> web.StreamResponse:
+                              started: float, priority: str, level: int,
+                              tenant: str) -> web.StreamResponse:
         model_name = "unknown"
         try:
             body = await req.json()
+        # dynalint: ok(swallowed-exception) malformed client JSON: counted
+        # through _count (the tenant-labelled request counter) and
+        # answered with a 400 — the parse error text is client data
         except Exception:
-            self.m_requests.inc(model_name, endpoint, "400")
+            self._count(model_name, endpoint, "400", tenant)
             return _err(400, "invalid JSON body")
         if not isinstance(body, dict):
-            self.m_requests.inc(model_name, endpoint, "400")
+            self._count(model_name, endpoint, "400", tenant)
             return _err(400, "request body must be a JSON object")
         try:
             if endpoint == "chat":
@@ -286,16 +346,16 @@ class HttpService:
             else:
                 oai_req = CompletionRequest.from_dict(body)
         except ProtocolError as e:
-            self.m_requests.inc("unknown", endpoint, "400")
+            self._count("unknown", endpoint, "400", tenant)
             return _err(400, str(e))
         except Exception as e:
             # any other parse failure is still the client's malformed input
-            self.m_requests.inc("unknown", endpoint, "400")
+            self._count("unknown", endpoint, "400", tenant)
             return _err(400, f"malformed request: {e}")
         try:
             timeout = _request_timeout(req)
         except ValueError as e:
-            self.m_requests.inc("unknown", endpoint, "400")
+            self._count("unknown", endpoint, "400", tenant)
             return _err(400, str(e))
         # brownout degradation (fleet level, store-published): shrink the
         # work an admitted request may cost — cap max_tokens, drop
@@ -312,9 +372,15 @@ class HttpService:
                              else served.completion_engine)
         if engine is None:
             # label with a constant to keep metric cardinality bounded
-            # (model names of 404s are client-controlled)
-            self.m_requests.inc("unknown", endpoint, "404")
-            return _err(404, f"model {model_name!r} not found")
+            # (model names of 404s are client-controlled) — EXCEPT for
+            # fleet-registered models, a bounded set whose 404s are the
+            # planner's scale-from-zero wake signal
+            known = self.known_models() if self.known_models else ()
+            label = model_name if model_name in known else "unknown"
+            self._count(label, endpoint, "404", tenant)
+            return _err(404, f"model {model_name!r} not found"
+                        + (" (registered, no live replica — booting or "
+                           "scaled to zero)" if label != "unknown" else ""))
 
         # end-to-end deadline (x-request-timeout header, DYN_REQUEST_TIMEOUT
         # default): every downstream hop sees it via the context / wire
@@ -387,7 +453,7 @@ class HttpService:
                 tracing.current_span_var.reset(root_token)
             tracer.finish(root, status="ok" if status == "200" else "error")
             self.m_inflight.dec(model_name)
-            self.m_requests.inc(model_name, endpoint, status)
+            self._count(model_name, endpoint, status, tenant)
             self.m_duration.observe(model_name, endpoint,
                                     value=time.monotonic() - started)
 

@@ -166,10 +166,15 @@ class KvScheduler:
 
     def __init__(self, block_size: int,
                  selector: Optional[WorkerSelector] = None,
-                 on_hit_rate: Optional[Callable[[KVHitRateEvent], None]] = None):
+                 on_hit_rate: Optional[Callable[[KVHitRateEvent], None]] = None,
+                 model: Optional[str] = None):
         self.block_size = block_size
         self.selector = selector
         self.on_hit_rate = on_hit_rate
+        # fleet mode: the model this scheduler's candidate set serves —
+        # stamped on every audit-ring entry so a merged multi-model
+        # decision log stays attributable
+        self.model = model
         self.endpoints = ProcessedEndpoints()
         # optional callable -> set of breaker-OPEN worker ids (wired by the
         # router service when it has breaker visibility); fast-fail treats
@@ -219,6 +224,7 @@ class KvScheduler:
         # saw the full-precision values
         self.decisions.append({
             "seq": self._seq,
+            **({"model": self.model} if self.model is not None else {}),
             "at": time.time(),
             "isl_tokens": len(tokens),
             "isl_blocks": max(1, len(tokens) // self.block_size),

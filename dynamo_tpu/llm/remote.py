@@ -58,9 +58,14 @@ class RemoteCoreEngine(AsyncEngine[BackendInput, EngineOutput]):
     request to the returned worker (KV-aware routing)."""
 
     def __init__(self, worker_client: Client,
-                 router_client: Optional[Client] = None):
+                 router_client: Optional[Client] = None,
+                 model_name: Optional[str] = None):
         self.worker_client = worker_client
         self.router_client = router_client
+        # fleet routing: the model this engine serves, carried on every
+        # route request so a FleetKvRouter scores the right candidate
+        # set (single-model routers ignore the field)
+        self.model_name = model_name
 
     async def generate(self, request: BackendInput,
                        context: Context) -> AsyncIterator[EngineOutput]:
@@ -73,7 +78,9 @@ class RemoteCoreEngine(AsyncEngine[BackendInput, EngineOutput]):
                         # engine publishes blocks under — score overlap with
                         # it so image prompts get router-side prefix credit
                         {"token_ids": request.token_ids,
-                         "lora_id": request.kv_salt or request.lora_id},
+                         "lora_id": request.kv_salt or request.lora_id,
+                         **({"model": self.model_name}
+                            if self.model_name else {})},
                         context.child()):
                     wid = resp.get("worker_id")
                     if wid is not None and wid in self.worker_client.instances:

@@ -114,6 +114,21 @@ class LocalConnector:
                 "--advertise-host", "127.0.0.1",
                 "--metrics-interval", "0.25", *spec.extra_args]
 
+    # ------------------------------------------------------------------
+    # dynamic pool membership (the fleet plane adds/removes model pools
+    # while the planner runs)
+    def set_pool(self, pool: str, spec: PoolSpec) -> None:
+        self.pools[pool] = spec
+        self.owned.setdefault(pool, [])
+
+    async def remove_pool(self, pool: str) -> None:
+        """A model left the registry: gracefully drain every worker this
+        connector owns in its pool, then forget the spec. Externally
+        started workers are (as ever) not ours to signal."""
+        for o in self.live_owned(pool):
+            await self._drain(o, pool)
+        self.pools.pop(pool, None)
+
     def live_owned(self, pool: str) -> List[_Owned]:
         """Owned workers still running (reaps exited ones' allocations)."""
         alive = []
@@ -247,6 +262,23 @@ class KubeConnector:
 
     def _service(self, pool: str) -> str:
         return self.service_for_pool.get(pool, pool).lower()
+
+    def set_pool(self, pool: str, spec) -> None:
+        """Fleet-plane hook: map a model pool onto its CRD service name
+        (the PoolSpec's component; the reconciler owns the rest)."""
+        self.service_for_pool.setdefault(
+            pool, getattr(spec, "component", pool))
+
+    async def remove_pool(self, pool: str) -> None:
+        """A model left the registry: patch its service to zero replicas
+        (the registry contract — 'the planner's next tick drains the
+        pool') and drop the mapping. A missing resource is fine: the
+        deployment may never have been reconciled."""
+        try:
+            await asyncio.to_thread(self._apply_sync, pool, 0)
+        except RuntimeError:
+            log.info("fleet pool %s: no kube resource to drain", pool)
+        self.service_for_pool.pop(pool, None)
 
     def _apply_sync(self, pool: str, target: int) -> None:
         svc = self._service(pool)

@@ -36,7 +36,7 @@ from ..llm.metrics_aggregator import stage_key
 from ..runtime.store_client import StoreError
 from ..utils import tracing
 from ..utils.prometheus import Registry
-from .policy import HOLD, Decision, PlannerCore
+from .policy import HOLD, SCALE_DOWN, SCALE_UP, Decision, PlannerCore
 from .signals import PoolSignals, SignalCollector
 
 log = logging.getLogger("dynamo_tpu.planner")
@@ -120,12 +120,17 @@ class Planner:
     (e.g. ``{"decode": "backend", "prefill": "prefill"}``)."""
 
     def __init__(self, drt, namespace: str, pools: Dict[str, str],
-                 policy, connector, config: Optional[PlannerConfig] = None):
+                 policy, connector, config: Optional[PlannerConfig] = None,
+                 fleet=None):
         self.drt = drt
         self.namespace = namespace
         self.pools = dict(pools)
         self.config = config or PlannerConfig()
         self.connector = connector
+        # fleet mode (dynamo_tpu/fleet): the pool set follows the model
+        # registry live, targets pass through the chip arbiter, and a
+        # lease-bound status record is published per model each tick
+        self.fleet = fleet
         self.core = PlannerCore(
             policy,
             min_replicas=self.config.min_replicas,
@@ -151,6 +156,8 @@ class Planner:
 
     # ------------------------------------------------------------------
     async def start(self) -> "Planner":
+        if self.fleet is not None:
+            await self.fleet.start()
         await self._watch_override()
         await self._resume_seq()
         self._task = asyncio.create_task(self._run_loop())
@@ -223,15 +230,28 @@ class Planner:
         now = time.time() if now is None else now
         tracer = tracing.get_tracer()
         async with tracer.span("planner.evaluate"):
+            if self.fleet is not None:
+                await self.fleet.sync(self)
             signals = await self.collector.collect()
             self._last_signals = signals
             await self._brownout_tick(signals)
             decisions = self.core.evaluate(signals, now)
-            for d in decisions:
+            if self.fleet is not None:
+                decisions = self.fleet.arbitrate(decisions, signals)
+            # scale-ups actuate BEFORE scale-downs: a booting worker's
+            # weight load overlaps the donor pool's drain, so a chip
+            # handoff between models costs one boot, not boot + drain in
+            # series (and scale-to-zero cold boots hide behind drains)
+            order = {SCALE_UP: 0, HOLD: 1, SCALE_DOWN: 2}
+            for d in sorted(decisions,
+                            key=lambda d: order.get(d.action, 1)):
                 await self._publish_decision(d)
                 self._export(d, signals.get(d.pool))
                 if d.action != HOLD and not d.dry_run:
                     await self._actuate(d)
+            if self.fleet is not None and not self.config.dry_run:
+                await self.fleet.publish_status(self.drt, decisions,
+                                                signals)
         self.metrics.evaluations.inc()
         await self._publish_state(now)
         return decisions
@@ -305,6 +325,7 @@ class Planner:
             "paused": self.core.paused,
             "overrides": self.core.overrides,
             "clamps": [self.config.min_replicas, self.config.max_replicas],
+            "fleet": self.fleet is not None,
             "pools": {
                 pool: {
                     "component": comp,
@@ -314,6 +335,7 @@ class Planner:
                     "kv_utilization":
                         round(s.kv_utilization, 3) if s else None,
                     "breaker_open": s.breaker_open if s else None,
+                    "slo_burn": round(s.slo_pressure, 3) if s else None,
                 }
                 for pool, comp in self.pools.items()
                 for s in (self._last_signals.get(pool),)

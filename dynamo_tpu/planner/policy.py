@@ -104,6 +104,12 @@ class LoadPolicy:
                        f"(> {self.occupancy_high})")
         if s.kv_utilization > self.kv_high:
             hot.append(f"kv {s.kv_utilization:.2f} (> {self.kv_high})")
+        if s.unserved > 0:
+            # scale-from-zero: requests arrived for a model nobody
+            # serves — ANY unserved demand wakes the pool (there is no
+            # queue to deepen and no occupancy to breach at 0 replicas)
+            hot.append(f"{s.unserved:.0f} unserved request(s) "
+                       f"(scale from zero)")
         if hot:
             slots_per_replica = (s.total_slots / s.replicas
                                  if s.replicas and s.total_slots else 1.0)
@@ -149,7 +155,7 @@ class SlaPolicy:
         # shed_rate is REJECTED demand (req/s the fleet refused): without
         # it the SLA maths would size the fleet to only the traffic that
         # survived admission — overload would read as fitting capacity
-        demand = s.active_slots + s.queue_depth + s.shed_rate
+        demand = s.active_slots + s.queue_depth + s.shed_rate + s.unserved
         need = max(1, math.ceil(demand / self.capacity))
         # breaker-open instances serve nothing: replace them
         need += s.breaker_open
@@ -199,6 +205,10 @@ class PlannerCore:
         self.dry_run = dry_run
         self.paused = False
         self.overrides: Dict[str, int] = {}
+        # per-pool clamp overrides (the fleet plane's per-model
+        # min/max_replicas); a pool absent here uses the global clamps.
+        # min 0 is legal per-pool: scale-to-zero is a fleet policy.
+        self.pool_clamps: Dict[str, Tuple[int, int]] = {}
         self._pools: Dict[str, _PoolState] = {}
         self._seq = 0
 
@@ -208,8 +218,24 @@ class PlannerCore:
         self.overrides = dict(overrides)
         self.paused = paused
 
-    def _clamp(self, n: int) -> int:
-        return max(self.min_replicas, min(self.max_replicas, n))
+    def set_pool_clamps(self, clamps: Dict[str, Tuple[int, int]]) -> None:
+        """Per-pool replica bounds (fleet registry records)."""
+        for pool, (lo, hi) in clamps.items():
+            if lo < 0 or hi < max(lo, 1):
+                raise ValueError(f"bad clamp range [{lo}, {hi}] for "
+                                 f"pool {pool!r}")
+        self.pool_clamps = {p: (int(lo), int(hi))
+                            for p, (lo, hi) in clamps.items()}
+
+    def forget_pool(self, pool: str) -> None:
+        """Drop a removed pool's damping state (fleet model removal)."""
+        self._pools.pop(pool, None)
+        self.pool_clamps.pop(pool, None)
+
+    def _clamp(self, n: int, pool: Optional[str] = None) -> int:
+        lo, hi = self.pool_clamps.get(pool,
+                                      (self.min_replicas, self.max_replicas))
+        return max(lo, min(hi, n))
 
     # ------------------------------------------------------------------
     def evaluate(self, signals: Dict[str, PoolSignals],
@@ -234,7 +260,7 @@ class PlannerCore:
         if pool in self.overrides:
             # operator override: authoritative, bypasses policy AND damping
             d.proposed = int(self.overrides[pool])
-            d.target = self._clamp(d.proposed)
+            d.target = self._clamp(d.proposed, pool)
             d.reason = f"operator override -> {d.proposed}"
             d.policy = "override"
             if d.target != d.proposed:
@@ -249,7 +275,7 @@ class PlannerCore:
         proposed, reason = self.policy.propose(s)
         d.proposed = proposed
         d.reason = reason
-        bounded = self._clamp(proposed)
+        bounded = self._clamp(proposed, pool)
         clamped = bounded != proposed
         if bounded == s.replicas:
             d.target = bounded

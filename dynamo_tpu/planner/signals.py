@@ -62,6 +62,11 @@ class PoolSignals:
     shed_rate: float = 0.0
     # in-flight requests currently held by admission controllers
     admission_depth: float = 0.0
+    # scale-from-zero pressure (fleet/model pools only): requests observed
+    # for this model while NO replica served it (the frontend's
+    # model-labelled 404s). A scaled-to-zero pool has no queue and no
+    # occupancy — unserved demand is its only wake signal.
+    unserved: float = 0.0
 
     @property
     def slo_pressure(self) -> float:
@@ -132,6 +137,56 @@ def quantile_from_states(states: Iterable[Tuple[str, Dict]], metric: str,
     return buckets[-1]
 
 
+def filter_states_by_model(states: Iterable[Tuple[str, Dict]],
+                           model: str) -> List[Tuple[str, Dict]]:
+    """Project one round of ``(component, state_dump)`` pairs down to a
+    single model: every metric carrying a ``model`` label keeps only that
+    model's series; label-less metrics pass through untouched. This is
+    what makes TTFT/ITL quantiles and SLO burn *model-scoped* for fleet
+    pools — the histograms were per-model all along (the ``model`` label
+    exists since PR 1), the readers just merged them."""
+    out: List[Tuple[str, Dict]] = []
+    for comp, dump in states:
+        nd: Dict = {}
+        for name, st in dump.items():
+            labels = (list(st.get("labels") or ())
+                      if isinstance(st, dict) else [])
+            if "model" not in labels:
+                nd[name] = st
+                continue
+            pos = labels.index("model")
+            series = {k: v for k, v in (st.get("series") or {}).items()
+                      if (k.split("\x1f") + [""])[pos] == model}
+            nd[name] = {**st, "series": series}
+        out.append((comp, nd))
+    return out
+
+
+def model_request_count(states: Iterable[Tuple[str, Dict]], model: str,
+                        status: str = "404") -> float:
+    """Cumulative ``dyn_http_requests_total`` count for one (model,
+    status) across every frontend dump — the scale-from-zero wake
+    counter (frontends label a 404 with the model name when the model is
+    fleet-registered, so the label set stays bounded)."""
+    total = 0.0
+    for _component, dump in states:
+        st = dump.get("dyn_http_requests_total")
+        if not st or st.get("kind") != "counter":
+            continue
+        labels = list(st.get("labels") or ())
+        try:
+            m_pos = labels.index("model")
+            s_pos = labels.index("status")
+        except ValueError:
+            continue
+        for skey, val in st.get("series", {}).items():
+            parts = skey.split("\x1f")
+            if (len(parts) > max(m_pos, s_pos) and parts[m_pos] == model
+                    and parts[s_pos] == status):
+                total += val
+    return total
+
+
 def open_instance_ids(states: Iterable[Tuple[str, Dict]]) -> Set[str]:
     """Hex instance ids at least one observer's exported
     ``dyn_circuit_state`` series currently marks OPEN (value 2) — shared
@@ -177,9 +232,28 @@ class SignalCollector:
         # the planner's stage registry (published with the dyn_planner_*
         # series), its breach log feeds PoolSignals.slo_burn
         self.slo = SloMonitor()
+        # fleet mode: pool name -> model name. A model pool's latency/SLO
+        # signals are computed over filter_states_by_model (its own
+        # histogram series), and unserved-request wake pressure is
+        # tracked for scale-from-zero.
+        self.pool_models: Dict[str, str] = {}
+        # per-model monitors observe WITHOUT exporting (the gauge has no
+        # model label; the global monitor above owns the exported series)
+        self._model_slo: Dict[str, "SloMonitor"] = {}
+        self._unserved_prev: Dict[str, float] = {}
         # shed-rate derivation: cumulative fleet shed counters from the
         # last collect, differentiated against the wall between ticks
         self._shed_prev: Optional[Tuple[float, float]] = None
+
+    def forget_pool(self, pool: str) -> None:
+        """Drop a removed fleet pool's accumulated state. Without this a
+        model removed and later re-added under the same name would
+        compute burn deltas against pre-removal snapshots, and rings for
+        never-returning models would accumulate for the planner's
+        lifetime."""
+        self.pool_models.pop(pool, None)
+        self._model_slo.pop(pool, None)
+        self._unserved_prev.pop(pool, None)
 
     async def live_instances(self, component: str,
                              known: Iterable[int] = ()) -> List[int]:
@@ -252,6 +326,7 @@ class SignalCollector:
         slo_burn = self.slo.max_burn()
         shed_rate = self._shed_rate(stage_states)
         admission_depth = admission_depth_total(stage_states)
+        model_share = self._model_shed_share()
         prefill_q = 0
         for qname in prefill_queue_names(self.namespace):
             try:
@@ -281,21 +356,71 @@ class SignalCollector:
                 # is the queue depth above.
                 s.queue_depth += prefill_q
             else:
+                model = self.pool_models.get(pool)
+                # model pools read their OWN latency series; the
+                # single-pool shape keeps the all-series merge
+                scoped = (filter_states_by_model(stage_states, model)
+                          if model else stage_states)
                 s.ttft_p90 = quantile_from_states(
-                    stage_states, "llm_ttft_seconds", 0.90)
+                    scoped, "llm_ttft_seconds", 0.90)
                 s.itl_p90 = quantile_from_states(
-                    stage_states, "llm_inter_token_seconds", 0.90)
+                    scoped, "llm_inter_token_seconds", 0.90)
                 # end-to-end SLO burn is serving-side pressure, same
                 # attribution rule as ttft/itl above (more prefill
                 # replicas can't fix a decode-side latency breach)
-                s.slo_burn = dict(slo_burn)
+                s.slo_burn = (self._model_burn(pool, model, scoped)
+                              if model else dict(slo_burn))
                 # rejected demand is serving-side pressure too: admission
                 # and worker-queue sheds are absorbed by the decode fleet
-                s.shed_rate = shed_rate
-                s.admission_depth = admission_depth
+                # (model pools get their even share — see above)
+                share = model_share if model else 1.0
+                s.shed_rate = shed_rate * share
+                s.admission_depth = admission_depth * share
+                if model:
+                    s.unserved = self._unserved_delta(
+                        pool, model, stage_states, s.replicas)
             s.breaker_open = breaker_open_instances(stage_states, ids)
             out[pool] = s
         return out
+
+    def _model_shed_share(self) -> float:
+        """Fleet mode: sheds happen pre-body (no model label), so the
+        fleet-wide shed rate cannot be attributed to one model — but
+        handing every model pool the FULL rate would let one model's
+        storm inflate every pool's demand N-fold. Each model pool gets
+        an even 1/N share: total scale-up pressure stays the true fleet
+        total, no pool sees phantom demand beyond its share. Classic
+        (non-fleet) pools keep full attribution."""
+        n = sum(1 for p in self.pools
+                if p in self.pool_models and p != "prefill")
+        return 1.0 / n if n else 1.0
+
+    def _model_burn(self, pool: str, model: str,
+                    scoped_states) -> Dict[str, float]:
+        """Per-model SLO burn: a private monitor per model pool fed the
+        model-filtered dumps (same DYN_SLO_* objectives, no gauge export
+        — the exported series stays the fleet aggregate)."""
+        from ..utils.slo import SloMonitor
+
+        mon = self._model_slo.get(pool)
+        if mon is None:
+            mon = self._model_slo[pool] = SloMonitor(registry_gauge=None)
+        if not mon.objectives:
+            return {}
+        mon.observe(scoped_states)
+        return mon.max_burn()
+
+    def _unserved_delta(self, pool: str, model: str, stage_states,
+                        replicas: int) -> float:
+        """Requests that 404'd on this model since the last tick, counted
+        only while the pool is at zero replicas (once a replica serves,
+        stale 404s from the boot race must not keep inflating demand)."""
+        total = model_request_count(stage_states, model, "404")
+        prev = self._unserved_prev.get(pool)
+        self._unserved_prev[pool] = total
+        if replicas > 0 or prev is None:
+            return 0.0
+        return max(total - prev, 0.0)
 
 
 def fake_signals(pool: str, **kw) -> PoolSignals:

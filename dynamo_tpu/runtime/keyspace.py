@@ -106,6 +106,29 @@ _ALL: List[KeyFamily] = [
         prefix="fleet/", helpers=("fleet_beacon_key",
                                   "fleet_beacon_prefix")),
     KeyFamily(
+        name="fleet-models",
+        pattern="fleet_models/{ns}/{model}",
+        owner="fleet/registry.py", lifecycle=PERSISTENT,
+        description="desired-state model registry: one record per served "
+                    "model (card ref, component, chip shape, min/max "
+                    "replicas, priority, tenant quota table) mutated by "
+                    "`ctl fleet add/remove`, reconciled by the planner's "
+                    "fleet plane and watched by fleet routers/frontends",
+        prefix="fleet_models/",
+        helpers=("fleet_model_key", "fleet_models_prefix"),
+        constants=("FLEET_MODELS_PREFIX",)),
+    KeyFamily(
+        name="fleet-status",
+        pattern="fleet_status/{ns}/{model}",
+        owner="fleet/registry.py", lifecycle=LEASE,
+        description="observed per-model state (replicas, target, "
+                    "ready/booting/draining/off, chips, SLO burn) "
+                    "published lease-bound by the reconciling planner; "
+                    "rendered by GET /v1/models, dyntop and plannerctl",
+        prefix="fleet_status/",
+        helpers=("fleet_status_key", "fleet_status_prefix"),
+        constants=("FLEET_STATUS_PREFIX",)),
+    KeyFamily(
         name="faults",
         pattern="faults/{point}",
         owner="utils/faults.py", lifecycle=PERSISTENT,

@@ -69,6 +69,7 @@ _DOCS = {
     "logging": "docs/observability.md",
     "slo": "docs/observability.md",
     "roofline": "docs/observability.md",
+    "multi_model": "docs/multi_model.md",
     "disagg": "docs/disagg_serving.md",
     "router": "docs/kv_cache_routing.md",
     "planner": "docs/planner.md",
@@ -149,6 +150,19 @@ _ALL: List[Knob] = [
        "min seconds between downward brownout steps"),
     _k("DYN_BROWNOUT_MAX_LEVEL", "int", "3", "overload",
        "highest brownout level the controller may reach (ladder max 4)"),
+    _k("DYN_TENANT_QUOTAS", "json", "", "overload",
+       "static per-tenant admission quotas at HTTP ingress, e.g. "
+       "'{\"acme\": {\"rps\": 5, \"burst\": 10, \"concurrency\": 8}}'; "
+       "merged with (and overridden by) the fleet registry's per-model "
+       "tenant tables"),
+    _k("DYN_TENANT_AVAILABILITY", "float", "", "overload",
+       "per-tenant good-request fraction objective (e.g. 0.99); when "
+       "set, the worst tenant's burn also steps the brownout ladder"),
+    # --------------------------------------------------------- multi-model
+    _k("DYN_FLEET_PREEMPT_MARGIN", "float", "0.5", "multi_model",
+       "SLO-burn advantage a model needs before the chip arbiter "
+       "preempts another model's live replicas (hysteresis against "
+       "replica thrash; higher priority classes preempt regardless)"),
     # -------------------------------------------------------------- faults
     _k("DYN_FAULTS", "csv", "", "faults",
        "fault-injection table armed at process start, "
@@ -278,6 +292,9 @@ _PLANNER = [
     ("DOWN_CONSENSUS", "int", "3", "consecutive down-votes before a "
                                    "scale-down actuates"),
     ("DRY_RUN", "bool", "0", "publish decisions but never actuate"),
+    ("FLEET", "bool", "0", "reconcile the multi-model fleet registry "
+                           "(pool set follows ctl fleet add/remove, "
+                           "targets pass the chip arbiter)"),
     ("BROWNOUT", "bool", "0", "run the SLO-burn brownout controller on "
                               "the planner loop"),
     ("QUEUE_HIGH", "float", "1.0", "load policy: queue-depth-per-replica "

@@ -44,7 +44,7 @@ import json
 import logging
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..runtime.engine import EngineError
 from .knobs import env_float as _env_float
@@ -72,6 +72,30 @@ def parse_priority(raw: Optional[str]) -> str:
         raise ValueError(
             f"{PRIORITY_HEADER}: {raw!r} (expected one of {PRIORITIES})")
     return p
+
+
+# ---------------------------------------------------------------------------
+# tenancy: who is asking, and on what terms
+# ---------------------------------------------------------------------------
+TENANT_HEADER = "x-tenant"
+DEFAULT_TENANT = "default"
+_TENANT_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
+
+
+def parse_tenant(raw: Optional[str]) -> str:
+    """``x-tenant`` header -> tenant id. Absent/empty => ``default``
+    (tenancy-unaware clients share one bucket); a malformed id raises
+    ValueError (400 — a typo'd tenant silently pooled into ``default``
+    would dodge its quota)."""
+    if not raw:
+        return DEFAULT_TENANT
+    t = raw.strip()
+    if not t or len(t) > 64 or not set(t) <= _TENANT_CHARS:
+        raise ValueError(
+            f"{TENANT_HEADER}: {raw!r} (expected 1-64 chars of "
+            f"[A-Za-z0-9._-])")
+    return t
 
 
 class OverloadError(EngineError):
@@ -217,6 +241,260 @@ class AdmissionController:
     def release(self) -> None:
         self.inflight = max(0, self.inflight - 1)
         self._metrics().admission_depth.set(value=self.inflight)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant quotas: isolation, not capacity management
+# ---------------------------------------------------------------------------
+@dataclass
+class TenantQuota:
+    """One tenant's ingress allowance. Zero fields are *uncapped* (a
+    tenant with only an rps quota has unlimited concurrency and vice
+    versa); a tenant with no quota record at all is ungoverned — only the
+    global admission caps apply to it."""
+
+    rps: float = 0.0            # token-bucket refill (req/s); 0 = uncapped
+    burst: float = 0.0          # bucket size; default 2 x rps
+    concurrency: int = 0        # max in-flight; 0 = uncapped
+
+    @property
+    def enabled(self) -> bool:
+        return self.rps > 0 or self.concurrency > 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"rps": self.rps, "burst": self.burst,
+                "concurrency": self.concurrency}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TenantQuota":
+        return cls(rps=float(d.get("rps", 0.0)),
+                   burst=float(d.get("burst", 0.0)),
+                   concurrency=int(d.get("concurrency", 0)))
+
+
+def tenant_quotas_from_env(env: Optional[Dict[str, str]] = None
+                           ) -> Dict[str, TenantQuota]:
+    """``DYN_TENANT_QUOTAS`` — a JSON object mapping tenant id to
+    ``{"rps": .., "burst": .., "concurrency": ..}``. A malformed table is
+    the operator's typo: logged and ignored (never inflicted on clients
+    as spurious 429s)."""
+    import os
+
+    raw = (os.environ if env is None else env).get("DYN_TENANT_QUOTAS")
+    if not raw:
+        return {}
+    try:
+        table = json.loads(raw)
+        return {str(t): TenantQuota.from_dict(q)
+                for t, q in table.items()}
+    except (ValueError, TypeError, AttributeError, json.JSONDecodeError):
+        log.warning("ignoring malformed DYN_TENANT_QUOTAS=%r", raw)
+        return {}
+
+
+class TenantAdmission:
+    """Per-tenant token buckets + in-flight caps, layered *under* the
+    global :class:`AdmissionController` at HTTP ingress.
+
+    A tenant-quota shed is a different beast from an overload shed: it is
+    deliberate *isolation* (this tenant exceeded its contract), not a
+    capacity signal — so it counts ``dyn_tenant_admission_rejects_total``
+    but NOT ``dyn_admission_rejects_total``, keeping the planner's
+    rejected-demand scale-up pressure blind to it by design (scaling the
+    fleet up must not be how a tenant escapes its quota).
+
+    Metric label cardinality is bounded by construction: only tenants
+    present in the quota table get their own label; everyone else is
+    ``other`` (tenant ids are client-controlled strings).
+
+    ``set_quotas`` applies live updates (the fleet registry watch feeds
+    it) while *preserving* the bucket level of unchanged quotas — a
+    registry refresh must not hand every hog a freshly full bucket."""
+
+    def __init__(self, quotas: Optional[Dict[str, TenantQuota]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.clock = clock
+        self.quotas: Dict[str, TenantQuota] = {}
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._inflight: Dict[str, int] = {}
+        self.set_quotas(quotas or {})
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None
+                 ) -> "TenantAdmission":
+        return cls(tenant_quotas_from_env(env))
+
+    @property
+    def enabled(self) -> bool:
+        return any(q.enabled for q in self.quotas.values())
+
+    def label(self, tenant: str) -> str:
+        """Bounded-cardinality metric label for ``tenant``: quota-table
+        tenants and the built-in default keep their name, every other
+        client-controlled string collapses to ``other``."""
+        if tenant in self.quotas or tenant == DEFAULT_TENANT:
+            return tenant
+        return "other"
+
+    def set_quotas(self, quotas: Dict[str, TenantQuota]) -> None:
+        for tenant, q in quotas.items():
+            old = self.quotas.get(tenant)
+            if q.rps > 0 and (old is None or old.rps != q.rps
+                              or old.burst != q.burst
+                              or tenant not in self._buckets):
+                burst = q.burst if q.burst > 0 else 2.0 * q.rps
+                self._buckets[tenant] = TokenBucket(
+                    q.rps, max(burst, 1.0), clock=self.clock)
+            elif q.rps <= 0:
+                self._buckets.pop(tenant, None)
+        for tenant in list(self._buckets):
+            if tenant not in quotas:
+                self._buckets.pop(tenant)
+        self.quotas = dict(quotas)
+
+    def _reject(self, tenant: str, priority: str, reason: str,
+                retry_after: float) -> OverloadError:
+        from .prometheus import stage_metrics
+
+        stage_metrics().tenant_rejects.inc(self.label(tenant), reason)
+        return OverloadError(
+            f"tenant {tenant!r} over quota ({reason}; "
+            f"priority={priority}): retry after {retry_after:.2f}s",
+            stage="admission", reason=reason, retry_after=retry_after)
+
+    def try_admit(self, tenant: str,
+                  priority: str = PRIORITY_INTERACTIVE
+                  ) -> Optional[OverloadError]:
+        """Reserve a tenant slot or explain the shed. The caller MUST
+        :meth:`release` on every exit path after a None return — same
+        contract as :class:`AdmissionController`. Unquota'd tenants are
+        admitted without bookkeeping (release is a no-op for them)."""
+        q = self.quotas.get(tenant)
+        if q is None or not q.enabled:
+            return None
+        held = self._inflight.get(tenant, 0)
+        if q.concurrency > 0 and held >= q.concurrency:
+            return self._reject(tenant, priority, "tenant_concurrency", 1.0)
+        bucket = self._buckets.get(tenant)
+        if bucket is not None and not bucket.take(1.0):
+            return self._reject(tenant, priority, "tenant_rate",
+                                bucket.retry_after(1.0))
+        self._inflight[tenant] = held + 1
+        from .prometheus import stage_metrics
+
+        stage_metrics().tenant_inflight.set(self.label(tenant),
+                                            value=held + 1)
+        return None
+
+    def release(self, tenant: str) -> None:
+        held = self._inflight.get(tenant)
+        if held is None:
+            return
+        self._inflight[tenant] = max(held - 1, 0)
+        from .prometheus import stage_metrics
+
+        stage_metrics().tenant_inflight.set(self.label(tenant),
+                                            value=self._inflight[tenant])
+
+
+def tenant_availability_objective(env: Optional[Dict[str, str]] = None
+                                  ) -> Optional[float]:
+    """``DYN_TENANT_AVAILABILITY`` — per-tenant good-request fraction
+    objective (e.g. 0.99). Unset/invalid = tenant burn not monitored."""
+    import os
+
+    raw = (os.environ if env is None else env).get("DYN_TENANT_AVAILABILITY")
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        log.warning("ignoring malformed DYN_TENANT_AVAILABILITY=%r", raw)
+        return None
+    return v if 0.0 < v < 1.0 else None
+
+
+def tenant_request_totals(states) -> Dict[str, Tuple[float, float]]:
+    """{tenant: (total, bad)} cumulative request counts from the
+    ``dyn_tenant_requests_total{tenant,status}`` series frontends
+    publish. bad = 5xx (server-fault); 429s are the tenant's own quota
+    and 4xx its own input — neither burns the *server's* budget."""
+    out: Dict[str, List[float]] = {}
+    for _component, dump in states:
+        st = dump.get("dyn_tenant_requests_total")
+        if not st or st.get("kind") != "counter":
+            continue
+        labels = list(st.get("labels") or ())
+        try:
+            t_pos = labels.index("tenant")
+            s_pos = labels.index("status")
+        except ValueError:
+            continue
+        for skey, val in st.get("series", {}).items():
+            parts = skey.split("\x1f")
+            if len(parts) <= max(t_pos, s_pos):
+                continue
+            acc = out.setdefault(parts[t_pos], [0.0, 0.0])
+            acc[0] += val
+            try:
+                if int(parts[s_pos]) >= 500:
+                    acc[1] += val
+            except ValueError:
+                pass
+    return {t: (v[0], v[1]) for t, v in out.items()}
+
+
+class TenantBurnTracker:
+    """Per-tenant availability error-budget burn over the published
+    stage dumps — the tenant-scoped SLO signal the brownout ladder (and
+    dyntop) consume. Same cumulative-snapshot-ring recipe as
+    ``utils/slo.SloMonitor``, one ring per tenant, worst window wins."""
+
+    def __init__(self, objective: float,
+                 windows: Optional[Tuple[float, ...]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        from .slo import windows_from_env
+
+        self.objective = objective
+        self.budget = max(1.0 - objective, 1e-9)
+        self.windows = tuple(windows or windows_from_env())
+        self.clock = clock
+        self._rings: Dict[str, collections.deque] = {}
+        self._last: Dict[str, float] = {}
+
+    def observe(self, states, now: Optional[float] = None
+                ) -> Dict[str, float]:
+        """{tenant: worst-window burn}; also exports the
+        ``dyn_tenant_slo_burn`` gauge per tenant seen."""
+        now = self.clock() if now is None else now
+        from .prometheus import stage_metrics
+
+        out: Dict[str, float] = {}
+        horizon = now - max(self.windows) - 1.0
+        for tenant, (total, bad) in tenant_request_totals(states).items():
+            ring = self._rings.setdefault(tenant, collections.deque())
+            ring.append((now, total, bad))
+            while len(ring) > 2 and ring[1][0] < horizon:
+                ring.popleft()
+            worst = 0.0
+            for w in self.windows:
+                base_t, base_total, base_bad = ring[0]
+                for ts, t_, b_ in ring:
+                    if ts <= now - w:
+                        base_t, base_total, base_bad = ts, t_, b_
+                    else:
+                        break
+                d_total = total - base_total
+                if d_total > 0:
+                    worst = max(worst,
+                                ((bad - base_bad) / d_total) / self.budget)
+            out[tenant] = worst
+            stage_metrics().tenant_burn.set(tenant, value=worst)
+        self._last = out
+        return out
+
+    def worst(self) -> float:
+        return max(self._last.values(), default=0.0)
 
 
 # ---------------------------------------------------------------------------
@@ -632,6 +910,12 @@ class BrownoutMonitor:
         self.slo = slo_monitor or SloMonitor(registry_gauge=None)
         self.lease = lease
         self._published: Optional[int] = None
+        # tenant-scoped burn (DYN_TENANT_AVAILABILITY): one tenant's
+        # server-fault failures step the ladder even when the fleet
+        # aggregate still looks healthy — per-tenant SLOs are promises,
+        # not averages
+        obj = tenant_availability_objective()
+        self.tenant_burn = TenantBurnTracker(obj) if obj else None
 
     async def apply(self, burn: float) -> int:
         """Step the controller on ``burn``, export the gauge, publish the
@@ -660,6 +944,9 @@ class BrownoutMonitor:
         burns = self.slo.observe(states) if self.slo.objectives else {}
         burn = max((b for per_w in burns.values()
                     for b in per_w.values()), default=0.0)
+        if self.tenant_burn is not None:
+            self.tenant_burn.observe(states)
+            burn = max(burn, self.tenant_burn.worst())
         return await self.apply(burn)
 
     async def run(self, interval: float = 1.0) -> None:

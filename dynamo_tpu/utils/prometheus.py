@@ -420,6 +420,27 @@ class StageMetrics:
             "dyn_admission_queue_depth",
             "In-flight requests currently held by the admission "
             "controller", ())
+        # tenancy plane (utils/overload.py TenantAdmission/BurnTracker):
+        # quota sheds are deliberate isolation, counted separately from
+        # overload sheds so rejected-demand autoscaling pressure stays
+        # blind to them; label cardinality is bounded to the quota table
+        # plus "other" (tenant ids are client-controlled strings)
+        self.tenant_rejects = r.counter(
+            "dyn_tenant_admission_rejects_total",
+            "Requests rejected by a per-tenant quota at HTTP ingress "
+            "(tenant_rate | tenant_concurrency)", ("tenant", "reason"))
+        self.tenant_requests = r.counter(
+            "dyn_tenant_requests_total",
+            "HTTP requests by tenant and status (the per-tenant "
+            "availability burn's input)", ("tenant", "status"))
+        self.tenant_inflight = r.gauge(
+            "dyn_tenant_inflight",
+            "In-flight requests per quota-governed tenant", ("tenant",))
+        self.tenant_burn = r.gauge(
+            "dyn_tenant_slo_burn",
+            "Per-tenant availability error-budget burn, worst window "
+            "(feeds the brownout ladder when DYN_TENANT_AVAILABILITY is "
+            "set)", ("tenant",))
         # fleet-safe telemetry pipelines (utils/tracing.py head sampling +
         # the span sink's bounded retain-on-outage buffer, and the stage
         # publisher's delta batching): the pressure-relief valves must be

@@ -93,7 +93,9 @@ class Procs:
         self.store[0].send_signal(signal.SIGKILL)
         self.store[0].wait()
 
-    def start_worker(self) -> int:
+    def start_worker(self, extra=()) -> int:
+        """``extra`` appends per-worker argv (the mixed-model lanes use
+        it to place workers in per-model components)."""
         idx = self._n
         self._n += 1
         self.workers[idx] = self._spawn(
@@ -102,7 +104,7 @@ class Procs:
             "--advertise-host", "127.0.0.1",
             "--namespace", self.namespace,
             "--metrics-interval", "0.5", "--echo-slots", "4",
-            *self.worker_extra)
+            *self.worker_extra, *extra)
         try:
             self._wait_log(self.workers[idx][1], "serving", 30,
                            proc=self.workers[idx][0])
@@ -341,6 +343,131 @@ async def soak(duration: float, n_workers: int, concurrency: int,
     return stats
 
 
+async def model_kill_soak(duration: float, n_workers: int,
+                          concurrency: int, request_deadline: float,
+                          min_success: float, logdir: str) -> dict:
+    """Mixed-model blast-radius scenario: kill an ENTIRE model pool
+    mid-traffic; the surviving model's success rate and latency must
+    stay flat (model pools share a namespace and a store, nothing else).
+
+    PASS iff model A (survivor): zero hung requests, success >=
+    ``min_success`` through the whole run, and post-kill p90 latency
+    within 2x its pre-kill p90 (+50ms slack) — the client-side proxy for
+    "its SLO burn stays flat". Model B's post-kill failures are the
+    point, not a defect (they must be typed, never hangs).
+    """
+    from dynamo_tpu.llm.protocols.common import BackendInput
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.engine import Context
+
+    store_port = _free_port()
+    procs = Procs(logdir, store_port)
+    procs.start_store()
+    pools = {"a": [], "b": []}
+    for model in pools:
+        for _ in range(n_workers):
+            pools[model].append(procs.start_worker(
+                extra=["--component", f"backend-{model}",
+                       "--model-name", f"model{model}",
+                       "--register-model"]))
+
+    drt = await DistributedRuntime(store_port=store_port,
+                                   advertise_host="127.0.0.1").connect()
+    clients = {}
+    for model in pools:
+        clients[model] = await (
+            drt.namespace(NAMESPACE).component(f"backend-{model}")
+            .endpoint("generate").client().start())
+        await clients[model].wait_for_instances(n_workers, timeout=30)
+
+    rows = {m: [] for m in pools}     # (t_rel, ok, hung, latency)
+    payload = BackendInput(token_ids=list(range(1, 9))).to_dict()
+    t0 = time.monotonic()
+    kill_at = duration / 3.0
+    stop_at = t0 + duration
+
+    async def one(model):
+        sub = time.monotonic()
+        ok, hung = False, False
+        ctx = Context(deadline=time.time() + request_deadline)
+
+        async def run():
+            async for _ in clients[model].generate(payload, ctx):
+                pass
+
+        try:
+            await asyncio.wait_for(run(), request_deadline + 10.0)
+            ok = True
+        except asyncio.TimeoutError:
+            hung = True
+        except Exception:  # noqa: BLE001 - typed failure == not hung
+            pass
+        rows[model].append((sub - t0, ok, hung,
+                            time.monotonic() - sub))
+
+    async def traffic(model, conc):
+        while time.monotonic() < stop_at:
+            await asyncio.gather(*[one(model) for _ in range(conc)])
+            await asyncio.sleep(0.05)
+
+    async def killer():
+        await asyncio.sleep(max(0.0, t0 + kill_at - time.monotonic()))
+        print(f"chaos: kill -9 ENTIRE model b pool "
+              f"({len(pools['b'])} workers)", flush=True)
+        for idx in pools["b"]:
+            procs.kill_worker(idx)
+
+    def p90(vals):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(0.9 * len(vals)))]
+
+    verdicts = {}
+    try:
+        await asyncio.gather(traffic("a", concurrency),
+                             traffic("b", max(concurrency // 2, 1)),
+                             killer())
+        a_rows = rows["a"]
+        a_ok = sum(1 for r in a_rows if r[1])
+        a_hung = sum(1 for r in a_rows if r[2])
+        pre = [r[3] for r in a_rows if r[0] < kill_at and r[1]]
+        post = [r[3] for r in a_rows if r[0] >= kill_at and r[1]]
+        b_post = [r for r in rows["b"] if r[0] >= kill_at + 1.0]
+        verdicts = {
+            "survivor_zero_hung": a_hung == 0,
+            "survivor_success": (a_ok / max(len(a_rows), 1)
+                                 >= min_success),
+            "survivor_latency_flat":
+                p90(post) <= 2.0 * p90(pre) + 0.05,
+            "victim_failures_typed":
+                all(not r[2] for r in rows["b"]),
+        }
+        result = {
+            "duration_s": duration,
+            "survivor": {"submitted": len(a_rows), "ok": a_ok,
+                         "hung": a_hung,
+                         "p90_pre_kill_s": round(p90(pre), 4),
+                         "p90_post_kill_s": round(p90(post), 4)},
+            "victim": {"submitted": len(rows["b"]),
+                       "ok": sum(1 for r in rows["b"] if r[1]),
+                       "post_kill_ok": sum(1 for r in b_post if r[1]),
+                       "hung": sum(1 for r in rows["b"] if r[2])},
+            "verdicts": verdicts,
+        }
+        return result
+    finally:
+        try:
+            await drt.close()
+        # dynalint: ok(swallowed-exception) harness teardown after the
+        # verdict is already computed; procs.stop() below reaps anyway
+        except Exception:
+            pass
+        if not verdicts or not all(verdicts.values()):
+            procs.dump()
+        procs.stop()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(prog="chaos_soak")
     ap.add_argument("--duration", type=float, default=30.0)
@@ -356,7 +483,33 @@ def main() -> int:
                     help="run the overload-control ramp scenario instead "
                          "(scripts/overload_soak.py: open-loop 3x ramp, "
                          "goodput must plateau)")
+    ap.add_argument("--model-kill", action="store_true",
+                    help="mixed-model blast-radius scenario: kill an "
+                         "entire model pool mid-traffic; the surviving "
+                         "model's success + latency must stay flat")
     a = ap.parse_args()
+    if a.model_kill:
+        import json as _json
+
+        logdir = tempfile.mkdtemp(prefix="model_kill_soak_")
+        print(f"model-kill soak: {a.duration}s, {a.workers} workers per "
+              f"model pool, logs {logdir}", flush=True)
+        result = asyncio.run(model_kill_soak(
+            a.duration, a.workers, a.concurrency, a.request_deadline,
+            a.min_success, logdir))
+        out = os.path.join(REPO, "bench_points", "model_kill_soak.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            _json.dump(result, f, indent=2, sort_keys=True)
+        print(_json.dumps(result, indent=2, sort_keys=True), flush=True)
+        print(f"artifact: {out}", flush=True)
+        failed = [k for k, ok in result["verdicts"].items() if not ok]
+        if failed:
+            print(f"FAIL: {failed}", flush=True)
+            return 1
+        print("PASS: surviving model undisturbed by the pool kill",
+              flush=True)
+        return 0
     if a.overload:
         # the overload soak IS a chaos scenario: same process harness,
         # different failure mode (congestion instead of kill -9)

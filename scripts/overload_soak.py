@@ -371,6 +371,185 @@ async def run_soak(a, logdir: str):
         procs.stop()
 
 
+# ---------------------------------------------------------------------------
+# mixed-model, multi-tenant lane: two echo models, skewed tenant traffic
+# ---------------------------------------------------------------------------
+async def run_mixed_model(a, logdir: str):
+    """Per-tenant quota isolation under 3x overload, across two models.
+
+    Two echo models (own components, fleet-registered), two tenants:
+    ``good`` stays inside its quota, ``hog`` offers 3x its quota. Phases:
+
+        solo    good tenant alone        -> its interactive baseline
+        mixed   good + hog at 3x quota   -> isolation must hold
+
+    PASS iff the good tenant's interactive success in the mixed phase is
+    not below its solo baseline (beyond epsilon), the hog's overage is
+    shed with typed per-tenant 429s, and BOTH models keep serving
+    through the storm. Artifact: bench_points/mixed_model_soak.json.
+    """
+    from chaos_soak import Procs, _free_port
+
+    import aiohttp
+
+    from dynamo_tpu.cli.http import run_http
+
+    service_s = a.tokens * a.token_delay_ms / 1000.0
+    per_worker = a.slots / service_s
+    good_rate = 0.3 * per_worker            # well inside one worker
+    hog_quota = 0.3 * per_worker
+    hog_rate = 3.0 * hog_quota              # 3x its own quota
+    os.environ["DYN_TENANT_QUOTAS"] = json.dumps({
+        "good": {"rps": good_rate * 1.5, "burst": good_rate * 3},
+        "hog": {"rps": hog_quota, "burst": hog_quota},
+    })
+    print(f"mixed-model soak: per-worker capacity ~{per_worker:.0f} req/s, "
+          f"good {good_rate:.0f} req/s, hog {hog_rate:.0f} req/s "
+          f"(quota {hog_quota:.0f}), logs {logdir}", flush=True)
+
+    store_port = _free_port()
+    procs = Procs(logdir, store_port, namespace=NAMESPACE,
+                  env_extra={"DYN_TOKEN_ECHO_DELAY_MS":
+                             str(a.token_delay_ms),
+                             "DYN_WORKER_SLOTS": str(a.slots)})
+    procs.start_store()
+    models = ("mixa", "mixb")
+    for model in models:
+        for _ in range(a.workers):
+            procs.start_worker(extra=["--component", f"backend-{model}",
+                                      "--model-name", model,
+                                      "--register-model",
+                                      "--echo-slots", str(a.slots)])
+
+    svc = None
+    rows = []          # (phase, tenant, model, status, latency)
+    pending = set()
+    verdicts = {}
+    try:
+        http_args = argparse.Namespace(
+            store=f"127.0.0.1:{store_port}", host="127.0.0.1", port=0,
+            router_component=None, namespace=NAMESPACE)
+        svc = await run_http(http_args)
+        base = f"http://127.0.0.1:{svc.port}"
+        session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=0))
+        for model in models:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                async with session.get(f"{base}/v1/models") as r:
+                    if model in {m["id"]
+                                 for m in (await r.json())["data"]}:
+                        break
+                await asyncio.sleep(0.2)
+            else:
+                raise RuntimeError(f"{model} never appeared via discovery")
+
+        t0 = time.monotonic()
+
+        async def one(phase, tenant, model):
+            sub = time.monotonic()
+            status = -2
+            try:
+                async with session.post(
+                        f"{base}/v1/completions",
+                        json={"model": model, "prompt": "x" * a.tokens,
+                              "max_tokens": a.tokens},
+                        headers={"x-tenant": tenant,
+                                 "x-priority": "interactive",
+                                 "x-request-timeout": "5"}) as r:
+                    await r.json()
+                    status = r.status
+            except Exception:  # noqa: BLE001 - counted as failure
+                pass
+            rows.append((phase, tenant, model,
+                         status, time.monotonic() - sub))
+
+        async def drive(phase, tenant, rate, duration):
+            loop = asyncio.get_event_loop()
+            end = loop.time() + duration
+            next_t = loop.time()
+            i = 0
+            while loop.time() < end:
+                model = models[i % 2]     # tenants spread over models
+                i += 1
+                t = asyncio.create_task(one(phase, tenant, model))
+                pending.add(t)
+                t.add_done_callback(pending.discard)
+                next_t += 1.0 / rate
+                delay = next_t - loop.time()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+
+        print(f"phase solo: good at {good_rate:.0f} req/s "
+              f"for {a.solo_s:.0f}s", flush=True)
+        await drive("solo", "good", good_rate, a.solo_s)
+        print(f"phase mixed: good {good_rate:.0f} + hog {hog_rate:.0f} "
+              f"req/s for {a.mixed_s:.0f}s", flush=True)
+        await asyncio.gather(
+            drive("mixed", "good", good_rate, a.mixed_s),
+            drive("mixed", "hog", hog_rate, a.mixed_s))
+        if pending:
+            await asyncio.wait_for(
+                asyncio.gather(*list(pending), return_exceptions=True),
+                20.0)
+        await session.close()
+
+        def stats(phase, tenant):
+            sel = [r for r in rows if r[0] == phase and r[1] == tenant]
+            ok = sum(1 for r in sel if r[3] == 200)
+            return {
+                "submitted": len(sel), "ok": ok,
+                "shed_429": sum(1 for r in sel if r[3] == 429),
+                "success": round(ok / len(sel), 4) if sel else None,
+                "per_model": {
+                    m: {"submitted": sum(1 for r in sel if r[2] == m),
+                        "ok": sum(1 for r in sel
+                                  if r[2] == m and r[3] == 200)}
+                    for m in models},
+            }
+
+        solo = stats("solo", "good")
+        mixed_good = stats("mixed", "good")
+        mixed_hog = stats("mixed", "hog")
+        both_served = all(
+            mixed_good["per_model"][m]["ok"] > 0 for m in models)
+        verdicts = {
+            # the acceptance bar: a tenant at 3x its quota cannot push
+            # another tenant's interactive success below its solo
+            # baseline (epsilon for sampling noise)
+            "tenant_isolated": (mixed_good["success"] is not None
+                                and solo["success"] is not None
+                                and mixed_good["success"]
+                                >= solo["success"] - a.isolation_eps),
+            "hog_shed_by_quota": mixed_hog["shed_429"] > 0,
+            "hog_not_starved": mixed_hog["ok"] > 0,   # quota, not a ban
+            "both_models_served": both_served,
+        }
+        result = {
+            "config": {k: getattr(a, k) for k in vars(a)},
+            "rates": {"good": round(good_rate, 1),
+                      "hog": round(hog_rate, 1),
+                      "hog_quota": round(hog_quota, 1)},
+            "solo_good": solo,
+            "mixed_good": mixed_good,
+            "mixed_hog": mixed_hog,
+            "verdicts": verdicts,
+        }
+        return result
+    finally:
+        try:
+            if svc is not None:
+                await svc.stop()
+        # dynalint: ok(swallowed-exception) harness teardown after the
+        # verdicts dict is already built; procs.stop() below reaps anyway
+        except Exception:
+            pass
+        if not verdicts or not all(verdicts.values()):
+            procs.dump()
+        procs.stop()
+        os.environ.pop("DYN_TENANT_QUOTAS", None)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(prog="overload_soak")
     ap.add_argument("--workers", type=int, default=2)
@@ -403,26 +582,49 @@ def main() -> int:
     ap.add_argument("--dwell-down", type=float, default=3.0)
     ap.add_argument("--brownout-tick", type=float, default=0.25)
     ap.add_argument("--min-interactive", type=float, default=0.95)
-    ap.add_argument("--out", default=os.path.join(
-        REPO, "bench_points", "overload_soak.json"))
+    ap.add_argument("--mixed-model", action="store_true",
+                    help="run the mixed-model multi-tenant isolation "
+                         "lane instead of the overload ramp (two echo "
+                         "models, one tenant at 3x its quota)")
+    ap.add_argument("--solo-s", type=float, default=6.0,
+                    help="mixed-model lane: good-tenant-only baseline "
+                         "seconds")
+    ap.add_argument("--mixed-s", type=float, default=10.0,
+                    help="mixed-model lane: good+hog seconds")
+    ap.add_argument("--isolation-eps", type=float, default=0.02,
+                    help="mixed-model lane: allowed success-rate slack "
+                         "vs the solo baseline")
+    ap.add_argument("--out", default=None)
     a = ap.parse_args()
+    if a.out is None:
+        a.out = os.path.join(
+            REPO, "bench_points",
+            "mixed_model_soak.json" if a.mixed_model
+            else "overload_soak.json")
     logdir = tempfile.mkdtemp(prefix="overload_soak_")
-    result = asyncio.run(run_soak(a, logdir))
+    if a.mixed_model:
+        result = asyncio.run(run_mixed_model(a, logdir))
+    else:
+        result = asyncio.run(run_soak(a, logdir))
     os.makedirs(os.path.dirname(a.out), exist_ok=True)
     with open(a.out, "w") as f:
         json.dump(result, f, indent=2, sort_keys=True)
     print(json.dumps({k: v for k, v in result.items()
                       if k != "config" and k != "brownout"},
                      indent=2, sort_keys=True), flush=True)
-    print(f"brownout: max L{result['brownout']['max_level']}, "
-          f"final L{result['brownout']['final_level']}", flush=True)
+    if not a.mixed_model:
+        print(f"brownout: max L{result['brownout']['max_level']}, "
+              f"final L{result['brownout']['final_level']}", flush=True)
     print(f"artifact: {a.out}", flush=True)
     failed = [k for k, ok in result["verdicts"].items() if not ok]
     if failed:
         print(f"FAIL: {failed}", flush=True)
         return 1
-    print("PASS: goodput plateaued, sheds fast, interactive protected, "
-          "brownout cycled", flush=True)
+    print("PASS: " + ("tenant isolation held across models under 3x "
+                      "hog overload"
+                      if a.mixed_model else
+                      "goodput plateaued, sheds fast, interactive "
+                      "protected, brownout cycled"), flush=True)
     return 0
 
 

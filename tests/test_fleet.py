@@ -1,0 +1,936 @@
+"""Fleet plane tests: registry round-trips and lease semantics over a
+real store, chip-arbiter units (budget clamp, burn-weighted preemption,
+floors, margin hysteresis), planner N-pool reconciliation (registry-
+driven pool set, boots-before-drains ordering, dry-run parity), tenant
+quota enforcement (typed 429 body, bounded metric labels, burn tracker),
+model-scoped routing stamps, and the end-to-end loopback: a second model
+`fleet add`-ed mid-traffic serves without disturbing the first.
+"""
+
+import argparse
+import asyncio
+import json
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.fleet.arbiter import (SUPPRESSED_CHIP_BUDGET, ChipArbiter,
+                                      PoolClaim)
+from dynamo_tpu.fleet.registry import (FleetModelSpec, FleetRegistry,
+                                       fetch_fleet_status,
+                                       fleet_status_key, get_fleet_model,
+                                       list_fleet_models, publish_fleet_status,
+                                       put_fleet_model, remove_fleet_model)
+from dynamo_tpu.fleet.plane import FleetPlane
+from dynamo_tpu.planner.loop import Planner, PlannerConfig
+from dynamo_tpu.planner.policy import (HOLD, SCALE_DOWN, SCALE_UP,
+                                       LoadPolicy, PlannerCore, SlaPolicy)
+from dynamo_tpu.planner.signals import (SignalCollector, fake_signals,
+                                        filter_states_by_model,
+                                        model_request_count)
+from dynamo_tpu.utils import overload
+from dynamo_tpu.utils.overload import (TenantAdmission, TenantBurnTracker,
+                                       TenantQuota, parse_tenant)
+
+
+# ---------------------------------------------------------------------------
+# registry records
+# ---------------------------------------------------------------------------
+def test_spec_roundtrip_and_validation():
+    spec = FleetModelSpec(
+        name="llama", engine="jax", model_path="/m/llama",
+        chips_per_replica=2, min_replicas=1, max_replicas=4, priority=2,
+        tenants={"acme": TenantQuota(rps=5, burst=10, concurrency=8)},
+        extra_args=["--echo-slots", "4"])
+    assert spec.component == "backend-llama"       # defaulted
+    again = FleetModelSpec.from_dict(json.loads(json.dumps(spec.to_dict())))
+    assert again == spec
+    with pytest.raises(ValueError):
+        FleetModelSpec(name="bad", min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        FleetModelSpec(name="bad", chips_per_replica=-1)
+
+
+def test_spec_rejects_path_shaped_names():
+    # '/' in the name would desync the registry key's last segment from
+    # the spec name (HF-style ids go in --model-path, not the name)
+    with pytest.raises(ValueError):
+        FleetModelSpec(name="meta-llama/Llama-3-8B")
+    with pytest.raises(ValueError):
+        FleetModelSpec(name="")
+    with pytest.raises(ValueError):
+        FleetModelSpec(name="x" * 65)
+
+
+def test_registry_tenant_quota_merge_takes_max():
+    reg = FleetRegistry.__new__(FleetRegistry)
+    reg.models = {
+        "a": FleetModelSpec(name="a", tenants={
+            "t": TenantQuota(rps=2, burst=4, concurrency=1)}),
+        "b": FleetModelSpec(name="b", tenants={
+            "t": TenantQuota(rps=5, burst=3, concurrency=8),
+            "u": TenantQuota(rps=1)}),
+    }
+    merged = FleetRegistry.tenant_quotas(reg)
+    assert merged["t"] == TenantQuota(rps=5, burst=4, concurrency=8)
+    assert merged["u"] == TenantQuota(rps=1)
+
+
+async def test_registry_store_roundtrip_and_lease_semantics():
+    """Desired state persists across sessions; observed status dies with
+    the publishing planner's lease."""
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    srv = StoreServer()
+    port = await srv.start()
+    ns = "fleetreg"
+    try:
+        drt = await DistributedRuntime(store_port=port).connect()
+        spec = FleetModelSpec(name="m1", component="backend-m1",
+                              min_replicas=0, max_replicas=2)
+        await put_fleet_model(drt.store, ns, spec)
+        assert (await get_fleet_model(drt.store, ns, "m1")) == spec
+        await publish_fleet_status(drt.store, ns, "m1",
+                                   {"state": "ready", "replicas": 1},
+                                   lease=drt.lease)
+        assert (await fetch_fleet_status(drt.store, ns))["m1"]["state"] \
+            == "ready"
+        # lease dies with the session -> status gone, desired state stays
+        await drt.close()
+        await asyncio.sleep(0.2)
+        drt2 = await DistributedRuntime(store_port=port).connect()
+        assert await fetch_fleet_status(drt2.store, ns) == {}
+        got = await list_fleet_models(drt2.store, ns)
+        assert [s.name for s in got] == ["m1"]
+
+        # live watch: add + remove propagate, on_change fires
+        reg = await FleetRegistry(drt2.store, ns).start()
+        events = []
+        reg.on_change = lambda name, s: events.append((name, s is None))
+        assert set(reg.models) == {"m1"}
+        await put_fleet_model(drt2.store, ns,
+                              FleetModelSpec(name="m2"))
+        await remove_fleet_model(drt2.store, ns, "m1")
+        await asyncio.sleep(0.2)
+        assert set(reg.models) == {"m2"}
+        assert ("m2", False) in events and ("m1", True) in events
+        await drt2.close()
+    finally:
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# chip arbiter
+# ---------------------------------------------------------------------------
+def test_arbiter_budget_clamp_splits_evenly():
+    arb = ChipArbiter(8, preempt_margin=0.5)
+    g = arb.grant([PoolClaim("a", 4, 0, 2, 0, burn=1.0),
+                   PoolClaim("b", 4, 0, 2, 0, burn=1.0)])
+    assert g["a"][0] == 2 and g["b"][0] == 2
+    assert "does not fit" in g["a"][1]
+
+
+def test_arbiter_burn_weighted_preemption():
+    arb = ChipArbiter(8, preempt_margin=0.5)
+    g = arb.grant([PoolClaim("cold", 4, 4, 2, 1, burn=0.1),
+                   PoolClaim("hot", 1, 0, 2, 0, burn=3.0)])
+    assert g["hot"] == (1, None)
+    assert g["cold"][0] == 3 and "yielded to hot" in g["cold"][1]
+
+
+def test_arbiter_margin_hysteresis_blocks_borderline_preemption():
+    arb = ChipArbiter(8, preempt_margin=0.5)
+    g = arb.grant([PoolClaim("a", 4, 4, 2, 1, burn=1.0),
+                   PoolClaim("b", 1, 0, 2, 0, burn=1.2)])
+    assert g["a"][0] == 4 and g["b"][0] == 0    # 0.2 < margin: no thrash
+
+
+def test_arbiter_priority_class_beats_burn():
+    arb = ChipArbiter(6, preempt_margin=0.5)
+    g = arb.grant([PoolClaim("lo", 3, 3, 2, 0, priority=0, burn=2.0),
+                   PoolClaim("hi", 1, 0, 2, 0, priority=1, burn=0.0)])
+    assert g["hi"] == (1, None)
+    assert g["lo"][0] == 2 and "priority 1 vs 0" in g["lo"][1]
+
+
+def test_arbiter_partial_preemption_rolls_back():
+    """A preemption that cannot complete a whole replica for the
+    beneficiary must not drain the victim anyway (chips would strand:
+    the victim loses a live replica every tick while the hot model still
+    never boots)."""
+    arb = ChipArbiter(5, preempt_margin=0.5)
+    g = arb.grant([PoolClaim("a", 3, 3, 1, 2, burn=0.0),
+                   PoolClaim("b", 1, 0, 4, 0, burn=5.0)])
+    # draining a to its floor (2) frees only 1 chip (left=3 < 4): the
+    # attempt must roll back — a keeps all 3 replicas, b stays unbooted
+    assert g["a"][0] == 3 and g["a"][1] is None
+    assert g["b"][0] == 0
+
+
+def test_arbiter_multi_victim_preemption_completes():
+    """Accumulating one beneficiary replica across SEVERAL victims is
+    legitimate — only incomplete drains roll back."""
+    arb = ChipArbiter(4, preempt_margin=0.5)
+    g = arb.grant([PoolClaim("a", 2, 2, 1, 1, burn=0.0),
+                   PoolClaim("c", 2, 2, 1, 1, burn=0.1),
+                   PoolClaim("b", 1, 0, 2, 0, burn=5.0)])
+    # b needs 2 chips; a and c each yield 1 (down to their floors)
+    assert g["b"][0] == 1
+    assert g["a"][0] == 1 and g["c"][0] == 1
+    assert "yielded to b" in g["a"][1] and "yielded to b" in g["c"][1]
+
+
+def test_ctl_tenant_quota_parse():
+    from dynamo_tpu.cli.ctl import parse_tenant_quota
+
+    tenant, q = parse_tenant_quota("acme:rps=5,burst=10,concurrency=8")
+    assert tenant == "acme"
+    assert q == TenantQuota(rps=5, burst=10, concurrency=8)
+    for bad in ("acme", "acme:", ":rps=5", "acme:bogus=1",
+                "acme:rps=abc"):
+        with pytest.raises(SystemExit):
+            parse_tenant_quota(bad)
+
+
+def test_collector_forget_pool_drops_model_state():
+    collector = SignalCollector.__new__(SignalCollector)
+    collector.pool_models = {"m": "m"}
+    collector._model_slo = {"m": object()}
+    collector._unserved_prev = {"m": 5.0}
+    collector.forget_pool("m")
+    assert collector.pool_models == {}
+    assert collector._model_slo == {}
+    assert collector._unserved_prev == {}
+
+
+def test_arbiter_floors_and_exempt_pools():
+    arb = ChipArbiter(4, preempt_margin=0.5)
+    # a's floor eats the whole budget; even burn 5 can't take it
+    g = arb.grant([PoolClaim("a", 2, 2, 2, 2, burn=0.0),
+                   PoolClaim("b", 2, 0, 2, 0, burn=5.0)])
+    assert g["a"] == (2, None) and g["b"][0] == 0
+    # chips_per_replica == 0 pools bypass the budget entirely
+    g = arb.grant([PoolClaim("cpu", 9, 0, 0, 0),
+                   PoolClaim("a", 2, 0, 2, 0)])
+    assert g["cpu"] == (9, None) and g["a"][0] == 2
+
+
+# ---------------------------------------------------------------------------
+# planner core: per-pool clamps, scale-to-zero
+# ---------------------------------------------------------------------------
+def test_core_per_pool_clamps_and_scale_to_zero():
+    core = PlannerCore(LoadPolicy(), min_replicas=1, max_replicas=8,
+                       cooldown_up=0.0, cooldown_down=0.0,
+                       down_consensus=1)
+    core.set_pool_clamps({"m1": (0, 2), "m2": (1, 3)})
+    idle = fake_signals("m1", replicas=1, total_slots=8)
+    d = core.evaluate({"m1": idle}, 100.0)[0]
+    assert d.action == SCALE_DOWN and d.target == 0   # pool min is 0
+    # a pool WITHOUT a clamp override keeps the global floor of 1
+    d = core.evaluate({"other": fake_signals("other", replicas=1,
+                                             total_slots=8)}, 200.0)[0]
+    assert d.action == HOLD and d.target == 1
+    # per-pool max clamps the surge
+    hot = fake_signals("m2", replicas=3, active_slots=24, total_slots=24,
+                       queue_depth=50)
+    d = core.evaluate({"m2": hot}, 300.0)[0]
+    assert d.target == 3 and d.suppressed == "clamp"
+    with pytest.raises(ValueError):
+        core.set_pool_clamps({"x": (2, 1)})
+    core.forget_pool("m1")
+    assert "m1" not in core.pool_clamps
+
+
+def test_load_policy_wakes_on_unserved_requests():
+    pol = LoadPolicy()
+    s = fake_signals("m", replicas=0, unserved=1.0)
+    target, reason = pol.propose(s)
+    assert target >= 1 and "scale from zero" in reason
+    # SlaPolicy counts unserved into demand too
+    class Tbl:
+        def capacity_per_replica(self, *a):
+            return 4.0
+    target, _ = SlaPolicy(Tbl(), 1.0, 0.1).propose(
+        fake_signals("m", replicas=0, unserved=2.0))
+    assert target >= 1
+
+
+# ---------------------------------------------------------------------------
+# model-scoped signal filtering
+# ---------------------------------------------------------------------------
+def _states_two_models():
+    return [("http", {
+        "llm_ttft_seconds": {
+            "kind": "histogram", "labels": ["model"],
+            "buckets": [0.1, 1.0],
+            "series": {
+                "fast": {"counts": [10, 0], "total": 10, "sum": 0.5},
+                "slow": {"counts": [0, 10], "total": 10, "sum": 9.0},
+            }},
+        "dyn_http_requests_total": {
+            "kind": "counter",
+            "labels": ["model", "endpoint", "status", "tenant"],
+            "series": {
+                "zero\x1fcompletions\x1f404\x1fdefault": 3.0,
+                "unknown\x1fcompletions\x1f404\x1fdefault": 7.0,
+            }},
+        "dyn_queue_shed_total": {"kind": "counter", "labels": ["stage"],
+                                 "series": {"worker_queue": 2.0}},
+    })]
+
+
+def test_filter_states_by_model_scopes_series():
+    from dynamo_tpu.planner.signals import quantile_from_states
+
+    states = _states_two_models()
+    assert quantile_from_states(states, "llm_ttft_seconds", 0.9) > 0.1
+    fast = filter_states_by_model(states, "fast")
+    assert quantile_from_states(fast, "llm_ttft_seconds", 0.9) <= 0.1
+    # label-less metrics pass through untouched
+    assert fast[0][1]["dyn_queue_shed_total"]["series"] == {
+        "worker_queue": 2.0}
+    assert model_request_count(states, "zero", "404") == 3.0
+    assert model_request_count(states, "missing", "404") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# tenancy
+# ---------------------------------------------------------------------------
+def test_parse_tenant():
+    assert parse_tenant(None) == "default"
+    assert parse_tenant("") == "default"
+    assert parse_tenant(" acme-01 ") == "acme-01"
+    with pytest.raises(ValueError):
+        parse_tenant("bad tenant!")
+    with pytest.raises(ValueError):
+        parse_tenant("x" * 65)
+
+
+def test_tenant_admission_rate_concurrency_and_labels():
+    t = [0.0]
+    ta = TenantAdmission(
+        {"hog": TenantQuota(rps=1.0, burst=2.0, concurrency=2)},
+        clock=lambda: t[0])
+    assert ta.enabled
+    assert ta.try_admit("hog") is None
+    assert ta.try_admit("hog") is None
+    rej = ta.try_admit("hog")                       # concurrency first
+    assert rej is not None and rej.reason == "tenant_concurrency"
+    assert rej.code == 429 and "hog" in str(rej)
+    ta.release("hog")
+    rej = ta.try_admit("hog")                       # bucket empty now
+    assert rej is not None and rej.reason == "tenant_rate"
+    t[0] += 1.0                                     # refill 1 token
+    assert ta.try_admit("hog") is None
+    # unquota'd tenants are ungoverned; labels stay bounded
+    assert ta.try_admit("randomclient") is None
+    assert ta.label("randomclient") == "other"
+    assert ta.label("default") == "default"
+    assert ta.label("hog") == "hog"
+
+
+def test_tenant_admission_live_update_preserves_bucket_level():
+    t = [0.0]
+    ta = TenantAdmission({"a": TenantQuota(rps=1.0, burst=2.0)},
+                         clock=lambda: t[0])
+    assert ta.try_admit("a") is None
+    assert ta.try_admit("a") is None                # bucket drained
+    # same quota re-applied (registry refresh): bucket NOT refilled
+    ta.set_quotas({"a": TenantQuota(rps=1.0, burst=2.0)})
+    assert ta.try_admit("a") is not None
+    # changed quota rebuilds the bucket
+    ta.set_quotas({"a": TenantQuota(rps=10.0, burst=5.0)})
+    assert ta.try_admit("a") is None
+    # dropped from the table -> ungoverned
+    ta.set_quotas({})
+    assert ta.try_admit("a") is None and not ta.enabled
+
+
+def test_tenant_quotas_from_env_parses_and_survives_garbage():
+    q = overload.tenant_quotas_from_env(
+        {"DYN_TENANT_QUOTAS":
+         '{"acme": {"rps": 5, "burst": 10, "concurrency": 8}}'})
+    assert q["acme"] == TenantQuota(rps=5, burst=10, concurrency=8)
+    assert overload.tenant_quotas_from_env(
+        {"DYN_TENANT_QUOTAS": "{nope"}) == {}
+    assert overload.tenant_quotas_from_env({}) == {}
+
+
+def test_tenant_burn_tracker_windows():
+    t = [100.0]
+    tr = TenantBurnTracker(objective=0.9, windows=(60.0,),
+                           clock=lambda: t[0])
+
+    def states(total, bad):
+        return [("http", {"dyn_tenant_requests_total": {
+            "kind": "counter", "labels": ["tenant", "status"],
+            "series": {"acme\x1f200": total - bad,
+                       "acme\x1f503": bad,
+                       "good\x1f200": 100.0}}})]
+
+    tr.observe(states(100, 0))
+    t[0] += 10
+    burns = tr.observe(states(200, 10))     # 10% bad in window / 0.1 budget
+    assert burns["acme"] == pytest.approx(1.0)
+    assert burns["good"] == 0.0
+    assert tr.worst() == pytest.approx(1.0)
+    # tenant 429s are NOT server-fault: only 5xx counts as bad
+    t[0] += 10
+    extra = states(300, 10)
+    extra[0][1]["dyn_tenant_requests_total"]["series"][
+        "acme\x1f429"] = 50.0
+    assert tr.observe(extra)["acme"] < 1.0
+
+
+async def test_http_tenant_quota_429_and_labels():
+    from test_http_service import start_service
+
+    svc, base = await start_service()
+    svc.tenants.set_quotas({"hog": TenantQuota(rps=0.001, burst=1.0)})
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "echo", "prompt": "hi", "max_tokens": 2}
+            hdr = {"x-tenant": "hog"}
+            async with s.post(f"{base}/v1/completions", json=body,
+                              headers=hdr) as r:
+                assert r.status == 200
+            async with s.post(f"{base}/v1/completions", json=body,
+                              headers=hdr) as r:
+                assert r.status == 429
+                assert r.headers.get("Retry-After")
+                err = (await r.json())["error"]
+                assert err["reason"] == "tenant_rate"
+                assert err["stage"] == "admission"
+                assert "hog" in err["message"]
+            # another tenant is untouched by hog's quota
+            async with s.post(f"{base}/v1/completions", json=body,
+                              headers={"x-tenant": "friend"}) as r:
+                assert r.status == 200
+            async with s.post(f"{base}/v1/completions", json=body,
+                              headers={"x-tenant": "no spaces!"}) as r:
+                assert r.status == 400
+            async with s.get(f"{base}/metrics") as r:
+                metrics = await r.text()
+        assert ('dyn_http_requests_total{model="echo",endpoint='
+                '"completions",status="200",tenant="hog"} 1') in metrics
+        # unquota'd tenants collapse to "other": bounded cardinality
+        assert 'tenant="friend"' not in metrics
+        reject_rows = [ln for ln in metrics.splitlines()
+                       if ln.startswith("dyn_tenant_admission_rejects_total{")]
+        assert any('tenant="hog"' in ln and 'reason="tenant_rate"' in ln
+                   for ln in reject_rows), reject_rows
+    finally:
+        await svc.stop()
+
+
+async def test_http_models_reports_fleet_state():
+    from test_http_service import start_service
+
+    svc, base = await start_service()
+
+    async def fleet_status():
+        return {"echo": {"state": "ready", "replicas": 2, "target": 2,
+                         "component": "backend-echo", "chips": 2},
+                "zero": {"state": "off", "replicas": 0, "target": 0,
+                         "component": "backend-zero", "chips": 0}}
+
+    svc.fleet_status = fleet_status
+    svc.known_models = lambda: {"echo", "zero"}
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/v1/models") as r:
+                data = (await r.json())["data"]
+            rows = {d["id"]: d for d in data}
+            assert rows["echo"]["state"] == "ready"
+            assert rows["echo"]["replicas"] == 2
+            # scaled-to-zero model appears even though nothing serves it
+            assert rows["zero"]["state"] == "off"
+            # a 404 for a REGISTERED model keeps its model label (the
+            # scale-from-zero wake signal)...
+            async with s.post(f"{base}/v1/completions", json={
+                    "model": "zero", "prompt": "x"}) as r:
+                assert r.status == 404
+                assert "scaled to zero" in (await r.json())[
+                    "error"]["message"]
+            # ...an unregistered one stays "unknown"
+            async with s.post(f"{base}/v1/completions", json={
+                    "model": "nope", "prompt": "x"}) as r:
+                assert r.status == 404
+            async with s.get(f"{base}/metrics") as r:
+                metrics = await r.text()
+        assert ('dyn_http_requests_total{model="zero",endpoint='
+                '"completions",status="404",tenant="default"} 1') in metrics
+        assert 'model="nope"' not in metrics
+    finally:
+        await svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# model-scoped routing
+# ---------------------------------------------------------------------------
+def test_scheduler_stamps_model_on_audit_entries():
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.kv_router.scheduler import KvScheduler
+
+    sched = KvScheduler(4, model="llama")
+    sched.update_endpoints({1: ForwardPassMetrics(
+        request_active_slots=0, request_total_slots=4)})
+    wid = sched.schedule([1, 2, 3, 4], OverlapScores())
+    assert wid == 1
+    entry = sched.decision_log()[-1]
+    assert entry["model"] == "llama"
+
+
+async def test_fleet_router_follows_registry_and_rejects_unknown():
+    from dynamo_tpu.llm.kv_router.router import FleetKvRouter
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.engine import EngineError
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    srv = StoreServer()
+    port = await srv.start()
+    ns = "fleetrt"
+    try:
+        drt = await DistributedRuntime(store_port=port).connect()
+        await put_fleet_model(drt.store, ns, FleetModelSpec(
+            name="m1", component="backend-m1"))
+        router = await FleetKvRouter(drt, ns, block_size=4).start()
+        assert set(router.routers) == {"m1"}
+        assert router.routers["m1"].worker_component == "backend-m1"
+        assert router.routers["m1"].scheduler.model == "m1"
+        # registry change mid-flight arms/drops routing
+        await put_fleet_model(drt.store, ns, FleetModelSpec(
+            name="m2", component="backend-m2"))
+        await asyncio.sleep(0.3)
+        assert set(router.routers) == {"m1", "m2"}
+        with pytest.raises(EngineError) as ei:
+            await router.route([1, 2, 3], model="ghost")
+        assert ei.value.code == 503 and ei.value.reason == "unknown_model"
+        # single-model convenience only applies when exactly one pool
+        with pytest.raises(EngineError):
+            await router.route([1, 2, 3], model=None)
+        await remove_fleet_model(drt.store, ns, "m2")
+        await asyncio.sleep(0.3)
+        assert set(router.routers) == {"m1"}
+        await router.stop()
+        await drt.close()
+    finally:
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# planner N-pool reconciliation (no subprocesses: fake workers + a
+# recording connector)
+# ---------------------------------------------------------------------------
+class FleetRecordingConnector:
+    name = "recording"
+
+    def __init__(self):
+        self.applied = []
+        self.pool_specs = {}
+        self.removed = []
+
+    def set_pool(self, pool, spec):
+        self.pool_specs[pool] = spec
+
+    async def remove_pool(self, pool):
+        self.removed.append(pool)
+
+    async def apply(self, pool, target, decision):
+        self.applied.append((pool, target, decision.action))
+
+    async def close(self):
+        pass
+
+
+async def _seed_worker(drt, namespace, component, active=0, total=8):
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.metrics_aggregator import metrics_key
+    from dynamo_tpu.runtime.component import EndpointInfo, endpoint_key
+
+    info = EndpointInfo(host="127.0.0.1", port=1, endpoint="generate",
+                        lease=drt.lease, worker_id=drt.worker_id)
+    await drt.store.put(
+        endpoint_key(namespace, component, "generate", drt.lease),
+        info.to_bytes(), lease=drt.lease)
+    m = ForwardPassMetrics(request_active_slots=active,
+                           request_total_slots=total)
+    await drt.store.put(metrics_key(namespace, component, drt.worker_id),
+                        json.dumps(m.to_dict()).encode(), lease=drt.lease)
+
+
+async def test_planner_fleet_pools_follow_registry():
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    srv = StoreServer()
+    port = await srv.start()
+    ns = "fleetplan"
+    try:
+        drt = await DistributedRuntime(store_port=port).connect()
+        hot = await DistributedRuntime(store_port=port).connect()
+        await put_fleet_model(drt.store, ns, FleetModelSpec(
+            name="hotm", component="backend-hotm", chips_per_replica=0,
+            min_replicas=0, max_replicas=3))
+        await _seed_worker(hot, ns, "backend-hotm", active=8, total=8)
+
+        conn = FleetRecordingConnector()
+        plane = FleetPlane(drt.store, ns, total_chips=4)
+        planner = Planner(
+            drt, ns, {}, LoadPolicy(), conn,
+            PlannerConfig(interval=30.0, min_replicas=1, max_replicas=8,
+                          cooldown_up=0.0, cooldown_down=0.0,
+                          down_consensus=1),
+            fleet=plane)
+        await plane.start()
+        await planner._watch_override()
+
+        ds = await planner.run_once(now=1000.0)
+        assert planner.pools == {"hotm": "backend-hotm"}
+        by_pool = {d.pool: d for d in ds}
+        assert by_pool["hotm"].action == SCALE_UP     # occupancy 1.0
+        assert conn.applied and conn.applied[0][0] == "hotm"
+        # connector got the model's PoolSpec with identity args
+        spec = conn.pool_specs["hotm"]
+        assert spec.component == "backend-hotm"
+        assert "--model-name" in spec.extra_args \
+            and "--register-model" in spec.extra_args
+
+        # status published lease-bound, state=booting (target > live)
+        status = await fetch_fleet_status(drt.store, ns)
+        assert status["hotm"]["state"] == "booting"
+        assert status["hotm"]["replicas"] == 1
+
+        # model removed -> pool drained and forgotten next tick
+        await remove_fleet_model(drt.store, ns, "hotm")
+        await asyncio.sleep(0.2)
+        ds = await planner.run_once(now=2000.0)
+        assert ds == []
+        assert conn.removed == ["hotm"]
+        assert planner.pools == {}
+        await hot.close()
+        await drt.close()
+    finally:
+        await srv.stop()
+
+
+async def test_planner_fleet_boots_before_drains_and_dry_run_parity():
+    """One tick with a scale-up AND a scale-down actuates the boot first
+    (weight load overlaps drain); dry-run emits identical decisions but
+    touches neither connector nor status keys."""
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    srv = StoreServer()
+    port = await srv.start()
+    ns = "fleetorder"
+    try:
+        drt = await DistributedRuntime(store_port=port).connect()
+        idle1 = await DistributedRuntime(store_port=port).connect()
+        idle2 = await DistributedRuntime(store_port=port).connect()
+        hot = await DistributedRuntime(store_port=port).connect()
+        for spec in (FleetModelSpec(name="coldm", component="backend-coldm",
+                                    chips_per_replica=0, min_replicas=0,
+                                    max_replicas=4),
+                     FleetModelSpec(name="hotm", component="backend-hotm",
+                                    chips_per_replica=0, min_replicas=0,
+                                    max_replicas=4)):
+            await put_fleet_model(drt.store, ns, spec)
+        await _seed_worker(idle1, ns, "backend-coldm")
+        await _seed_worker(idle2, ns, "backend-coldm")
+        await _seed_worker(hot, ns, "backend-hotm", active=8, total=8)
+
+        def build(conn, dry):
+            return Planner(
+                drt, ns, {}, LoadPolicy(), conn,
+                PlannerConfig(interval=30.0, min_replicas=1,
+                              max_replicas=8, cooldown_up=0.0,
+                              cooldown_down=0.0, down_consensus=1,
+                              dry_run=dry),
+                fleet=FleetPlane(drt.store, ns, total_chips=4))
+
+        dry_conn = FleetRecordingConnector()
+        dry = build(dry_conn, True)
+        await dry.fleet.start()
+        await dry._watch_override()
+        dry_ds = {d.pool: d for d in await dry.run_once(now=1000.0)}
+        assert dry_conn.applied == []
+        assert await fetch_fleet_status(drt.store, ns) == {}
+
+        conn = FleetRecordingConnector()
+        live = build(conn, False)
+        await live.fleet.start()
+        await live._watch_override()
+        live_ds = {d.pool: d for d in await live.run_once(now=1000.0)}
+        # identical decision stream (modulo dry_run/seq/ts)
+        for pool in ("hotm", "coldm"):
+            for fld in ("current", "proposed", "target", "action",
+                        "policy", "suppressed"):
+                assert getattr(live_ds[pool], fld) == \
+                    getattr(dry_ds[pool], fld), (pool, fld)
+        actions = [(p, a) for p, _t, a in conn.applied]
+        assert actions == [("hotm", SCALE_UP), ("coldm", SCALE_DOWN)]
+        status = await fetch_fleet_status(drt.store, ns)
+        assert status["hotm"]["state"] == "booting"
+        assert status["coldm"]["state"] == "draining"
+        for c in (idle1, idle2, hot, drt):
+            await c.close()
+    finally:
+        await srv.stop()
+
+
+async def test_planner_fleet_component_move_drains_old_pool():
+    """Re-adding a model under a different component is remove + add:
+    the old component's workers drain (they would otherwise hold chips
+    forever, invisible to collector and arbiter)."""
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    srv = StoreServer()
+    port = await srv.start()
+    ns = "fleetmove"
+    try:
+        drt = await DistributedRuntime(store_port=port).connect()
+        await put_fleet_model(drt.store, ns, FleetModelSpec(
+            name="m", component="backend-x", chips_per_replica=0))
+        conn = FleetRecordingConnector()
+        planner = Planner(
+            drt, ns, {}, LoadPolicy(), conn,
+            PlannerConfig(interval=30.0, cooldown_up=0.0,
+                          cooldown_down=0.0, down_consensus=1),
+            fleet=FleetPlane(drt.store, ns, total_chips=4))
+        await planner.fleet.start()
+        await planner._watch_override()
+        await planner.run_once(now=1000.0)
+        assert planner.pools == {"m": "backend-x"}
+        await put_fleet_model(drt.store, ns, FleetModelSpec(
+            name="m", component="backend-y", chips_per_replica=0))
+        await asyncio.sleep(0.2)
+        await planner.run_once(now=2000.0)
+        assert conn.removed == ["m"]           # old pool drained
+        assert planner.pools == {"m": "backend-y"}
+        assert conn.pool_specs["m"].component == "backend-y"
+        await drt.close()
+    finally:
+        await srv.stop()
+
+
+async def test_kube_connector_remove_pool_zeroes_service():
+    from dynamo_tpu.deploy.kube import FakeKubeApi
+    from dynamo_tpu.planner.connectors import KubeConnector
+
+    api = FakeKubeApi()
+    api.apply({"apiVersion": "dynamo.tpu/v1alpha1",
+               "kind": "DynamoDeployment",
+               "metadata": {"name": "dep", "namespace": "default"},
+               "spec": {"services": {"m": {"replicas": 3},
+                                     "other": {"replicas": 2}}}})
+    conn = KubeConnector(api, "dep")
+    await conn.remove_pool("m")
+    obj = api.get("DynamoDeployment", "default", "dep")
+    assert obj["spec"]["services"]["m"]["replicas"] == 0
+    assert obj["spec"]["services"]["other"]["replicas"] == 2
+    # a pool that never reconciled must not crash the drain
+    await conn.remove_pool("ghost-pool")
+
+
+def test_collector_splits_fleet_shed_rate_across_model_pools():
+    """One model's storm must not inflate every model pool's demand
+    N-fold: the (unattributable, pre-body) fleet shed rate is split
+    evenly across model pools; classic pools keep full attribution."""
+    collector = SignalCollector.__new__(SignalCollector)
+    collector.pools = {"a": "backend-a", "b": "backend-b"}
+    collector.pool_models = {"a": "a", "b": "b"}
+    assert collector._model_shed_share() == pytest.approx(0.5)
+    collector.pools = {"decode": "backend", "prefill": "prefill"}
+    collector.pool_models = {}
+    assert collector._model_shed_share() == 1.0
+
+
+def test_plane_arbitrate_annotates_reductions():
+    plane = FleetPlane.__new__(FleetPlane)
+    plane.arbiter = ChipArbiter(4, preempt_margin=0.5)
+    reg = FleetRegistry.__new__(FleetRegistry)
+    reg.models = {
+        "a": FleetModelSpec(name="a", chips_per_replica=2,
+                            min_replicas=0, max_replicas=4),
+        "b": FleetModelSpec(name="b", chips_per_replica=2,
+                            min_replicas=0, max_replicas=4),
+    }
+    plane.registry = reg
+    from dynamo_tpu.planner.policy import Decision
+
+    mk = lambda pool, cur, tgt, act: Decision(
+        pool=pool, current=cur, proposed=tgt, target=tgt, action=act,
+        reason="r", policy="load")
+    decisions = [mk("a", 2, 2, HOLD), mk("b", 0, 2, SCALE_UP)]
+    signals = {"a": fake_signals("a", replicas=2),
+               "b": fake_signals("b", replicas=0, slo_burn={"x": 5.0})}
+    out = {d.pool: d for d in plane.arbitrate(decisions, signals)}
+    # budget 4: b's dominant burn preempts a down to its floor (0 — a
+    # model that must keep replicas sets min_replicas) so b boots 2
+    assert out["b"].target == 2 and out["b"].action == SCALE_UP
+    assert out["a"].target == 0
+    assert out["a"].action == SCALE_DOWN
+    assert out["a"].suppressed == SUPPRESSED_CHIP_BUDGET
+    assert "yielded to b" in out["a"].reason
+
+
+# ---------------------------------------------------------------------------
+# end-to-end loopback: second model added mid-traffic (tier-1, echo
+# engines, one worker per model)
+# ---------------------------------------------------------------------------
+async def _await_serving(session, base, name, timeout=90.0):
+    """Poll until ``name`` actually answers a completion (worker booted,
+    registered, discovered)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        async with session.post(f"{base}/v1/completions", json={
+                "model": name, "prompt": "ping", "max_tokens": 2}) as r:
+            if r.status == 200:
+                return
+        await asyncio.sleep(0.25)
+    raise AssertionError(f"model {name} never served in {timeout}s")
+
+
+async def _await_gone(session, base, name, timeout=45.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        async with session.get(f"{base}/v1/models") as r:
+            data = (await r.json())["data"]
+        if name not in {d["id"] for d in data}:
+            return
+        await asyncio.sleep(0.25)
+    raise AssertionError(f"model {name} never disappeared in {timeout}s")
+
+
+# ---------------------------------------------------------------------------
+# the mixed-model rigs themselves (multi-process; excluded from tier-1)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_mixed_model_soak_lane(tmp_path):
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "scripts/overload_soak.py", "--mixed-model",
+         "--workers", "1", "--solo-s", "5", "--mixed-s", "8",
+         "--out", str(tmp_path / "mixed_model_soak.json")],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_model_kill_soak_lane():
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "scripts/chaos_soak.py", "--model-kill",
+         "--duration", "15", "--workers", "2"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+
+
+async def test_fleet_e2e_second_model_added_mid_traffic():
+    from dynamo_tpu.cli.http import run_http
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    srv = StoreServer()
+    port = await srv.start()
+    ns = "fleete2e"
+    store_addr = f"127.0.0.1:{port}"
+    child_env = {"JAX_PLATFORMS": "cpu", "DYNAMO_TPU_DATAPLANE": "python",
+                 "DYN_TOKEN_ECHO_DELAY_MS": "5"}
+    drt = await DistributedRuntime(store_port=port).connect()
+    from dynamo_tpu.planner.connectors import LocalConnector
+
+    conn = LocalConnector(store_addr, ns, {}, platform="cpu")
+    plane = FleetPlane(drt.store, ns, total_chips=4,
+                       worker_env=child_env)
+    planner = None
+    svc = None
+    failures = []
+    stop_traffic = asyncio.Event()
+    a_served = [0]
+
+    async def traffic(session, base):
+        body = {"model": "modela", "prompt": "hello", "max_tokens": 4}
+        while not stop_traffic.is_set():
+            try:
+                async with session.post(f"{base}/v1/completions",
+                                        json=body) as r:
+                    if r.status == 200:
+                        a_served[0] += 1
+                    else:
+                        failures.append((r.status, await r.text()))
+            except Exception as e:  # noqa: BLE001 - recorded as failure
+                failures.append(("exc", repr(e)))
+            await asyncio.sleep(0.15)
+
+    try:
+        # model A registered, then the fleet planner boots its worker
+        await put_fleet_model(drt.store, ns, FleetModelSpec(
+            name="modela", component="backend-modela", engine="echo",
+            chips_per_replica=1, min_replicas=1, max_replicas=2,
+            extra_args=["--echo-slots", "4"]))
+        planner = await Planner(
+            drt, ns, {}, LoadPolicy(), conn,
+            PlannerConfig(interval=0.25, min_replicas=1, max_replicas=4,
+                          cooldown_up=1.0, cooldown_down=5.0,
+                          down_consensus=3),
+            fleet=plane).start()
+        http_args = argparse.Namespace(store=store_addr, host="127.0.0.1",
+                                       port=0, router_component=None,
+                                       namespace=ns)
+        svc = await run_http(http_args, drt=drt)
+        base = f"http://127.0.0.1:{svc.port}"
+        async with aiohttp.ClientSession() as session:
+            await _await_serving(session, base, "modela")
+            tt = asyncio.create_task(traffic(session, base))
+            # ---- mid-traffic: add a second model (ctl fleet add shape)
+            await asyncio.sleep(1.0)
+            await put_fleet_model(drt.store, ns, FleetModelSpec(
+                name="modelb", component="backend-modelb", engine="echo",
+                chips_per_replica=1, min_replicas=1, max_replicas=2,
+                extra_args=["--echo-slots", "4"]))
+            # B serves (its own pool, its own component)
+            await _await_serving(session, base, "modelb")
+            # /v1/models carries fleet state for both
+            async with session.get(f"{base}/v1/models") as r:
+                rows = {d["id"]: d for d in (await r.json())["data"]}
+            assert rows["modela"].get("state") in ("ready", "booting")
+            assert rows["modela"].get("component") == "backend-modela"
+            assert "modelb" in rows
+            # ---- remove B mid-traffic; A must stay undisturbed
+            await remove_fleet_model(drt.store, ns, "modelb")
+            await _await_gone(session, base, "modelb")
+            await asyncio.sleep(0.5)
+            stop_traffic.set()
+            await tt
+        assert failures == [], f"model A disturbed: {failures[:5]}"
+        assert a_served[0] > 5
+        # the planner's status plane tracked both models
+        status = await fetch_fleet_status(drt.store, ns)
+        assert "modela" in status and "modelb" not in status
+    finally:
+        stop_traffic.set()
+        if svc is not None:
+            await svc.stop()
+        if planner is not None:
+            await planner.stop()
+        await conn.close()
+        await drt.close()
+        await srv.stop()

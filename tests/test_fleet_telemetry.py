@@ -296,6 +296,8 @@ def test_classify_key_covers_every_registered_family():
         "deploy-status": "deploy/status/ns/app",
         "deploy-artifacts": "deploy/artifacts/app/00000001",
         "fleet-soak": "fleet/fleet/beacon",
+        "fleet-models": "fleet_models/dynamo/llama-8b",
+        "fleet-status": "fleet_status/dynamo/llama-8b",
         "kv-cluster": "kv_cluster/dynamo/backend/1a2b",
     }
     # every registered family must have a classified example here — a new

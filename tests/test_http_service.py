@@ -114,7 +114,8 @@ async def test_errors_and_metrics():
                 assert r.status == 200
             async with s.get(f"{base}/metrics") as r:
                 metrics = await r.text()
-        assert 'dyn_http_requests_total{model="echo",endpoint="chat",status="200"} 1' in metrics
+        assert ('dyn_http_requests_total{model="echo",endpoint="chat",'
+                'status="200",tenant="default"} 1') in metrics
         assert 'status="404"' in metrics
         assert "dyn_http_request_duration_seconds_bucket" in metrics
     finally:
