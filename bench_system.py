@@ -31,6 +31,11 @@ A/B pairs:
   sharing on it arrives donor-stamped and fetches the prefix from the
   owner's host tier (llm/kv_cluster/); off, it recomputes. The A/B is the
   second worker's tier-hit TTFT vs recompute TTFT.
+- long_context: KV paging A/B (llm/kvpage/) — a needle-in-a-haystack
+  workload at 2x/8x/32x the device page budget, paged engine vs an
+  unpaged reference. Token exactness and a fault-free steady-state
+  decode are ASSERTED (a paging regression fails the lane); TTFT/ITL
+  land in bench_points/long_context_<N>x.json.
 """
 
 from __future__ import annotations
@@ -708,11 +713,151 @@ def kv_cluster_ab(families: int = 10, prefix_len: int = 1536,
 
 
 # ---------------------------------------------------------------------------
+# long-context lane: KV paging A/B (llm/kvpage/, docs/long_context.md)
+# ---------------------------------------------------------------------------
+
+def _needle_prompt(n_tokens: int, seed: int = 11) -> List[int]:
+    """Needle-in-a-haystack-shaped token stream over the byte vocab: a
+    distinctive 16-token motif planted ~5% in, pseudorandom filler, and
+    the motif's first half repeated at the very end (the 'query'). The
+    random-weight model can't answer it, but the SHAPE is the workload:
+    early tokens the decode working set must still reach."""
+    rng = random.Random(seed)
+    motif = [250 - i for i in range(16)]
+    toks = [rng.randrange(1, 250) for _ in range(n_tokens)]
+    at = max(1, n_tokens // 20)
+    toks[at:at + len(motif)] = motif
+    toks[-8:] = motif[:8]
+    return toks[:n_tokens]
+
+
+def _drive_engine(core, seq_id: str, prompt: List[int],
+                  max_tokens: int) -> Dict[str, Any]:
+    """Run one request on an EngineCore, timing TTFT/ITL host-side."""
+    from dynamo_tpu.llm.protocols.common import (BackendInput,
+                                                 StopConditions)
+
+    core.submit(seq_id, BackendInput(
+        token_ids=list(prompt), stop=StopConditions(max_tokens=max_tokens)))
+    pager = core.kvpager.pager if core.kvpager is not None else None
+    t0 = time.perf_counter()
+    toks: List[int] = []
+    stamps: List[float] = []
+    faults_at_first = 0
+    for _ in range(200000):
+        for so in core.step():
+            assert so.error is None, f"bench request errored: {so.error}"
+            if not stamps and pager is not None:
+                # first token = prefill done: faults past this point are
+                # steady-state decode faults, the ones that must be zero
+                faults_at_first = pager.faults
+            toks.append(so.token)
+            stamps.append(time.perf_counter())
+        if stamps and len(toks) >= max_tokens:
+            break
+    itls = [b - a for a, b in zip(stamps, stamps[1:])]
+    return {
+        "tokens": toks,
+        "faults_at_first_token": faults_at_first,
+        "ttft_s": round(stamps[0] - t0, 4) if stamps else None,
+        "itl_mean_s": (round(statistics.mean(itls), 5) if itls else None),
+    }
+
+
+def long_context_lane(multiples=(2, 8, 32), budget_pages: int = 8,
+                      page_size: int = 16, max_tokens: int = 16,
+                      points_dir: str = "bench_points") -> Dict[str, Any]:
+    """Paged-vs-unpaged A/B at N x the device budget: pins token
+    exactness (ASSERTS — a paging regression fails the lane, it does not
+    just dent a number), zero synchronous page faults in the steady-state
+    decode phase, and reports TTFT/ITL for both arms per multiple.
+
+    Runs in-process against EngineCore (not an HTTP topology): the claim
+    under test is the engine's paged serving itself, and the unpaged
+    reference needs a pool the paged engine is forbidden to have."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+    from dynamo_tpu.models import llama
+
+    budget_tokens = budget_pages * page_size
+    # the paged lane needs chunk_pages + 2 <= budget
+    chunk = min(64, (budget_pages - 2) * page_size)
+    max_ctx = max(multiples) * budget_tokens + 256
+    # f32 so the only paged-vs-dense difference is softmax reassociation
+    mcfg = llama.preset("tiny-byte", max_position=max_ctx,
+                        dtype=jnp.float32)
+    results: Dict[str, Any] = {"budget_pages": budget_pages,
+                               "page_size": page_size,
+                               "multiples": list(multiples)}
+    os.makedirs(points_dir, exist_ok=True)
+    for mult in multiples:
+        ctx = mult * budget_tokens
+        prompt = _needle_prompt(ctx)
+        ref = EngineCore(JaxEngineConfig(
+            model=mcfg, max_batch=2, max_context=ctx + max_tokens + 64,
+            page_size=page_size, prefill_chunk=chunk, decode_steps=4,
+            kvpage_budget=0))
+        try:
+            unpaged = _drive_engine(ref, f"ref{mult}", prompt, max_tokens)
+        finally:
+            ref.close()
+        core = EngineCore(JaxEngineConfig(
+            model=mcfg, max_batch=2, max_context=budget_tokens,
+            page_size=page_size, prefill_chunk=chunk, decode_steps=4,
+            host_cache_blocks=ctx // page_size + 64,
+            kvpage_budget=budget_pages, kvpage_seg_pages=4,
+            kvpage_prefetch=2,
+            kvpage_max_context=ctx + max_tokens + 64))
+        try:
+            pager = core.kvpager.pager
+            paged = _drive_engine(core, f"pg{mult}", prompt, max_tokens)
+            # prefill faults (plan warm-up) are excluded: steady state is
+            # the decode phase, where every page-in must be prefetched
+            decode_faults = pager.faults - paged["faults_at_first_token"]
+            point = {
+                "multiple": mult,
+                "context_tokens": ctx,
+                "budget_pages": budget_pages,
+                "device_budget_tokens": budget_tokens,
+                "exact": paged["tokens"] == unpaged["tokens"],
+                "decode_phase_faults": decode_faults,
+                "pageins": pager.pageins,
+                "paged": {k: v for k, v in paged.items() if k != "tokens"},
+                "unpaged": {k: v for k, v in unpaged.items()
+                            if k != "tokens"},
+                "tokens": paged["tokens"],
+            }
+        finally:
+            core.close()
+        with open(os.path.join(points_dir,
+                               f"long_context_{mult}x.json"), "w") as f:
+            json.dump(point, f, indent=2)
+        results[f"{mult}x"] = point
+        # the regression gates: byte-for-byte output parity with the
+        # dense path, and a fault-free steady-state decode
+        assert point["exact"], (
+            f"paged output diverged from unpaged at {mult}x budget: "
+            f"{paged['tokens']} != {unpaged['tokens']}")
+        assert decode_faults == 0, (
+            f"{decode_faults} synchronous page faults in steady-state "
+            f"decode at {mult}x budget")
+    results["checks"] = {
+        "all_exact": all(results[f"{m}x"]["exact"] for m in multiples),
+        "zero_decode_faults": all(
+            results[f"{m}x"]["decode_phase_faults"] == 0
+            for m in multiples),
+    }
+    return results
+
+
+# ---------------------------------------------------------------------------
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--pairs", default="routing,disagg,kv_cluster",
-                    help="comma list: routing, disagg, kv_cluster")
+                    help="comma list: routing, disagg, kv_cluster, "
+                         "long_context")
     ap.add_argument("--requests", type=int, default=100)
     ap.add_argument("--json", dest="json_out", default=None)
     args = ap.parse_args()
@@ -730,6 +875,8 @@ def main() -> None:
             out["routing"]["checks"][f"{pct}_win"] = bool(spd and spd > 1.0)
     if "kv_cluster" in pairs:
         out["kv_cluster"] = kv_cluster_ab()
+    if "long_context" in pairs:
+        out["long_context"] = long_context_lane()
     if "disagg" in pairs:
         out["disagg"] = disagg_ab()
         if "skipped" not in out["disagg"]:

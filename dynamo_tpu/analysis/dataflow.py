@@ -207,7 +207,7 @@ def get_device_taint(mod: Module, options: Optional[dict] = None
     key = tuple(sorted(
         (k, tuple(v) if isinstance(v, (list, set, tuple)) else v)
         for k, v in (options or {}).items()
-        if k in ("device_attrs", "jit_wrappers")))
+        if k in ("device_attrs", "jit_wrappers", "jitfn_attrs")))
     cache = getattr(mod, "_taint_cache", None)
     if cache is None:
         cache = mod._taint_cache = {}
@@ -244,6 +244,12 @@ class DeviceTaint:
                              | set(options.get("jit_wrappers", ())))
         self.attr_tags: Dict[str, str] = {
             a: DEVICE for a in options.get("device_attrs", ())}
+        # jitfn_attrs: attribute names known to hold jit-compiled
+        # callables ACROSS module boundaries (e.g. the kvpage runner
+        # calling programs built in programs.py) — per-module attribute
+        # scanning cannot see those assignments
+        self.attr_tags.update(
+            {a: JITFN for a in options.get("jitfn_attrs", ())})
         self.global_tags: Dict[str, str] = {}
         self.summaries: Dict[str, Optional[str]] = {}
         self.traced: Set[ast.AST] = set()

@@ -35,6 +35,11 @@ SCOPE = [
     "dynamo_tpu/ops",
     "dynamo_tpu/parallel",
     "dynamo_tpu/models",
+    # the KV-paging plane moves pages d2h/h2d by design — every one of
+    # its transfer sites must carry a reasoned suppression (they ARE the
+    # documented paging budget), and a new un-reasoned sync still fails
+    "dynamo_tpu/llm/kvpage",
+    "dynamo_tpu/llm/kvbm/transfer.py",
 ]
 
 
@@ -47,7 +52,17 @@ class HostSyncRule(Rule):
     scope = list(SCOPE)
 
     def check_module(self, mod: Module) -> List[Finding]:
-        taint = get_device_taint(mod, self.options)
+        opts = dict(self.options or {})
+        if mod.rel.startswith("dynamo_tpu/llm/kvpage"):
+            # the paged runner consumes jitted programs BUILT in
+            # programs.py; per-module attribute scanning cannot see those
+            # assignments, so name them — their call results are device
+            # arrays, and every fetch of one must carry a reasoned
+            # suppression (the paging plane's transfer budget)
+            opts["jitfn_attrs"] = tuple(opts.get("jitfn_attrs", ())) + (
+                "embed", "qkv", "attn_hot", "attn_cold", "layer_out",
+                "head")
+        taint = get_device_taint(mod, opts)
         out: List[Finding] = []
         dup: Dict[str, int] = {}
         for func in taint.top_level_functions():

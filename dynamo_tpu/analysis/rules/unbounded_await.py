@@ -48,6 +48,7 @@ LEGACY_SCOPE = [
     "dynamo_tpu/cli/dyntop.py",
     "dynamo_tpu/utils/overload.py",
     "dynamo_tpu/llm/kv_cluster",
+    "dynamo_tpu/llm/kvpage",
     "dynamo_tpu/fleet",
     "scripts/overload_soak.py",
     "scripts/fleet_soak.py",

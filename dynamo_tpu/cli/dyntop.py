@@ -168,6 +168,7 @@ class ClusterSnapshotter:
         }
         return {
             "cluster": cluster_kv_totals(states),
+            "paging": kvpage_totals(states),
             "fleet": fleet,
             "at": time.time(),
             "namespace": self.namespace,
@@ -265,6 +266,29 @@ def cluster_kv_totals(states) -> Dict[str, float]:
             out[field] += sum((st.get("series") or {}).values())
         st = dump.get("dyn_kv_tier_blocks") or {}
         out["tier_blocks"] += sum((st.get("series") or {}).values())
+    return out
+
+
+def kvpage_totals(states) -> Dict[str, float]:
+    """Fleet-summed KV-paging counters + resident bytes by tier — the
+    ``paging:`` line. All-zero when no engine pages (nothing rendered)."""
+    names = {
+        "dyn_kvpage_demotions_total": "demotions",
+        "dyn_kvpage_pageins_total": "pageins",
+        "dyn_kvpage_faults_total": "faults",
+    }
+    out = {v: 0.0 for v in names.values()}
+    out["device_bytes"] = 0.0
+    out["host_bytes"] = 0.0
+    for _component, dump in states:
+        for metric, field in names.items():
+            st = dump.get(metric) or {}
+            out[field] += sum((st.get("series") or {}).values())
+        st = dump.get("dyn_kvpage_resident_bytes") or {}
+        for skey, val in (st.get("series") or {}).items():
+            tier = skey.split("\x1f")[0] if skey else "?"
+            key = "device_bytes" if tier == "device" else "host_bytes"
+            out[key] += val
     return out
 
 
@@ -370,6 +394,14 @@ def render(snap: Dict, store_detail: bool = False) -> str:
             f"peer_hits={int(cl.get('hits', 0))}  "
             f"fetches={int(cl.get('fetches', 0))}  "
             f"fallbacks={int(cl.get('fallbacks', 0))}")
+    pg = snap.get("paging") or {}
+    if any(pg.values()):
+        lines.append(
+            f"paging: demoted={int(pg.get('demotions', 0))}  "
+            f"pageins={int(pg.get('pageins', 0))}  "
+            f"faults={int(pg.get('faults', 0))}  "
+            f"resident={pg.get('device_bytes', 0.0) / 1e6:.0f}MB dev / "
+            f"{pg.get('host_bytes', 0.0) / 1e6:.0f}MB host")
     comps = snap.get("compiles") or {}
     if comps:
         lines.append("compiles: " + "  ".join(

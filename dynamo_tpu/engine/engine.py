@@ -124,6 +124,16 @@ class JaxEngineConfig:
     spec: Optional[str] = None          # "ngram" | "draft" | "off"/None
     spec_k: Optional[int] = None        # max drafts/lane (None => DYN_SPEC_K)
     spec_draft: Optional[str] = None    # draft preset/dir (None => env)
+    # KV paging (llm/kvpage/): serve contexts beyond max_context with
+    # device residency bounded to a page budget — chunked prefill demotes
+    # sealed blocks d2h, decode streams the cold tail back through staged
+    # uploads. None => consult the DYN_KVPAGE_* env knobs; 0 disables.
+    # Requires host_cache_blocks > 0 and composes with neither spec
+    # decoding nor pp/sp/multi-host (validated at construction).
+    kvpage_budget: Optional[int] = None      # device pages for the lane
+    kvpage_seg_pages: Optional[int] = None   # blocks per staging segment
+    kvpage_prefetch: Optional[int] = None    # segments prefetched ahead
+    kvpage_max_context: Optional[int] = None  # paged context ceiling
 
     @classmethod
     def from_card(cls, card: ModelDeploymentCard, tensor_parallel: int = 1,
@@ -197,6 +207,13 @@ class StepOutput:
     # this token's own logprob (not re-derivable from the cumulative without
     # float cancellation)
     token_logprob: float = 0.0
+    # typed-error fields (meaningful only with finish == ERROR): the
+    # http-ish status + stage/reason triple the uniform error body exposes,
+    # so an engine-side rejection (over-length prompt -> 400) survives to
+    # the frontend instead of collapsing into a generic 500
+    error_code: int = 500
+    error_stage: Optional[str] = None
+    error_reason: Optional[str] = None
 
 
 class EngineCore:
@@ -511,6 +528,28 @@ class EngineCore:
         # every device dispatch so follower processes can replay it
         self.dispatch_hook: Optional[Any] = None
 
+        # --- KV paging lane (llm/kvpage/) -----------------------------
+        # long-context requests the pool/max_context would reject are
+        # served with bounded device residency: chunked prefill demotes
+        # sealed blocks to the host tier, decode streams them back per
+        # layer through staged uploads (docs/long_context.md)
+        self.kvpager = None
+        from ..llm.kvpage.runner import PagedConfig
+        pcfg = PagedConfig.resolve(cfg)
+        if pcfg is not None:
+            from ..llm.kvpage.programs import PagedPrograms
+            from ..llm.kvpage.runner import PagedEngine
+            why = PagedPrograms.validate(cfg)
+            if why is not None:
+                raise ValueError(f"KV paging does not support {why}")
+            if self.tiered is None:
+                raise ValueError("KV paging needs a host tier to demote "
+                                 "into (set host_cache_blocks > 0)")
+            if self.spec is not None:
+                raise ValueError("KV paging does not compose with "
+                                 "speculative decoding")
+            self.kvpager = PagedEngine(self, pcfg)
+
         if cfg.warmup:
             self.warmup()
 
@@ -819,7 +858,10 @@ class EngineCore:
     # ------------------------------------------------------------------
     def close(self) -> None:
         """Release host-side cache resources (the disk tier's spill
-        memmaps + files). Idempotent; called from JaxEngine.shutdown."""
+        memmaps + files, the pager's prefetch thread). Idempotent; called
+        from JaxEngine.shutdown."""
+        if self.kvpager is not None:
+            self.kvpager.close()
         if self.tiered is not None:
             self.tiered.close()
 
@@ -833,10 +875,14 @@ class EngineCore:
         else:
             self.waiting = collections.deque(
                 (s, r) for s, r in self.waiting if s != seq_id)
+            if self.kvpager is not None:
+                self.kvpager.cancel(seq_id)
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting or self.by_seq or self._inflight)
+        return bool(self.waiting or self.by_seq or self._inflight
+                    or (self.kvpager is not None
+                        and self.kvpager.has_work))
 
     @property
     def active(self) -> int:
@@ -847,6 +893,20 @@ class EngineCore:
         hit_rate = (self.prefix_hit_tokens / self.prefix_query_tokens
                     if self.prefix_query_tokens else 0.0)
         goodput = self.goodput.snapshot()
+        # byte-honest residency: device pool bytes in use plus the paged
+        # lane's pinned host working set, against device + host-tier
+        # capacity — the router's bytes-pressure scoring input (a 128k
+        # request shows up here at its true size, not as one slot)
+        blk_bytes = float(llama.kv_block_bytes(self.cfg.model,
+                                               self.cfg.page_size))
+        resident = float(total - self.pool.free_pages) * blk_bytes
+        capacity = float(total) * blk_bytes
+        if self.tiered is not None:
+            capacity += float(self.tiered.host.num_blocks) * blk_bytes
+        if self.kvpager is not None:
+            # the lane's device pages are already counted in-pool; its
+            # pinned host working set is the part slots cannot see
+            resident += self.kvpager.resident_bytes()[1]
         return {
             "request_active_slots": float(self.active),
             "request_total_slots": float(self.cfg.max_batch),
@@ -865,6 +925,8 @@ class EngineCore:
             "mfu": goodput["mfu"],
             "mbu": goodput["mbu"],
             "hbm_gbps": goodput["hbm_gbps"],
+            "kv_resident_bytes": resident,
+            "kv_capacity_bytes": capacity,
         }
 
     # ------------------------------------------------------------------
@@ -921,7 +983,14 @@ class EngineCore:
 
         prompt = list(request.token_ids)
         if len(prompt) + 1 >= self.cfg.max_context:
-            raise ValueError(f"prompt of {len(prompt)} exceeds max_context")
+            # typed 400 (not a bare ValueError): the disagg frontend's
+            # error body names the configured limit and the stage that
+            # rejected, end to end over the wire
+            from ..runtime.engine import EngineError
+            raise EngineError(
+                f"prompt of {len(prompt)} tokens exceeds the configured "
+                f"max_context of {self.cfg.max_context}", 400,
+                stage="prefill", reason="context_exceeded")
         if request.images:
             raise ValueError("disaggregated prefill does not take image "
                              "requests yet; serve VLM prompts aggregated")
@@ -1019,7 +1088,11 @@ class EngineCore:
         out: List[StepOutput] = []
         self._advance_writethrough()
         out.extend(self._reap_cancelled())
-        n_reaped = len(out)
+        n_reaped = len(out)     # paged outputs below don't change slots
+        if self.kvpager is not None and self.kvpager.has_work:
+            # one unit of paged long-context work (a prefill chunk or a
+            # decode token) interleaves with every normal engine step
+            out.extend(self.kvpager.advance())
 
         prefill_work = any(s is not None and s.prefill_done < len(s.prompt)
                            for s in self.slots)
@@ -1236,20 +1309,31 @@ class EngineCore:
         or "blocked" (no KV capacity right now)."""
         seq_id, req = self.waiting[0]
         prompt = list(req.token_ids)
-        if len(prompt) >= self.cfg.max_context:
+        over_ctx = len(prompt) >= self.cfg.max_context
+        over_pool = (self.pool.pages_needed(len(prompt) + 1)
+                     > self.pool.num_pages - 1)
+        if over_ctx or over_pool:
+            # beyond the dense path's reach. With KV paging enabled this
+            # is exactly the long-context lane's workload; without it,
+            # reject with the typed 400 body naming the configured limit
+            # (can NEVER fit, even with an empty pool: don't starve)
             self.waiting.popleft()
+            if self.kvpager is not None:
+                so = self.kvpager.try_route(seq_id, req)
+                if so is None:
+                    return "paged"
+                out.append(so)
+                return "rejected"
+            if over_ctx:
+                msg = (f"prompt of {len(prompt)} tokens exceeds the "
+                       f"configured max_context of {self.cfg.max_context}")
+            else:
+                msg = (f"prompt of {len(prompt)} tokens cannot fit in the "
+                       f"KV pool ({self.pool.num_pages - 1} pages)")
             out.append(StepOutput(
-                seq_id, 0, 0.0, FinishReason.ERROR,
-                error=f"prompt of {len(prompt)} tokens exceeds max_context "
-                      f"{self.cfg.max_context}"))
-            return "rejected"
-        if self.pool.pages_needed(len(prompt) + 1) > self.pool.num_pages - 1:
-            # can NEVER fit, even with an empty pool: reject, don't starve
-            self.waiting.popleft()
-            out.append(StepOutput(
-                seq_id, 0, 0.0, FinishReason.ERROR,
-                error=f"prompt of {len(prompt)} tokens cannot fit in the KV "
-                      f"pool ({self.pool.num_pages - 1} pages)"))
+                seq_id, 0, 0.0, FinishReason.ERROR, error=msg,
+                error_code=400, error_stage="engine_admission",
+                error_reason="context_exceeded"))
             return "rejected"
         if not self.pool.can_admit(len(prompt) + 1):
             return "blocked"  # decode will free KV space eventually
@@ -1301,7 +1385,7 @@ class EngineCore:
             admitted = self._admit_one(out)
             if admitted == "blocked":
                 break
-            if admitted == "rejected":
+            if admitted in ("rejected", "paged"):
                 continue
             # fully satisfied by prefix reuse still needs its last token
             # computed, so every admission lands in the chunk list
@@ -2179,7 +2263,10 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                 if so.finish == FinishReason.ERROR:
                     yield EngineOutput(token_ids=[],
                                        finish_reason=FinishReason.ERROR,
-                                       error=so.error or "engine error")
+                                       error=so.error or "engine error",
+                                       error_code=so.error_code,
+                                       error_stage=so.error_stage,
+                                       error_reason=so.error_reason)
                     return
                 yield EngineOutput(
                     token_ids=[so.token],

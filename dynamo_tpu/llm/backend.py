@@ -51,8 +51,14 @@ class Backend(AsyncEngine[BackendInput, EngineOutput]):
                 if out.finish_reason is FinishReason.ERROR:
                     # surface the cause as a typed error: over the wire it
                     # becomes an error frame, at the HTTP edge an SSE error
-                    # event — never a silently terminated stream
-                    raise EngineError(out.error or "engine error", 500)
+                    # event — never a silently terminated stream. The
+                    # engine's code/stage/reason ride along so an
+                    # over-length rejection maps to a 400 body naming the
+                    # limit, not a generic 500
+                    raise EngineError(out.error or "engine error",
+                                      out.error_code or 500,
+                                      stage=out.error_stage,
+                                      reason=out.error_reason)
                 text_parts = []
                 finish = out.finish_reason
                 for tid in out.token_ids:

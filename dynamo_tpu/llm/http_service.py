@@ -323,6 +323,7 @@ class HttpService:
                                               priority, level, tenant)
         finally:
             self.admission.release()
+            self.admission.release_kv(req.get("dyn_kv_cost", 0.0))
             self.tenants.release(tenant)
 
     async def _serve_admitted(self, req: web.Request, endpoint: str,
@@ -366,6 +367,20 @@ class HttpService:
                 else min(oai_req.max_tokens, cap)
         if overload.disables_spec(level):
             oai_req.ext["no_spec"] = True
+        # byte-honest admission, second gate: with the body read, price
+        # the request's KV working set (estimated tokens x per-token
+        # bytes) against the in-flight budget — one long-context request
+        # consumes its true share of the envelope, not one slot. Released
+        # in _serve's finally via the request-scoped cost.
+        if self.admission.kv_enabled:
+            kv_cost = self.admission.price_kv(
+                overload.estimate_request_tokens(oai_req))
+            shed = self.admission.try_reserve_kv(kv_cost,
+                                                 priority)
+            if shed is not None:
+                self._count("unknown", endpoint, str(shed.code), tenant)
+                return _err_engine(shed)
+            req["dyn_kv_cost"] = kv_cost
         model_name = oai_req.model
         served = self.manager.get(model_name)
         engine = served and (served.chat_engine if endpoint == "chat"

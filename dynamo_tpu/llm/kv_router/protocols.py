@@ -111,6 +111,14 @@ class ForwardPassMetrics:
     mfu: float = 0.0
     mbu: float = 0.0
     hbm_gbps: float = 0.0
+    # byte-honest KV residency (llm/kvpage/): total KV working set in
+    # bytes (device pool in use + the paged lane's pinned host blocks)
+    # against device+host capacity. Slots price every request the same;
+    # these price a 128k context at its true footprint, so the router's
+    # bytes-pressure term steers work away from a worker whose tiers one
+    # long request is consuming (0/0 on engines that predate the fields)
+    kv_resident_bytes: float = 0.0
+    kv_capacity_bytes: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)

@@ -1,12 +1,17 @@
 """KvScheduler: pick the worker for a tokenized request.
 
-Default cost (reference formula, kv_router/scheduler.rs:92-205):
+Default cost (reference formula, kv_router/scheduler.rs:92-205, extended
+with the byte-honest residency dimension):
 
-    logit = 2.0 * overlap_blocks_norm - cache_usage - normalized_active_slots
+    logit = 2.0 * overlap_blocks_norm - cache_usage
+            - normalized_active_slots - kv_bytes_frac
 
 highest logit wins; ties break randomly; if every candidate is saturated the
-request waits for capacity. The selector is pluggable (CustomWorkerSelector
-override point, components/router/src/main.rs:36-95).
+request waits for capacity. ``kv_bytes_frac`` is the worker's published KV
+working set in bytes over its device+host capacity — the term that prices a
+long paged context at its true footprint (0 when unpublished). The selector
+is pluggable (CustomWorkerSelector override point,
+components/router/src/main.rs:36-95).
 """
 
 from __future__ import annotations
@@ -105,6 +110,13 @@ def score_candidates(tokens: Sequence[int], block_size: int,
         overlap_norm = eff / isl_blocks
         load = (m.request_active_slots / m.request_total_slots
                 if m.request_total_slots else 0.0)
+        # bytes-resident dimension: the worker's total KV working set
+        # (device pool + pinned host paging blocks) over its device+host
+        # capacity. cache_usage prices device blocks; this prices what
+        # slots cannot see — a 128k paged context pinning half the host
+        # tier. 0 on workers that don't publish the byte fields.
+        bytes_frac = (m.kv_resident_bytes / m.kv_capacity_bytes
+                      if m.kv_capacity_bytes else 0.0)
         # full precision: the selector's tie-break compares these — the
         # audit ring rounds at serialization time, not here
         out.append({
@@ -116,7 +128,9 @@ def score_candidates(tokens: Sequence[int], block_size: int,
             "overlap_norm": overlap_norm,
             "cache_usage": m.cache_usage,
             "load": load,
-            "logit": 2.0 * overlap_norm - m.cache_usage - load,
+            "kv_bytes_frac": bytes_frac,
+            "logit": 2.0 * overlap_norm - m.cache_usage - load
+            - bytes_frac,
             "saturated": saturated,
         })
     return out
@@ -237,6 +251,7 @@ class KvScheduler:
                 {**c, "overlap_norm": round(c["overlap_norm"], 4),
                  "cache_usage": round(c["cache_usage"], 4),
                  "load": round(c["load"], 4),
+                 "kv_bytes_frac": round(c["kv_bytes_frac"], 4),
                  "logit": round(c["logit"], 4)}
                 for c in candidates],
         })

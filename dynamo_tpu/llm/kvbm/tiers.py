@@ -35,8 +35,18 @@ from ...utils.prometheus import stage_metrics
 log = logging.getLogger("dynamo_tpu.kvbm")
 
 
+class OutOfTierSpace(RuntimeError):
+    """A pinned insert found no evictable slot (every resident block is
+    pinned) — the paging working set outgrew the tier."""
+
+
 class _SlotCache:
-    """Fixed-capacity LRU of KV blocks in one preallocated array pair."""
+    """Fixed-capacity LRU of KV blocks in one preallocated array pair.
+
+    ``pinned`` hashes are excluded from LRU eviction: the KV-paging plane
+    pins a long sequence's demoted working set so a cluster-traffic burst
+    cannot silently drop blocks a live decode still has to read back.
+    """
 
     def __init__(self, num_blocks: int, block_shape: Tuple[int, ...],
                  dtype, k_store: np.ndarray, v_store: np.ndarray):
@@ -48,6 +58,7 @@ class _SlotCache:
         self._slot_of: "collections.OrderedDict[int, int]" = \
             collections.OrderedDict()          # seq_hash -> slot, LRU order
         self._free = list(range(num_blocks - 1, -1, -1))
+        self.pinned: set = set()
 
     def __len__(self) -> int:
         return len(self._slot_of)
@@ -55,10 +66,24 @@ class _SlotCache:
     def __contains__(self, seq_hash: int) -> bool:
         return seq_hash in self._slot_of
 
-    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray
+    def _victim(self) -> Optional[int]:
+        """Oldest resident hash that is not pinned (None = all pinned)."""
+        for h in self._slot_of:                # iterates LRU -> MRU
+            if h not in self.pinned:
+                return h
+        return None
+
+    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray,
+            required: bool = False
             ) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
         """Insert a block. Returns the evicted (hash, k, v) if the cache was
-        full (caller may cascade it to the next tier), else None."""
+        full (caller may cascade it to the next tier), else None.
+
+        When full and every resident block is pinned, the incoming block is
+        DROPPED (cache semantics; the caller's data was best-effort) unless
+        ``required=True`` — then :class:`OutOfTierSpace` is raised, because
+        the caller (the paging plane depositing a pinned block) cannot
+        tolerate silent loss."""
         evicted = None
         if seq_hash in self._slot_of:
             self._slot_of.move_to_end(seq_hash)
@@ -67,7 +92,16 @@ class _SlotCache:
             slot = self._free.pop()
             self._slot_of[seq_hash] = slot
         else:
-            old_hash, slot = self._slot_of.popitem(last=False)  # LRU out
+            old_hash = self._victim()
+            if old_hash is None:
+                if required:
+                    raise OutOfTierSpace(
+                        f"all {self.num_blocks} tier blocks are pinned; "
+                        f"cannot insert block {seq_hash:x}")
+                log.warning("KV tier full of pinned blocks; dropping "
+                            "offloaded block %x", seq_hash)
+                return None
+            slot = self._slot_of.pop(old_hash)
             evicted = (old_hash, self._k[slot].copy(), self._v[slot].copy())
             self._slot_of[seq_hash] = slot
         self._k[slot] = k
@@ -89,9 +123,20 @@ class _SlotCache:
             return None
         return self._k[slot], self._v[slot]
 
+    def peek_layer(self, seq_hash: int, layer: int
+                   ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """One layer's [Hkv, page, Dh] slice, no LRU touch — the paging
+        plane streams cold blocks layer-at-a-time, and copying the whole
+        [L, ...] block per layer would multiply the memcpy by L."""
+        slot = self._slot_of.get(seq_hash)
+        if slot is None:
+            return None
+        return self._k[slot][layer], self._v[slot][layer]
+
     def pop(self, seq_hash: int) -> None:
         slot = self._slot_of.pop(seq_hash, None)
         if slot is not None:
+            self.pinned.discard(seq_hash)
             self._free.append(slot)
 
 
@@ -205,10 +250,27 @@ class TieredKvCache:
                 if got is not None:   # promote to host (may spill another)
                     tier = "disk"
                     k, v = got[0].copy(), got[1].copy()
-                    self.disk.pop(seq_hash)
-                    self._offload_locked(seq_hash, k, v)
                     got = (k, v)
-                    promoted = True
+                    if seq_hash in self.disk.pinned:
+                        # a pin must never be separated from its data:
+                        # promote only if the host can take it as pinned,
+                        # else serve from disk and leave it there
+                        try:
+                            spilled = self.host.put(seq_hash, k, v,
+                                                    required=True)
+                        except OutOfTierSpace:
+                            spilled = None
+                        else:
+                            if spilled is not None:
+                                self.disk.put(*spilled)
+                            self.disk.pop(seq_hash)
+                            self.host.pinned.add(seq_hash)
+                            self._set_block_gauges()
+                            promoted = True
+                    else:
+                        self.disk.pop(seq_hash)
+                        self._offload_locked(seq_hash, k, v)
+                        promoted = True
             if got is None:
                 self.misses += 1
                 stage.kv_tier_misses.inc()
@@ -232,6 +294,65 @@ class TieredKvCache:
                 return None
             return got[0].copy(), got[1].copy()
 
+    def peek_layer(self, seq_hash: int, layer: int
+                   ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Copy ONE layer's [Hkv, page, Dh] slice of a resident block, no
+        LRU touch — the KV-paging plane's page-in read (streaming cold
+        blocks layer-at-a-time must not thrash the reuse order that serves
+        admission restores)."""
+        with self._lock:
+            got = self.host.peek_layer(seq_hash, layer)
+            if got is None and self.disk is not None:
+                got = self.disk.peek_layer(seq_hash, layer)
+            if got is None:
+                return None
+            return got[0].copy(), got[1].copy()
+
+    # ------------------------------------------------------------------
+    # pinning (KV-paging working set)
+    # ------------------------------------------------------------------
+    def pin(self, seq_hash: int) -> bool:
+        """Exclude a resident block from LRU eviction (False = not
+        resident anywhere). Pins survive disk->host promotion."""
+        with self._lock:
+            if seq_hash in self.host:
+                self.host.pinned.add(seq_hash)
+                return True
+            if self.disk is not None and seq_hash in self.disk:
+                self.disk.pinned.add(seq_hash)
+                return True
+            return False
+
+    def unpin(self, seq_hash: int) -> None:
+        with self._lock:
+            self.host.pinned.discard(seq_hash)
+            if self.disk is not None:
+                self.disk.pinned.discard(seq_hash)
+
+    def pinned_count(self) -> int:
+        with self._lock:
+            return len(self.host.pinned) + (
+                len(self.disk.pinned) if self.disk is not None else 0)
+
+    def deposit_pinned(self, seq_hash: int, k: np.ndarray,
+                       v: np.ndarray) -> None:
+        """Insert a block that MUST stick: pinned on arrival, and the
+        insert raises :class:`OutOfTierSpace` instead of dropping when the
+        host tier is wall-to-wall pinned (a demoted decode working set is
+        state, not cache). Host-LRU spill of unpinned neighbors cascades
+        to disk as usual."""
+        with self._lock:
+            self.host.pinned.add(seq_hash)
+            try:
+                spilled = self.host.put(seq_hash, k, v, required=True)
+            except OutOfTierSpace:
+                self.host.pinned.discard(seq_hash)
+                raise
+            if spilled is not None and self.disk is not None:
+                self.disk.put(*spilled)
+            self._set_block_gauges()
+        self._fire_change()
+
     def hashes(self) -> Tuple[List[int], List[int]]:
         """Snapshot of the resident (host, disk) sequence hashes — the
         cluster registry publisher's record body."""
@@ -246,6 +367,8 @@ class TieredKvCache:
                 "host_blocks": len(self.host),
                 "disk_blocks": len(self.disk) if self.disk is not None
                 else 0,
+                "pinned_blocks": len(self.host.pinned) + (
+                    len(self.disk.pinned) if self.disk is not None else 0),
                 "hits": self.hits,
                 "misses": self.misses,
             }

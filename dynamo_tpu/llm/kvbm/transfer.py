@@ -49,7 +49,11 @@ class CopyStream:
         dispatch per pool."""
         idx = jnp.asarray(list(pages), jnp.int32)
         if not pipeline:
+            # dynalint: ok(host-sync) the d2h page copy IS the transfer:
+            # tier offload / pager demotion ships blocks host-staged,
+            # batched per eviction flush or demotion, never per token
             return (np.asarray(self._gather_all(k_pool, idx)),
+                    # dynalint: ok(host-sync) second half of the same copy
                     np.asarray(self._gather_all(v_pool, idx)))
         L = k_pool.shape[0]
         # dispatch every layer's gather before converting any (async queue)

@@ -1,0 +1,232 @@
+"""PageScheduler: stages demoted KV blocks back toward the device ahead
+of the attention pass that needs them.
+
+The paged forward consumes cold blocks as (layer, segment) items in a
+fully deterministic order — the runner publishes that order as a
+:class:`PageinPlan` before each chunk/token forward. A background thread
+walks the plan, assembling each segment's host staging buffer (per-layer
+``peek_layer`` copies out of the tier — deliberately NOT ``lookup``, so
+page-in traffic never perturbs the LRU order that serves admission
+restores) up to ``prefetch`` segments ahead of the consumer. The h2d
+upload itself is issued by the runner (it owns the device queue), so by
+the time attention for segment *s* dispatches, segment *s+1* is already
+assembled and its upload enqueued: page-in overlaps compute.
+
+``take`` is the fault boundary: an item the thread already finished is
+an async page-in (``dyn_kvpage_pageins_total``); an item that has to be
+assembled inline on the engine thread — prefetch disabled, or a plan the
+thread has not reached — is a *page fault*
+(``dyn_kvpage_faults_total``): a counted synchronous upload, never a
+crash. Time spent blocked on a scheduled-but-unfinished item lands in
+the ``dyn_kvpage_pagein_wait_seconds`` histogram; in steady-state decode
+both the fault counter and that histogram should sit at zero, which the
+long-context bench lane asserts.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...utils.prometheus import stage_metrics
+
+log = logging.getLogger("dynamo_tpu.kvpage")
+
+#: one plan item: (layer, segment index within that layer)
+ItemKey = Tuple[int, int]
+
+
+class KvPageMiss(RuntimeError):
+    """A cold block vanished from every tier mid-decode (the pin
+    discipline failed) — fatal for the request, not the engine."""
+
+
+@dataclass
+class PageinPlan:
+    """The deterministic page-in order of one paged forward: for each
+    layer, the cold segments (tuples of block hashes) it will consume."""
+
+    segments: List[List[Tuple[int, ...]]]   # [layer][seg] -> block hashes
+    generation: int = 0
+
+    def items(self) -> List[ItemKey]:
+        return [(l, s) for l, segs in enumerate(self.segments)
+                for s in range(len(segs))]
+
+    def hashes(self, key: ItemKey) -> Tuple[int, ...]:
+        return self.segments[key[0]][key[1]]
+
+
+@dataclass
+class _Assembled:
+    k: Optional[np.ndarray]       # [seg_pages, Hkv, page, Dh]
+    v: Optional[np.ndarray]
+    n_valid: int
+    ready: threading.Event = field(default_factory=threading.Event)
+    error: Optional[Exception] = None
+
+
+class PageScheduler:
+    """Prefetches cold-block staging buffers ahead of the paged forward.
+
+    Single consumer (the engine thread) + one assembler thread; the tier
+    handles its own locking (``peek_layer`` copies under the tier lock),
+    so the scheduler only guards its plan/ready bookkeeping.
+    """
+
+    def __init__(self, tiered, seg_pages: int, prefetch: int = 2):
+        self.tiered = tiered
+        self.seg_pages = int(seg_pages)
+        self.prefetch = int(prefetch)
+        self.faults = 0
+        self.pageins = 0
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._plan: Optional[PageinPlan] = None
+        self._order: List[ItemKey] = []
+        self._built: Dict[ItemKey, _Assembled] = {}
+        self._next = 0                # thread's cursor into _order
+        self._taken = 0               # consumer's cursor (backpressure)
+        self._gen = 0
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def begin(self, plan: PageinPlan) -> None:
+        """Install the next forward's page-in order; the assembler starts
+        on it immediately (prefetch permitting)."""
+        with self._wake:
+            self._gen += 1
+            plan.generation = self._gen
+            self._plan = plan
+            self._order = plan.items()
+            self._built = {}
+            self._next = 0
+            self._taken = 0
+            self._wake.notify_all()
+        if (self.prefetch > 0 and self._order and self._thread is None
+                and not self._closed):
+            self._thread = threading.Thread(
+                target=self._run, name="kvpage-prefetch", daemon=True)
+            self._thread.start()
+
+    def take(self, key: ItemKey) -> Tuple[np.ndarray, np.ndarray, int]:
+        """The staging buffer for one plan item: (k, v, n_valid_blocks).
+        Prefetched items count as page-ins (time blocked on an in-flight
+        assembly lands in the wait histogram); an item the assembler will
+        never deliver — prefetch disabled, thread gone — is assembled
+        inline: a counted synchronous page fault."""
+        stage = stage_metrics()
+        t0 = time.perf_counter()
+        with self._wake:
+            ent = self._built.pop(key, None)
+            if (ent is None and self.prefetch > 0
+                    and self._thread is not None):
+                # the assembler claims items strictly in plan order; if it
+                # has not reached this one yet, it is about to — wait for
+                # the claim instead of duplicating the work inline
+                try:
+                    idx = self._order.index(key)
+                except ValueError:
+                    idx = -1
+                while (ent is None and idx >= 0 and not self._closed
+                       and self._plan is not None and self._next <= idx):
+                    self._wake.wait(0.05)
+                    ent = self._built.pop(key, None)
+                if ent is None:
+                    ent = self._built.pop(key, None)
+            if ent is not None:
+                self._taken += 1
+                self._wake.notify_all()   # a prefetch slot freed up
+        if ent is None:
+            # the assembler will never deliver this item: synchronous
+            # page-in on the engine thread
+            self.faults += 1
+            stage.kvpage_faults.inc()
+            plan = self._plan
+            if plan is None:
+                raise KvPageMiss(f"take({key}) with no active plan")
+            ent = self._assemble(plan.hashes(key), layer=key[0])
+            stage.kvpage_pagein_wait.observe(
+                value=time.perf_counter() - t0)
+            with self._wake:
+                self._taken += 1
+                self._wake.notify_all()
+            return ent.k, ent.v, ent.n_valid
+        ent.ready.wait()
+        if ent.error is not None:
+            raise ent.error
+        self.pageins += 1
+        stage.kvpage_pageins.inc()
+        stage.kvpage_pagein_wait.observe(value=time.perf_counter() - t0)
+        return ent.k, ent.v, ent.n_valid
+
+    def close(self) -> None:
+        with self._wake:
+            self._closed = True
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ------------------------------------------------------------------
+    def _assemble(self, hashes: Tuple[int, ...], layer: int
+                  ) -> _Assembled:
+        """Stack one segment's per-layer block slices into a fixed-shape
+        staging buffer (padded to ``seg_pages``)."""
+        ks: List[np.ndarray] = []
+        vs: List[np.ndarray] = []
+        for h in hashes:
+            got = self.tiered.peek_layer(h, layer)
+            if got is None:
+                raise KvPageMiss(
+                    f"cold block {h:x} missing from every tier (layer "
+                    f"{layer}); the pin discipline was violated")
+            ks.append(got[0])
+            vs.append(got[1])
+        n = len(ks)
+        pad = self.seg_pages - n
+        if pad:
+            z = np.zeros_like(ks[0])
+            ks.extend([z] * pad)
+            vs.extend([z] * pad)
+        return _Assembled(np.stack(ks), np.stack(vs), n,
+                          ready=_DONE)
+
+    def _run(self) -> None:
+        while True:
+            with self._wake:
+                while not self._closed and (
+                        self._plan is None
+                        or self._next >= len(self._order)
+                        or self._next - self._taken >= self.prefetch):
+                    self._wake.wait()
+                if self._closed:
+                    return
+                key = self._order[self._next]
+                ent = _Assembled(None, None, 0)  # placeholder until built
+                self._built[key] = ent
+                self._next += 1
+                self._wake.notify_all()   # a consumer may await the claim
+                hashes = self._plan.hashes(key)
+            try:
+                built = self._assemble(hashes, layer=key[0])
+                ent.k, ent.v, ent.n_valid = built.k, built.v, built.n_valid
+                ent.error = None
+            except Exception as e:  # noqa: BLE001 - delivered to take()
+                ent.error = e
+            finally:
+                # if a new plan superseded this one mid-assembly, begin()
+                # already discarded the stale entry — setting the
+                # orphaned event is harmless
+                ent.ready.set()
+
+
+#: shared always-set event for inline (fault-path) assemblies
+_DONE = threading.Event()
+_DONE.set()

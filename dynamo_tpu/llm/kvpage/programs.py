@@ -1,0 +1,200 @@
+"""Jitted programs for paged (working-set-bounded) prefill and decode.
+
+The standard engine runs attention as ONE dispatch over the whole
+context — every KV page must be device-resident when it runs. These
+programs decompose each layer's attention into *partial* passes with
+online-softmax accumulators (the flash-attention recurrence, applied
+across dispatches instead of across kernel tiles):
+
+- :meth:`PagedPrograms.attn_hot` attends over the device-resident tail
+  (read through the pool, causally masked);
+- :meth:`PagedPrograms.attn_cold` attends over one staged segment of
+  demoted blocks uploaded h2d into a scratch buffer (all cold positions
+  strictly precede every query, so only the padding-validity mask
+  applies);
+- :meth:`PagedPrograms.layer_out` normalizes the merged accumulators and
+  finishes the layer (o-proj, residual, FFN).
+
+Splitting per (layer, segment) is what makes bounded residency possible:
+between partial passes only the tiny per-chunk activations and the f32
+(o, m, d) accumulators persist on device, so the cold tail can stream
+through a fixed pair of staging slots regardless of context length.
+Exactness: softmax reassociation is the only difference from the dense
+path — accumulation stays f32 end to end, and the long-context bench
+lane pins token-identity against an unpaged run.
+
+The layer index rides every program as a TRACED scalar (stacked layer
+params are gathered with it), so the whole layer stack replays TWO
+compiled variants per program (prefill-chunk and decode shapes), not 2*L.
+That is also why models with per-layer static structure (sliding-window
+layers, dual-base rope) are excluded from paging at config time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...models import llama
+from ...models.llama import NEG_INF
+
+
+def _merge(o0, m0, d0, o1, m1, d1):
+    """Online-softmax merge of two partial-attention accumulators.
+    Shapes: o [1, Hkv, G, T, Dh] f32; m, d [1, Hkv, G, T] f32."""
+    m = jnp.maximum(m0, m1)
+    a0 = jnp.exp(m0 - m)
+    a1 = jnp.exp(m1 - m)
+    return (o0 * a0[..., None] + o1 * a1[..., None],
+            m, d0 * a0 + d1 * a1)
+
+
+def _partial_attend(cfg, q, k, v, mask):
+    """Unnormalized attention stats for one KV span.
+
+    q: [1, T, Hq, Dh]; k, v: [1, S, Hkv, Dh]; mask: [1, T, S] bool.
+    Returns (o [1,Hkv,G,T,Dh], m [1,Hkv,G,T], d [1,Hkv,G,T]), all f32.
+    Scores mirror :func:`llama.attend` (scale then softcap then mask)."""
+    Hq = cfg.num_heads
+    Hkv = cfg.num_kv_heads
+    G = Hq // Hkv
+    B, T, _, Dh = q.shape
+    qg = q.reshape(B, T, Hkv, G, Dh)
+    scores = jnp.einsum("bthgd,bshd->bhgts", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * cfg.attn_scale
+    if cfg.attn_logit_softcap:
+        scores = jnp.tanh(scores / cfg.attn_logit_softcap) \
+            * cfg.attn_logit_softcap
+    mg = mask[:, None, None, :, :]                      # [B,1,1,T,S]
+    scores = jnp.where(mg, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)                        # [B,Hkv,G,T]
+    p = jnp.where(mg, jnp.exp(scores - m[..., None]), 0.0)
+    d = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhgts,bshd->bhgtd", p, v.astype(jnp.float32))
+    return o, m, d
+
+
+class PagedPrograms:
+    """The compiled-program surface of the paged path, built once per
+    engine. All programs take batch dim 1 (the paged lane runs solo)."""
+
+    def __init__(self, cfg, mesh, rep_sharding, kv_sharding):
+        self.cfg = cfg
+        m = cfg.model
+        rep, kv = rep_sharding, kv_sharding
+        page = cfg.page_size
+
+        def embed(params, tokens):
+            return llama._embed(params, m, tokens)
+
+        self.embed = jax.jit(embed, out_shardings=rep)
+
+        def qkv(params, l, x, positions, k_pool, v_pool, write_idx):
+            lp = params["layers"]
+            h = llama.rms_norm(x, lp["ln1"][l], m.rms_eps, m.norm_offset)
+            q = jnp.einsum("btd,dhk->bthk", h, lp["wq"][l])
+            k = jnp.einsum("btd,dhk->bthk", h, lp["wk"][l])
+            v = jnp.einsum("btd,dhk->bthk", h, lp["wv"][l])
+            if m.attention_bias:
+                q = q + lp["bq"][l]
+                k = k + lp["bk"][l]
+                v = v + lp["bv"][l]
+            if m.qk_norm:
+                q = llama.rms_norm(q, lp["ln_q"][l], m.rms_eps,
+                                   m.norm_offset)
+                k = llama.rms_norm(k, lp["ln_k"][l], m.rms_eps,
+                                   m.norm_offset)
+            cos, sin = llama.rope_tables(m, positions)
+            q = llama.apply_rope(q, cos, sin)
+            k = llama.apply_rope(k, cos, sin)
+            B, T = positions.shape
+            flat_w = write_idx.reshape(-1)
+            wp, wo = flat_w // page, flat_w % page
+            k_pool = k_pool.at[l, :, wp, wo].set(
+                k.reshape(B * T, *k.shape[2:]))
+            v_pool = v_pool.at[l, :, wp, wo].set(
+                v.reshape(B * T, *v.shape[2:]))
+            return q, k_pool, v_pool
+
+        self.qkv = jax.jit(qkv, donate_argnums=(4, 5),
+                           out_shardings=(rep, kv, kv))
+
+        def attn_hot(q, l, k_pool, v_pool, read_idx, read_pos, read_valid,
+                     positions):
+            rp, ro = read_idx // page, read_idx % page
+            k_ctx = k_pool[l, :, rp[0], ro[0]][None]    # [1, S, Hkv, Dh]
+            v_ctx = v_pool[l, :, rp[0], ro[0]][None]
+            mask = (read_valid[:, None, :]
+                    & (read_pos[:, None, :] <= positions[:, :, None]))
+            return _partial_attend(m, q, k_ctx, v_ctx, mask)
+
+        self.attn_hot = jax.jit(attn_hot, out_shardings=(rep, rep, rep))
+
+        def attn_cold(q, k_seg, v_seg, seg_valid, o, m_, d):
+            # k_seg/v_seg: [n, Hkv, page, Dh] staged blocks; every cold
+            # position strictly precedes every query position, so only the
+            # padding-validity mask applies
+            n = k_seg.shape[0]
+            k_ctx = jnp.transpose(k_seg, (0, 2, 1, 3)).reshape(
+                1, n * page, k_seg.shape[1], k_seg.shape[3])
+            v_ctx = jnp.transpose(v_seg, (0, 2, 1, 3)).reshape(
+                1, n * page, v_seg.shape[1], v_seg.shape[3])
+            T = q.shape[1]
+            mask = jnp.broadcast_to(seg_valid[None, None, :],
+                                    (1, T, n * page))
+            o1, m1, d1 = _partial_attend(m, q, k_ctx, v_ctx, mask)
+            return _merge(o, m_, d, o1, m1, d1)
+
+        self.attn_cold = jax.jit(attn_cold, donate_argnums=(4, 5, 6),
+                                 out_shardings=(rep, rep, rep))
+
+        def layer_out(params, l, x, o, m_, d):
+            lp = params["layers"]
+            B, Hkv, G, T, Dh = o.shape
+            attn = o / jnp.where(d == 0.0, 1.0, d)[..., None]
+            attn = jnp.transpose(attn, (0, 3, 1, 2, 4)).reshape(
+                B, T, Hkv * G, Dh).astype(x.dtype)
+            x = llama._attn_residual(
+                x, jnp.einsum("bthk,hkd->btd", attn, lp["wo"][l]), lp, l, m)
+            return llama._ffn_block(x, lp, l, m)
+
+        self.layer_out = jax.jit(layer_out, out_shardings=rep)
+
+        def head(params, x, last_i, temp, top_p, top_k, key, counts,
+                 freq_pen, pres_pen):
+            from ...engine.sampling import apply_penalties, sample
+            xs = jnp.take_along_axis(
+                x, last_i[:, None, None].astype(jnp.int32), axis=1)
+            logits = llama._lm_head(xs, params, m)[:, 0]       # [1, V]
+            lg = apply_penalties(logits, counts, freq_pen, pres_pen)
+            tok, logp, new_key = sample(lg, temp, top_p, top_k, key)
+            counts = counts.at[jnp.arange(1), tok].add(1)
+            # token ids < 2^24 are exact in f32: one packed (token,
+            # logprob) array = one host fetch per sampled token
+            packed = jnp.stack([tok.astype(jnp.float32), logp], -1)
+            return packed, new_key, counts
+
+        self.head = jax.jit(head, donate_argnums=(7,),
+                            out_shardings=(rep, rep, rep))
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def validate(cfg) -> Optional[str]:
+        """Why this engine config cannot run the paged path (None = ok).
+        The constraints are exactly the per-layer-static model features
+        the traced-layer-index programs cannot express."""
+        m = cfg.model
+        if m.sliding_window is not None:
+            return "sliding-window models (per-layer window pattern)"
+        if m.rope_local_theta is not None:
+            return "dual-base rope models (per-layer rope tables)"
+        if m.num_experts:
+            return "MoE models"
+        if m.vision is not None:
+            return "VLM deployments (image spans need the dense path)"
+        if cfg.pp > 1 or cfg.sp > 1:
+            return "pp/sp parallel engines"
+        return None

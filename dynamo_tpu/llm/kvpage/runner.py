@@ -1,0 +1,553 @@
+"""PagedEngine: serve contexts far beyond the device KV pool.
+
+The virtual-memory model (docs/long_context.md):
+
+- **Chunked prefill with seal-and-demote.** Each prefill chunk writes its
+  KV into device pages leased from the engine's pool; once the chunk's
+  dispatch has been issued, full (sealed) blocks beyond the hot-window
+  budget are demoted d2h into the host tier (``TieredKvCache``) — pinned,
+  because a demoted decode working set is state, not cache — and their
+  device pages return to the pool. Device residency therefore stays
+  bounded at ``budget`` pages for ANY context length. The d2h gather is
+  enqueued against the post-write pool arrays, so JAX sequences it after
+  the writing dispatch by data dependency (a one-hop version of the
+  cluster write-through's two-step ratchet: here the runner owns the
+  issue order, so it demotes the moment the write is in the queue).
+- **Decode over a windowed working set.** Attention runs hot-first over
+  the resident tail through the pool, then merges one staged cold
+  segment at a time (``programs.attn_cold``), while the
+  :class:`~.pager.PageScheduler` assembles the next segment ahead of
+  need and the runner enqueues its h2d upload before dispatching the
+  current segment's attention — double-buffered, never blocking
+  dispatch. Faults degrade to counted synchronous uploads.
+- **Prefix reuse for free.** Demoted blocks carry their chained sequence
+  hashes, so a repeated long prompt pins matching tier blocks at
+  admission and skips recomputing them; at release the pins drop and the
+  blocks become ordinary LRU tier content (servable to cluster peers).
+
+The paged lane runs ONE sequence at a time (batch dim 1): long-context
+requests queue behind each other rather than thrash one device budget.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...llm.kvbm.pool import OutOfBlocks
+from ...llm.kvbm.tiers import OutOfTierSpace
+from ...llm.protocols.common import BackendInput, FinishReason
+from ...llm.tokens import TokenSequence, chain_hash, hash_tokens, \
+    lora_chain_root
+from ...utils.knobs import env_float as _env_float
+from ...utils.prometheus import stage_metrics
+from .pager import KvPageMiss, PageinPlan, PageScheduler
+from .programs import PagedPrograms
+
+log = logging.getLogger("dynamo_tpu.kvpage")
+
+
+@dataclass
+class PagedConfig:
+    """Resolved ``DYN_KVPAGE_*`` surface (engine-config fields win over
+    env knobs; a zero/unset budget disables the plane entirely)."""
+
+    budget: int                 # device pages the paged lane may lease
+    seg_pages: int              # blocks per cold staging segment
+    prefetch: int               # segments assembled ahead (0 = sync)
+    max_context: int            # paged-lane context ceiling, tokens
+
+    @classmethod
+    def resolve(cls, cfg) -> Optional["PagedConfig"]:
+        budget = cfg.kvpage_budget
+        if budget is None:
+            budget = int(_env_float("DYN_KVPAGE_DEVICE_BUDGET", 0))
+        if budget <= 0:
+            return None
+        seg = cfg.kvpage_seg_pages or int(
+            _env_float("DYN_KVPAGE_SEG_PAGES", 8))
+        prefetch = cfg.kvpage_prefetch
+        if prefetch is None:
+            prefetch = int(_env_float("DYN_KVPAGE_PREFETCH", 2))
+        max_ctx = cfg.kvpage_max_context or int(
+            _env_float("DYN_KVPAGE_MAX_CONTEXT", 131072))
+        return cls(budget=int(budget), seg_pages=max(1, int(seg)),
+                   prefetch=max(0, int(prefetch)),
+                   max_context=int(max_ctx))
+
+
+@dataclass
+class _PagedSeq:
+    seq_id: str
+    request: BackendInput
+    prompt: List[int]
+    tokseq: TokenSequence
+    # device pages for blocks [first_res, first_res + len(resident));
+    # the resident span is always the contiguous tail of the context
+    resident: List[int] = field(default_factory=list)
+    first_res: int = 0
+    pinned: List[int] = field(default_factory=list)   # demoted block hashes
+    total_len: int = 0          # tokens written to the KV (pool or tier)
+    prefill_done: int = 0
+    generated: int = 0
+    last_token: int = 0
+    cum_logprob: float = 0.0
+    cancelled: bool = False
+    # per-sequence device sampling state (the paged lane does not occupy
+    # an engine slot, so it carries its own key/penalty counts)
+    key: Optional[jax.Array] = None
+    counts: Optional[jax.Array] = None
+    temp: Optional[np.ndarray] = None
+    top_p: Optional[np.ndarray] = None
+    top_k: Optional[np.ndarray] = None
+    freq_pen: Optional[np.ndarray] = None
+    pres_pen: Optional[np.ndarray] = None
+
+
+class PagedEngine:
+    """The paged lane of one :class:`~...engine.engine.EngineCore`.
+
+    Driven from the engine thread: ``advance()`` performs exactly one
+    unit of work (one prefill chunk or one decode token) so paged and
+    normal traffic interleave at engine-step granularity.
+    """
+
+    def __init__(self, core, pcfg: PagedConfig):
+        from ...engine.engine import StepOutput  # noqa: F401 (typing aid)
+
+        self.core = core
+        self.pcfg = pcfg
+        cfg = core.cfg
+        self.page = cfg.page_size
+        m = cfg.model
+        self.programs = PagedPrograms(cfg, core.mesh, core._rep_sharding,
+                                      core.kv_sharding)
+        self.pager = PageScheduler(core.tiered, pcfg.seg_pages,
+                                   pcfg.prefetch)
+        self.chunk = cfg.prefill_chunk
+        self.chunk_pages = -(-self.chunk // self.page)
+        if pcfg.budget < self.chunk_pages + 2:
+            raise ValueError(
+                f"kvpage budget of {pcfg.budget} pages cannot hold a "
+                f"prefill chunk ({self.chunk_pages} pages) plus the hot "
+                f"tail; need >= {self.chunk_pages + 2}")
+        from ...models.llama import kv_block_bytes
+        self.block_bytes = kv_block_bytes(m, self.page)
+        # hot-window residency ceilings: during prefill the in-flight
+        # chunk's pages ride inside the budget
+        self.hot_keep = max(1, pcfg.budget - self.chunk_pages - 1)
+        self.active: Optional[_PagedSeq] = None
+        self.queue: Deque[Tuple[str, BackendInput]] = collections.deque()
+        self._worker = str(os.getpid())
+        # hot-span shape buckets (page multiples, powers of two) keep the
+        # attn_hot program count logarithmic in the budget
+        self.s_hot_buckets: List[int] = []
+        b = self.page
+        while b < pcfg.budget * self.page:
+            self.s_hot_buckets.append(b)
+            b *= 2
+        self.s_hot_buckets.append(pcfg.budget * self.page)
+
+    # ------------------------------------------------------------------
+    @property
+    def has_work(self) -> bool:
+        return self.active is not None or bool(self.queue)
+
+    def resident_bytes(self) -> Tuple[float, float]:
+        """(device bytes, pinned host bytes) of the paged working set."""
+        seq = self.active
+        if seq is None:
+            return 0.0, 0.0
+        return (float(len(seq.resident) * self.block_bytes),
+                float(len(seq.pinned) * self.block_bytes))
+
+    def close(self) -> None:
+        self.pager.close()
+
+    def cancel(self, seq_id: str) -> None:
+        if self.active is not None and self.active.seq_id == seq_id:
+            self.active.cancelled = True
+        else:
+            self.queue = collections.deque(
+                (s, r) for s, r in self.queue if s != seq_id)
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+    def try_route(self, seq_id: str, req: BackendInput):
+        """Accept the request into the paged lane (None) or explain why
+        not (a typed ERROR StepOutput the engine emits as-is)."""
+        from ...engine.engine import StepOutput
+
+        prompt_len = len(req.token_ids)
+
+        def err(msg, code, reason):
+            return StepOutput(seq_id, 0, 0.0, FinishReason.ERROR,
+                              error=msg, error_code=code,
+                              error_stage="engine_admission",
+                              error_reason=reason)
+
+        if prompt_len >= self.pcfg.max_context:
+            return err(
+                f"prompt of {prompt_len} tokens exceeds the paged "
+                f"context limit of {self.pcfg.max_context} "
+                f"(DYN_KVPAGE_MAX_CONTEXT)", 400, "context_exceeded")
+        if req.images:
+            return err("image requests are not servable on the paged "
+                       "long-context lane", 400, "unsupported")
+        if self.core.dispatch_hook is not None:
+            return err("KV paging does not run on multi-host engines",
+                       400, "unsupported")
+        max_new = req.stop.max_tokens or (self.pcfg.max_context
+                                          - prompt_len)
+        blocks = -(-(prompt_len + max_new) // self.page)
+        host = self.core.tiered.host
+        # byte-honest admission: the pinned working set must fit the host
+        # tier next to what is already pinned, or this one request would
+        # evict the pool's (and its neighbors') working sets
+        if blocks + len(host.pinned) + 1 > host.num_blocks:
+            return err(
+                f"paged working set of {blocks} KV blocks "
+                f"({blocks * self.block_bytes / 1e6:.0f} MB) does not fit "
+                f"the host tier ({host.num_blocks} blocks, "
+                f"{len(host.pinned)} already pinned)", 503,
+                "kvpage_capacity")
+        self.queue.append((seq_id, req))
+        return None
+
+    # ------------------------------------------------------------------
+    # engine-step driver
+    # ------------------------------------------------------------------
+    def advance(self) -> List:
+        """One unit of paged work: start a queued sequence, advance one
+        prefill chunk, or decode one token."""
+        from ...engine.engine import StepOutput
+
+        out: List[StepOutput] = []
+        seq = self.active
+        if seq is not None and seq.cancelled:
+            out.append(StepOutput(seq.seq_id, seq.last_token,
+                                  seq.cum_logprob, FinishReason.CANCELLED))
+            self._release(seq)
+            seq = None
+        if seq is None:
+            if not self.queue:
+                return out
+            seq_id, req = self.queue.popleft()
+            seq = self._start(seq_id, req)
+        try:
+            if seq.prefill_done < len(seq.prompt):
+                self._prefill_chunk(seq, out)
+            else:
+                self._decode_step(seq, out)
+        except Exception as e:  # noqa: BLE001 - a paged failure must kill
+            # THIS request, never the engine: letting it escape would hit
+            # step()'s catch-all, which errors every DENSE sequence and
+            # never releases the paged lane — the engine would then retry
+            # the same broken state forever. Capacity pressure is a
+            # retryable 503; a KvPageMiss (pin discipline violated — a
+            # data-loss bug, not load) and anything unexpected are 500s
+            # with distinct reasons so dashboards can tell them apart.
+            log.exception("paged sequence %s failed", seq.seq_id)
+            if isinstance(e, (OutOfBlocks, OutOfTierSpace)):
+                code, reason = 503, "kvpage_capacity"
+            elif isinstance(e, KvPageMiss):
+                code, reason = 500, "kvpage_miss"
+            else:
+                code, reason = 500, "kvpage_internal"
+            out.append(StepOutput(
+                seq.seq_id, seq.last_token, seq.cum_logprob,
+                FinishReason.ERROR,
+                error=f"paged serving failed: {e}", error_code=code,
+                error_stage="engine", error_reason=reason))
+            self._release(seq)
+        return out
+
+    # ------------------------------------------------------------------
+    def _start(self, seq_id: str, req: BackendInput) -> _PagedSeq:
+        prompt = list(req.token_ids)
+        lora_id = getattr(req, "lora_id", 0)
+        seq = _PagedSeq(seq_id, req, prompt,
+                        TokenSequence(self.page, lora_id=lora_id))
+        # prefix reuse against the tier: pin matching leading blocks and
+        # skip recomputing them — they are cold context from token 0
+        page = self.page
+        usable = (len(prompt) - 1) // page
+        parent = lora_chain_root(lora_id)
+        matched = 0
+        tiered = self.core.tiered
+        for b in range(usable):
+            blk = prompt[b * page:(b + 1) * page]
+            sh = chain_hash(parent, hash_tokens(blk))
+            if not tiered.pin(sh):
+                break
+            seq.pinned.append(sh)
+            parent = sh
+            matched += 1
+        for t in prompt[:matched * page]:
+            seq.tokseq.append(int(t))
+        seq.first_res = matched
+        seq.total_len = matched * page
+        seq.prefill_done = matched * page
+        self.core.last_prefix_hit = matched * page
+        self.core.prefix_hit_tokens += matched * page
+        self.core.prefix_query_tokens += len(prompt)
+
+        # sampling state (lane-of-one mirrors of SamplingState)
+        sp = req.sampling
+        from ...engine.sampling import STATIC_K
+        seq.temp = np.asarray([float(sp.temperature or 0.0)], np.float32)
+        seq.top_p = np.asarray(
+            [float(sp.top_p if sp.top_p is not None else 1.0)], np.float32)
+        seq.top_k = np.asarray([int(min(sp.top_k or 0, STATIC_K))],
+                               np.int32)
+        seq.freq_pen = np.asarray([float(sp.frequency_penalty or 0.0)],
+                                  np.float32)
+        seq.pres_pen = np.asarray([float(sp.presence_penalty or 0.0)],
+                                  np.float32)
+        seed = sp.seed if sp.seed is not None else self.core.cfg.seed
+        seq.key = jax.vmap(jax.random.key)(jnp.asarray([int(seed)]))
+        seq.counts = jnp.zeros((1, self.core.cfg.model.vocab_size),
+                               jnp.int32)
+        self.active = seq
+        self._set_gauges(seq)
+        return seq
+
+    def _release(self, seq: _PagedSeq) -> None:
+        for page in seq.resident:
+            self.core.pool.blocks.release(page)
+        seq.resident = []
+        tiered = self.core.tiered
+        for h in seq.pinned:
+            tiered.unpin(h)
+        seq.pinned = []
+        if self.active is seq:
+            self.active = None
+        g = stage_metrics().kvpage_resident_bytes
+        g.set("device", self._worker, value=0.0)
+        g.set("host", self._worker, value=0.0)
+
+    def _set_gauges(self, seq: _PagedSeq) -> None:
+        dev, host = self.resident_bytes()
+        g = stage_metrics().kvpage_resident_bytes
+        g.set("device", self._worker, value=dev)
+        g.set("host", self._worker, value=host)
+
+    # ------------------------------------------------------------------
+    # residency
+    # ------------------------------------------------------------------
+    def _slot(self, seq: _PagedSeq, pos: int) -> int:
+        """Pool token-slot of position ``pos`` (must be resident)."""
+        blk = pos // self.page
+        return (seq.resident[blk - seq.first_res] * self.page
+                + pos % self.page)
+
+    def _ensure_resident(self, seq: _PagedSeq, upto: int) -> None:
+        """Lease device pages so every position < ``upto`` beyond the
+        demoted prefix has a slot."""
+        need_blocks = -(-upto // self.page)
+        while seq.first_res + len(seq.resident) < need_blocks:
+            seq.resident.append(self.core.pool.blocks.lease_new())
+
+    def _demote(self, seq: _PagedSeq, keep: int) -> None:
+        """Seal-and-demote the oldest resident blocks until at most
+        ``keep`` stay resident. Only full (hashed) blocks demote; the
+        d2h gather reads the post-write pool arrays, so it is ordered
+        after the writing dispatch by data dependency."""
+        sealed = len(seq.tokseq.blocks)
+        n = 0
+        while (len(seq.resident) - n > keep
+               and seq.first_res + n < sealed):
+            n += 1
+        if n <= 0:
+            return
+        pages = seq.resident[:n]
+        hashes = [seq.tokseq.blocks[seq.first_res + i].sequence_hash
+                  for i in range(n)]
+        k, v = self.core.copy_stream.d2h_pages(
+            self.core.k_pool, self.core.v_pool, pages, pipeline=n > 4)
+        tiered = self.core.tiered
+        for i, h in enumerate(hashes):
+            tiered.deposit_pinned(h, k[i], v[i])
+            seq.pinned.append(h)
+        for page in pages:
+            self.core.pool.blocks.release(page)
+        del seq.resident[:n]
+        seq.first_res += n
+        stage_metrics().kvpage_demotions.inc(amount=float(n))
+        self._set_gauges(seq)
+
+    def _cold_segments(self, seq: _PagedSeq) -> List[Tuple[int, ...]]:
+        """The demoted prefix [0, first_res) grouped into staging
+        segments of ``seg_pages`` blocks."""
+        hashes = seq.pinned
+        sp = self.pcfg.seg_pages
+        return [tuple(hashes[i:i + sp]) for i in range(0, len(hashes), sp)]
+
+    # ------------------------------------------------------------------
+    # forward
+    # ------------------------------------------------------------------
+    def _bucket_hot(self, n: int) -> int:
+        for b in self.s_hot_buckets:
+            if n <= b:
+                return b
+        return self.s_hot_buckets[-1]
+
+    def _upload(self, key) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Take one assembled staging segment and ENQUEUE its h2d upload;
+        returns device arrays the attention dispatch consumes."""
+        k, v, n = self.pager.take(key)
+        dt = self.core.cfg.model.dtype
+        valid = np.arange(self.pcfg.seg_pages * self.page) < n * self.page
+        return (jnp.asarray(k, dt), jnp.asarray(v, dt), jnp.asarray(valid))
+
+    def _forward(self, seq: _PagedSeq, tokens: np.ndarray,
+                 positions: np.ndarray, write_idx: np.ndarray,
+                 read_idx: np.ndarray, read_pos: np.ndarray,
+                 read_valid: np.ndarray) -> jax.Array:
+        """The segmented forward: per layer, qkv+write, hot partial
+        attention through the pool, cold segments merged one staged
+        upload at a time (next segment's upload enqueued before the
+        current segment's attention dispatches), then the layer tail."""
+        core = self.core
+        prg = self.programs
+        L = core.cfg.model.num_layers
+        cold = self._cold_segments(seq)
+        if cold:
+            self.pager.begin(PageinPlan([list(cold)] * L))
+        x = prg.embed(core.params, jnp.asarray(tokens))
+        for l in range(L):
+            li = np.int32(l)
+            q, core.k_pool, core.v_pool = prg.qkv(
+                core.params, li, x, positions, core.k_pool, core.v_pool,
+                write_idx)
+            o, m, d = prg.attn_hot(q, li, core.k_pool, core.v_pool,
+                                   read_idx, read_pos, read_valid,
+                                   positions)
+            if cold:
+                nxt = self._upload((l, 0))
+                for s in range(len(cold)):
+                    cur = nxt
+                    nxt = (self._upload((l, s + 1))
+                           if s + 1 < len(cold) else None)
+                    o, m, d = prg.attn_cold(q, cur[0], cur[1], cur[2],
+                                            o, m, d)
+            x = prg.layer_out(core.params, li, x, o, m, d)
+        return x
+
+    def _sample(self, seq: _PagedSeq, x: jax.Array,
+                last_i: int) -> Tuple[int, float]:
+        prg = self.programs
+        packed, seq.key, seq.counts = prg.head(
+            self.core.params, x, np.asarray([last_i], np.int32),
+            seq.temp, seq.top_p, seq.top_k, seq.key, seq.counts,
+            seq.freq_pen, seq.pres_pen)
+        # dynalint: ok(host-sync) THE designed paged-lane fetch: one
+        # packed (token, logprob) pair per sampled token — the paged
+        # path is synchronous per token by design (stop conditions and
+        # the next feed depend on it)
+        arr = np.asarray(packed)
+        return int(arr[0, 0]), float(arr[0, 1])
+
+    # ------------------------------------------------------------------
+    def _hot_read(self, seq: _PagedSeq, upto: int, padded: int
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(slots, positions, valid) of static width ``padded`` covering
+        the resident span [first_res*page, upto)."""
+        start = seq.first_res * self.page
+        n = upto - start
+        slots = np.zeros(padded, np.int32)
+        pos = np.zeros(padded, np.int32)
+        valid = np.zeros(padded, bool)
+        t = np.arange(start, upto)
+        pages = np.asarray(seq.resident, np.int32)
+        slots[:n] = (pages[t // self.page - seq.first_res] * self.page
+                     + t % self.page)
+        pos[:n] = t
+        valid[:n] = True
+        return slots[None], pos[None], valid[None]
+
+    def _prefill_chunk(self, seq: _PagedSeq, out: List) -> None:
+        from ...engine.engine import StepOutput
+
+        C = self.chunk
+        prompt = seq.prompt
+        start = seq.prefill_done
+        count = min(C, len(prompt) - start)
+        self._ensure_resident(seq, start + count)
+        tokens = np.zeros((1, C), np.int32)
+        positions = np.zeros((1, C), np.int32)
+        write_idx = np.zeros((1, C), np.int32)    # pad -> scratch page 0
+        tokens[0, :count] = prompt[start:start + count]
+        positions[0, :count] = np.arange(start, start + count)
+        write_idx[0, :count] = [self._slot(seq, p)
+                                for p in range(start, start + count)]
+        S = self._bucket_hot(start + count - seq.first_res * self.page)
+        read_idx, read_pos, read_valid = self._hot_read(
+            seq, start + count, S)
+        x = self._forward(seq, tokens, positions, write_idx,
+                          read_idx, read_pos, read_valid)
+        for t in prompt[start:start + count]:
+            seq.tokseq.append(int(t))
+        seq.total_len = start + count
+        seq.prefill_done = start + count
+        is_last = seq.prefill_done >= len(prompt)
+        # demote beyond the hot window now that the writes are enqueued
+        self._demote(seq, self.hot_keep)
+        if not is_last:
+            return
+        tok, lp = self._sample(seq, x, count - 1)
+        seq.generated = 1
+        seq.last_token = tok
+        seq.cum_logprob = lp
+        fin = self._finish(seq, tok)
+        out.append(StepOutput(seq.seq_id, tok, seq.cum_logprob, fin,
+                              prompt_tokens=len(prompt),
+                              token_logprob=lp))
+        if fin is not None:
+            self._release(seq)
+
+    def _decode_step(self, seq: _PagedSeq, out: List) -> None:
+        from ...engine.engine import StepOutput
+
+        pos = seq.total_len
+        self._ensure_resident(seq, pos + 1)
+        if len(seq.resident) > self.pcfg.budget:
+            self._demote(seq, self.pcfg.budget - 1)
+        tokens = np.asarray([[seq.last_token]], np.int32)
+        positions = np.asarray([[pos]], np.int32)
+        write_idx = np.asarray([[self._slot(seq, pos)]], np.int32)
+        S = self._bucket_hot(pos + 1 - seq.first_res * self.page)
+        read_idx, read_pos, read_valid = self._hot_read(seq, pos + 1, S)
+        x = self._forward(seq, tokens, positions, write_idx,
+                          read_idx, read_pos, read_valid)
+        seq.tokseq.append(int(seq.last_token))
+        seq.total_len = pos + 1
+        tok, lp = self._sample(seq, x, 0)
+        seq.generated += 1
+        seq.last_token = tok
+        seq.cum_logprob += lp
+        fin = self._finish(seq, tok)
+        out.append(StepOutput(seq.seq_id, tok, seq.cum_logprob, fin,
+                              token_logprob=lp))
+        if fin is not None:
+            self._release(seq)
+
+    def _finish(self, seq: _PagedSeq, token: int) -> Optional[FinishReason]:
+        req = seq.request
+        if not req.stop.ignore_eos:
+            eos = set(req.eos_token_ids) | set(req.stop.stop_token_ids)
+            if token in eos and seq.generated >= (req.stop.min_tokens or 0):
+                return FinishReason.EOS
+        if req.stop.max_tokens and seq.generated >= req.stop.max_tokens:
+            return FinishReason.LENGTH
+        if len(seq.prompt) + seq.generated >= self.pcfg.max_context:
+            return FinishReason.LENGTH
+        return None

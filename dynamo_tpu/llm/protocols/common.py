@@ -171,6 +171,13 @@ class EngineOutput:
     # human-readable cause when finish_reason == ERROR — surfaced all the
     # way to the SSE client instead of a silently terminated stream
     error: Optional[str] = None
+    # typed-error triple accompanying ``error``: http-ish status plus the
+    # stage/reason fields of the uniform error body, so an engine-side
+    # 400/503 maps to that status at the HTTP edge (and over the wire)
+    # instead of a generic 500
+    error_code: Optional[int] = None
+    error_stage: Optional[str] = None
+    error_reason: Optional[str] = None
     # engine-side bookkeeping surfaced for routing/metrics
     kv_prefix_hit_tokens: Optional[int] = None
     index: int = 0  # choice index for n>1

@@ -556,6 +556,15 @@ def validate_pp(cfg: LlamaConfig, pp: int, tp: int = 1) -> None:
             f"(got {cfg.num_kv_heads}): the staged path shards the KV pool")
 
 
+def kv_block_bytes(cfg: LlamaConfig, page_size: int) -> int:
+    """Bytes of one KV block (k+v, all layers) at device precision — the
+    ONE unit the byte-honest planes price in (engine residency gauges,
+    paged-lane admission, router bytes scoring). ml_dtypes registers
+    bfloat16 with numpy, so np.dtype resolves every served precision."""
+    return (2 * cfg.num_layers * cfg.num_kv_heads * page_size
+            * cfg.head_dim * np.dtype(cfg.dtype).itemsize)
+
+
 def kv_cache_spec(cfg: LlamaConfig, tp: int, pp: int = 1) -> P:
     """KV pool sharding ([L, Hkv, n_pages, page, Dh]): shard kv heads over tp
     when divisible, else replicate (GQA with kv_heads < tp). With ``pp > 1``

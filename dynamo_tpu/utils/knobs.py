@@ -70,6 +70,7 @@ _DOCS = {
     "slo": "docs/observability.md",
     "roofline": "docs/observability.md",
     "multi_model": "docs/multi_model.md",
+    "kvpage": "docs/long_context.md",
     "disagg": "docs/disagg_serving.md",
     "router": "docs/kv_cache_routing.md",
     "planner": "docs/planner.md",
@@ -132,6 +133,15 @@ _ALL: List[Knob] = [
     _k("DYN_ADMIT_BATCH_RESERVE", "float", "0.25", "overload",
        "fraction of admission capacity batch-priority traffic may use "
        "when interactive traffic is waiting"),
+    _k("DYN_ADMIT_KV_BYTES", "float", "0", "overload",
+       "in-flight KV byte budget at HTTP ingress: requests are priced "
+       "at estimated tokens x DYN_ADMIT_KV_TOKEN_BYTES so one "
+       "long-context request consumes its true share of the admission "
+       "envelope (0 = dimension off)"),
+    _k("DYN_ADMIT_KV_TOKEN_BYTES", "float", "0", "overload",
+       "per-token KV price in bytes for the byte-honest admission "
+       "dimension (2 * layers * kv_heads * head_dim * dtype_bytes of "
+       "the served model; 0 = dimension off)"),
     _k("DYN_WORKER_SLOTS", "int", "0", "overload",
        "worker decode slot gate (0/unset = ungated)"),
     _k("DYN_WORKER_QUEUE_DEPTH", "int", "2*slots", "overload",
@@ -183,6 +193,18 @@ _ALL: List[Knob] = [
        "trailing-token window the n-gram proposer indexes"),
     _k("DYN_SPEC_DRAFT", "str", "", "spec",
        "draft model preset name or checkpoint dir (mode=draft)"),
+    # -------------------------------------------------------- KV paging
+    _k("DYN_KVPAGE_DEVICE_BUDGET", "int", "0", "kvpage",
+       "device KV pages the paged long-context lane may hold resident "
+       "(0 = KV paging off; engine-config kvpage_budget overrides)"),
+    _k("DYN_KVPAGE_SEG_PAGES", "int", "8", "kvpage",
+       "cold KV blocks per staged h2d upload segment"),
+    _k("DYN_KVPAGE_PREFETCH", "int", "2", "kvpage",
+       "segments the page-in thread assembles ahead of the attention "
+       "pass (0 = synchronous page-ins, every one a counted fault)"),
+    _k("DYN_KVPAGE_MAX_CONTEXT", "int", "131072", "kvpage",
+       "context ceiling of the paged lane, tokens (the dense path's "
+       "max_context still governs normal requests)"),
     # -------------------------------------------------------------- engine
     _k("DYN_PROFILE_DIR", "str", "", "engine",
        "capture an XLA profile of the first working iterations into "

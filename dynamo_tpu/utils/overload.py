@@ -160,6 +160,13 @@ class AdmissionConfig:
                                 # interactive traffic (batch rejects at
                                 # ``concurrency``); default concurrency//2
     batch_reserve: float = 0.25  # fraction of burst batch may not drain
+    # byte-honest KV dimension: in-flight requests are additionally
+    # priced in estimated KV bytes (tokens x kv_token_bytes) against a
+    # kv_bytes budget, so ONE 128k-context request consumes its true
+    # share of the admission envelope instead of one concurrency slot.
+    # Both must be > 0 to arm the dimension.
+    kv_bytes: float = 0.0       # in-flight KV byte budget; 0 = off
+    kv_token_bytes: float = 0.0  # per-token KV price, bytes
 
     @classmethod
     def from_env(cls, env: Optional[Dict[str, str]] = None
@@ -172,7 +179,10 @@ class AdmissionConfig:
             queue = conc // 2
         reserve = _env_float("DYN_ADMIT_BATCH_RESERVE", 0.25, env)
         return cls(rps=rps, burst=burst, concurrency=conc, queue=queue,
-                   batch_reserve=min(max(reserve, 0.0), 1.0))
+                   batch_reserve=min(max(reserve, 0.0), 1.0),
+                   kv_bytes=_env_float("DYN_ADMIT_KV_BYTES", 0.0, env),
+                   kv_token_bytes=_env_float("DYN_ADMIT_KV_TOKEN_BYTES",
+                                             0.0, env))
 
 
 class AdmissionController:
@@ -192,6 +202,7 @@ class AdmissionController:
         self.bucket = (TokenBucket(c.rps, max(c.burst, 1.0), clock)
                        if c.rps > 0 else None)
         self.inflight = 0
+        self.kv_inflight_bytes = 0.0
 
     @classmethod
     def from_env(cls, env: Optional[Dict[str, str]] = None
@@ -241,6 +252,52 @@ class AdmissionController:
     def release(self) -> None:
         self.inflight = max(0, self.inflight - 1)
         self._metrics().admission_depth.set(value=self.inflight)
+
+    # ------------------------------------------------------------------
+    # byte-honest KV dimension (second gate, once token counts exist)
+    # ------------------------------------------------------------------
+    @property
+    def kv_enabled(self) -> bool:
+        c = self.config
+        return c.kv_bytes > 0 and c.kv_token_bytes > 0
+
+    def price_kv(self, est_tokens: float) -> float:
+        """A request's KV price in bytes (0 when the dimension is off)."""
+        return (est_tokens * self.config.kv_token_bytes
+                if self.kv_enabled else 0.0)
+
+    def try_reserve_kv(self, kv_bytes: float,
+                       priority: str = PRIORITY_INTERACTIVE
+                       ) -> Optional[OverloadError]:
+        """Reserve ``kv_bytes`` of the in-flight KV budget or explain the
+        shed. Runs AFTER the header-stage gate (token counts only exist
+        once the body is read); the caller must :meth:`release_kv` the
+        same amount on every exit path after a None return. A request
+        larger than the whole budget is a 400-shaped client error, not a
+        retryable 429 — retrying cannot ever fit it."""
+        if kv_bytes <= 0 or not self.kv_enabled:
+            return None
+        c = self.config
+        if kv_bytes > c.kv_bytes:
+            self._metrics().admission_rejects.inc("kv_bytes", priority)
+            return OverloadError(
+                f"request KV working set of {kv_bytes / 1e6:.0f} MB "
+                f"exceeds the whole admission budget "
+                f"({c.kv_bytes / 1e6:.0f} MB)", stage="admission",
+                reason="kv_bytes", code=400)
+        if self.kv_inflight_bytes + kv_bytes > c.kv_bytes:
+            return self._reject("kv_bytes", priority, 1.0)
+        self.kv_inflight_bytes += kv_bytes
+        self._metrics().admission_kv_bytes.set(
+            value=self.kv_inflight_bytes)
+        return None
+
+    def release_kv(self, kv_bytes: float) -> None:
+        if kv_bytes <= 0 or not self.kv_enabled:
+            return
+        self.kv_inflight_bytes = max(0.0, self.kv_inflight_bytes - kv_bytes)
+        self._metrics().admission_kv_bytes.set(
+            value=self.kv_inflight_bytes)
 
 
 # ---------------------------------------------------------------------------
@@ -495,6 +552,29 @@ class TenantBurnTracker:
 
     def worst(self) -> float:
         return max(self._last.values(), default=0.0)
+
+
+def estimate_request_tokens(oai_req) -> float:
+    """Crude ingress-side token estimate for KV-byte pricing: prompt
+    characters (exact for the byte tokenizer, a safe overestimate for
+    BPE) plus the requested ``max_tokens`` (256 when unset). Runs before
+    tokenization, so it is a pricing heuristic, not an accounting truth —
+    the engine's paged-admission check re-prices exactly in blocks."""
+    chars = 0
+    prompt = getattr(oai_req, "prompt", None)
+    if isinstance(prompt, str):
+        chars = len(prompt)
+    elif isinstance(prompt, (list, tuple)):
+        chars = sum(len(p) if isinstance(p, str) else 1 for p in prompt)
+    for msg in getattr(oai_req, "messages", None) or ():
+        content = msg.get("content") if isinstance(msg, dict) else None
+        if isinstance(content, str):
+            chars += len(content)
+        elif isinstance(content, (list, tuple)):
+            for part in content:
+                if isinstance(part, dict):
+                    chars += len(str(part.get("text", "")))
+    return float(chars) + float(getattr(oai_req, "max_tokens", None) or 256)
 
 
 # ---------------------------------------------------------------------------

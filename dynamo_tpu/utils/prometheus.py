@@ -420,6 +420,11 @@ class StageMetrics:
             "dyn_admission_queue_depth",
             "In-flight requests currently held by the admission "
             "controller", ())
+        self.admission_kv_bytes = r.gauge(
+            "dyn_admission_kv_bytes",
+            "Estimated KV bytes of all admitted in-flight requests (the "
+            "byte-honest admission dimension; 0 when DYN_ADMIT_KV_BYTES "
+            "is off)", ())
         # tenancy plane (utils/overload.py TenantAdmission/BurnTracker):
         # quota sheds are deliberate isolation, counted separately from
         # overload sheds so rejected-demand autoscaling pressure stays
@@ -495,6 +500,32 @@ class StageMetrics:
             "dyn_kv_cluster_fetch_seconds",
             "Peer prefix fetch duration, request out to blocks deposited",
             (), buckets=LATENCY_BUCKETS_FAST + (2.5, 10.0))
+        # KV paging plane (llm/kvpage/): the virtual-memory counters —
+        # demotions (d2h seal-and-demote), page-ins (async staged h2d),
+        # faults (synchronous inline page-ins: the number that must stay
+        # at zero in steady-state decode), and the lane's true footprint
+        # in bytes (device-resident pages + pinned host working set)
+        self.kvpage_demotions = r.counter(
+            "dyn_kvpage_demotions_total",
+            "KV blocks sealed and demoted d2h to the host tier by the "
+            "paging plane", ())
+        self.kvpage_pageins = r.counter(
+            "dyn_kvpage_pageins_total",
+            "Cold-block segments paged in h2d ahead of the attention "
+            "pass that read them (async prefetch hits)", ())
+        self.kvpage_faults = r.counter(
+            "dyn_kvpage_faults_total",
+            "Page faults: cold segments assembled synchronously on the "
+            "engine thread because prefetch had not staged them", ())
+        self.kvpage_resident_bytes = r.gauge(
+            "dyn_kvpage_resident_bytes",
+            "Paged-lane working set in bytes by residency tier "
+            "(device pages vs pinned host blocks)", ("tier", "worker"))
+        self.kvpage_pagein_wait = r.histogram(
+            "dyn_kvpage_pagein_wait_seconds",
+            "Time the paged forward blocked waiting for a scheduled "
+            "page-in to finish assembling (0 = fully overlapped)",
+            (), buckets=LATENCY_BUCKETS_FAST)
 
     def clear_worker(self, worker: str) -> None:
         """Drop every per-worker gauge series for ``worker`` (pid). Wired
@@ -504,6 +535,7 @@ class StageMetrics:
         for g in (self.batch_occupancy, self.mfu, self.mbu, self.hbm_gbps):
             g.clear_label(0, worker)
         self.kv_tier_blocks.clear_label(1, worker)   # (tier, worker)
+        self.kvpage_resident_bytes.clear_label(1, worker)
 
 
 _stage: Optional[StageMetrics] = None

@@ -1,0 +1,527 @@
+"""KV paging (llm/kvpage/): virtual memory for the decode working set.
+
+- paged serving is token-identical to the dense path at >= 16x the
+  device page budget, with zero steady-state decode faults (the ISSUE 12
+  acceptance pin, at tiny geometry so it stays tier-1 cheap)
+- typed 400/503 admission errors (over-length without paging, paged-lane
+  capacity) carry {code, stage, reason} end to end
+- PageScheduler prefetch/fault/miss semantics
+- tier pinning + concurrency: demotion racing cluster write-through and
+  peer-donor reads on one TieredKvCache (RLock discipline, on_change
+  fires once per deposit, pager peeks don't perturb LRU order)
+- byte-honest admission (DYN_ADMIT_KV_BYTES) and the router's
+  kv_bytes_frac scoring dimension
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.llm.kvbm.tiers import (HostKvTier, OutOfTierSpace,
+                                       TieredKvCache)
+from dynamo_tpu.llm.protocols.common import (BackendInput, FinishReason,
+                                             StopConditions)
+
+BLK = (2, 2, 8, 4)          # [L, Hkv, page, Dh] toy tier-block geometry
+
+
+def _blk(seed: float):
+    k = np.full(BLK, seed, np.float32)
+    return k, -k
+
+
+def _req(tokens, max_tokens=4, **kw):
+    return BackendInput(token_ids=list(tokens),
+                        stop=StopConditions(max_tokens=max_tokens), **kw)
+
+
+def _drain(core, want_err=False, n=30000):
+    got = []
+    for _ in range(n):
+        for so in core.step():
+            if not want_err:
+                assert so.error is None, so.error
+            got.append(so)
+        if got and got[-1].finish is not None:
+            return got
+    raise AssertionError("sequence never finished")
+
+
+# ---------------------------------------------------------------------------
+# engine fixtures (module-scoped: engines are compile-bound)
+# ---------------------------------------------------------------------------
+CTX = 2048
+PAGE = 16
+BUDGET = 8                              # 128 resident tokens
+PROMPT = [(i * 7 + 3) % 251 for i in range(16 * BUDGET * PAGE + 37)]
+
+
+def _model():
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models import llama
+    # f32 so paged-vs-dense differences are softmax reassociation only
+    return llama.preset("tiny-byte", max_position=4096, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def paged_core():
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+
+    core = EngineCore(JaxEngineConfig(
+        model=_model(), max_batch=2, max_context=256, page_size=PAGE,
+        prefill_chunk=64, decode_steps=4,
+        host_cache_blocks=len(PROMPT) // PAGE + 64,
+        kvpage_budget=BUDGET, kvpage_seg_pages=4, kvpage_prefetch=2,
+        kvpage_max_context=4096))
+    yield core
+    core.close()
+
+
+@pytest.fixture(scope="module")
+def ref_tokens():
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+
+    core = EngineCore(JaxEngineConfig(
+        model=_model(), max_batch=2, max_context=4096, page_size=PAGE,
+        prefill_chunk=64, decode_steps=4, kvpage_budget=0))
+    try:
+        core.submit("ref", _req(PROMPT))
+        return [so.token for so in _drain(core)]
+    finally:
+        core.close()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: 16x budget, token-identical, fault-free decode
+# ---------------------------------------------------------------------------
+def test_paged_matches_unpaged_at_16x_budget(paged_core, ref_tokens):
+    assert len(PROMPT) >= 16 * BUDGET * PAGE
+    core = paged_core
+    pager = core.kvpager.pager
+    core.submit("p", _req(PROMPT))
+    outs = _drain(core)
+    faults_after_prefill = pager.faults   # decode already ran, see below
+    toks = [so.token for so in outs]
+    assert toks == ref_tokens
+    # the demoted working set went through the host tier and back
+    assert pager.pageins > 0
+    assert core.kvpager.active is None            # released
+    assert core.tiered.pinned_count() == 0        # pins dropped at finish
+    assert faults_after_prefill == pager.faults   # nothing faulted since
+
+
+def test_paged_reserve_prefix_reuse(paged_core, ref_tokens):
+    """Re-serving the same long prompt prefix-hits the tier blocks the
+    first run left behind (pinned-then-unpinned -> ordinary reuse)."""
+    core = paged_core
+    core.submit("p2", _req(PROMPT))
+    toks = [so.token for so in _drain(core)]
+    assert toks == ref_tokens
+    # everything demoted during the first run is matchable; only the
+    # final hot window (<= budget blocks, released to the device pool at
+    # finish) never reached the tier
+    assert core.last_prefix_hit >= (len(PROMPT) // PAGE - BUDGET - 1) * PAGE
+
+
+def test_paged_emits_prompt_tokens_and_finish(paged_core):
+    core = paged_core
+    core.submit("meta", _req(PROMPT[:300], max_tokens=2))
+    outs = [so for so in _drain(core) if so.seq_id == "meta"]
+    assert outs[0].prompt_tokens == 300
+    assert outs[-1].finish == FinishReason.LENGTH
+
+
+def test_paged_cancel(paged_core):
+    core = paged_core
+    core.submit("gone", _req(PROMPT[:400], max_tokens=64))
+    for _ in range(3):
+        core.step()
+    core.cancel("gone")
+    outs = _drain(core, want_err=True)
+    assert any(so.seq_id == "gone" and so.finish == FinishReason.CANCELLED
+               for so in outs)
+    assert core.kvpager.active is None
+    assert core.tiered.pinned_count() == 0
+
+
+def test_paged_admission_errors(paged_core):
+    core = paged_core
+    # beyond the paged ceiling: typed 400 naming the knob
+    core.submit("huge", _req(list(range(5000)), max_tokens=1))
+    outs = _drain(core, want_err=True)
+    so = next(o for o in outs if o.seq_id == "huge")
+    assert so.finish == FinishReason.ERROR
+    assert so.error_code == 400
+    assert so.error_stage == "engine_admission"
+    assert so.error_reason == "context_exceeded"
+    assert "DYN_KVPAGE_MAX_CONTEXT" in so.error
+    # a working set the host tier cannot pin: typed 503
+    host_blocks = core.tiered.host.num_blocks
+    too_big = _req(PROMPT[:290], max_tokens=(host_blocks + 8) * PAGE)
+    core.submit("fat", too_big)
+    outs = _drain(core, want_err=True)
+    so = next(o for o in outs if o.seq_id == "fat")
+    assert (so.error_code, so.error_reason) == (503, "kvpage_capacity")
+
+
+def test_overlength_without_paging_is_typed_400():
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+
+    core = EngineCore(JaxEngineConfig(
+        model=_model(), max_batch=2, max_context=128, page_size=PAGE,
+        prefill_chunk=32, kvpage_budget=0))
+    try:
+        core.submit("big", _req(list(range(200)), max_tokens=1))
+        so = next(o for o in _drain(core, want_err=True)
+                  if o.seq_id == "big")
+        assert so.finish == FinishReason.ERROR
+        assert so.error_code == 400
+        assert so.error_stage == "engine_admission"
+        assert so.error_reason == "context_exceeded"
+        assert "max_context" in so.error and "128" in so.error
+    finally:
+        core.close()
+
+
+def test_kvpage_config_validation():
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+
+    with pytest.raises(ValueError, match="host tier"):
+        EngineCore(JaxEngineConfig(
+            model=_model(), max_batch=1, max_context=128, page_size=PAGE,
+            prefill_chunk=32, kvpage_budget=8))
+    with pytest.raises(ValueError, match="prefill chunk"):
+        EngineCore(JaxEngineConfig(
+            model=_model(), max_batch=1, max_context=128, page_size=PAGE,
+            prefill_chunk=64, host_cache_blocks=8, kvpage_budget=2))
+
+
+# ---------------------------------------------------------------------------
+# PageScheduler semantics
+# ---------------------------------------------------------------------------
+def _tier(blocks=8, seeds=()):
+    t = TieredKvCache(HostKvTier(blocks, BLK, np.float32))
+    for h, s in seeds:
+        t.offload(h, *_blk(s))
+    return t
+
+
+def test_pager_prefetch_and_fault_counting():
+    from dynamo_tpu.llm.kvpage.pager import PageinPlan, PageScheduler
+
+    tier = _tier(seeds=[(1, 1.0), (2, 2.0), (3, 3.0)])
+    # prefetch on: every take is an async page-in, zero faults
+    ps = PageScheduler(tier, seg_pages=2, prefetch=2)
+    try:
+        plan = PageinPlan([[(1, 2), (3,)], [(1, 2), (3,)]])
+        ps.begin(plan)
+        for key in plan.items():
+            k, v, n = ps.take(key)
+            assert k.shape == (2, *BLK[1:])
+            assert n == len(plan.hashes(key))
+            np.testing.assert_array_equal(
+                k[0], np.full(BLK[1:], float(plan.hashes(key)[0]),
+                              np.float32))
+        assert ps.faults == 0 and ps.pageins == 4
+    finally:
+        ps.close()
+    # prefetch off: every take is a counted synchronous fault
+    ps = PageScheduler(tier, seg_pages=2, prefetch=0)
+    try:
+        ps.begin(PageinPlan([[(1, 2)]]))
+        ps.take((0, 0))
+        assert ps.faults == 1 and ps.pageins == 0
+    finally:
+        ps.close()
+
+
+def test_pager_miss_is_fatal_not_silent():
+    from dynamo_tpu.llm.kvpage.pager import (KvPageMiss, PageinPlan,
+                                             PageScheduler)
+
+    ps = PageScheduler(_tier(), seg_pages=2, prefetch=2)
+    try:
+        ps.begin(PageinPlan([[(99,)]]))
+        with pytest.raises(KvPageMiss):
+            ps.take((0, 0))
+    finally:
+        ps.close()
+
+
+# ---------------------------------------------------------------------------
+# tier pinning + concurrency under paging
+# ---------------------------------------------------------------------------
+def test_pinned_blocks_survive_lru_pressure():
+    tier = _tier(blocks=4)
+    tier.deposit_pinned(1, *_blk(1.0))
+    for h in range(10, 20):                 # way past capacity
+        tier.offload(h, *_blk(float(h)))
+    got = tier.peek_layer(1, 0)
+    assert got is not None
+    np.testing.assert_array_equal(got[0],
+                                  np.full(BLK[1:], 1.0, np.float32))
+    tier.unpin(1)
+    for h in range(30, 36):
+        tier.offload(h, *_blk(float(h)))
+    assert tier.peek(1) is None             # unpinned -> ordinary LRU
+
+
+def test_all_pinned_tier_raises_for_pinned_drops_for_cache():
+    tier = _tier(blocks=2)
+    tier.deposit_pinned(1, *_blk(1.0))
+    tier.deposit_pinned(2, *_blk(2.0))
+    with pytest.raises(OutOfTierSpace):
+        tier.deposit_pinned(3, *_blk(3.0))
+    assert 3 not in tier
+    tier.offload(4, *_blk(4.0))             # cache insert: dropped, no raise
+    assert 4 not in tier and 1 in tier and 2 in tier
+
+
+def test_pinned_disk_block_survives_promotion_into_full_host():
+    """lookup() of a disk-pinned block when the host tier is wall-to-wall
+    pinned must serve the block and LEAVE it on disk (pin intact) — not
+    drop it mid-promotion (the ghost-pin bug)."""
+    from dynamo_tpu.llm.kvbm.tiers import DiskKvTier
+
+    disk = DiskKvTier(4, BLK, np.float32, "/tmp/test_kvpage_spill")
+    tier = TieredKvCache(HostKvTier(2, BLK, np.float32), disk)
+    try:
+        tier.deposit_pinned(1, *_blk(1.0))
+        tier.deposit_pinned(2, *_blk(2.0))          # host now all pinned
+        disk.put(7, *_blk(7.0))
+        disk.pinned.add(7)                          # pinned, disk-resident
+        got = tier.lookup(7)
+        assert got is not None
+        np.testing.assert_array_equal(got[0],
+                                      np.full(BLK, 7.0, np.float32))
+        assert 7 in disk and 7 in disk.pinned       # not promoted, not lost
+        assert tier.peek_layer(7, 1) is not None
+        # with host room, the same lookup DOES promote, pin and all
+        tier.unpin(1)
+        tier.host.pop(1)
+        got = tier.lookup(7)
+        assert got is not None and 7 in tier.host.pinned
+        assert 7 not in disk
+    finally:
+        tier.close()
+
+
+def test_pager_peek_does_not_perturb_lru():
+    tier = _tier(blocks=2, seeds=[(1, 1.0), (2, 2.0)])
+    for _ in range(3):
+        assert tier.peek_layer(1, 0) is not None    # pager-style reads
+    tier.offload(3, *_blk(3.0))             # evicts LRU
+    assert 1 not in tier                    # peeks did NOT refresh 1
+    assert 2 in tier and 3 in tier
+
+
+def test_tier_concurrency_demote_vs_writethrough_vs_donor():
+    """Pager demotions, cluster write-through offloads and peer-donor
+    peeks hammer one TieredKvCache from three threads: no exception, no
+    torn reads (a block read back is uniform), on_change fired exactly
+    once per deposit."""
+    tier = _tier(blocks=64)
+    changes = []
+    tier.on_change = lambda: changes.append(1)
+    stop = threading.Event()
+    errors = []
+
+    def demoter():                          # pager: pinned deposits
+        try:
+            # sliding pin window (like a live paged sequence): the tier
+            # must never fill wall-to-wall with pins mid-test
+            for i in range(200):
+                tier.deposit_pinned(1000 + i, *_blk(float(i)))
+                if i >= 32:
+                    tier.unpin(1000 + i - 32)
+            for i in range(168, 200):
+                tier.unpin(1000 + i)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def writethrough():                     # engine: cache offloads
+        try:
+            for i in range(200):
+                tier.offload(2000 + i, *_blk(float(i)))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def donor():                            # asyncio plane: peeks
+        try:
+            while not stop.is_set():
+                for h in (1000, 1050, 2000, 2100):
+                    got = tier.peek(h)
+                    if got is not None:
+                        k = got[0]
+                        assert (k == k.flat[0]).all(), "torn block read"
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=f)
+               for f in (demoter, writethrough, donor)]
+    for t in threads[:2]:
+        t.start()
+    threads[2].start()
+    threads[0].join(), threads[1].join()
+    stop.set()
+    threads[2].join()
+    assert not errors, errors
+    assert len(changes) == 400              # one on_change per deposit
+    assert tier.pinned_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# byte-honest admission + router bytes dimension
+# ---------------------------------------------------------------------------
+def test_admission_kv_bytes_dimension():
+    from dynamo_tpu.utils.overload import (AdmissionConfig,
+                                           AdmissionController)
+
+    ctl = AdmissionController(AdmissionConfig(
+        kv_bytes=1000.0, kv_token_bytes=10.0))
+    assert ctl.kv_enabled
+    assert ctl.price_kv(50) == 500.0
+    assert ctl.try_reserve_kv(500.0) is None
+    assert ctl.try_reserve_kv(400.0) is None
+    shed = ctl.try_reserve_kv(200.0)        # 900 + 200 > 1000
+    assert shed is not None and shed.reason == "kv_bytes"
+    assert shed.code == 429
+    ctl.release_kv(400.0)
+    assert ctl.try_reserve_kv(200.0) is None
+    # larger than the whole budget: a 400, retrying can never fit it
+    big = ctl.try_reserve_kv(2000.0)
+    assert big is not None and big.code == 400
+    # dimension off: everything passes, nothing tracked
+    off = AdmissionController(AdmissionConfig())
+    assert not off.kv_enabled
+    assert off.price_kv(10_000) == 0.0
+    assert off.try_reserve_kv(0.0) is None
+
+
+def test_estimate_request_tokens():
+    from dynamo_tpu.llm.protocols.openai import (ChatCompletionRequest,
+                                                 CompletionRequest)
+    from dynamo_tpu.utils.overload import estimate_request_tokens
+
+    comp = CompletionRequest.from_dict(
+        {"model": "m", "prompt": "x" * 100, "max_tokens": 7})
+    assert estimate_request_tokens(comp) == 107.0
+    chat = ChatCompletionRequest.from_dict(
+        {"model": "m", "messages": [{"role": "user", "content": "y" * 40}]})
+    assert estimate_request_tokens(chat) == 40.0 + 256.0
+
+
+def test_router_scores_bytes_pressure():
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.kv_router.scheduler import (ProcessedEndpoints,
+                                                    score_candidates)
+
+    def fpm(resident, capacity):
+        return ForwardPassMetrics(request_active_slots=1,
+                                  request_total_slots=4,
+                                  kv_resident_bytes=resident,
+                                  kv_capacity_bytes=capacity)
+
+    eps = ProcessedEndpoints({1: fpm(0.0, 100.0), 2: fpm(90.0, 100.0),
+                              3: fpm(0.0, 0.0)})
+    cands = {c["worker_id"]: c for c in score_candidates(
+        [0] * 32, 16, OverlapScores(), eps)}
+    assert cands[1]["kv_bytes_frac"] == 0.0
+    assert cands[2]["kv_bytes_frac"] == pytest.approx(0.9)
+    assert cands[3]["kv_bytes_frac"] == 0.0    # unpublished -> no term
+    assert cands[1]["logit"] > cands[2]["logit"]
+    assert cands[1]["logit"] == pytest.approx(cands[3]["logit"])
+
+
+def test_engine_utilization_publishes_bytes(paged_core):
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+
+    u = paged_core.utilization()
+    assert u["kv_capacity_bytes"] > 0
+    # every utilization key must be a ForwardPassMetrics field (the
+    # worker publisher constructs it with **utilization())
+    m = ForwardPassMetrics(**u)
+    assert m.kv_capacity_bytes == u["kv_capacity_bytes"]
+
+
+def test_paged_failure_kills_request_not_engine(paged_core, monkeypatch):
+    """An unexpected exception inside the paged forward must terminate
+    THAT request (typed 500, lane released) — never escape into
+    step()'s catch-all, which would error every dense sequence and
+    leave the paged lane leaking its pages and pins forever."""
+    core = paged_core
+
+    def boom(*a, **kw):
+        raise RuntimeError("synthetic upload failure")
+
+    monkeypatch.setattr(core.kvpager, "_forward", boom)
+    core.submit("doomed", _req(PROMPT[:400], max_tokens=4))
+    outs = _drain(core, want_err=True)
+    so = next(o for o in outs if o.seq_id == "doomed")
+    assert so.finish == FinishReason.ERROR
+    assert (so.error_code, so.error_reason) == (500, "kvpage_internal")
+    assert core.kvpager.active is None
+    assert core.tiered.pinned_count() == 0
+    monkeypatch.undo()
+    # the engine keeps serving paged traffic afterwards
+    core.submit("after", _req(PROMPT[:300], max_tokens=2))
+    outs = [so for so in _drain(core) if so.seq_id == "after"]
+    assert outs[-1].finish is not None and outs[-1].error is None
+
+
+def test_typed_error_survives_to_http_body():
+    """StepOutput {code, stage, reason} -> EngineOutput -> backend
+    EngineError -> the frontend's uniform error body, end to end."""
+    import asyncio
+    import json
+
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.http_service import _err_engine
+    from dynamo_tpu.llm.protocols.common import EngineOutput
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+    from dynamo_tpu.runtime.engine import Context, EngineError
+
+    class ErrEngine:
+        async def generate(self, request, context):
+            yield EngineOutput(
+                token_ids=[], finish_reason=FinishReason.ERROR,
+                error="prompt of 5000 tokens exceeds the configured "
+                      "max_context of 256",
+                error_code=400, error_stage="engine_admission",
+                error_reason="context_exceeded")
+
+    async def run():
+        stream = Backend(ErrEngine(), ByteTokenizer()).generate(
+            _req([1, 2, 3]), Context("r1"))
+        with pytest.raises(EngineError) as ei:
+            async for _ in stream:
+                pass
+        return ei.value
+
+    e = asyncio.run(run())
+    assert (e.code, e.stage, e.reason) == (400, "engine_admission",
+                                           "context_exceeded")
+    resp = _err_engine(e, "r1")
+    body = json.loads(resp.body)["error"]
+    assert resp.status == 400
+    assert body["type"] == "invalid_request_error"
+    assert body["stage"] == "engine_admission"
+    assert body["reason"] == "context_exceeded"
+    assert "max_context" in body["message"]
+
+
+# ---------------------------------------------------------------------------
+# bench lane smoke (tiny: one multiple, small budget)
+# ---------------------------------------------------------------------------
+def test_long_context_bench_lane_smoke(tmp_path):
+    import bench_system
+
+    r = bench_system.long_context_lane(
+        multiples=(2,), budget_pages=6, page_size=8, max_tokens=4,
+        points_dir=str(tmp_path))
+    assert r["checks"]["all_exact"]
+    assert r["checks"]["zero_decode_faults"]
+    assert (tmp_path / "long_context_2x.json").exists()
