@@ -22,6 +22,7 @@ import json
 
 from ..llm.model_card import ModelDeploymentCard
 from ..llm.remote import list_models, register_model, unregister_model
+from ..runtime.scale.shards import make_store_client
 from ..runtime.store_client import StoreClient
 
 
@@ -115,7 +116,7 @@ def parse_tenant_quota(entry: str):
 
 async def run(args) -> int:
     host, port = args.store.split(":")
-    store = await StoreClient(host, int(port)).connect()
+    store = await make_store_client(host, int(port)).connect()
     try:
         if args.plane == "fleet":
             from ..fleet.registry import (FleetModelSpec, fetch_fleet_status,

@@ -23,6 +23,7 @@ import json
 import sys
 
 from ..deploy.crd import DEPLOY_PREFIX, Deployment
+from ..runtime.scale.shards import make_store_client
 from ..runtime.store_client import StoreClient
 
 
@@ -35,7 +36,7 @@ def _load_resource(path: str) -> Deployment:
 
 async def _with_client(store: str, fn):
     host, port = store.split(":")
-    client = await StoreClient(host, int(port)).connect()
+    client = await make_store_client(host, int(port)).connect()
     try:
         return await fn(client)
     finally:

@@ -75,14 +75,15 @@ class ClusterSnapshotter:
 
     async def collect(self) -> Dict:
         from ..llm.disagg import prefill_queue_names
-        from ..llm.metrics_aggregator import (fetch_stage_states,
+        from ..llm.metrics_aggregator import (fetch_stage_states_ex,
                                               fetch_worker_metrics)
         from ..planner.signals import open_instance_ids, quantile_from_states
         from ..utils.overload import (admission_depth_total,
                                       brownout_level_from_states,
                                       shed_totals)
 
-        states = await fetch_stage_states(self.store, self.namespace)
+        states, regional = await fetch_stage_states_ex(self.store,
+                                                       self.namespace)
         # fleet plane: per-model pool rows (and their components join the
         # worker table automatically — a fleet's pools are per-model, so
         # a static --component list would render an empty fleet)
@@ -109,9 +110,16 @@ class ClusterSnapshotter:
             if f["component"] not in components:
                 components.append(f["component"])
         workers: Dict[str, Dict] = {}
-        for comp in components:
-            workers[comp] = await fetch_worker_metrics(
-                self.store, self.namespace, comp)
+        if regional is not None:
+            # region path: per-worker ForwardPassMetrics ride the region
+            # records — zero per-component store scans, and components
+            # the aggregators found join the table automatically
+            for comp in set(components) | set(regional.fpm):
+                workers[comp] = regional.workers_for(comp)
+        else:
+            for comp in components:
+                workers[comp] = await fetch_worker_metrics(
+                    self.store, self.namespace, comp)
         q_depth = 0
         for qname in prefill_queue_names(self.namespace):
             try:
@@ -160,6 +168,34 @@ class ClusterSnapshotter:
                                 "ops": store_stats["ops_total"],
                                 "fanout": store_stats["fanout_total"],
                                 "fams": fam_counts}
+        # sharded store: every shard publishes its own self-dump under
+        # the same metrics_stage/_store/ key in its own KV — read each
+        # shard's copy for the --store-detail per-shard rows
+        store_shards: Optional[Dict[str, Optional[Dict]]] = None
+        shard_of_family: Dict[str, str] = {}
+        if hasattr(self.store, "get_prefix_on"):
+            from ..llm.metrics_aggregator import (STORE_STAGE_PREFIX,
+                                                  merge_stage_items)
+
+            store_shards = {}
+            for i, name in enumerate(self.store.shard_names):
+                try:
+                    items = await self.store.get_prefix_on(
+                        i, STORE_STAGE_PREFIX)
+                except Exception:  # noqa: BLE001 - a dead shard renders
+                    # as such instead of blanking the whole table
+                    store_shards[name] = None
+                    continue
+                sstates = [(d.get("component") or "store", m)
+                           for _k, (d, m) in
+                           merge_stage_items(items).items()]
+                st = store_stats_from_states(sstates)
+                if st is not None:
+                    st.pop("_fam_counts", None)
+                    st.pop("_buckets", None)
+                store_shards[name] = st
+            for fam, idx in self.store.fam_map.items():
+                shard_of_family[fam] = self.store.shard_names[idx]
         burn = self.slo.observe(states) if self.slo.objectives else {}
         overload = {
             "brownout": brownout_level_from_states(states),
@@ -172,7 +208,10 @@ class ClusterSnapshotter:
             "fleet": fleet,
             "at": time.time(),
             "namespace": self.namespace,
+            "regions": regional.meta if regional is not None else None,
             "store": store_stats,
+            "store_shards": store_shards,
+            "shard_of_family": shard_of_family,
             "workers": workers,
             "breaker_open": open_instance_ids(states),
             "ttft_p90": quantile_from_states(states, "llm_ttft_seconds",
@@ -193,10 +232,15 @@ def store_stats_from_states(states) -> Optional[Dict]:
     snapshotter differentiates successive calls into op/s and fan-out/s.
     None when no store dump is being published (old store, or
     ``DYN_STORE_METRICS_INTERVAL=0``)."""
-    dump = next((d for comp, d in states
-                 if comp == "store" and "dyn_store_op_seconds" in d), None)
-    if dump is None:
+    from ..utils.prometheus import merge_state_dumps
+
+    dumps = [d for comp, d in states
+             if comp == "store" and "dyn_store_op_seconds" in d]
+    if not dumps:
         return None
+    # a sharded store surfaces one dump per shard: the store: line shows
+    # their sum (the per-shard split lives in --store-detail)
+    dump = dumps[0] if len(dumps) == 1 else merge_state_dumps(dumps)
 
     def gauge(name: str) -> float:
         st = dump.get(name) or {}
@@ -353,20 +397,53 @@ def render(snap: Dict, store_detail: bool = False) -> str:
             f"  fanout={fan_s}  drops={drops}"
             f"  sampled_out={int(st.get('spans_sampled_out', 0))}")
         if store_detail:
-            lines.append(
-                f"  {'family':<16} {'ops':>9} {'p99':>8} {'keys':>7} "
-                f"{'MiB':>8} {'qdepth':>6}")
+            shard_of = snap.get("shard_of_family") or {}
+            shard_col = bool(snap.get("store_shards"))
+            hdr = (f"  {'family':<16} {'ops':>9} {'p99':>8} {'keys':>7} "
+                   f"{'MiB':>8} {'qdepth':>6}")
+            lines.append(hdr + (f" {'shard':>6}" if shard_col else ""))
             life = st.get("families") or {}   # lifetime totals here
             gauges = st.get("family_gauges") or {}
             for fam in sorted(set(life) | set(gauges)):
                 f_ops = life.get(fam, {})
                 g = gauges.get(fam, {})
-                lines.append(
+                row = (
                     f"  {fam:<16} {int(f_ops.get('ops', 0)):>9} "
                     f"{_fmt_ms(f_ops.get('p99_s')):>8} "
                     f"{int(g.get('keys', 0)):>7} "
                     f"{g.get('bytes', 0) / 2**20:>8.2f} "
                     f"{int(g.get('queue_depth', 0)):>6}")
+                if shard_col:
+                    row += f" {shard_of.get(fam, 's0'):>6}"
+                lines.append(row)
+    shards = snap.get("store_shards")
+    if shards and (store_detail or st is None):
+        # per-shard store summary: each dynstore's own self-telemetry
+        for name in sorted(shards):
+            sd = shards[name]
+            if sd is None:
+                lines.append(f"  shard {name}: UNREACHABLE")
+                continue
+            fams = sd.get("families") or {}
+            hot = max(fams, key=lambda f: fams[f]["ops"]) if fams else None
+            lines.append(
+                f"  shard {name}: ops={int(sd.get('ops_total', 0))}"
+                + (f"  p99[{hot}]={_fmt_ms(fams[hot]['p99_s'])}"
+                   if hot else "")
+                + f"  keys={int(sd.get('keys_total', 0))}"
+                f"  watches={int(sd.get('watches', 0))}"
+                f"  leases={int(sd.get('leases', 0))}"
+                f"  conns={int(sd.get('conns', 0))}")
+    rg = snap.get("regions")
+    if rg:
+        lines.append(
+            f"regions: aggs={rg.get('aggregators', 0)}"
+            + (f"(+{rg['stale']} stale)" if rg.get("stale") else "")
+            + f"  workers={rg.get('workers', 0)} "
+            f"({rg.get('workers_min', 0)}..{rg.get('workers_max', 0)}"
+            f"/region)  merge_p50={_fmt_ms(rg.get('merge_p50_s'))} "
+            f"p99={_fmt_ms(rg.get('merge_p99_s'))}  "
+            f"age_max={rg.get('age_max_s', 0.0):.1f}s")
     fleet = snap.get("fleet") or {}
     if fleet:
         lines.append("fleet:")
@@ -449,10 +526,10 @@ def render(snap: Dict, store_detail: bool = False) -> str:
 # drivers
 # ---------------------------------------------------------------------------
 async def run_once(args) -> str:
-    from ..runtime.store_client import StoreClient
+    from ..runtime.scale.shards import make_store_client
 
     host, port = args.store.split(":")
-    store = StoreClient(host, int(port))
+    store = make_store_client(host, int(port))
     await store.connect()
     try:
         snap = await ClusterSnapshotter(
@@ -464,10 +541,10 @@ async def run_once(args) -> str:
 
 
 async def _loop_plain(args) -> None:
-    from ..runtime.store_client import StoreClient
+    from ..runtime.scale.shards import make_store_client
 
     host, port = args.store.split(":")
-    store = StoreClient(host, int(port))
+    store = make_store_client(host, int(port))
     await store.connect()
     snapper = ClusterSnapshotter(store, args.namespace,
                                  args.component or ["backend", "prefill"])
@@ -486,10 +563,10 @@ async def _loop_plain(args) -> None:
 async def _loop_curses(args) -> None:
     import curses
 
-    from ..runtime.store_client import StoreClient
+    from ..runtime.scale.shards import make_store_client
 
     host, port = args.store.split(":")
-    store = StoreClient(host, int(port))
+    store = make_store_client(host, int(port))
     await store.connect()
     snapper = ClusterSnapshotter(store, args.namespace,
                                  args.component or ["backend", "prefill"])

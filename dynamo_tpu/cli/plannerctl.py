@@ -19,7 +19,7 @@ import json
 import time
 
 from ..planner.loop import decisions_prefix, override_key, state_key
-from ..runtime.store_client import StoreClient
+from ..runtime.scale.shards import make_store_client
 from ..utils.dynconfig import EnvDefaultsParser
 
 
@@ -56,7 +56,7 @@ async def _load_override(store, ns: str) -> dict:
 
 async def run(args) -> int:
     host, port = args.store.split(":")
-    store = await StoreClient(host, int(port)).connect()
+    store = await make_store_client(host, int(port)).connect()
     ns = args.namespace
     try:
         if args.action == "status":
@@ -70,7 +70,8 @@ async def run(args) -> int:
             mode = "DRY-RUN" if st.get("dry_run") else "live"
             flags = [mode, f"policy={st.get('policy')}",
                      f"connector={st.get('connector')}",
-                     f"clamps={st.get('clamps')}"]
+                     f"clamps={st.get('clamps')}",
+                     f"signals={st.get('signal_source', 'flat')}"]
             if st.get("fleet"):
                 flags.append("FLEET")
             if st.get("paused"):

@@ -27,6 +27,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from ..runtime.scale.shards import make_store_client
 from ..runtime.store_client import StoreClient
 from .crd import DEPLOY_PREFIX, Deployment, SpecError, deploy_key, status_key
 
@@ -69,7 +70,7 @@ class ApiStore:
         return app
 
     async def start(self) -> int:
-        self.client = await StoreClient(self.store_host,
+        self.client = await make_store_client(self.store_host,
                                         self.store_port).connect()
         self._runner = web.AppRunner(self._build_app())
         await self._runner.setup()

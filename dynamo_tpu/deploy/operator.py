@@ -28,6 +28,7 @@ import sys
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from ..runtime.scale.shards import make_store_client
 from ..runtime.store_client import StoreClient
 from .crd import (
     DEPLOY_PREFIX,
@@ -134,7 +135,7 @@ class Operator:
 
     # ------------------------------------------------------------------
     async def start(self) -> "Operator":
-        self.client = await StoreClient(self.store_host,
+        self.client = await make_store_client(self.store_host,
                                         self.store_port).connect()
         await self.client.watch_prefix(DEPLOY_PREFIX, self._on_event)
         for key, value in await self.client.get_prefix(DEPLOY_PREFIX):

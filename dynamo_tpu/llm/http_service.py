@@ -113,6 +113,9 @@ class HttpService:
         # (cli/http discovery mode): /metrics must skip its own published
         # key or the scrape would merge this process's counters twice
         self.stage_worker_id: Optional[int] = None
+        # queue-until-boot (DYN_BOOT_WAIT): requests currently parked at
+        # ingress waiting for a scaled-to-zero model's replica to boot
+        self._boot_parked = 0
         self.stage = stage_metrics()
         self.registry = Registry()
         m = self.registry
@@ -382,9 +385,7 @@ class HttpService:
                 return _err_engine(shed)
             req["dyn_kv_cost"] = kv_cost
         model_name = oai_req.model
-        served = self.manager.get(model_name)
-        engine = served and (served.chat_engine if endpoint == "chat"
-                             else served.completion_engine)
+        engine = self._engine_for(model_name, endpoint)
         if engine is None:
             # label with a constant to keep metric cardinality bounded
             # (model names of 404s are client-controlled) — EXCEPT for
@@ -392,10 +393,29 @@ class HttpService:
             # planner's scale-from-zero wake signal
             known = self.known_models() if self.known_models else ()
             label = model_name if model_name in known else "unknown"
-            self._count(label, endpoint, "404", tenant)
-            return _err(404, f"model {model_name!r} not found"
-                        + (" (registered, no live replica — booting or "
-                           "scaled to zero)" if label != "unknown" else ""))
+            if label != "unknown":
+                # queue-until-boot (DYN_BOOT_WAIT): park the request,
+                # bounded and deadline-aware, until the wake signal has
+                # booted a replica — scale-from-zero then costs latency
+                # instead of a 404 retry storm
+                t_park = time.monotonic()
+                engine, shed = await self._queue_until_boot(
+                    model_name, endpoint, timeout)
+                if shed is not None:
+                    self._count(label, endpoint, str(shed.code), tenant)
+                    return _err_engine(shed)
+                if engine is not None and timeout is not None:
+                    # the park spent part of the request's end-to-end
+                    # budget; the serve gets the remainder, never a
+                    # fresh full window
+                    timeout = max(timeout - (time.monotonic() - t_park),
+                                  0.05)
+            if engine is None:
+                self._count(label, endpoint, "404", tenant)
+                return _err(404, f"model {model_name!r} not found"
+                            + (" (registered, no live replica — booting "
+                               "or scaled to zero)"
+                               if label != "unknown" else ""))
 
         # end-to-end deadline (x-request-timeout header, DYN_REQUEST_TIMEOUT
         # default): every downstream hop sees it via the context / wire
@@ -471,6 +491,65 @@ class HttpService:
             self._count(model_name, endpoint, status, tenant)
             self.m_duration.observe(model_name, endpoint,
                                     value=time.monotonic() - started)
+
+    def _engine_for(self, model_name: str,
+                    endpoint: str) -> Optional[AsyncEngine]:
+        served = self.manager.get(model_name)
+        if served is None:
+            return None
+        return (served.chat_engine if endpoint == "chat"
+                else served.completion_engine)
+
+    async def _queue_until_boot(self, model_name: str, endpoint: str,
+                                timeout: Optional[float]):
+        """Park a request for a fleet-registered model with no live
+        replica until one boots: ``(engine, None)`` when a replica
+        appeared, ``(None, shed)`` for a typed 503 (park window expired
+        while still booting, or the bounded park queue is full), and
+        ``(None, None)`` when the feature is off (caller 404s as
+        before). Parks are counted per model
+        (``dyn_queue_until_boot_total``) and feed the planner's
+        unserved-demand wake signal exactly like the 404s they
+        replace."""
+        from ..utils.knobs import env_float
+
+        wait_s = env_float("DYN_BOOT_WAIT", 0.0, minimum=0.0)
+        if wait_s <= 0:
+            return None, None
+        # deadline-aware: never park past the request's own budget
+        # (leave a slice of it for the actual serve)
+        if timeout is not None:
+            wait_s = min(wait_s, max(timeout * 0.8, 0.0))
+        max_parked = int(env_float("DYN_BOOT_WAIT_QUEUE", 64, minimum=0))
+        qub = self.stage.queue_until_boot
+        if self._boot_parked >= max_parked:
+            qub.inc(model_name, "overflow")
+            return None, EngineError(
+                f"model {model_name!r} is booting and the park queue is "
+                f"full ({max_parked} requests already waiting)", 503,
+                stage="ingress", reason="boot_queue_full",
+                retry_after=2.0)
+        qub.inc(model_name, "parked")
+        self._boot_parked += 1
+        try:
+            deadline = time.monotonic() + wait_s
+            while True:
+                engine = self._engine_for(model_name, endpoint)
+                if engine is not None:
+                    qub.inc(model_name, "served")
+                    return engine, None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                await asyncio.sleep(min(0.25, remaining))
+        finally:
+            self._boot_parked -= 1
+        qub.inc(model_name, "expired")
+        return None, EngineError(
+            f"model {model_name!r} has no live replica after waiting "
+            f"{wait_s:.1f}s for boot (registered — scale-from-zero in "
+            f"progress)", 503, stage="ingress", reason="booting",
+            retry_after=2.0)
 
     async def _stream(self, req: web.Request, engine: AsyncEngine, oai_req,
                       ctx: Context, model: str, endpoint: str,

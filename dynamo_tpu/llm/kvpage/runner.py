@@ -34,6 +34,7 @@ from __future__ import annotations
 import collections
 import logging
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Deque, List, Optional, Tuple
 
@@ -146,6 +147,14 @@ class PagedEngine:
         self.active: Optional[_PagedSeq] = None
         self.queue: Deque[Tuple[str, BackendInput]] = collections.deque()
         self._worker = str(os.getpid())
+        # goodput accounting: paged dispatches feed the engine's shared
+        # GoodputMeter so MFU/MBU stop under-reporting on long-context
+        # traffic. The paged programs compile per (kind, hot-bucket)
+        # shape with no instrument_compile wrapper, so first-use shapes
+        # are tracked here and their work units excluded — same
+        # compile-not-compute convention as the dense path's
+        # _take_compiled_flag.
+        self._accounted_shapes: set = set()
         # hot-span shape buckets (page multiples, powers of two) keep the
         # attn_hot program count logarithmic in the budget
         self.s_hot_buckets: List[int] = []
@@ -400,6 +409,17 @@ class PagedEngine:
                 return b
         return self.s_hot_buckets[-1]
 
+    def _account(self, kind: str, S: int, flops: float, bytes_: float,
+                 tokens: int, elapsed_s: float) -> None:
+        """Feed one paged work unit into the engine's GoodputMeter —
+        unless this (kind, hot-bucket) shape just compiled, in which
+        case the wall time is XLA, not compute."""
+        shape = (kind, S)
+        if shape not in self._accounted_shapes:
+            self._accounted_shapes.add(shape)
+            return
+        self.core.goodput.account(flops, bytes_, elapsed_s, tokens)
+
     def _upload(self, key) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """Take one assembled staging segment and ENQUEUE its h2d upload;
         returns device arrays the attention dispatch consumes."""
@@ -477,6 +497,7 @@ class PagedEngine:
     def _prefill_chunk(self, seq: _PagedSeq, out: List) -> None:
         from ...engine.engine import StepOutput
 
+        t_disp = time.perf_counter()
         C = self.chunk
         prompt = seq.prompt
         start = seq.prefill_done
@@ -502,8 +523,18 @@ class PagedEngine:
         # demote beyond the hot window now that the writes are enqueued
         self._demote(seq, self.hot_keep)
         if not is_last:
+            from ...utils.roofline import prefill_cost
+
+            fl, by, tk = prefill_cost(self.core.costs, [(start, count)])
+            self._account("prefill", S, fl, by, tk,
+                          time.perf_counter() - t_disp)
             return
         tok, lp = self._sample(seq, x, count - 1)
+        from ...utils.roofline import prefill_cost
+
+        fl, by, tk = prefill_cost(self.core.costs, [(start, count)])
+        self._account("prefill", S, fl, by, tk,
+                      time.perf_counter() - t_disp)
         seq.generated = 1
         seq.last_token = tok
         seq.cum_logprob = lp
@@ -517,6 +548,7 @@ class PagedEngine:
     def _decode_step(self, seq: _PagedSeq, out: List) -> None:
         from ...engine.engine import StepOutput
 
+        t_disp = time.perf_counter()
         pos = seq.total_len
         self._ensure_resident(seq, pos + 1)
         if len(seq.resident) > self.pcfg.budget:
@@ -531,6 +563,11 @@ class PagedEngine:
         seq.tokseq.append(int(seq.last_token))
         seq.total_len = pos + 1
         tok, lp = self._sample(seq, x, 0)
+        from ...utils.roofline import decode_cost
+
+        fl, by, tk = decode_cost(self.core.costs, [pos], 1)
+        self._account("decode", S, fl, by, tk,
+                      time.perf_counter() - t_disp)
         seq.generated += 1
         seq.last_token = tok
         seq.cum_logprob += lp

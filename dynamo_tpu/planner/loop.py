@@ -326,6 +326,10 @@ class Planner:
             "overrides": self.core.overrides,
             "clamps": [self.config.min_replicas, self.config.max_replicas],
             "fleet": self.fleet is not None,
+            # which observer path fed the last signals: "region" (the
+            # hierarchical aggregator tree's pre-merged records) or
+            # "flat" (the per-worker prefix scan fallback)
+            "signal_source": self.collector.last_source,
             "pools": {
                 pool: {
                     "component": comp,

@@ -187,6 +187,26 @@ def model_request_count(states: Iterable[Tuple[str, Dict]], model: str,
     return total
 
 
+def model_parked_count(states: Iterable[Tuple[str, Dict]],
+                       model: str) -> float:
+    """Cumulative queue-until-boot parks for one model
+    (``dyn_queue_until_boot_total{model,outcome="parked"}``): a parked
+    request never produced the 404 the wake signal was built on, so the
+    unserved-demand delta must count it too or parking would starve the
+    very boot it waits for."""
+    total = 0.0
+    for _component, dump in states:
+        st = dump.get("dyn_queue_until_boot_total")
+        if not st or st.get("kind") != "counter":
+            continue
+        for skey, val in st.get("series", {}).items():
+            parts = skey.split("\x1f")
+            if len(parts) >= 2 and parts[0] == model \
+                    and parts[1] == "parked":
+                total += val
+    return total
+
+
 def open_instance_ids(states: Iterable[Tuple[str, Dict]]) -> Set[str]:
     """Hex instance ids at least one observer's exported
     ``dyn_circuit_state`` series currently marks OPEN (value 2) — shared
@@ -228,6 +248,10 @@ class SignalCollector:
         self.namespace = namespace
         self.pools = dict(pools)
         self.endpoint = endpoint
+        # which path fed the last collect(): "region" when live regional
+        # aggregators' pre-merged records served the scrape, "flat" when
+        # the per-worker prefix scan did (plannerctl reports this)
+        self.last_source = "flat"
         # SLO burn monitor over the same stage dumps: its gauges land on
         # the planner's stage registry (published with the dyn_planner_*
         # series), its breach log feeds PoolSignals.slo_burn
@@ -273,15 +297,28 @@ class SignalCollector:
         return sorted(ids)
 
     async def _fetch_stage(self) -> Tuple[List[Tuple[str, Dict]],
-                                          Dict[str, Set[int]]]:
-        """One scan of the namespace's stage-metrics prefix yielding BOTH
-        the ``(component, state_dump)`` pairs (quantiles, breaker state)
-        and the per-component worker-id sets (liveness) — the dumps are
+                                          Dict[str, Set[int]],
+                                          Optional["object"]]:
+        """One scan of the namespace's stage-metrics prefix yielding the
+        ``(component, state_dump)`` pairs (quantiles, breaker state), the
+        per-component worker-id sets (liveness), and — when the region
+        plane served the read — the per-component ForwardPassMetrics
+        maps, sparing the per-pool ``metrics/`` scans too. The dumps are
         multi-KB, so fetching them once per tick instead of 1+P times
-        matters on a standing daemon."""
+        matters on a standing daemon; at fleet scale the regional
+        aggregators' R pre-merged records replace the N-worker scan
+        entirely (flat fallback when no fresh region exists)."""
         from ..llm.metrics_aggregator import (merge_stage_items,
                                               stage_base_key)
+        from ..runtime.scale.regions import fetch_region_states
 
+        regional = await fetch_region_states(self.store, self.namespace)
+        if regional is not None:
+            self.last_source = "region"
+            return (regional.states,
+                    {c: set(ids) for c, ids in regional.ids.items()},
+                    regional)
+        self.last_source = "flat"
         states: List[Tuple[str, Dict]] = []
         ids: Dict[str, Set[int]] = {}
         prefix = f"{STAGE_PREFIX}{self.namespace}/"
@@ -305,7 +342,7 @@ class SignalCollector:
         for base, (d, metrics) in merge_stage_items(items).items():
             if base in valid:
                 states.append((d.get("component") or valid[base], metrics))
-        return states, ids
+        return states, ids, None
 
     def _shed_rate(self, stage_states) -> float:
         total = shed_totals(stage_states)
@@ -320,7 +357,7 @@ class SignalCollector:
         return rate
 
     async def collect(self) -> Dict[str, PoolSignals]:
-        stage_states, stage_ids = await self._fetch_stage()
+        stage_states, stage_ids, regional = await self._fetch_stage()
         if self.slo.objectives:
             self.slo.observe(stage_states)
         slo_burn = self.slo.max_burn()
@@ -335,8 +372,11 @@ class SignalCollector:
                 pass
         out: Dict[str, PoolSignals] = {}
         for pool, component in self.pools.items():
-            workers = await fetch_worker_metrics(self.store, self.namespace,
-                                                 component)
+            if regional is not None:
+                workers = regional.workers_for(component)
+            else:
+                workers = await fetch_worker_metrics(
+                    self.store, self.namespace, component)
             ids = await self.live_instances(
                 component,
                 known=set(workers) | stage_ids.get(component, set()))
@@ -412,10 +452,12 @@ class SignalCollector:
 
     def _unserved_delta(self, pool: str, model: str, stage_states,
                         replicas: int) -> float:
-        """Requests that 404'd on this model since the last tick, counted
-        only while the pool is at zero replicas (once a replica serves,
-        stale 404s from the boot race must not keep inflating demand)."""
-        total = model_request_count(stage_states, model, "404")
+        """Requests that 404'd on — or were parked at ingress waiting
+        for — this model since the last tick, counted only while the
+        pool is at zero replicas (once a replica serves, stale 404s from
+        the boot race must not keep inflating demand)."""
+        total = (model_request_count(stage_states, model, "404")
+                 + model_parked_count(stage_states, model))
         prev = self._unserved_prev.get(pool)
         self._unserved_prev[pool] = total
         if replicas > 0 or prev is None:

@@ -161,7 +161,11 @@ class DistributedRuntime:
 
     def __init__(self, store_host: str = "127.0.0.1", store_port: int = 4222,
                  advertise_host: Optional[str] = None):
-        self.store = StoreClient(store_host, store_port)
+        # DYN_STORE_SHARDS set => a ShardedStoreClient routing each
+        # keyspace family to its owning dynstore; unset => the plain
+        # single-store client (identical behavior)
+        from .scale.shards import make_store_client
+        self.store = make_store_client(store_host, store_port)
         self.lease: Optional[int] = None
         self.worker_id: int = 0
         self._advertise_host = advertise_host

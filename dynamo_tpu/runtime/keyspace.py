@@ -34,6 +34,18 @@ PERSISTENT = "persistent"  # lives until an explicit delete
 TTL = "ttl"                # bound to a short no-keepalive lease
 QUEUE = "queue"            # dynstore work queue (q_push/q_pull namespace)
 
+#: shard ownership groups: the family sets that co-locate when the store
+#: is split across dynstore processes (``DYN_STORE_SHARDS`` tokens may
+#: name a group instead of listing its families one by one — see
+#: runtime/scale/shards.py). The boundaries follow the per-family op
+#: accounting (``dyn_store_op_seconds{family}``): write-heavy telemetry
+#: and the TTL-churning span sink are the planes worth isolating first.
+SHARD_CONTROL = "control"      # discovery/config/planner — low rate, hot
+SHARD_TELEMETRY = "telemetry"  # metrics dumps + region records — high write
+SHARD_TRACES = "traces"        # span sink — highest key churn (TTL)
+SHARD_QUEUE = "queue"          # prefill work queues — latency-critical
+SHARD_KV = "kv"                # KV-cluster registry — router-read-heavy
+
 
 @dataclass(frozen=True)
 class KeyFamily:
@@ -51,6 +63,9 @@ class KeyFamily:
     helpers: Tuple[str, ...] = ()
     #: module-level constants naming the prefix
     constants: Tuple[str, ...] = ()
+    #: shard ownership group (see SHARD_* above): which dynstore process
+    #: serves this family when DYN_STORE_SHARDS splits the keyspace
+    shard: str = SHARD_CONTROL
 
 
 _ALL: List[KeyFamily] = [
@@ -77,7 +92,7 @@ _ALL: List[KeyFamily] = [
         description="per-worker ForwardPassMetrics snapshots (slots, KV "
                     "occupancy, hit rate) scraped by router/planner",
         prefix="metrics/", helpers=("metrics_key",),
-        constants=("METRICS_PREFIX",)),
+        constants=("METRICS_PREFIX",), shard=SHARD_TELEMETRY),
     KeyFamily(
         name="metrics-stage",
         pattern="metrics_stage/{ns}/{component}/{worker_id:x}[/delta]",
@@ -86,7 +101,7 @@ _ALL: List[KeyFamily] = [
                     "cluster-wide by the metrics aggregator (full "
                     "snapshot + coalesced since-last-full delta key)",
         prefix="metrics_stage/", helpers=("stage_key", "stage_delta_key"),
-        constants=("STAGE_PREFIX",)),
+        constants=("STAGE_PREFIX",), shard=SHARD_TELEMETRY),
     KeyFamily(
         name="metrics-store",
         pattern="metrics_stage/_store/store/0",
@@ -95,7 +110,8 @@ _ALL: List[KeyFamily] = [
                     "keyspace family, watch/lease/key gauges), written "
                     "into its KV by the server itself; dies with the "
                     "store process",
-        prefix="metrics_stage/_store/", constants=("STORE_STAGE_PREFIX",)),
+        prefix="metrics_stage/_store/", constants=("STORE_STAGE_PREFIX",),
+        shard=SHARD_TELEMETRY),
     KeyFamily(
         name="fleet-soak",
         pattern="fleet/{ns}/beacon",
@@ -104,7 +120,8 @@ _ALL: List[KeyFamily] = [
                     "timestamped payload, every synthetic worker watches "
                     "the prefix and reports delivery lag",
         prefix="fleet/", helpers=("fleet_beacon_key",
-                                  "fleet_beacon_prefix")),
+                                  "fleet_beacon_prefix"),
+        shard=SHARD_TELEMETRY),
     KeyFamily(
         name="fleet-models",
         pattern="fleet_models/{ns}/{model}",
@@ -150,7 +167,7 @@ _ALL: List[KeyFamily] = [
         description="cross-process span sink (TTL-leased, rotated at "
                     "ttl/2) read by GET /v1/traces/{request_id}",
         prefix="traces/", helpers=("trace_store_key",),
-        constants=("TRACE_STORE_PREFIX",)),
+        constants=("TRACE_STORE_PREFIX",), shard=SHARD_TRACES),
     KeyFamily(
         name="planner",
         pattern="planner/{ns}/(state|override|decisions/{seq:016d})",
@@ -170,7 +187,7 @@ _ALL: List[KeyFamily] = [
                     "hashes) watched by routers for cluster-hit scoring; "
                     "dead owners' records vanish with their lease",
         prefix="kv_cluster/", helpers=("cluster_key", "cluster_prefix"),
-        constants=("KV_CLUSTER_PREFIX",)),
+        constants=("KV_CLUSTER_PREFIX",), shard=SHARD_KV),
     KeyFamily(
         name="disagg-config",
         pattern="disagg/{ns}/{model}",
@@ -186,14 +203,28 @@ _ALL: List[KeyFamily] = [
         owner="llm/disagg.py", lifecycle=QUEUE,
         description="per-priority remote-prefill work queues (interactive "
                     "keeps the legacy unsuffixed name)",
-        helpers=("prefill_queue_name", "prefill_queue_names")),
+        helpers=("prefill_queue_name", "prefill_queue_names"),
+        shard=SHARD_QUEUE),
     KeyFamily(
         name="prefill-cancel",
         pattern="{ns}.prefill/cancelled/{request_id}",
         owner="llm/disagg.py", lifecycle=TTL,
         description="cancellation tombstones letting prefill workers drop "
                     "dequeued jobs nobody waits for (TTL-leased)",
-        helpers=("_cancel_key",)),
+        helpers=("_cancel_key",), shard=SHARD_QUEUE),
+    KeyFamily(
+        name="regions",
+        pattern="regions/{ns}/{agg_id:x}",
+        owner="runtime/scale/regions.py", lifecycle=LEASE,
+        description="hierarchical observer tree: one lease-bound record "
+                    "per regional aggregator (pre-merged stage metrics + "
+                    "ForwardPassMetrics of its rendezvous-owned workers), "
+                    "read by fetch_stage_states / planner / SLO / dyntop "
+                    "instead of the flat per-worker scrape; a dead "
+                    "aggregator's record vanishes with its lease and the "
+                    "surviving peers re-absorb its workers",
+        prefix="regions/", helpers=("region_key", "regions_prefix"),
+        constants=("REGIONS_PREFIX",), shard=SHARD_TELEMETRY),
     KeyFamily(
         name="deployments",
         pattern="deploy/deployments/{ns}/{name}",
@@ -241,6 +272,25 @@ def family_for_literal(head: str) -> Optional[KeyFamily]:
         if head.startswith(prefix) or prefix.startswith(head):
             return fam
     return None
+
+
+def families_for_prefix(prefix: str) -> List[str]:
+    """Every family a ``get_prefix``/``watch_prefix`` over ``prefix``
+    could touch — the sharded store client's fan-out set (a scan may
+    span families: ``metrics_stage/`` covers both ``metrics-stage`` and
+    ``metrics-store``). Falls back to the placeholder-led patterns like
+    :func:`classify_key`; an unmatchable prefix returns ``["other"]``
+    (routed to the default shard), and the EMPTY prefix scans every
+    family."""
+    if prefix == "":
+        return [f.name for f in _ALL] + ["other"]
+    out = [fam.name for p, fam in PREFIXES
+           if p.startswith(prefix) or prefix.startswith(p)]
+    if "/components/" in prefix:
+        out.append("endpoints")
+    if not out:
+        out.append("other")
+    return out
 
 
 def classify_key(key: str) -> str:
@@ -301,12 +351,21 @@ def render_markdown(wire_fields: Optional[Dict[str, str]] = None) -> str:
         "work",
         "queues rather than KV keys.",
         "",
-        "| family | key pattern | owner | lifecycle | description |",
-        "|---|---|---|---|---|",
+        "The **shard** column is the family's ownership group when the",
+        "store is split across dynstore processes: a `DYN_STORE_SHARDS`",
+        "token may name a group to route all of its families to one",
+        "shard (see [observability](observability.md) § Scale "
+        "plane).",
+        "Unrouted families (and the `other` fallback) stay on the",
+        "default store.",
+        "",
+        "| family | key pattern | owner | lifecycle | shard | "
+        "description |",
+        "|---|---|---|---|---|---|",
     ]
     for f in sorted(_ALL, key=lambda f: f.name):
         out.append(f"| `{f.name}` | `{f.pattern}` | {f.owner} | "
-                   f"{f.lifecycle} | {f.description} |")
+                   f"{f.lifecycle} | {f.shard} | {f.description} |")
     out.extend([
         "",
         f"{len(_ALL)} key families registered.",

@@ -472,8 +472,16 @@ class StoreClient:
 
     # -- leases ----------------------------------------------------------
     async def lease_grant(self, ttl: float = 5.0,
-                          auto_keepalive: bool = True) -> int:
-        r = await self._call("lease_grant", ttl=ttl)
+                          auto_keepalive: bool = True,
+                          reuse: Optional[int] = None) -> int:
+        """Grant a lease; ``reuse`` asks the server for a SPECIFIC id —
+        how a sharded store mirrors one session lease onto every shard
+        (and how session replay preserves identity). A server that
+        cannot honor it returns its own id; the caller must check."""
+        kw = {"ttl": ttl}
+        if reuse is not None:
+            kw["reuse"] = int(reuse)
+        r = await self._call("lease_grant", **kw)
         lease = r["lease"]
         if auto_keepalive:
             # kept-alive leases are SESSION leases: re-granted (same id)

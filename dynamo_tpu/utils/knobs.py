@@ -168,6 +168,14 @@ _ALL: List[Knob] = [
     _k("DYN_TENANT_AVAILABILITY", "float", "", "overload",
        "per-tenant good-request fraction objective (e.g. 0.99); when "
        "set, the worst tenant's burn also steps the brownout ladder"),
+    _k("DYN_BOOT_WAIT", "float", "0", "multi_model",
+       "queue-until-boot: max seconds a request for a fleet-registered "
+       "scaled-to-zero model parks at HTTP ingress waiting for a "
+       "replica to boot, bounded by the request deadline "
+       "(0 = off, immediate 404 as before)"),
+    _k("DYN_BOOT_WAIT_QUEUE", "int", "64", "multi_model",
+       "max requests parked by queue-until-boot at once; beyond it "
+       "requests get an immediate typed 503 (boot_queue_full)"),
     # --------------------------------------------------------- multi-model
     _k("DYN_FLEET_PREEMPT_MARGIN", "float", "0.5", "multi_model",
        "SLO-burn advantage a model needs before the chip arbiter "
@@ -231,6 +239,18 @@ _ALL: List[Knob] = [
     _k("DYN_STORE_METRICS_INTERVAL", "float", "2.0", "store",
        "seconds between the store server's self-telemetry dumps into its "
        "own KV (0 = record but never publish)"),
+    _k("DYN_STORE_SHARDS", "str", "", "store",
+       "static store shard map routing keyspace families/groups to "
+       "extra dynstore processes, e.g. "
+       "'telemetry=10.0.0.2:4222;traces=10.0.0.3:4222' (unset = the "
+       "single default store; unrouted families stay on it)"),
+    # --------------------------------------------------------------- scale
+    _k("DYN_REGION_INTERVAL", "float", "2.0", "store",
+       "seconds between a regional aggregator's pre-merge ticks (one "
+       "region record published per tick)"),
+    _k("DYN_REGION_STALE", "float", "3*interval", "store",
+       "age in seconds beyond which observers treat a region record as "
+       "dead and fall back to the flat per-worker scrape"),
     _k("DYN_LOG", "str", "info", "logging",
        "root log level, with per-target overrides "
        "('info,dynamo_tpu.runtime=debug')"),
@@ -357,6 +377,20 @@ _ALL.extend(
     _k(f"DYN_PLANNER_{flag}", typ, default, "planner", desc, derived=True)
     for flag, typ, default, desc in _PLANNER)
 
+# The regional aggregator daemon (cli/aggregator.py) resolves its flags
+# through the dynconfig layering as DYN_AGGREGATOR_<FLAG>.
+_AGGREGATOR = [
+    ("STORE", "str", "127.0.0.1:4222", "store host:port"),
+    ("NAMESPACE", "str", "dynamo", "namespace whose workers this "
+                                   "aggregator's region tree covers"),
+    ("INTERVAL", "float", "DYN_REGION_INTERVAL", "seconds between "
+                                                 "region merges"),
+]
+_ALL.extend(
+    _k(f"DYN_AGGREGATOR_{flag}", typ, default, "store", desc,
+       derived=True)
+    for flag, typ, default, desc in _AGGREGATOR)
+
 # The fleet-soak rig (scripts/fleet_soak.py) resolves its flags through
 # the same dynconfig layering as DYN_FLEET_SOAK_<FLAG>.
 _FLEET_SOAK = [
@@ -380,7 +414,18 @@ _FLEET_SOAK = [
     ("KNEE_MULT", "float", "4.0", "saturation-knee threshold: first step "
                                   "whose store op p99 exceeds this "
                                   "multiple of the first step's"),
-    ("OUT", "str", "bench_points/fleet_soak.json", "artifact path"),
+    ("OUT", "str", "bench_points/fleet_soak.json", "artifact path "
+                                                   "(hier mode defaults "
+                                                   "to fleet_soak_hier"
+                                                   ".json)"),
+    ("MODE", "str", "flat", "observer path under test: flat (per-worker "
+                            "scrape) or hier (regional aggregators + "
+                            "region records)"),
+    ("AGGREGATORS", "int", "4", "regional aggregator daemons spawned in "
+                                "hier mode"),
+    ("SHARDS", "int", "1", "dynstore processes: 2 adds a telemetry "
+                           "shard, 3 adds a traces shard too "
+                           "(DYN_STORE_SHARDS armed fleet-wide)"),
 ]
 _ALL.extend(
     _k(f"DYN_FLEET_SOAK_{flag}", typ, default, "fleet", desc, derived=True)

@@ -207,6 +207,76 @@ def diff_states(base: Dict[str, Dict], cur: Dict[str, Dict],
             if name not in skip and base.get(name) != st}
 
 
+#: gauges whose series describe a STATE (enum / worst-of), not a quantity:
+#: merging across publishers must take the max, never the sum — summing two
+#: observers' OPEN(2) circuit states would read as 4 and match no state
+GAUGE_MERGE_MAX = frozenset({"dyn_circuit_state", "dyn_brownout_level"})
+
+
+def merge_state_dumps(dumps: Iterable[Dict[str, Dict]],
+                      gauge_max: Iterable[str] = GAUGE_MERGE_MAX
+                      ) -> Dict[str, Dict]:
+    """Reduce many ``registry.state_dump()`` images into ONE equivalent
+    dump — the regional aggregator's pre-merge (runtime/scale/regions.py).
+
+    Merge rules match what every state-dump consumer already assumes:
+    counters and histogram counts/sums/totals add (so quantile/burn/total
+    math over the merged dump equals the same math over the originals);
+    gauges add too — per-worker gauges carry a worker/observer label, so
+    addition is concatenation — EXCEPT the state-enum gauges in
+    ``gauge_max``, which take the worst value. Metrics with mismatched
+    kind/labels/buckets across dumps keep the first image seen (same
+    skip-don't-corrupt rule as :func:`render_states`)."""
+    gauge_max = set(gauge_max)
+    out: Dict[str, Dict] = {}
+    for dump in dumps:
+        for name, st in dump.items():
+            if not isinstance(st, dict):
+                continue
+            cur = out.get(name)
+            if cur is None:
+                # deep-copy histogram series: the merge accumulates in
+                # place and must never mutate a caller's dump
+                series0 = {
+                    k: ({"counts": list(v.get("counts") or ()),
+                         "sum": v.get("sum", 0.0),
+                         "total": v.get("total", 0)}
+                        if st.get("kind") == "histogram" else v)
+                    for k, v in (st.get("series") or {}).items()}
+                out[name] = {**st, "series": series0}
+                continue
+            if (cur.get("kind") != st.get("kind")
+                    or list(cur.get("labels") or ()) != list(
+                        st.get("labels") or ())):
+                continue
+            kind = st.get("kind")
+            if kind == "histogram" and list(st.get("buckets") or ()) != \
+                    list(cur.get("buckets") or ()):
+                continue
+            series = cur["series"]
+            for skey, val in (st.get("series") or {}).items():
+                prev = series.get(skey)
+                if prev is None:
+                    series[skey] = ({"counts": list(val["counts"]),
+                                     "sum": val["sum"],
+                                     "total": val["total"]}
+                                    if kind == "histogram" else val)
+                elif kind == "histogram":
+                    if len(prev.get("counts") or ()) == len(
+                            val.get("counts") or ()):
+                        prev["counts"] = [a + b for a, b in zip(
+                            prev["counts"], val["counts"])]
+                        prev["sum"] += val["sum"]
+                        prev["total"] += val["total"]
+                elif kind == "counter":
+                    series[skey] = prev + val
+                elif name in gauge_max:
+                    series[skey] = max(prev, val)
+                else:
+                    series[skey] = prev + val
+    return out
+
+
 def hist_quantile(buckets, counts, total, q: float) -> Optional[float]:
     """Bucket upper edge covering quantile ``q`` of a state-dump
     histogram (conservative: the true value is <= the returned edge).
@@ -526,6 +596,28 @@ class StageMetrics:
             "Time the paged forward blocked waiting for a scheduled "
             "page-in to finish assembling (0 = fully overlapped)",
             (), buckets=LATENCY_BUCKETS_FAST)
+        # scale plane (runtime/scale/): the hierarchical observer tree's
+        # own health — region pre-merge cost per tick (the number the
+        # hierarchy exists to keep flat as the fleet grows) — and the
+        # sharded store client's per-shard degradation counter
+        self.region_merge = r.histogram(
+            "dyn_region_merge_seconds",
+            "One regional aggregator tick: scrape the owned workers' "
+            "stage dumps, pre-merge, publish the region record", (),
+            buckets=LATENCY_BUCKETS_FAST + (2.5, 10.0))
+        self.store_shard_errors = r.counter(
+            "dyn_store_shard_errors_total",
+            "Store calls that failed against one shard of a sharded "
+            "store (that shard's families degraded; others unaffected)",
+            ("shard",))
+        # queue-until-boot (llm/http_service.py): scale-from-zero requests
+        # parked at ingress until the planner boots a replica — parked is
+        # also the planner's wake signal (counted into PoolSignals.unserved
+        # alongside model-labelled 404s)
+        self.queue_until_boot = r.counter(
+            "dyn_queue_until_boot_total",
+            "Scale-from-zero requests parked at HTTP ingress by outcome "
+            "(parked|served|expired|overflow)", ("model", "outcome"))
 
     def clear_worker(self, worker: str) -> None:
         """Drop every per-worker gauge series for ``worker`` (pid). Wired

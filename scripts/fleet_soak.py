@@ -37,6 +37,19 @@ op p99 exceeds ``--knee-mult``× the first step's, above a noise floor)
 
     JAX_PLATFORMS=cpu python scripts/fleet_soak.py            # full ramp
     ... --workers 8 --steps 2 --step-duration 2 --traffic-rps 0   # mini
+    ... --mode hier --aggregators 4 --shards 2 --workers 1000     # scale
+
+**Modes** (the ``mode`` field on every observer-latency slice keeps
+flat/hier artifacts comparable in one plot):
+
+- ``flat`` (default): observers scrape every worker's dumps directly —
+  the path PR 9 proved saturates first (merge p50 0.3s → 2.8s).
+- ``hier``: ``--aggregators`` regional-aggregator daemons pre-merge the
+  fleet into region records (runtime/scale/regions.py) and the
+  observers read those; ``--shards`` > 1 additionally splits the store
+  by keyspace family (``DYN_STORE_SHARDS`` armed fleet-wide: 2 =
+  telemetry shard, 3 = + traces shard). Exit proof for the scale plane:
+  observer merge p50 stays flat (<0.5s) past the old knee.
 
 CPU-only, no model weights. The pytest mini run is tier-1; the full ramp
 is marked ``chaos`` + ``slow``.
@@ -117,11 +130,14 @@ class SyntheticWorker:
         from dynamo_tpu.llm.metrics_aggregator import (StagePublisher,
                                                        metrics_key)
         from dynamo_tpu.runtime.component import EndpointInfo, endpoint_key
-        from dynamo_tpu.runtime.store_client import StoreClient
+        from dynamo_tpu.runtime.scale.shards import make_store_client
         from dynamo_tpu.utils import tracing
         from dynamo_tpu.utils.prometheus import Registry
 
-        self.store = await StoreClient(self.host, self.port).connect()
+        # sharding-aware: with DYN_STORE_SHARDS armed each synthetic
+        # worker's planes land on their owning shards, like a real worker
+        self.store = await make_store_client(self.host,
+                                             self.port).connect()
         self.lease = await self.store.lease_grant(ttl=8.0)
         await self.store.put(
             endpoint_key(self.namespace, FLEET_COMPONENT, "generate",
@@ -219,13 +235,32 @@ class SyntheticWorker:
 # ---------------------------------------------------------------------------
 async def read_store_dump(store) -> Optional[Dict]:
     from dynamo_tpu.llm.metrics_aggregator import STORE_STAGE_PREFIX
+    from dynamo_tpu.utils.prometheus import merge_state_dumps
 
-    for _key, value in await store.get_prefix(STORE_STAGE_PREFIX):
-        try:
-            return json.loads(value.decode())["metrics"]
-        except (ValueError, KeyError):
-            log.warning("malformed store self-dump")
-    return None
+    dumps = []
+    if hasattr(store, "get_prefix_on"):
+        # sharded: every shard publishes its own self-dump under the
+        # same key in its own KV — the curve must sum all of them
+        for i in range(store.num_shards):
+            try:
+                items = await store.get_prefix_on(i, STORE_STAGE_PREFIX)
+            except Exception:
+                log.warning("shard %d store dump unreadable", i)
+                continue
+            for _key, value in items:
+                try:
+                    dumps.append(json.loads(value.decode())["metrics"])
+                except (ValueError, KeyError):
+                    log.warning("malformed store self-dump")
+    else:
+        for _key, value in await store.get_prefix(STORE_STAGE_PREFIX):
+            try:
+                dumps.append(json.loads(value.decode())["metrics"])
+            except (ValueError, KeyError):
+                log.warning("malformed store self-dump")
+    if not dumps:
+        return None
+    return dumps[0] if len(dumps) == 1 else merge_state_dumps(dumps)
 
 
 def _json_p99(p99: Optional[float], buckets) -> Optional[float]:
@@ -306,25 +341,83 @@ def find_knee(steps: List[Dict], knee_mult: float,
 
 
 # ---------------------------------------------------------------------------
+# the observer probe (its own process, like the real planner/dyntop)
+# ---------------------------------------------------------------------------
+async def run_observer_probe(store_addr: str, out_path: str,
+                             interval: float = 2.0) -> None:
+    """Tick the planner's SignalCollector and the dyntop snapshotter
+    against the store forever, appending one JSONL row per round:
+    ``{"t", "planner", "snapshot", "source"}`` (seconds per collect;
+    the driver slices rows into per-step percentiles). Runs as a
+    subprocess so the measurement reflects the observer path, not the
+    driver loop that hosts a thousand synthetic workers."""
+    from dynamo_tpu.cli.dyntop import ClusterSnapshotter
+    from dynamo_tpu.planner.signals import SignalCollector
+    from dynamo_tpu.runtime.scale.shards import make_store_client
+
+    host, port = store_addr.split(":")
+    store = await make_store_client(host, int(port)).connect()
+    collector = SignalCollector(store, NAMESPACE,
+                                {"fleet": FLEET_COMPONENT})
+    snapper = ClusterSnapshotter(store, NAMESPACE,
+                                 ["backend", FLEET_COMPONENT])
+    with open(out_path, "a") as f:
+        while True:
+            row: Dict[str, Any] = {"t": time.time()}
+            for name, coro in (("planner", collector.collect),
+                               ("snapshot", snapper.collect)):
+                t0 = time.monotonic()
+                try:
+                    await coro()
+                    row[name] = time.monotonic() - t0
+                except Exception:
+                    row[name] = None
+                    log.debug("%s probe tick failed", name,
+                              exc_info=True)
+            row["source"] = collector.last_source
+            f.write(json.dumps(row) + "\n")
+            f.flush()
+            await asyncio.sleep(interval)
+
+
+# ---------------------------------------------------------------------------
 # the ramp
 # ---------------------------------------------------------------------------
 async def run_soak(a, logdir: str) -> Dict[str, Any]:
     from chaos_soak import Procs, _free_port
 
-    from dynamo_tpu.cli.dyntop import ClusterSnapshotter
-    from dynamo_tpu.planner.signals import SignalCollector
-    from dynamo_tpu.runtime.store_client import StoreClient
+    from dynamo_tpu.runtime.scale.shards import make_store_client
     from dynamo_tpu.utils.prometheus import stage_metrics
 
     os.environ["DYN_TRACE_SAMPLE"] = str(a.trace_sample)
     os.environ["DYN_METRICS_PUSH_INTERVAL"] = "0"
     os.environ["DYN_SLO_TTFT_P90"] = "0.5"
     store_port = _free_port()
+    # shard plan: extra dynstore procs + the DYN_STORE_SHARDS map every
+    # process (driver, synthetic workers, aggregators, serving procs)
+    # resolves through make_store_client
+    shard_ports = [_free_port() for _ in range(max(a.shards, 1) - 1)]
+    shard_map = ""
+    if shard_ports:
+        entries = [f"telemetry=127.0.0.1:{shard_ports[0]}"]
+        if len(shard_ports) > 1:
+            entries.append(f"traces=127.0.0.1:{shard_ports[1]}")
+        shard_map = ";".join(entries)
+    os.environ["DYN_STORE_SHARDS"] = shard_map
     procs = Procs(logdir, store_port, namespace=NAMESPACE,
                   worker_extra=["--echo-slots", "8", "--register-model"],
                   env_extra={"DYN_TOKEN_ECHO_DELAY_MS": "10",
-                             "DYN_TRACE_SAMPLE": str(a.trace_sample)})
+                             "DYN_TRACE_SAMPLE": str(a.trace_sample),
+                             "DYN_STORE_SHARDS": shard_map})
     await asyncio.to_thread(procs.start_store)
+    for i, port in enumerate(shard_ports):
+        name = f"store-shard{i + 1}"
+        procs.workers[name] = procs._spawn(
+            name, "dynamo_tpu.runtime.store_server", "--impl", "python",
+            "--host", "127.0.0.1", "--port", str(port))
+        await asyncio.to_thread(procs._wait_log, procs.workers[name][1],
+                                "dynstore listening", 20,
+                                procs.workers[name][0])
 
     svc = None
     session = None
@@ -337,9 +430,26 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
     pending: set = set()
     steps_out: List[Dict[str, Any]] = []
 
-    store = await StoreClient("127.0.0.1", store_port).connect()
+    store = await make_store_client("127.0.0.1", store_port).connect()
+    probe_proc = None
+    probe_log = None
 
     try:
+        # hier mode: the regional aggregator daemons ARE the observer
+        # tree; the collectors below read their region records instead
+        # of the flat per-worker scrape
+        if a.mode == "hier":
+            for i in range(max(a.aggregators, 1)):
+                name = f"aggregator{i}"
+                procs.workers[name] = procs._spawn(
+                    name, "dynamo_tpu.cli.aggregator",
+                    "--store", f"127.0.0.1:{store_port}",
+                    "--namespace", NAMESPACE,
+                    "--interval", str(min(a.beat_interval, 2.0)))
+                await asyncio.to_thread(
+                    procs._wait_log, procs.workers[name][1],
+                    "regional aggregator serving", 30,
+                    procs.workers[name][0])
         base = None
         if a.traffic_rps > 0:
             import aiohttp
@@ -374,26 +484,36 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
                 raise RuntimeError("echo model never appeared")
 
         # observers: the planner signal collector and the dyntop/SLO
-        # snapshotter scrape the whole fleet; their latency is data
-        collector = SignalCollector(store, NAMESPACE,
-                                    {"fleet": FLEET_COMPONENT})
-        snapper = ClusterSnapshotter(store, NAMESPACE,
-                                     ["backend", FLEET_COMPONENT])
-        observer_lat = {"planner": [], "snapshot": []}
+        # snapshotter scrape the whole fleet; their latency is data.
+        # They run in their OWN process (like the real planner/dyntop
+        # daemons) — the driver's event loop is saturated hosting the
+        # synthetic fleet, and an in-loop observer would measure that
+        # starvation, not the merge path under test.
+        import subprocess
 
-        async def observer_loop():
-            while True:
-                for name, coro in (("planner", collector.collect),
-                                   ("snapshot", snapper.collect)):
-                    t0 = time.monotonic()
-                    try:
-                        await coro()
-                        observer_lat[name].append(
-                            time.monotonic() - t0)
-                    except Exception:
-                        log.debug("%s observer tick failed", name,
-                                  exc_info=True)
-                await asyncio.sleep(2.0)
+        probe_path = os.path.join(logdir, "observer_probe.jsonl")
+        probe_log = open(os.path.join(logdir, "observer_probe.log"), "wb")
+        probe_proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--observer-probe", "--probe-out", probe_path,
+             "--store", f"127.0.0.1:{store_port}"],
+            cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            stdout=probe_log, stderr=subprocess.STDOUT)
+
+        def probe_rows(t0: float, t1: float) -> List[Dict]:
+            rows = []
+            try:
+                with open(probe_path, "r") as f:
+                    for line in f:
+                        try:
+                            r = json.loads(line)
+                        except ValueError:
+                            continue
+                        if t0 <= r.get("t", 0) <= t1:
+                            rows.append(r)
+            except OSError:
+                pass
+            return rows
 
         beacon_seq = {"n": 0}
 
@@ -451,7 +571,6 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
                 t.add_done_callback(pending.discard)
                 await asyncio.sleep(1.0 / a.traffic_rps)
 
-        tasks.append(asyncio.create_task(observer_loop()))
         tasks.append(asyncio.create_task(beacon_loop()))
         if base is not None:
             tasks.append(asyncio.create_task(traffic_loop()))
@@ -469,9 +588,12 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
 
         targets = [max(1, round(a.workers * (i + 1) / a.steps))
                    for i in range(a.steps)]
-        print(f"fleet soak: ramp {targets} synthetic workers, "
+        print(f"fleet soak [{a.mode}]: ramp {targets} synthetic workers, "
               f"{a.step_duration}s/step, trace_sample={a.trace_sample}, "
-              f"logs {logdir}", flush=True)
+              f"shards={max(a.shards, 1)}"
+              + (f", aggregators={a.aggregators}" if a.mode == "hier"
+                 else "")
+              + f", logs {logdir}", flush=True)
 
         for target in targets:
             # spawn up to the target in connect bursts of 50
@@ -496,11 +618,12 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
             pipe0 = pipeline_counters()
             lag_mark = len(lag_sink)
             ttft_mark = len(ttfts)
-            obs_marks = {k: len(v) for k, v in observer_lat.items()}
             spans_mark = sum(w.spans_emitted for w in fleet)
             t_step = time.monotonic()
+            t_wall0 = time.time()
             await asyncio.sleep(a.step_duration)
             dt = time.monotonic() - t_step
+            step_obs = probe_rows(t_wall0, time.time())
             dump1 = await read_store_dump(store)
             pipe1 = pipeline_counters()
 
@@ -547,14 +670,22 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
                               "pushes_skipped")},
                 # per-step slices (like lags/ttfts/spans): cumulative
                 # history would let the fast early-step samples mask an
-                # observer that slowed down at fleet size
+                # observer that slowed down at fleet size. The mode
+                # stamp keeps pre/post scale-plane artifacts comparable
+                # in one plot; source records which path actually fed
+                # the collector this step (hier degrades to flat when
+                # every region record is stale).
                 "observer": {
+                    "mode": a.mode,
+                    "source": (step_obs[-1].get("source", "flat")
+                               if step_obs else None),
+                    "ticks": len(step_obs),
                     "planner_collect_p50_s": _percentile(
-                        observer_lat["planner"][obs_marks["planner"]:],
-                        0.50),
+                        [r["planner"] for r in step_obs
+                         if r.get("planner") is not None], 0.50),
                     "snapshot_p50_s": _percentile(
-                        observer_lat["snapshot"][obs_marks["snapshot"]:],
-                        0.50),
+                        [r["snapshot"] for r in step_obs
+                         if r.get("snapshot") is not None], 0.50),
                 },
                 "traffic": {
                     "ttft_p50_s": _percentile(step_ttfts, 0.50),
@@ -603,6 +734,15 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
                 http_retr["checked"] == 0
                 or http_retr["found"] == http_retr["checked"]),
         }
+        if a.mode == "hier":
+            # the scale-plane exit bar: region records fed the observers
+            # and the merge path stayed flat at the biggest step
+            last_obs = steps_out[-1]["observer"] if steps_out else {}
+            p50 = last_obs.get("planner_collect_p50_s")
+            verdicts["observer_region_fed"] = \
+                last_obs.get("source") == "region"
+            verdicts["observer_p50_flat"] = (p50 is not None
+                                             and p50 < 0.5)
         return {
             "config": {k: getattr(a, k) for k in vars(a)},
             "steps": steps_out,
@@ -613,6 +753,18 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
             "verdicts": verdicts,
         }
     finally:
+        if probe_proc is not None:
+            try:
+                probe_proc.terminate()
+                probe_proc.wait(timeout=5)
+            except Exception:
+                log.debug("observer probe teardown failed",
+                          exc_info=True)
+        if probe_log is not None:
+            try:
+                probe_log.close()
+            except Exception:  # noqa: BLE001 - teardown must not mask
+                log.debug("probe log close failed", exc_info=True)
         for t in tasks:
             t.cancel()
         if tasks:
@@ -664,9 +816,35 @@ def main(argv=None) -> int:
     ap.add_argument("--real-workers", type=int, default=2,
                     help="echo workers actually serving the traffic")
     ap.add_argument("--knee-mult", type=float, default=4.0)
+    ap.add_argument("--mode", choices=("flat", "hier"), default="flat",
+                    help="observer path: flat per-worker scrape, or "
+                         "hier regional-aggregator tree")
+    ap.add_argument("--aggregators", type=int, default=4,
+                    help="regional aggregator daemons in hier mode")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="dynstore processes (2 = telemetry shard, "
+                         "3 = + traces shard; DYN_STORE_SHARDS armed "
+                         "fleet-wide)")
     ap.add_argument("--out", default=os.path.join(
         REPO, "bench_points", "fleet_soak.json"))
+    # internal probe-mode flags (the driver spawns itself with these)
+    ap.add_argument("--observer-probe", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--probe-out", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--store", default="127.0.0.1:4222",
+                    help=argparse.SUPPRESS)
     a = ap.parse_args(argv)
+    if a.observer_probe:
+        try:
+            asyncio.run(run_observer_probe(a.store, a.probe_out))
+        except KeyboardInterrupt:
+            pass
+        return 0
+    if a.mode == "hier" and a.out == os.path.join(
+            REPO, "bench_points", "fleet_soak.json"):
+        # the two modes keep separate artifacts so the before/after
+        # curves survive side by side
+        a.out = os.path.join(REPO, "bench_points", "fleet_soak_hier.json")
     logdir = tempfile.mkdtemp(prefix="fleet_soak_")
     result = asyncio.run(run_soak(a, logdir))
     os.makedirs(os.path.dirname(a.out), exist_ok=True)

@@ -299,6 +299,7 @@ def test_classify_key_covers_every_registered_family():
         "fleet-models": "fleet_models/dynamo/llama-8b",
         "fleet-status": "fleet_status/dynamo/llama-8b",
         "kv-cluster": "kv_cluster/dynamo/backend/1a2b",
+        "regions": "regions/dynamo/1a2b",
     }
     # every registered family must have a classified example here — a new
     # family without classification coverage fails this test
@@ -447,3 +448,24 @@ def test_fleet_soak_full_ramp(tmp_path):
         last["spans"]["store_writes"], 1)
     assert art["verdicts"]["http_error_traces"]
     assert art["traffic"]["ok"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_soak_full_ramp_hier(tmp_path):
+    """The scale-plane acceptance ramp: 1000 synthetic workers with the
+    hierarchical observer tree + a telemetry store shard. Region records
+    must feed the observers and the merge p50 must stay under the 0.5s
+    bar at the biggest step (the flat path blows through it here)."""
+    art = _run_fleet_soak(
+        ["--mode", "hier", "--aggregators", "4", "--shards", "2",
+         "--workers", "1000", "--steps", "4", "--step-duration", "8",
+         "--out", str(tmp_path / "hier.json")],
+        timeout=900)
+    _assert_artifact_schema(art, expect_steps=4)
+    assert art["steps"][-1]["workers"] >= 1000
+    assert art["verdicts"]["observer_region_fed"]
+    assert art["verdicts"]["observer_p50_flat"]
+    assert art["knee"]["workers"] is None   # no store-op knee
+    for step in art["steps"]:
+        assert step["observer"]["mode"] == "hier"

@@ -110,6 +110,12 @@ def test_paged_matches_unpaged_at_16x_budget(paged_core, ref_tokens):
     assert core.kvpager.active is None            # released
     assert core.tiered.pinned_count() == 0        # pins dropped at finish
     assert faults_after_prefill == pager.faults   # nothing faulted since
+    # goodput: paged dispatches feed the engine's meter (first-use
+    # shapes excluded as compile-bearing, so with many chunks + decode
+    # steps the accounted count is large but below the work-unit count)
+    assert core.goodput.dispatches > 0
+    assert core.goodput.flops_total > 0 and core.goodput.bytes_total > 0
+    assert core.goodput.tokens_total > 0
 
 
 def test_paged_reserve_prefix_reuse(paged_core, ref_tokens):

@@ -1,0 +1,526 @@
+"""Scale plane (runtime/scale/): hierarchical observer tree + sharded
+store.
+
+Covers the tentpole's contracts with fakes/virtual state (no sleeps
+beyond real-store event settling):
+
+- rendezvous assignment is stable under membership churn (only the dead
+  member's workers move; a join steals an even slice);
+- a region record's pre-merged state answers every flat-scrape consumer
+  (histogram quantiles, SLO totals, breaker state, shed totals)
+  identically to merging the per-worker dumps;
+- the sharded client routes every registered keyspace family to its
+  owning shard, fans prefix scans out only across genuinely-spanning
+  shards, and mirrors leases so lease-bound puts land shard-locally;
+- one shard down degrades ONLY its families with the typed
+  StoreError(conn_lost), and partial fan-outs serve the survivors;
+- queue-until-boot parks a fleet-registered model's request until a
+  replica appears, bounded + deadline-aware, with typed 503s for
+  overflow/expiry (off by default: immediate 404 unchanged);
+- the aggregator daemon core over a real store: records published
+  lease-bound, peers re-absorb a dead region's workers, readers fall
+  back to flat when records go stale.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from dynamo_tpu.runtime.keyspace import (KEYSPACE, classify_key,
+                                         families_for_prefix)
+from dynamo_tpu.runtime.scale.rendezvous import (rendezvous_owner,
+                                                 rendezvous_shares)
+from dynamo_tpu.runtime.scale.shards import (ShardedStoreClient,
+                                             ShardSpec, make_store_client,
+                                             parse_shard_map)
+from dynamo_tpu.runtime.store_client import StoreClient, StoreError
+
+
+# ---------------------------------------------------------------------------
+# rendezvous
+# ---------------------------------------------------------------------------
+def test_rendezvous_stability_under_churn():
+    workers = list(range(1000, 2000))
+    members = ["a1", "a2", "a3", "a4", "a5"]
+    before = {w: rendezvous_owner(w, members) for w in workers}
+    # determinism across orderings
+    assert all(rendezvous_owner(w, list(reversed(members))) == before[w]
+               for w in workers[:50])
+    # member death: ONLY the dead member's workers move
+    after = {w: rendezvous_owner(w, [m for m in members if m != "a3"])
+             for w in workers}
+    for w in workers:
+        if before[w] != "a3":
+            assert after[w] == before[w]
+        else:
+            assert after[w] != "a3"
+    # join: steals roughly an even slice, moves nothing else
+    joined = {w: rendezvous_owner(w, members + ["a6"]) for w in workers}
+    moved = [w for w in workers if joined[w] != before[w]]
+    assert all(joined[w] == "a6" for w in moved)
+    assert 1000 / 6 * 0.5 < len(moved) < 1000 / 6 * 1.8
+
+    shares = rendezvous_shares(workers, members)
+    assert sorted(w for ws in shares.values() for w in ws) == workers
+    # balance: no member owns a wildly outsized share
+    sizes = [len(v) for v in shares.values()]
+    assert min(sizes) > 1000 / 5 * 0.5 and max(sizes) < 1000 / 5 * 1.7
+    assert rendezvous_owner(7, []) is None
+
+
+# ---------------------------------------------------------------------------
+# region pre-merge equivalence vs flat scrape
+# ---------------------------------------------------------------------------
+def _worker_dump(wid: int, err: bool = False):
+    """A realistic per-worker state dump: latency histogram, request
+    counter, per-observer breaker gauge, depth gauge."""
+    counts = [0] * 4
+    counts[wid % 4] = 3 + wid % 5
+    return {
+        "llm_ttft_seconds": {
+            "kind": "histogram", "labels": ["model"],
+            "buckets": [0.1, 0.5, 1.0, 5.0],
+            "series": {"echo": {"counts": counts, "sum": 1.0 * wid,
+                                "total": sum(counts)}}},
+        "dyn_http_requests_total": {
+            "kind": "counter", "labels": ["model", "endpoint", "status",
+                                          "tenant"],
+            "series": {f"echo\x1fcompletions\x1f"
+                       f"{'500' if err else '200'}\x1fdefault": 2.0}},
+        "dyn_circuit_state": {
+            "kind": "gauge", "labels": ["observer", "instance"],
+            "series": {f"{wid}\x1fdead1": 2.0 if err else 0.0}},
+        "dyn_admission_queue_depth": {
+            "kind": "gauge", "labels": [], "series": {"": 1.5}},
+        "dyn_brownout_level": {
+            "kind": "gauge", "labels": [], "series": {"": 2.0 if err
+                                                      else 0.0}},
+    }
+
+
+def test_region_merge_equals_flat_scrape():
+    from dynamo_tpu.planner.signals import (breaker_open_instances,
+                                            open_instance_ids,
+                                            quantile_from_states)
+    from dynamo_tpu.utils.overload import (admission_depth_total,
+                                           brownout_level_from_states)
+    from dynamo_tpu.utils.prometheus import merge_state_dumps
+    from dynamo_tpu.utils.slo import _availability_totals, _hist_totals
+
+    dumps = [_worker_dump(w, err=(w % 7 == 0)) for w in range(40)]
+    flat = [("backend", d) for d in dumps]
+    merged = [("backend", merge_state_dumps(dumps))]
+
+    assert quantile_from_states(flat, "llm_ttft_seconds", 0.9) == \
+        pytest.approx(quantile_from_states(merged, "llm_ttft_seconds",
+                                           0.9))
+    assert _hist_totals(flat, "llm_ttft_seconds", 0.5) == \
+        _hist_totals(merged, "llm_ttft_seconds", 0.5)
+    assert _availability_totals(flat, "dyn_http_requests_total") == \
+        _availability_totals(merged, "dyn_http_requests_total")
+    # state gauges merge by MAX: an OPEN(2) breaker stays exactly 2
+    assert open_instance_ids(merged) == open_instance_ids(flat) == \
+        {"dead1"}
+    assert breaker_open_instances(merged, [int("dead1", 16)]) == 1
+    assert brownout_level_from_states(merged) == \
+        brownout_level_from_states(flat) == 2
+    # quantity gauges merge by SUM (per-frontend depths add up)
+    assert admission_depth_total(merged) == \
+        pytest.approx(admission_depth_total(flat)) == \
+        pytest.approx(1.5 * 40)
+
+
+# ---------------------------------------------------------------------------
+# shard routing
+# ---------------------------------------------------------------------------
+#: one representative key per registered family — a NEW family must add
+#: its sample here or this test fails, keeping routing coverage total
+FAMILY_SAMPLES = {
+    "endpoints": "ns/components/backend/generate:ab12",
+    "models": "models/chat/echo",
+    "metrics": "metrics/ns/backend/ab12",
+    "metrics-stage": "metrics_stage/ns/backend/ab12",
+    "metrics-store": "metrics_stage/_store/store/0",
+    "fleet-soak": "fleet/ns/beacon",
+    "fleet-models": "fleet_models/ns/echo",
+    "fleet-status": "fleet_status/ns/echo",
+    "faults": "faults/store.connect",
+    "overload": "overload/ns/brownout",
+    "traces": "traces/tid/sid",
+    "planner": "planner/ns/state",
+    "kv-cluster": "kv_cluster/ns/backend/ab12",
+    "disagg-config": "disagg/ns/echo",
+    "prefill-queue": "ns.prefill",
+    "prefill-cancel": "ns.prefill/cancelled/rid",
+    "deployments": "deploy/deployments/ns/name",
+    "deploy-status": "deploy/status/ns/name",
+    "deploy-artifacts": "deploy/artifacts/name/00000001",
+    "regions": "regions/ns/ab12",
+}
+
+
+def test_every_family_has_a_routed_sample():
+    assert set(FAMILY_SAMPLES) == set(KEYSPACE), \
+        "new keyspace family: add a sample key to FAMILY_SAMPLES"
+    for fam, key in FAMILY_SAMPLES.items():
+        assert classify_key(key) == fam, (fam, key)
+
+
+class FakeShard:
+    """StoreClient-shaped in-memory shard; ``dead=True`` raises the typed
+    conn_lost on every call."""
+
+    def __init__(self, dead=False):
+        self.kv = {}
+        self.dead = dead
+        self.calls = []
+        self.leases = []
+        self.revoked = []
+        self.on_lease_lost = None
+        self.on_session_replayed = None
+        self.reconnect = None
+
+    def _check(self, op, key):
+        self.calls.append((op, key))
+        if self.dead:
+            raise StoreError("connection lost (store disconnected)",
+                             code="conn_lost")
+
+    async def put(self, key, value, lease=None):
+        self._check("put", key)
+        self.kv[key] = (value, lease)
+
+    async def get(self, key):
+        self._check("get", key)
+        v = self.kv.get(key)
+        return v[0] if v else None
+
+    async def get_prefix(self, prefix):
+        self._check("get_prefix", prefix)
+        return sorted((k, v[0]) for k, v in self.kv.items()
+                      if k.startswith(prefix))
+
+    async def delete(self, key):
+        self._check("delete", key)
+        return self.kv.pop(key, None) is not None
+
+    async def create(self, key, value, lease=None, or_validate=False):
+        self._check("create", key)
+        if key in self.kv:
+            return False
+        self.kv[key] = (value, lease)
+        return True
+
+    async def watch_prefix(self, prefix, callback):
+        self._check("watch", prefix)
+        return sorted((k, v[0]) for k, v in self.kv.items()
+                      if k.startswith(prefix))
+
+    async def lease_grant(self, ttl=5.0, auto_keepalive=True, reuse=None):
+        self._check("lease_grant", reuse)
+        lid = reuse if reuse is not None else 777
+        self.leases.append(lid)
+        return lid
+
+    async def lease_revoke(self, lease):
+        self._check("lease_revoke", lease)
+        self.revoked.append(lease)
+
+    async def q_push(self, queue, payload):
+        self._check("q_push", queue)
+        return 1
+
+    async def q_len(self, queue):
+        self._check("q_len", queue)
+        return 0
+
+
+def _sharded(dead=()):
+    specs = [ShardSpec("s0", "h", 1), ShardSpec("s1", "h", 2),
+             ShardSpec("s2", "h", 3)]
+    _specs, fam_map = parse_shard_map(
+        "telemetry=h:2;traces,queue=h:3", "h", 1)
+    shards = [FakeShard(dead=(i in dead)) for i in range(3)]
+    return ShardedStoreClient(specs, fam_map, clients=shards), shards
+
+
+async def test_shard_routing_covers_every_family():
+    sc, shards = _sharded()
+    expect = {"metrics": 1, "metrics-stage": 1, "metrics-store": 1,
+              "fleet-soak": 1, "regions": 1, "traces": 2,
+              "prefill-queue": 2, "prefill-cancel": 2}
+    for fam, key in FAMILY_SAMPLES.items():
+        want = expect.get(fam, 0)
+        if fam == "prefill-queue":
+            await sc.q_len(key)
+            assert shards[want].calls[-1] == ("q_len", key), fam
+            continue
+        await sc.put(key, b"x")
+        assert key in shards[want].kv, (fam, want)
+        assert await sc.get(key) == b"x"
+        for i in range(3):
+            if i != want:
+                assert key not in shards[i].kv, (fam, i)
+
+
+async def test_shard_prefix_fanout_and_single_shard_scan():
+    sc, shards = _sharded()
+    await sc.put("metrics_stage/ns/backend/a1", b"w")
+    await sc.put("metrics_stage/_store/store/0", b"s")
+    await sc.put("traces/t1/s1", b"t")
+    # metrics_stage/ spans metrics-stage + metrics-store: both live on
+    # the telemetry shard, so ONE scan serves it
+    shards[1].calls.clear()
+    items = await sc.get_prefix("metrics_stage/")
+    assert [k for k, _ in items] == ["metrics_stage/_store/store/0",
+                                     "metrics_stage/ns/backend/a1"]
+    assert shards[1].calls == [("get_prefix", "metrics_stage/")]
+    # a traces scan never touches the telemetry shard
+    shards[2].calls.clear()
+    assert await sc.get_prefix("traces/t1/") == [("traces/t1/s1", b"t")]
+    assert shards[2].calls and not any(
+        c[0] == "get_prefix" for c in shards[1].calls[1:])
+    # the empty prefix fans out everywhere and merges sorted
+    all_items = await sc.get_prefix("")
+    assert [k for k, _ in all_items] == sorted(k for k, _ in all_items)
+    assert len(all_items) == 3
+
+
+async def test_lease_mirrors_ride_every_shard():
+    sc, shards = _sharded()
+    lid = await sc.lease_grant(ttl=4.0)
+    assert shards[0].leases == [lid] or shards[0].leases == [777]
+    assert shards[1].leases and shards[2].leases
+    await sc.put("metrics/ns/backend/a1", b"m", lease=lid)
+    assert shards[1].kv["metrics/ns/backend/a1"][1] is not None
+    await sc.lease_revoke(lid)
+    assert shards[0].revoked and shards[1].revoked and shards[2].revoked
+
+
+async def test_one_shard_down_degrades_only_its_families():
+    sc, shards = _sharded(dead={1})
+    # telemetry family: typed conn_lost
+    with pytest.raises(StoreError) as ei:
+        await sc.put("metrics/ns/backend/a1", b"m")
+    assert ei.value.code == "conn_lost"
+    # control + traces families: unaffected
+    await sc.put("models/chat/echo", b"c")
+    await sc.put("traces/t1/s1", b"t")
+    assert await sc.get("models/chat/echo") == b"c"
+    # cross-shard fan-out serves the surviving shards' slice
+    items = await sc.get_prefix("")
+    assert ("models/chat/echo", b"c") in items
+    assert ("traces/t1/s1", b"t") in items
+    # every owning shard dead -> typed error, not silence
+    sc2, _ = _sharded(dead={0, 1, 2})
+    with pytest.raises(StoreError):
+        await sc2.get_prefix("")
+
+
+def test_parse_shard_map_rejects_bad_config():
+    with pytest.raises(ValueError):
+        parse_shard_map("nonsense=h:1", "h", 0)
+    with pytest.raises(ValueError):
+        parse_shard_map("traces=h:1;traces=h:2", "h", 0)
+    with pytest.raises(ValueError):
+        parse_shard_map("traces", "h", 0)
+    specs, fam = parse_shard_map("", "h", 9)
+    assert len(specs) == 1 and fam == {}
+    # unset env -> the plain client (zero-config identical path)
+    assert isinstance(make_store_client("h", 9, shards_env=""),
+                      StoreClient)
+
+
+# ---------------------------------------------------------------------------
+# aggregator + readers over a real store
+# ---------------------------------------------------------------------------
+async def _start_store():
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    srv = StoreServer()
+    port = await srv.start()
+    return srv, port
+
+
+async def _publish_worker(store, ns, comp, wid, dump):
+    from dynamo_tpu.llm.metrics_aggregator import metrics_key, stage_key
+
+    lease = await store.lease_grant(ttl=8.0)
+    await store.put(stage_key(ns, comp, wid),
+                    json.dumps({"component": comp, "seq": 1,
+                                "metrics": dump}).encode(), lease=lease)
+    await store.put(metrics_key(ns, comp, wid),
+                    json.dumps({"request_active_slots": 2,
+                                "request_total_slots": 4}).encode(),
+                    lease=lease)
+    return lease
+
+
+async def test_aggregator_region_records_and_reader_paths():
+    from dynamo_tpu.llm.metrics_aggregator import fetch_stage_states
+    from dynamo_tpu.planner.signals import quantile_from_states
+    from dynamo_tpu.runtime.scale.regions import (RegionalAggregator,
+                                                  fetch_region_states)
+
+    srv, port = await _start_store()
+    ns = "scaletest"
+    try:
+        pub = await StoreClient(port=port).connect()
+        for wid in range(1, 9):
+            await _publish_worker(pub, ns, "backend", wid,
+                                  _worker_dump(wid))
+        flat_states = await fetch_stage_states(pub, ns)
+        flat_q = quantile_from_states(flat_states, "llm_ttft_seconds",
+                                      0.9)
+
+        # two aggregators split the fleet
+        c1 = await StoreClient(port=port).connect()
+        c2 = await StoreClient(port=port).connect()
+        l1 = await c1.lease_grant(ttl=8.0)
+        l2 = await c2.lease_grant(ttl=8.0)
+        a1 = await RegionalAggregator(c1, ns, 0xa1, l1,
+                                      interval=0.2).start()
+        a2 = await RegionalAggregator(c2, ns, 0xa2, l2,
+                                      interval=0.2).start()
+        await a1.tick()
+        await asyncio.sleep(0.05)   # a1's record reaches a2's watch
+        await a2.tick()
+        await a1.tick()             # re-tick with both peers known
+
+        regional = await fetch_region_states(pub, ns)
+        assert regional is not None
+        assert regional.meta["aggregators"] == 2
+        assert sorted(regional.ids["backend"]) == list(range(1, 9))
+        assert set(regional.fpm["backend"]) == set(range(1, 9))
+        # the two regions partition the fleet, no overlap
+        per_region = [r["workers"] for r in regional.meta["regions"]]
+        assert sum(per_region) == 8 and all(n >= 0 for n in per_region)
+        # pre-merged quantiles match the flat scrape
+        hier_states = await fetch_stage_states(pub, ns)
+        assert quantile_from_states(hier_states, "llm_ttft_seconds",
+                                    0.9) == pytest.approx(flat_q)
+
+        # region death: revoking a1's lease drops its record; a2
+        # re-absorbs the orphans on its next tick
+        await c1.lease_revoke(l1)
+        await asyncio.sleep(0.05)
+        await a2.tick()
+        regional = await fetch_region_states(pub, ns)
+        assert regional.meta["aggregators"] == 1
+        assert sorted(regional.ids["backend"]) == list(range(1, 9))
+
+        # staleness: past the all-wedged backstop window every record is
+        # dead and readers return None (the flat fallback); modest
+        # reader-clock skew alone must NOT kill the plane
+        assert await fetch_region_states(pub, ns, stale_s=0.5,
+                                         now=time.time() + 10) is not None
+        assert await fetch_region_states(pub, ns, stale_s=0.5,
+                                         now=time.time() + 120) is None
+        await c2.close()
+        await pub.close()
+        await c1.close()
+    finally:
+        await srv.stop()
+
+
+async def test_signal_collector_region_vs_flat_source():
+    from dynamo_tpu.planner.signals import SignalCollector
+    from dynamo_tpu.runtime.scale.regions import RegionalAggregator
+
+    srv, port = await _start_store()
+    ns = "scalesrc"
+    try:
+        pub = await StoreClient(port=port).connect()
+        for wid in (3, 4):
+            await _publish_worker(pub, ns, "backend", wid,
+                                  _worker_dump(wid))
+        coll = SignalCollector(pub, ns, {"decode": "backend"})
+        sig = (await coll.collect())["decode"]
+        assert coll.last_source == "flat"
+        assert sig.replicas == 2 and sig.active_slots == 4
+
+        agg_store = await StoreClient(port=port).connect()
+        lease = await agg_store.lease_grant(ttl=8.0)
+        agg = await RegionalAggregator(agg_store, ns, 0xb1, lease,
+                                       interval=0.2).start()
+        await agg.tick()
+        sig = (await coll.collect())["decode"]
+        assert coll.last_source == "region"
+        assert sig.replicas == 2 and sig.active_slots == 4
+        assert sig.ttft_p90 is not None
+        await agg_store.close()
+        await pub.close()
+    finally:
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# queue-until-boot
+# ---------------------------------------------------------------------------
+async def test_queue_until_boot(monkeypatch):
+    import aiohttp
+
+    from dynamo_tpu.llm.http_service import (HttpService, ModelManager,
+                                             ServedModel)
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.pipeline import build_completion_engine
+    from dynamo_tpu.utils.prometheus import stage_metrics
+
+    monkeypatch.setenv("DYN_TOKEN_ECHO_DELAY_MS", "0")
+    manager = ModelManager()
+    svc = HttpService(manager, host="127.0.0.1", port=0)
+    svc.known_models = lambda: {"booting-model"}
+    port = await svc.start()
+    base = f"http://127.0.0.1:{port}"
+    card = ModelDeploymentCard.synthetic("booting-model")
+    body = {"model": "booting-model", "prompt": "hi", "max_tokens": 4}
+    qub = stage_metrics().queue_until_boot
+    try:
+        async with aiohttp.ClientSession() as s:
+            # off by default: immediate 404, no counters
+            async with s.post(f"{base}/v1/completions", json=body) as r:
+                assert r.status == 404
+            assert qub.get("booting-model", "parked") == 0
+
+            monkeypatch.setenv("DYN_BOOT_WAIT", "5")
+
+            async def boot_later():
+                await asyncio.sleep(0.3)
+                manager.add(ServedModel(
+                    card, completion_engine=build_completion_engine(
+                        card, "echo_core")))
+
+            boot = asyncio.ensure_future(boot_later())
+            async with s.post(f"{base}/v1/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+            await boot
+            assert qub.get("booting-model", "parked") == 1
+            assert qub.get("booting-model", "served") == 1
+
+            # expiry: a model that never boots gets the typed 503 after
+            # the deadline-bounded park window (deadline << DYN_BOOT_WAIT)
+            manager.remove("booting-model")
+            async with s.post(f"{base}/v1/completions", json=body,
+                              headers={"x-request-timeout": "0.4"}) as r:
+                assert r.status == 503
+                err = (await r.json())["error"]
+                assert err["reason"] == "booting"
+                assert err["stage"] == "ingress"
+            assert qub.get("booting-model", "expired") == 1
+
+            # overflow: park queue full -> immediate typed 503
+            monkeypatch.setenv("DYN_BOOT_WAIT_QUEUE", "0")
+            async with s.post(f"{base}/v1/completions", json=body) as r:
+                assert r.status == 503
+                assert (await r.json())["error"]["reason"] == \
+                    "boot_queue_full"
+            assert qub.get("booting-model", "overflow") == 1
+
+            # unregistered models keep the plain immediate 404
+            async with s.post(f"{base}/v1/completions",
+                              json={**body, "model": "nope"}) as r:
+                assert r.status == 404
+    finally:
+        await svc.stop()
