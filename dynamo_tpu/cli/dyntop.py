@@ -27,7 +27,7 @@ import argparse
 import asyncio
 import sys
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..utils.dynconfig import EnvDefaultsParser
 from ..utils.prometheus import hist_quantile
@@ -204,6 +204,7 @@ class ClusterSnapshotter:
         }
         return {
             "cluster": cluster_kv_totals(states),
+            "transfer": transfer_totals(states),
             "paging": kvpage_totals(states),
             "fleet": fleet,
             "at": time.time(),
@@ -310,6 +311,40 @@ def cluster_kv_totals(states) -> Dict[str, float]:
             out[field] += sum((st.get("series") or {}).values())
         st = dump.get("dyn_kv_tier_blocks") or {}
         out["tier_blocks"] += sum((st.get("series") or {}).values())
+    return out
+
+
+def transfer_totals(states) -> Dict[str, Any]:
+    """Fleet-summed KV-movement plane: bytes moved, streamed-ingest
+    counters, h2d-prefetch hit/stall counters, and the per-(src,dst)
+    bandwidth gauge folded to (pairs, min, max) — the ``transfer:``
+    line's numbers. All-zero when nothing has moved (line not
+    rendered)."""
+    names = {
+        "dyn_kv_stream_ingests_total": "stream_ingests",
+        "dyn_kv_stream_fallbacks_total": "stream_fallbacks",
+        "dyn_prefetch_h2d_hits_total": "prefetch_hits",
+        "dyn_prefetch_h2d_stalls_total": "prefetch_stalls",
+    }
+    out: Dict[str, Any] = {v: 0.0 for v in names.values()}
+    out["bytes"] = 0.0
+    bws: List[float] = []
+    for _component, dump in states:
+        for metric, field in names.items():
+            st = dump.get(metric) or {}
+            out[field] += sum((st.get("series") or {}).values())
+        # every transfer is counted by BOTH ends (send+recv pairs): sum
+        # only the receive-side directions so moved= reports each byte
+        # once
+        st = dump.get("llm_kv_transfer_bytes_total") or {}
+        for skey, val in (st.get("series") or {}).items():
+            if skey in ("recv", "cluster_recv"):
+                out["bytes"] += val
+        st = dump.get("llm_kv_pair_bw_bytes_per_s") or {}
+        bws.extend(v for v in (st.get("series") or {}).values() if v > 0)
+    out["pairs"] = float(len(bws))
+    out["bw_min"] = min(bws) if bws else 0.0
+    out["bw_max"] = max(bws) if bws else 0.0
     return out
 
 
@@ -471,6 +506,18 @@ def render(snap: Dict, store_detail: bool = False) -> str:
             f"peer_hits={int(cl.get('hits', 0))}  "
             f"fetches={int(cl.get('fetches', 0))}  "
             f"fallbacks={int(cl.get('fallbacks', 0))}")
+    tr = snap.get("transfer") or {}
+    if any(tr.values()):
+        line = (f"transfer: moved={tr.get('bytes', 0.0) / 1e6:.0f}MB  "
+                f"streamed={int(tr.get('stream_ingests', 0))}  "
+                f"stream_fallbacks={int(tr.get('stream_fallbacks', 0))}  "
+                f"prefetch_hits={int(tr.get('prefetch_hits', 0))}  "
+                f"stalls={int(tr.get('prefetch_stalls', 0))}")
+        if tr.get("pairs"):
+            line += (f"  pairs={int(tr['pairs'])} "
+                     f"bw={tr.get('bw_min', 0.0) / 1e6:.0f}"
+                     f"..{tr.get('bw_max', 0.0) / 1e6:.0f}MB/s")
+        lines.append(line)
     pg = snap.get("paging") or {}
     if any(pg.values()):
         lines.append(

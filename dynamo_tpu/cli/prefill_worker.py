@@ -162,7 +162,8 @@ async def run_prefill_worker(args, *,
                 with tracing.current_span_var_scope(
                         csp.context() if csp is not None else job_parent):
                     await push_kv(kv_client, job.decode_worker_id,
-                                  job.request_id, tok, logp, k, v)
+                                  job.request_id, tok, logp, k, v,
+                                  src_worker=drt.worker_id)
                 await queue.ack(msg_id)
                 log.info("prefilled %s (%d tokens) -> worker %x",
                          job.request_id, len(bi.token_ids),

@@ -27,7 +27,7 @@ from ..llm.disagg import (DisaggConfig, DisaggRouter, PrefillQueue,
 from ..llm.kv_router.protocols import KV_EVENT_SUBJECT, ForwardPassMetrics
 from ..llm.kv_router.publisher import KvEventPublisher
 from ..llm.kv_transfer import (KV_RECEIVE_ENDPOINT, KvReceiver,
-                               RemotePrefillError)
+                               RemotePrefillError, stream_enabled)
 from ..llm.model_card import ModelDeploymentCard
 from ..llm.protocols.common import BackendInput
 from ..llm.remote import register_model, serve_core_engine
@@ -213,7 +213,7 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                 max_prefill_queue_size=getattr(
                     args, "max_prefill_queue_size", 2)),
         ).start(drt.store)
-        receiver = KvReceiver()
+        receiver = KvReceiver(worker_id=drt.worker_id)
         await component.endpoint(KV_RECEIVE_ENDPOINT).serve(receiver.handler)
 
         remote_timeout = getattr(args, "remote_prefill_timeout", 120.0)
@@ -236,6 +236,14 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                 # cluster-warm prompt prefills locally instead of paying
                 # the remote-prefill queue for KV a peer already holds
                 await cluster.fetcher.ensure_prefix(bi, ctx)
+            if hasattr(engine, "prefetch_tiers"):
+                # placement-driven h2d prefetch: the upload of matched
+                # local tier blocks runs on an executor thread WHILE this
+                # request waits at the slot gate below, so admission's
+                # restore is a d2d scatter, not a critical-path h2d
+                from ..utils.aiotasks import spawn_blocking
+                spawn_blocking(engine.prefetch_tiers, bi,
+                               name="h2d-prefetch")
             if gate is not None:
                 await gate.acquire(ctx.priority, ctx.deadline)
                 svc_started = time.monotonic()
@@ -271,9 +279,17 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                     len(bi.token_ids), prefix_hit, qsize)
             tracer = tracing.get_tracer()
             if remote:
+                # layer-streamed ingest (DYN_KV_STREAM): hand the receiver
+                # an engine handle so each arriving layer's device scatter
+                # is enqueued while later layers are still on the wire —
+                # the future then resolves to the handle (not arrays) once
+                # the final scatter is enqueued, never synced
+                ingest = None
+                if stream_enabled() and hasattr(engine, "kv_ingest"):
+                    ingest = engine.kv_ingest(bi, ctx.id)
                 # register interest BEFORE enqueueing: a fast prefill worker
                 # may push the KV back before we'd otherwise start listening
-                fut = receiver.expect(ctx.id)
+                fut = receiver.expect(ctx.id, ingest=ingest)
                 async with tracer.span("prefill.remote_wait",
                                        trace_id=ctx.id,
                                        prompt_tokens=len(bi.token_ids),
@@ -313,6 +329,26 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                                 / max(qsize + 1, 1))
                     if wsp is not None:
                         wsp.attrs["fallback_local"] = kv is None
+                        wsp.attrs["streamed"] = kv is not None \
+                            and kv is ingest
+                if kv is not None and ingest is not None and kv is ingest:
+                    # the sequence is already entering decode; consume
+                    # its output queue. An engine-side ingest failure
+                    # surfaces BEFORE the first token as a typed error —
+                    # fall through to local prefill, never a user error
+                    try:
+                        async with tracer.span("decode.stream",
+                                               trace_id=ctx.id,
+                                               injected=True,
+                                               streamed=True):
+                            async for out in engine.generate_streamed(
+                                    bi, ctx, ingest):
+                                yield out.to_dict()
+                        return
+                    except RemotePrefillError as e:
+                        log.warning("streamed KV ingest for %s failed "
+                                    "(%s); prefilling locally", ctx.id, e)
+                        kv = None
                 if kv is not None:
                     k, v, tok, logp = kv
                     async with tracer.span("decode.stream",
@@ -332,8 +368,11 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                   else overload.SlotGatedEngine(engine, gate))
         if cluster is not None:
             # prefetch wraps OUTSIDE the slot gate: the peer fetch overlaps
-            # the queue wait instead of holding a slot while blocks stream
-            served = cluster.wrap(served)
+            # the queue wait instead of holding a slot while blocks
+            # stream, and the local-tier h2d prefetch uploads matched
+            # blocks to device staging during the same wait
+            served = cluster.wrap(
+                served, prefetcher=getattr(engine, "prefetch_tiers", None))
         await serve_core_engine(endpoint, served)
     if args.register_model:
         await register_model(drt.store, card, endpoint.path,

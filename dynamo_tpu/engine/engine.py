@@ -523,6 +523,25 @@ class EngineCore:
         self._inflight: Deque[Dict[str, Any]] = collections.deque()
         self._deferred_release: List[str] = []
         self._pending_seeds: List[Tuple[int, int]] = []
+        # --- layer-streamed KV injection (disagg receive path) --------
+        # seq_id -> in-flight stream-inject state: pool pages are leased
+        # at begin (unsealed, unregistered — invisible to attention and
+        # prefix matching), per-layer scatters enqueue as layers arrive,
+        # and only finish seals/publishes the blocks. Abort releases the
+        # pages untouched-by-anyone: a torn stream can never leave a
+        # half-written block reachable.
+        self._stream_injects: Dict[str, Dict[str, Any]] = {}
+        # --- placement-driven h2d prefetch staging --------------------
+        # seq_hash -> (k_dev, v_dev) device blocks uploaded by
+        # stage_prefetch (asyncio thread) while the request queues at the
+        # slot gate; admission's restore consumes them with a d2d scatter
+        # instead of a critical-path h2d. Bounded FIFO (insertion-ordered
+        # dict), guarded by _h2d_stage_lock (two-thread access).
+        self._h2d_stage: Dict[int, Tuple[Any, Any]] = {}
+        self._h2d_stage_lock = threading.Lock()
+        # hashes a prefetch was REQUESTED for: admission counts a host
+        # upload on one of these as a prefetch stall (vs a plain miss)
+        self._h2d_requested: set = set()
         self._last_final_tok = None   # device [B] from the last decode
         # multi-host lockstep: called with (kind, meta, arrays) right before
         # every device dispatch so follower processes can replay it
@@ -877,6 +896,9 @@ class EngineCore:
                 (s, r) for s, r in self.waiting if s != seq_id)
             if self.kvpager is not None:
                 self.kvpager.cancel(seq_id)
+            if seq_id in self._stream_injects:
+                # mid-stream cancel: release the half-written pages
+                self.abort_stream_inject(seq_id)
 
     @property
     def has_work(self) -> bool:
@@ -1046,7 +1068,15 @@ class EngineCore:
                                        k.astype(self.cfg.model.dtype))
         self.v_pool = self._scatter_fn(self.v_pool, slots,
                                        v.astype(self.cfg.model.dtype))
+        return self._enter_injected(seq_id, request, prompt, first_token,
+                                    first_logprob)
 
+    def _enter_injected(self, seq_id: str, request: BackendInput,
+                        prompt: List[int], first_token: int,
+                        first_logprob: float) -> StepOutput:
+        """Shared tail of the two KV-import paths (bulk inject / layer
+        stream): claim a slot straight into decode, seed bookkeeping, and
+        emit the prefill-worker-sampled first token."""
         slot_idx = self.slots.index(None)
         slot = _Slot(seq_id, request, prompt, prefill_done=len(prompt))
         self.slots[slot_idx] = slot
@@ -1069,6 +1099,113 @@ class EngineCore:
         if fin is not None:
             self._free_slot(slot_idx)
         return so
+
+    # ------------------------------------------------------------------
+    # layer-streamed KV injection (disagg receive; engine thread)
+    # ------------------------------------------------------------------
+    def begin_stream_inject(self, seq_id: str,
+                            request: BackendInput) -> None:
+        """Lease pool pages for a remotely-prefilled prompt whose KV is
+        still on the wire. The pages stay UNSEALED (no hash registration,
+        no stored events, no write-through) until :meth:`
+        finish_stream_inject` — a torn stream releases them with nothing
+        ever having referenced them."""
+        prompt = list(request.token_ids)
+        if None not in self.slots:
+            raise RuntimeError("no free slot for streamed sequence")
+        self.pool.create(seq_id, lora_id=getattr(request, "lora_id", 0))
+        try:
+            self.pool.ensure_pages(seq_id, len(prompt))
+        except Exception:
+            self.pool.release(seq_id)
+            raise
+        # leasing may have evicted reusable pages: their offload d2h must
+        # be enqueued before our scatters overwrite them
+        self._flush_evictions()
+        slots = jnp.asarray(self.pool.write_slots(seq_id, 0, len(prompt)))
+        if not hasattr(self, "_stream_scatter_fns"):
+            # grouped per-arrival scatter, keyed by group size G:
+            # [G] layer ids + [G, T, Hkv, Dh] values land in one donated
+            # dispatch (ls[:,None] broadcasts with the [T] slot indices
+            # to a [G, T] advanced subspace, placed leading — the wire
+            # layout lands without a host-side transpose). Grouping
+            # bounds the per-transfer dispatch count: one jit call per
+            # arriving layer would spend more host time on dispatch
+            # overhead than the scatters it hides.
+            self._stream_scatter_fns: Dict[int, Any] = {}
+        self._stream_injects[seq_id] = {
+            "request": request, "prompt": prompt, "slots": slots,
+            "layers_done": 0, "buf": [], "buf_l0": 0,
+            # flush granularity: ~4 scatter dispatches per pool per
+            # transfer, never coarser than half the model
+            "group": max(1, min(4, self.cfg.model.num_layers)),
+        }
+
+    def _stream_scatter(self, G: int):
+        fn = self._stream_scatter_fns.get(G)
+        if fn is None:
+            pg = self.page_size
+            fn = jax.jit(
+                lambda p, ls, s, vals: p.at[
+                    ls[:, None], :, s // pg, s % pg].set(vals),
+                donate_argnums=0)
+            self._stream_scatter_fns[G] = fn
+        return fn
+
+    def _flush_stream_buf(self, st) -> None:
+        buf = st["buf"]
+        if not buf:
+            return
+        dt = self.cfg.model.dtype
+        G = len(buf)
+        fn = self._stream_scatter(G)
+        ls = jnp.arange(st["buf_l0"], st["buf_l0"] + G)
+        k_vals = jnp.asarray(np.stack([b[0] for b in buf]), dt)
+        v_vals = jnp.asarray(np.stack([b[1] for b in buf]), dt)
+        self.k_pool = fn(self.k_pool, ls, st["slots"], k_vals)
+        self.v_pool = fn(self.v_pool, ls, st["slots"], v_vals)
+        st["buf_l0"] += G
+        st["buf"] = []
+
+    def stream_inject_layer(self, seq_id: str, layer: int,
+                            k: np.ndarray, v: np.ndarray) -> None:
+        """Accept ONE arriving layer ([T,Hkv,Dh] each) and enqueue its
+        group's device scatter while later layers are still in flight.
+        Donated, async: the engine keeps dispatching other sequences'
+        work in between."""
+        st = self._stream_injects[seq_id]
+        st["buf"].append((k, v))
+        st["layers_done"] = layer + 1
+        if len(st["buf"]) >= st["group"]:
+            self._flush_stream_buf(st)
+
+    def finish_stream_inject(self, seq_id: str, first_token: int,
+                             first_logprob: float) -> StepOutput:
+        """All scatters enqueued: seal+register the blocks (stored events
+        and write-through fire only now, for fully-arrived KV) and enter
+        the sequence straight into decode."""
+        st = self._stream_injects.pop(seq_id)
+        prompt = st["prompt"]
+        if st["layers_done"] != self.cfg.model.num_layers:
+            self.pool.release(seq_id)
+            raise ValueError(
+                f"stream inject for {seq_id} finished at layer "
+                f"{st['layers_done']}/{self.cfg.model.num_layers}")
+        if None not in self.slots:
+            self.pool.release(seq_id)
+            raise RuntimeError("no free slot for streamed sequence")
+        self._flush_stream_buf(st)         # tail group (< group layers)
+        self.pool.account_tokens(seq_id, prompt)
+        return self._enter_injected(seq_id, st["request"], prompt,
+                                    first_token, first_logprob)
+
+    def abort_stream_inject(self, seq_id: str) -> None:
+        """Torn stream: drop the ingest state and release the leased
+        pages. They were never sealed/registered, so nothing — attention,
+        prefix match, write-through, peers — can have observed the
+        partial writes; the pages return to the free list."""
+        if self._stream_injects.pop(seq_id, None) is not None:
+            self.pool.release(seq_id)
 
     # ------------------------------------------------------------------
     def step(self) -> List[StepOutput]:
@@ -1234,15 +1371,72 @@ class EngineCore:
         for i, (seq_hash, _) in enumerate(buf):
             self.tiered.offload(seq_hash, k[i], v[i])
 
+    # ------------------------------------------------------------------
+    # placement-driven h2d prefetch (asyncio thread -> admission restore)
+    # ------------------------------------------------------------------
+    def stage_prefetch(self, token_ids, lora_id: int = 0) -> int:
+        """Upload matched host/disk-tier prefix blocks to the device
+        STAGING buffer while the request still queues at the slot gate
+        (asyncio thread; the engine thread keeps dispatching). Admission's
+        restore then consumes them with a d2d scatter instead of paying
+        the h2d on first prefill's critical path. Returns blocks staged.
+
+        Safe concurrently with the engine thread: the tier is internally
+        locked, staged arrays are fresh device buffers nothing else
+        references, and the stage dict is lock-guarded."""
+        from ..llm.tokens import compute_seq_hashes
+        from ..utils.knobs import env_float
+
+        cap = int(env_float("DYN_H2D_PREFETCH_BLOCKS", 32, minimum=0.0))
+        if cap <= 0 or self.tiered is None:
+            return 0
+        dt = self.cfg.model.dtype
+        staged = 0
+        for h in compute_seq_hashes(list(token_ids), self.page_size,
+                                    lora_id=lora_id):
+            if self.pool.blocks.contains(h):
+                continue            # device-resident: nothing to move
+            with self._h2d_stage_lock:
+                if h in self._h2d_stage:
+                    continue
+            kv = self.tiered.peek(h)   # copies; no LRU perturbation
+            if kv is None:
+                break               # consecutive-prefix property
+            # enqueue the h2d now — by admission time the copy has been
+            # overlapping the queue wait instead of gating first prefill
+            k_dev = jnp.asarray(kv[0], dt)
+            v_dev = jnp.asarray(kv[1], dt)
+            with self._h2d_stage_lock:
+                while len(self._h2d_stage) >= cap:
+                    self._h2d_stage.pop(next(iter(self._h2d_stage)))
+                self._h2d_stage[h] = (k_dev, v_dev)
+                self._h2d_requested.add(h)
+                if len(self._h2d_requested) > 4 * cap:
+                    # cancelled/never-admitted requests must not grow the
+                    # stall-attribution set forever
+                    self._h2d_requested.clear()
+            staged += 1
+            if staged >= cap:
+                break
+        return staged
+
     def _restore_prefix(self, seq_id: str, prompt: List[int]) -> int:
-        """Prefix reuse at admission: claim matching device blocks and
-        upload matching host-tier blocks; returns tokens satisfied from
-        cache (always < len(prompt) so the last token still computes
-        logits)."""
+        """Prefix reuse at admission: claim matching device blocks,
+        consume prefetch-staged device blocks (d2d), and upload the
+        remaining matching host-tier blocks; returns tokens satisfied
+        from cache (always < len(prompt) so the last token still
+        computes logits)."""
         host_lookup = None
         fetched: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        staged: Dict[int, Tuple[Any, Any]] = {}
         if self.tiered is not None:
             def host_lookup(h):
+                with self._h2d_stage_lock:
+                    dev = self._h2d_stage.pop(h, None)
+                    self._h2d_requested.discard(h)
+                if dev is not None:
+                    staged[h] = dev
+                    return True
                 # fetch (and copy) eagerly: leasing the upload page can evict
                 # a device block whose offload lands in — and LRU-drops from —
                 # the very host tier we matched against
@@ -1255,11 +1449,30 @@ class EngineCore:
             seq_id, prompt, len(prompt) - 1, host_lookup)
         if uploads:
             self._flush_evictions()
-            pages = [p for _, p in uploads]
-            ks = np.stack([fetched[h][0] for h, _ in uploads])
-            vs = np.stack([fetched[h][1] for h, _ in uploads])
-            self.k_pool, self.v_pool = self.copy_stream.h2d_pages(
-                self.k_pool, self.v_pool, pages, ks, vs)
+            from ..utils.prometheus import stage_metrics
+            stage = stage_metrics()
+            host_up = [(h, p) for h, p in uploads if h not in staged]
+            dev_up = [(h, p) for h, p in uploads if h in staged]
+            if host_up:
+                pages = [p for _, p in host_up]
+                ks = np.stack([fetched[h][0] for h, _ in host_up])
+                vs = np.stack([fetched[h][1] for h, _ in host_up])
+                self.k_pool, self.v_pool = self.copy_stream.h2d_pages(
+                    self.k_pool, self.v_pool, pages, ks, vs)
+                stalls = 0
+                with self._h2d_stage_lock:
+                    for h, _ in host_up:
+                        if h in self._h2d_requested:
+                            self._h2d_requested.discard(h)
+                            stalls += 1
+                if stalls:
+                    stage.prefetch_h2d_stalls.inc(amount=float(stalls))
+            if dev_up:
+                self.k_pool, self.v_pool = self.copy_stream.scatter_blocks(
+                    self.k_pool, self.v_pool, [p for _, p in dev_up],
+                    [staged[h][0] for h, _ in dev_up],
+                    [staged[h][1] for h, _ in dev_up])
+                stage.prefetch_h2d_hits.inc(amount=float(len(dev_up)))
         return matched
 
     def _prepare_mm(self, req: BackendInput, prompt: List[int]):
@@ -2121,6 +2334,31 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                         so = StepOutput(seq_id, 0, 0.0, FinishReason.ERROR,
                                         error=f"KV injection failed: {e}")
                     self._deliver(so)
+                elif kind == "ingest_begin":
+                    try:
+                        self.core.begin_stream_inject(seq_id, payload)
+                    except Exception as e:  # noqa: BLE001
+                        log.exception("stream-inject begin failed")
+                        self._ingest_fail(seq_id, e)
+                elif kind == "ingest_layer":
+                    # a begin/earlier-layer failure already dropped the
+                    # state and delivered the error: later commands no-op
+                    if seq_id in self.core._stream_injects:
+                        try:
+                            self.core.stream_inject_layer(seq_id, *payload)
+                        except Exception as e:  # noqa: BLE001
+                            log.exception("stream-inject layer failed")
+                            self._ingest_fail(seq_id, e)
+                elif kind == "ingest_finish":
+                    if seq_id in self.core._stream_injects:
+                        try:
+                            self._deliver(self.core.finish_stream_inject(
+                                seq_id, *payload))
+                        except Exception as e:  # noqa: BLE001
+                            log.exception("stream-inject finish failed")
+                            self._ingest_fail(seq_id, e)
+                elif kind == "ingest_abort":
+                    self.core.abort_stream_inject(seq_id)
                 elif kind == "prefill_extract":
                     request, loop, fut = payload
                     try:
@@ -2206,6 +2444,16 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
         stage.mbu.set(pid, value=snap["mbu"])
         stage.hbm_gbps.set(pid, value=snap["hbm_gbps"])
 
+    def _ingest_fail(self, seq_id: str, e: Exception) -> None:
+        """Engine-thread cleanup of a failed stream inject: release the
+        pages (never sealed, never seen) and deliver ONE typed error the
+        consumer turns into a local-prefill fallback."""
+        self.core.abort_stream_inject(seq_id)
+        self._deliver(StepOutput(
+            seq_id, 0, 0.0, FinishReason.ERROR,
+            error=f"KV stream inject failed: {e}",
+            error_stage="kv_ingest", error_reason="ingest_failed"))
+
     def _deliver(self, so: StepOutput) -> None:
         loop = self._loop
         if loop is None:
@@ -2241,15 +2489,46 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
         async for out in self._generate(("inject", payload), context):
             yield out
 
+    # ------------------------------------------------------------------
+    # layer-streamed KV ingest (disagg receive path)
+    # ------------------------------------------------------------------
+    def kv_ingest(self, request: BackendInput, seq_id: str) -> "KvIngest":
+        """An asyncio-side handle the :class:`~..llm.kv_transfer.
+        KvReceiver` drives to scatter a remote prefill's KV layer-by-
+        layer as it arrives. Register it with ``receiver.expect(...,
+        ingest=handle)``; consume the entered sequence with
+        :meth:`generate_streamed` once the awaited future resolves to
+        the handle."""
+        return KvIngest(self, request, seq_id)
+
+    async def generate_streamed(self, request: BackendInput,
+                                context: Context, ingest: "KvIngest"
+                                ) -> AsyncIterator[EngineOutput]:
+        """Stream a request whose KV was ingested layer-streamed — the
+        inject commands are already queued; this only consumes the output
+        queue the ingest registered. Raises
+        :class:`~..llm.kv_transfer.RemotePrefillError` (before yielding
+        anything) if the engine-side ingest failed, so the caller can
+        fall back to local prefill."""
+        async for out in self._consume(context.id, context,
+                                       ingest_fallback=True):
+            yield out
+
     async def _generate(self, work, context: Context
                         ) -> AsyncIterator[EngineOutput]:
         kind, payload = work
         self._loop = asyncio.get_running_loop()
         seq_id = context.id
-        q: asyncio.Queue = asyncio.Queue()
-        self._queues[seq_id] = q
+        self._queues[seq_id] = asyncio.Queue()
         self._inbox.put((kind, seq_id, payload))
         self._wake.set()
+        async for out in self._consume(seq_id, context):
+            yield out
+
+    async def _consume(self, seq_id: str, context: Context,
+                       ingest_fallback: bool = False
+                       ) -> AsyncIterator[EngineOutput]:
+        q = self._queues[seq_id]
 
         async def watch_cancel():
             await context.stopped()
@@ -2261,6 +2540,13 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
             while True:
                 so: StepOutput = await q.get()
                 if so.finish == FinishReason.ERROR:
+                    if ingest_fallback and so.error_stage == "kv_ingest":
+                        # torn/failed stream inject: the pages are
+                        # released; hand control back so the caller
+                        # prefills locally instead of erroring the user
+                        from ..llm.kv_transfer import RemotePrefillError
+                        raise RemotePrefillError(so.error or "kv ingest "
+                                                             "failed")
                     yield EngineOutput(token_ids=[],
                                        finish_reason=FinishReason.ERROR,
                                        error=so.error or "engine error",
@@ -2268,6 +2554,7 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                                        error_stage=so.error_stage,
                                        error_reason=so.error_reason)
                     return
+                ingest_fallback = False   # tokens flowed: no fallback
                 yield EngineOutput(
                     token_ids=[so.token],
                     cum_log_prob=so.logprob,
@@ -2281,6 +2568,43 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
             self._queues.pop(seq_id, None)
             self._inbox.put(("cancel", seq_id, None))
             self._wake.set()
+
+    # ------------------------------------------------------------------
+    # placement-driven prefetch (asyncio thread)
+    # ------------------------------------------------------------------
+    def prefetch_tiers(self, request: BackendInput) -> int:
+        """Start h2d upload of the request's matched host/disk-tier
+        prefix (and touch draft-model state when spec is on) while it
+        waits in the slot-gate queue — admission consumes the staged
+        device blocks d2d instead of stalling first prefill on the
+        upload. Best-effort: any failure just means the legacy
+        synchronous restore path."""
+        if getattr(request, "images", None) \
+                and not getattr(request, "kv_salt", 0):
+            # admission will salt this VLM request's chain with the image
+            # digest it computes itself; prefetching under the unsalted
+            # chain would stage blocks admission never matches (and evict
+            # other requests' genuinely matching staged blocks)
+            return 0
+        try:
+            n = self.core.stage_prefetch(
+                request.token_ids,
+                lora_id=getattr(request, "kv_salt", 0)
+                or getattr(request, "lora_id", 0))
+        except Exception:  # noqa: BLE001 - prefetch must never fail a req
+            log.exception("h2d prefetch failed; admission restores "
+                          "synchronously")
+            return 0
+        prop = self.core.proposer
+        if prop is not None and hasattr(prop, "prefetch"):
+            # draft-model weight prefetch hook (spec decode): today's
+            # proposers load at init, so this is the seam for lazily-
+            # loaded drafts, not a transfer
+            try:
+                prop.prefetch()
+            except Exception:  # noqa: BLE001
+                log.debug("draft prefetch hook failed", exc_info=True)
+        return n
 
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
@@ -2297,3 +2621,84 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
         from ..utils.prometheus import stage_metrics
 
         stage_metrics().clear_worker(str(os.getpid()))
+
+class KvIngest:
+    """Asyncio-side handle for one layer-streamed KV injection.
+
+    Created by :meth:`JaxEngine.kv_ingest` before the request parks on
+    the prefill queue; the :class:`~..llm.kv_transfer.KvReceiver` drives
+    it from the ``kv_receive`` handler: :meth:`begin` validates the wire
+    geometry against the engine and registers the output queue,
+    :meth:`layer` posts one arrived layer's device scatter to the engine
+    thread (enqueued while later layers are still on the wire),
+    :meth:`finish` posts the finalize (seal + enter decode + first
+    token), :meth:`abort` tears everything down with the pool pages
+    released unseen. All methods are cheap posts — no device syncs."""
+
+    def __init__(self, engine: JaxEngine, request: BackendInput,
+                 seq_id: str):
+        self.engine = engine
+        self.request = request
+        self.seq_id = seq_id
+        self.began = False
+        self.finished = False
+
+    def _post(self, kind: str, payload) -> None:
+        self.engine._inbox.put((kind, self.seq_id, payload))
+        self.engine._wake.set()
+
+    def begin(self, meta: dict) -> bool:
+        """Validate the stream's geometry and arm the ingest. False =
+        decline (mismatched model geometry / tokens): the receiver falls
+        back to buffered assembly, which surfaces the mismatch through
+        the legacy import path."""
+        m = self.engine.core.cfg.model
+        if (int(meta.get("layers", -1)) != m.num_layers
+                or int(meta.get("kv_heads", -1)) != m.num_kv_heads
+                or int(meta.get("head_dim", -1)) != m.head_dim
+                or int(meta.get("tokens", -1))
+                != len(self.request.token_ids)):
+            log.warning("kv stream geometry %s does not match engine "
+                        "(%d layers, %d kv heads, %d head_dim); buffering",
+                        {k: meta.get(k) for k in
+                         ("layers", "kv_heads", "head_dim", "tokens")},
+                        m.num_layers, m.num_kv_heads, m.head_dim)
+            return False
+        self.engine._loop = asyncio.get_running_loop()
+        self.engine._queues[self.seq_id] = asyncio.Queue()
+        self._post("ingest_begin", self.request)
+        self.began = True
+        return True
+
+    def layer(self, layer: int, k: np.ndarray, v: np.ndarray) -> None:
+        self._post("ingest_layer", (layer, k, v))
+
+    def finish(self, first_token: int, first_logprob: float) -> None:
+        self.finished = True
+        self._post("ingest_finish", (int(first_token),
+                                     float(first_logprob)))
+
+    def abort(self) -> None:
+        """Idempotent, and a no-op once :meth:`finish` posted: the waiter
+        consumes the finished sequence's queue, so a late abandon (the
+        ``await_remote_kv`` finally) must not tear it down. For an
+        UNfinished ingest the abort posts through the same FIFO inbox the
+        begin rode, so a local-prefill resubmit of the same seq_id is
+        processed strictly after the pool pages were released."""
+        if self.began and not self.finished:
+            self._post("ingest_abort", None)
+            self.engine._queues.pop(self.seq_id, None)
+            self.began = False
+
+    def discard(self) -> None:
+        """The waiter gave up AFTER the ingest finished (its sequence is
+        already decoding) and will never consume the outputs: cancel the
+        orphaned sequence and drop its queue so the slot and the dict
+        entry don't leak until max_tokens."""
+        if self.finished:
+            self._post("cancel", None)
+            self.engine._queues.pop(self.seq_id, None)
+            self.finished = False
+            self.began = False
+        else:
+            self.abort()

@@ -89,15 +89,28 @@ def make_kv_fetch_handler(tiered):
 
 
 async def fetch_prefix(client, donor_id: int, hashes: Sequence[int],
-                       context: Optional[Context] = None
+                       context: Optional[Context] = None,
+                       receiver_id: Optional[int] = None
                        ) -> List[Tuple[int, np.ndarray, np.ndarray]]:
     """Pull the consecutive prefix of ``hashes`` from ``donor_id``'s
     tiers. Returns ``[(seq_hash, k, v)]`` ([L,Hkv,page,Dh] each); empty
-    when the donor no longer holds the first block."""
+    when the donor no longer holds the first block.
+
+    Arrivals stream through the shared :class:`~..kv_transfer.
+    LayerStream` assembler: each layer part is scattered into the
+    per-block output arrays the moment it lands (while layer l+1 is
+    still in flight), the codec (order/count) is validated by the one
+    implementation both receive paths share, and the observed
+    (donor → this worker) bandwidth feeds the router's per-pair
+    transfer-cost estimate."""
+    from ..kv_transfer import LayerStream, observe_pair_bw
+
     stage = stage_metrics()
     t0 = time.monotonic()
     meta = None
-    parts: List[bytes] = []
+    stream: Optional[LayerStream] = None
+    blocks_k = blocks_v = None
+    nbytes = 0
     async with get_tracer().span("kv_cluster.fetch",
                                  donor=f"{donor_id:x}",
                                  blocks_requested=len(hashes)):
@@ -108,28 +121,37 @@ async def fetch_prefix(client, donor_id: int, hashes: Sequence[int],
                 meta = item
                 if not meta.get("blocks"):
                     return []
+                n, L = int(meta["blocks"]), int(meta["layers"])
+                H, P, D = (int(meta["kv_heads"]), int(meta["page"]),
+                           int(meta["head_dim"]))
+                dtype = np.dtype(meta["dtype"])
+                blocks_k = np.empty((n, L, H, P, D), dtype)
+                blocks_v = np.empty((n, L, H, P, D), dtype)
+
+                def sink(layer, ka, va, _n=n, _P=P):
+                    # one concatenated [H, n*P, D] layer -> that layer's
+                    # slice of every per-block output array
+                    for i in range(_n):
+                        blocks_k[i, layer] = ka[:, i * _P:(i + 1) * _P]
+                        blocks_v[i, layer] = va[:, i * _P:(i + 1) * _P]
+                stream = LayerStream(L, sink)
             else:
-                parts.append(item)
-    n, L = int(meta["blocks"]), int(meta["layers"])
-    H, P, D = int(meta["kv_heads"]), int(meta["page"]), int(meta["head_dim"])
-    if len(parts) != 2 * L:
-        raise ValueError(
-            f"kv_fetch from {donor_id:x}: got {len(parts)}/{2 * L} parts")
-    dtype = np.dtype(meta["dtype"])
-    k_layers = [np.frombuffer(parts[2 * i], dtype).reshape(H, n * P, D)
-                for i in range(L)]
-    v_layers = [np.frombuffer(parts[2 * i + 1], dtype).reshape(H, n * P, D)
-                for i in range(L)]
+                stream.feed(np.frombuffer(item, dtype).reshape(
+                    H, int(meta["blocks"]) * P, D))
+                nbytes += len(item)
+    if meta is None:
+        return []
+    stream.close()   # truncated stream -> typed KvStreamError
     out: List[Tuple[int, np.ndarray, np.ndarray]] = []
-    for i, h in enumerate(meta["hashes"][:n]):
-        k = np.stack([kl[:, i * P:(i + 1) * P, :] for kl in k_layers])
-        v = np.stack([vl[:, i * P:(i + 1) * P, :] for vl in v_layers])
-        out.append((int(h), k, v))
+    for i, h in enumerate(meta["hashes"][:int(meta["blocks"])]):
+        out.append((int(h), blocks_k[i], blocks_v[i]))
     elapsed = time.monotonic() - t0
-    nbytes = sum(len(p) for p in parts)
     stage.kv_transfer.observe("cluster_recv", value=elapsed)
     stage.kv_transfer_bytes.inc("cluster_recv", amount=nbytes)
     stage.kv_cluster_fetch_seconds.observe(value=elapsed)
+    observe_pair_bw(f"{donor_id:x}",
+                    f"{receiver_id:x}" if receiver_id else "0",
+                    nbytes, elapsed)
     return out
 
 
@@ -185,7 +207,8 @@ class ClusterFetcher:
             return 0
         stage = stage_metrics()
         fetch = asyncio.ensure_future(
-            fetch_prefix(self.client, donor, missing, ctx.child()))
+            fetch_prefix(self.client, donor, missing, ctx.child(),
+                         receiver_id=self.worker_id))
         stop = asyncio.ensure_future(ctx.stopped())
         try:
             timeout = self.timeout

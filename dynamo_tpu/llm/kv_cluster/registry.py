@@ -236,27 +236,57 @@ class ClusterOverlap:
     blocks are the indexer's ``OverlapScores``, not this). ``weight`` is
     the score value of one peer block relative to one local block
     (:meth:`TransferCostModel.weight`).
+
+    When the router arms the pair-aware cost model, ``pair_weight`` /
+    ``pair_seconds`` are callables ``(src_wid, dst_wid, blocks) ->
+    float`` over the measured per-(src,dst) bandwidth: donor election
+    then maximizes transfer-cost-weighted *gain* instead of raw block
+    count (a near donor with fewer blocks can beat a far donor with
+    more), and scoring charges the chosen placement its expected
+    transfer seconds.
     """
 
     owners: Dict[int, int] = field(default_factory=dict)
     weight: float = 0.5
+    #: (src_wid, dst_wid, blocks) -> per-block score weight for that pair
+    pair_weight: Optional[Any] = None
+    #: (src_wid, dst_wid, blocks) -> expected transfer seconds
+    pair_seconds: Optional[Any] = None
 
     @property
     def blocks(self) -> int:
         """Best consecutive prefix length available anywhere."""
         return max(self.owners.values(), default=0)
 
+    def weight_for(self, src: int, dst: Optional[int], blocks: int) -> float:
+        if self.pair_weight is not None and dst is not None:
+            return float(self.pair_weight(src, dst, blocks))
+        return self.weight
+
+    def seconds_for(self, src: int, dst: Optional[int],
+                    blocks: int) -> float:
+        if self.pair_seconds is not None and dst is not None:
+            return float(self.pair_seconds(src, dst, blocks))
+        return 0.0
+
     def donor_for(self, worker_id: Optional[int], local_blocks: int
                   ) -> Tuple[Optional[int], int]:
-        """Best donor for ``worker_id``: the OTHER owner holding the most
-        consecutive blocks beyond what the worker already has locally."""
-        best, best_n = None, local_blocks
+        """Best donor for ``worker_id``: the OTHER owner whose extra
+        consecutive blocks beyond the worker's local coverage are worth
+        the most — raw block count without a cost model, transfer-cost-
+        weighted gain (``extra x pair_weight``) with one, so the
+        election prices the network pair, not just the prefix length."""
+        best, best_n, best_gain = None, 0, 0.0
         for wid, n in self.owners.items():
             if wid == worker_id:
                 continue
-            if n > best_n:
-                best, best_n = wid, n
-        return best, (best_n if best is not None else 0)
+            extra = n - local_blocks
+            if extra <= 0:
+                continue
+            gain = extra * self.weight_for(wid, worker_id, extra)
+            if best is None or gain > best_gain + 1e-12:
+                best, best_n, best_gain = wid, n, gain
+        return best, best_n
 
 
 class KvClusterIndex:
@@ -341,15 +371,24 @@ class KvClusterIndex:
 
 
 class TransferCostModel:
-    """Peer-block score weight from measured KV-transfer bandwidth.
+    """KV-movement cost estimates from measured transfer bandwidth —
+    fleet-wide AND per-(src,dst) worker pair.
 
     The router already merges every worker's ``llm_kv_transfer_seconds``
     histogram and ``llm_kv_transfer_bytes_total`` counter;
-    :meth:`update_from_states` differentiates them into an observed
-    bytes/s, and :meth:`weight` discounts a peer block by the estimated
-    fetch time: ``base / (1 + est_seconds)`` — a free fetch is worth
+    :meth:`update_from_states` differentiates them into a fleet-wide
+    observed bytes/s, and additionally reads the receiver-side
+    ``llm_kv_pair_bw_bytes_per_s`` gauges (EWMA per pair, see
+    ``kv_transfer.observe_pair_bw``) so a placement can be priced on the
+    SPECIFIC network pair it would move bytes over — NetKV's point:
+    decode selection must price the pair, not just the load.
+
+    :meth:`weight` discounts a peer block by the estimated fetch time:
+    ``base / (1 + est_seconds)`` — a free fetch is worth
     ``DYN_KV_CLUSTER_PEER_WEIGHT`` of a local block, a one-second fetch
     half that, never zero (a peer hit always beats recompute in score).
+    :meth:`estimate_seconds` is the raw expected-transfer-seconds term
+    ``score_candidates`` folds into the logit.
     """
 
     #: assumed bandwidth before any transfer has been measured (loopback
@@ -361,12 +400,17 @@ class TransferCostModel:
                               minimum=0.0) \
             if base_weight is None else float(base_weight)
         self.bytes_per_s: Optional[float] = None
+        #: (src_hex, dst_hex) -> observed bytes/s; src ``"q"`` is the
+        #: anonymous prefill pool (disagg pushes without a worker id)
+        self.pair_bw: Dict[Tuple[str, str], float] = {}
 
     def update_from_states(self, states) -> None:
         """Fold a ``fetch_stage_states`` result into the bandwidth
-        estimate (lifetime totals; good enough for a score weight)."""
+        estimates (lifetime totals for the fleet-wide rate, last-EWMA
+        gauges for the pairs)."""
         secs = 0.0
         byts = 0.0
+        pairs: Dict[Tuple[str, str], float] = {}
         for _component, dump in states:
             h = dump.get("llm_kv_transfer_seconds") or {}
             for val in (h.get("series") or {}).values():
@@ -374,13 +418,44 @@ class TransferCostModel:
             c = dump.get("llm_kv_transfer_bytes_total") or {}
             for val in (c.get("series") or {}).values():
                 byts += float(val)
+            g = dump.get("llm_kv_pair_bw_bytes_per_s") or {}
+            for skey, val in (g.get("series") or {}).items():
+                labels = skey.split("\x1f")
+                if len(labels) == 2 and float(val) > 0:
+                    pairs[(labels[0], labels[1])] = float(val)
         if secs > 0 and byts > 0:
             self.bytes_per_s = byts / secs
+        if pairs:
+            self.pair_bw = pairs
 
-    def estimate_seconds(self, blocks: int, block_bytes: int) -> float:
-        bw = self.bytes_per_s or self.DEFAULT_BYTES_PER_S
+    @staticmethod
+    def _hex(wid) -> Optional[str]:
+        if wid is None:
+            return None
+        return wid if isinstance(wid, str) else f"{wid:x}"
+
+    def bandwidth(self, src=None, dst=None) -> float:
+        """Best-informed bytes/s for a (src, dst) movement: the exact
+        pair's EWMA; else the mean of observed pairs INTO ``dst`` (a
+        disagg push's source is the anonymous prefill pool); else the
+        fleet-wide rate; else the optimistic default."""
+        s, d = self._hex(src), self._hex(dst)
+        if s is not None and d is not None:
+            bw = self.pair_bw.get((s, d))
+            if bw:
+                return bw
+        if d is not None:
+            into = [bw for (_, dk), bw in self.pair_bw.items() if dk == d]
+            if into:
+                return sum(into) / len(into)
+        return self.bytes_per_s or self.DEFAULT_BYTES_PER_S
+
+    def estimate_seconds(self, blocks: int, block_bytes: int,
+                         src=None, dst=None) -> float:
+        bw = self.bandwidth(src, dst)
         return (blocks * block_bytes) / bw if bw > 0 else 0.0
 
-    def weight(self, blocks: int, block_bytes: int) -> float:
-        return self.base / (1.0 + self.estimate_seconds(blocks,
-                                                        block_bytes))
+    def weight(self, blocks: int, block_bytes: int,
+               src=None, dst=None) -> float:
+        return self.base / (1.0 + self.estimate_seconds(
+            blocks, block_bytes, src=src, dst=dst))

@@ -13,9 +13,10 @@ uploads the pages with zero prefill recompute of the shared blocks.
 from __future__ import annotations
 
 import logging
-from typing import AsyncIterator, Optional
+from typing import AsyncIterator, Callable, Optional
 
 from ...runtime.engine import AsyncEngine, Context
+from ...utils.aiotasks import spawn_blocking
 from .fetch import KV_FETCH_ENDPOINT, ClusterFetcher, make_kv_fetch_handler
 from .registry import KvClusterPublisher
 
@@ -23,20 +24,36 @@ log = logging.getLogger("dynamo_tpu.kv_cluster")
 
 
 class ClusterPrefetchEngine(AsyncEngine):
-    """Engine decorator: bounded donor prefetch before generation.
+    """Engine decorator: bounded donor prefetch + local-tier h2d
+    prefetch before generation.
 
-    The prefetch overlaps the engine's in-flight dispatch queue (other
-    requests keep dispatching while this one's blocks stream in) and
-    degrades to plain local prefill on any failure — the inner engine
-    never sees the difference beyond a warmer host tier.
+    The donor fetch overlaps the engine's in-flight dispatch queue
+    (other requests keep dispatching while this one's blocks stream in)
+    and degrades to plain local prefill on any failure — the inner
+    engine never sees the difference beyond a warmer host tier.
+
+    ``prefetcher`` (the engine's ``prefetch_tiers``, when supported)
+    then starts the h2d upload of every matched host/disk-tier prefix
+    block — including what the donor fetch just deposited — on an
+    executor thread WHILE the request sits in the slot-gate queue the
+    wrap encloses: by admission, the blocks are device-staged and the
+    restore is a d2d scatter instead of a first-prefill-blocking h2d
+    (the PRESERVE direction: the router's placement already committed
+    this worker, so the movement its hit implies starts immediately).
     """
 
-    def __init__(self, inner: AsyncEngine, fetcher: ClusterFetcher):
+    def __init__(self, inner: AsyncEngine, fetcher: ClusterFetcher,
+                 prefetcher: Optional[Callable] = None):
         self.inner = inner
         self.fetcher = fetcher
+        self.prefetcher = prefetcher
 
     async def generate(self, request, context: Context) -> AsyncIterator:
         await self.fetcher.ensure_prefix(request, context)
+        if self.prefetcher is not None:
+            # retained: runs concurrently with the inner engine's queue
+            # wait; prefetch_tiers owns its own fallback semantics
+            spawn_blocking(self.prefetcher, request, name="h2d-prefetch")
         async for item in self.inner.generate(request, context):
             yield item
 
@@ -76,8 +93,10 @@ class KvClusterWorker:
                  drt.worker_id, KV_FETCH_ENDPOINT)
         return cls(publisher, fetcher, client)
 
-    def wrap(self, engine: AsyncEngine) -> AsyncEngine:
-        return ClusterPrefetchEngine(engine, self.fetcher)
+    def wrap(self, engine: AsyncEngine,
+             prefetcher: Optional[Callable] = None) -> AsyncEngine:
+        return ClusterPrefetchEngine(engine, self.fetcher,
+                                     prefetcher=prefetcher)
 
     async def stop(self) -> None:
         await self.publisher.stop()

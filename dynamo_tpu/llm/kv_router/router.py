@@ -159,17 +159,29 @@ class KvRouterService:
     # ------------------------------------------------------------------
     def _cluster_overlap(self, seq_hashes):
         """Cluster-wide prefix availability of a request's hash chain
-        (None when cluster sharing is off or the registry is empty)."""
+        (None when cluster sharing is off or the registry is empty),
+        armed with the pair-aware transfer-cost callables so donor
+        election and candidate scoring price the (src,dst) network pair
+        the placement would actually move bytes over."""
         if (self.cluster_index is None or not self.cluster_index.records
                 or not seq_hashes):
             return None
-        weight = self.cost_model.weight(
-            len(seq_hashes), self.cluster_index.any_block_bytes())
+        index, cm = self.cluster_index, self.cost_model
+        weight = cm.weight(len(seq_hashes), index.any_block_bytes())
         # only owners of the routed component: a foreign component's
         # record (disagg prefill pool, another model) is unreachable
         # through the worker's fetch client
-        return self.cluster_index.find(seq_hashes, weight=weight,
-                                       component=self.worker_component)
+        ov = index.find(seq_hashes, weight=weight,
+                        component=self.worker_component)
+
+        def _bb(src):
+            return index.block_bytes(src) or index.any_block_bytes()
+
+        ov.pair_weight = lambda src, dst, blocks: cm.weight(
+            blocks, _bb(src), src=src, dst=dst)
+        ov.pair_seconds = lambda src, dst, blocks: cm.estimate_seconds(
+            blocks, _bb(src), src=src, dst=dst)
+        return ov
 
     async def route(self, token_ids, lora_id: int = 0) -> Dict:
         # hash the prompt chain ONCE; the indexer and the cluster index

@@ -72,6 +72,14 @@ WorkerSelector = Callable[
     Optional[int]]
 
 
+def _transfer_weight() -> float:
+    """``DYN_ROUTER_TRANSFER_WEIGHT``: logit penalty per expected
+    KV-transfer second of a placement (0 = term off)."""
+    from ...utils.knobs import env_float
+
+    return env_float("DYN_ROUTER_TRANSFER_WEIGHT", 1.0, minimum=0.0)
+
+
 def score_candidates(tokens: Sequence[int], block_size: int,
                      overlaps: OverlapScores,
                      endpoints: ProcessedEndpoints,
@@ -87,8 +95,16 @@ def score_candidates(tokens: Sequence[int], block_size: int,
     into the overlap term: a candidate's OWN host/disk-tier coverage
     counts like a device hit (admission restores it locally), and the
     best prefix some *other* worker holds counts at the transfer-cost
-    weight — so local hit > peer hit > miss, by construction."""
+    weight — so local hit > peer hit > miss, by construction.
+
+    With a pair-aware cost model armed (``cluster.pair_weight`` /
+    ``pair_seconds``), donor election prices the (donor → candidate)
+    network pair and every candidate's logit is additionally charged
+    ``transfer_weight x expected-transfer-seconds`` for the bytes its
+    election would move — a candidate behind a slow pair loses to one a
+    cheap fetch away even at equal prefix coverage (FlowKV/NetKV)."""
     isl_blocks = max(1, len(tokens) // block_size)
+    tw = _transfer_weight()
     out: List[Dict[str, Any]] = []
     for wid, m in endpoints.workers.items():
         saturated = bool(
@@ -105,9 +121,15 @@ def score_candidates(tokens: Sequence[int], block_size: int,
             local_eq = max(overlap, cluster.owners.get(wid, 0))
             donor, donor_blocks = cluster.donor_for(wid, local_eq)
         extra = max(0, donor_blocks - local_eq) if donor is not None else 0
-        eff = min(local_eq + (cluster.weight if cluster else 0.0) * extra,
-                  float(isl_blocks))
+        peer_w = (cluster.weight_for(donor, wid, extra)
+                  if cluster is not None and donor is not None else 0.0)
+        eff = min(local_eq + peer_w * extra, float(isl_blocks))
         overlap_norm = eff / isl_blocks
+        # expected seconds the elected fetch would spend moving bytes
+        # onto THIS candidate (0 without a donor / without a cost model)
+        xfer_s = (cluster.seconds_for(donor, wid, extra)
+                  if cluster is not None and donor is not None and extra
+                  else 0.0)
         load = (m.request_active_slots / m.request_total_slots
                 if m.request_total_slots else 0.0)
         # bytes-resident dimension: the worker's total KV working set
@@ -129,8 +151,9 @@ def score_candidates(tokens: Sequence[int], block_size: int,
             "cache_usage": m.cache_usage,
             "load": load,
             "kv_bytes_frac": bytes_frac,
+            "transfer_seconds": xfer_s,
             "logit": 2.0 * overlap_norm - m.cache_usage - load
-            - bytes_frac,
+            - bytes_frac - tw * xfer_s,
             "saturated": saturated,
         })
     return out
@@ -252,6 +275,8 @@ class KvScheduler:
                  "cache_usage": round(c["cache_usage"], 4),
                  "load": round(c["load"], 4),
                  "kv_bytes_frac": round(c["kv_bytes_frac"], 4),
+                 "transfer_seconds": round(
+                     c.get("transfer_seconds", 0.0), 5),
                  "logit": round(c["logit"], 4)}
                 for c in candidates],
         })

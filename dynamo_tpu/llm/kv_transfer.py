@@ -10,22 +10,39 @@ replacing the reference's NIXL RDMA plane (docs/disagg_serving.md:58-91);
 intra-slice movement stays inside XLA as collectives.
 
 Sender: :func:`push_kv` (prefill worker). Receiver: :class:`KvReceiver`
-(decode worker) — serves the ``kv_receive`` endpoint and hands the assembled
-arrays to whoever is awaiting that request id.
+(decode worker) — serves the ``kv_receive`` endpoint and hands the sequence
+to whoever is awaiting that request id. With ``DYN_KV_STREAM`` (default on)
+and an engine that supports it, the receiver drives a **layer-streamed
+ingest**: each arriving layer's device scatter is enqueued on the engine
+thread while later layers are still on the wire, and the awaited future
+resolves once the final scatter is *enqueued* — never synced — so decode
+step 1 overlaps the transfer tail instead of starting after it. A torn
+stream (donor death, codec violation, abandoned waiter) aborts the ingest
+with the partially-written pool pages released before anything referenced
+them: attention can never observe a half-arrived prompt.
+
+:class:`LayerStream` is the one assembler for the layer-major codec — the
+disagg push above and the cluster peer-fetch receive path
+(``kv_cluster/fetch.py``) both validate and dispatch arrivals through it.
+Receivers also feed :func:`observe_pair_bw`, the per-(src,dst) bandwidth
+EWMA behind the router's transfer-cost scoring.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import threading
 import time
-from typing import AsyncIterator, Dict, Optional, Tuple
+from typing import AsyncIterator, Callable, Dict, Optional, Tuple
 
 import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
 import numpy as np
 
 from ..runtime.component import Client, StreamingRequest
 from ..runtime.engine import Context
+from ..utils.knobs import env_float
 from ..utils.prometheus import stage_metrics
 from ..utils.tracing import extract_wire, get_tracer, wire_context
 
@@ -33,9 +50,121 @@ log = logging.getLogger("dynamo_tpu.kv_transfer")
 
 KV_RECEIVE_ENDPOINT = "kv_receive"
 
+#: per-pair bandwidth source label for senders that are not addressable
+#: workers (the anonymous prefill-worker pool behind the queue)
+ANON_SRC = "q"
+
+
+def stream_enabled() -> bool:
+    """``DYN_KV_STREAM`` (default on): layer-streamed ingest of disagg KV
+    pushes. ``0`` restores the legacy full-arrival import — the bench
+    harness's A/B switch."""
+    return os.environ.get("DYN_KV_STREAM", "1").lower() in (
+        "1", "true", "yes", "on")
+
+
+# ---------------------------------------------------------------------------
+# per-(src,dst) transfer bandwidth (receiver-side EWMA)
+# ---------------------------------------------------------------------------
+
+_pair_bw: Dict[Tuple[str, str], float] = {}
+_pair_lock = threading.Lock()
+
+
+def observe_pair_bw(src: str, dst: str, nbytes: int,
+                    seconds: float) -> None:
+    """Fold one observed transfer into the (src,dst) bandwidth EWMA and
+    export it as ``llm_kv_pair_bw_bytes_per_s`` — the series the router's
+    :class:`~.kv_cluster.registry.TransferCostModel` reads back out of
+    the merged stage dumps."""
+    if nbytes <= 0 or seconds <= 0:
+        return
+    alpha = env_float("DYN_KV_BW_ALPHA", 0.3, minimum=0.0)
+    alpha = min(alpha, 1.0)
+    bw = nbytes / seconds
+    with _pair_lock:
+        prev = _pair_bw.get((src, dst))
+        cur = bw if prev is None else alpha * bw + (1.0 - alpha) * prev
+        _pair_bw[(src, dst)] = cur
+    stage_metrics().kv_pair_bw.set(src, dst, value=cur)
+
+
+# ---------------------------------------------------------------------------
+# the layer-major codec assembler (disagg push + cluster fetch share it)
+# ---------------------------------------------------------------------------
+
+class RemotePrefillError(RuntimeError):
+    pass
+
+
+class KvStreamError(RemotePrefillError):
+    """A KV stream violated the layer-major codec or tore mid-flight.
+    Subclasses :class:`RemotePrefillError` so every waiter's existing
+    typed-fallback path (local prefill) handles it unchanged."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"kv stream {reason}: {detail}")
+        self.reason = reason
+
+
+class LayerStream:
+    """Incremental assembler for the layer-major two-part codec: 2·L
+    parts, layer k then layer v, strictly in layer order. ``sink(layer,
+    k, v)`` fires the moment a layer's pair is complete — while later
+    layers are still in flight. :meth:`close` enforces completeness;
+    every violation is a typed :class:`KvStreamError` naming the reason
+    (the fallback counters' label)."""
+
+    def __init__(self, layers: int,
+                 sink: Callable[[int, np.ndarray, np.ndarray], None]):
+        self.layers = int(layers)
+        self.sink = sink
+        self._i = 0
+        self._k: Optional[np.ndarray] = None
+
+    @property
+    def parts_fed(self) -> int:
+        return self._i
+
+    @property
+    def complete(self) -> bool:
+        return self._i == 2 * self.layers
+
+    def feed(self, arr: np.ndarray) -> None:
+        """One wire part in arrival order (positional layer index)."""
+        layer, is_v = divmod(self._i, 2)
+        if layer >= self.layers:
+            raise KvStreamError(
+                "over_count",
+                f"part {self._i} beyond {2 * self.layers} expected")
+        if not is_v:
+            self._k = arr
+        else:
+            k, self._k = self._k, None
+            self.sink(layer, k, arr)
+        self._i += 1
+
+    def feed_layer(self, layer: int, k: np.ndarray,
+                   v: np.ndarray) -> None:
+        """Explicit-index entry point (sender-declared layer indices):
+        the codec is strictly in-order, so a skipped or repeated index is
+        a torn stream, not a reordering to tolerate."""
+        if self._i % 2 or layer != self._i // 2:
+            raise KvStreamError(
+                "out_of_order",
+                f"layer {layer} arrived at codec position {self._i}")
+        self.feed(k)
+        self.feed(v)
+
+    def close(self) -> None:
+        if not self.complete:
+            raise KvStreamError(
+                "truncated",
+                f"got {self._i}/{2 * self.layers} parts")
+
 
 def _meta(request_id: str, first_token: int, first_logprob: float,
-          k: np.ndarray) -> dict:
+          k: np.ndarray, src_worker: Optional[int] = None) -> dict:
     L, T, H, D = k.shape
     return {
         "request_id": request_id,
@@ -44,6 +173,9 @@ def _meta(request_id: str, first_token: int, first_logprob: float,
         "layers": int(L), "tokens": int(T),
         "kv_heads": int(H), "head_dim": int(D),
         "dtype": str(k.dtype),
+        # sender identity for the receiver's per-pair bandwidth EWMA
+        # (absent/0 = the anonymous prefill pool)
+        "src": f"{src_worker:x}" if src_worker else ANON_SRC,
         # span context rides the meta header (not just the wire control) so
         # the receive side stitches even on planes that drop control fields
         "trace": wire_context(),
@@ -53,10 +185,11 @@ def _meta(request_id: str, first_token: int, first_logprob: float,
 async def push_kv(client: Client, decode_worker_id: int, request_id: str,
                   first_token: int, first_logprob: float,
                   k: np.ndarray, v: np.ndarray,
-                  context: Optional[Context] = None) -> dict:
+                  context: Optional[Context] = None,
+                  src_worker: Optional[int] = None) -> dict:
     """Stream a sequence's prompt KV ([L,T,Hkv,Dh] each) to the decode
     worker that owns ``request_id``. Returns the receiver's ack."""
-    meta = _meta(request_id, first_token, first_logprob, k)
+    meta = _meta(request_id, first_token, first_logprob, k, src_worker)
     nbytes = k.nbytes + v.nbytes
 
     async def parts() -> AsyncIterator[bytes]:
@@ -86,10 +219,6 @@ async def push_kv(client: Client, decode_worker_id: int, request_id: str,
     return ack or {}
 
 
-class RemotePrefillError(RuntimeError):
-    pass
-
-
 async def _cancel_quietly(queue, request_id: str) -> None:
     """Tombstone a queued job, best-effort: a store mid-outage must not
     mask the caller's own outcome (timeout / client stop)."""
@@ -100,14 +229,32 @@ async def _cancel_quietly(queue, request_id: str) -> None:
                   request_id)
 
 
+def _discard_streamed(fut: asyncio.Future) -> None:
+    """A future that resolved while its waiter was giving up may hold a
+    streamed-ingest handle whose sequence ALREADY entered decode; the
+    waiter will never consume it, so the orphan must be cancelled (a
+    buffered tuple result needs nothing — it's just host arrays)."""
+    if not fut.done() or fut.cancelled() or fut.exception() is not None:
+        return
+    discard = getattr(fut.result(), "discard", None)
+    if discard is not None:
+        try:
+            discard()
+        except Exception:  # noqa: BLE001 - cleanup must not mask outcome
+            log.exception("streamed-ingest discard failed")
+
+
 async def await_remote_kv(ctx: Context, fut: asyncio.Future, queue,
                           receiver: "KvReceiver",
                           remote_timeout: float):
     """Decode-side wait for the remotely computed KV, racing client-stop,
     the request's end-to-end deadline, and the fallback timeout. Returns
-    the KV tuple, or None => fall back to local prefill. An expired
-    deadline raises a 504 naming the stage (``decode_kv_wait``) — there is
-    no point prefilling locally for a caller that already timed out."""
+    the KV tuple (buffered mode), a streamed-ingest handle (the sequence
+    is already entering decode — consume it with
+    ``engine.generate_streamed``), or None => fall back to local prefill.
+    An expired deadline raises a 504 naming the stage
+    (``decode_kv_wait``) — there is no point prefilling locally for a
+    caller that already timed out."""
     from ..runtime import deadline as dl
 
     stop = asyncio.ensure_future(ctx.stopped())
@@ -124,12 +271,22 @@ async def await_remote_kv(ctx: Context, fut: asyncio.Future, queue,
             return fut.result()  # may raise RemotePrefillError
         if stop in done:
             await _cancel_quietly(queue, ctx.id)
+            _discard_streamed(fut)
             raise asyncio.CancelledError
         # tombstone the queued job so a prefill worker doesn't burn a
-        # full prompt prefill on KV nobody will accept
+        # full prompt prefill on KV nobody will accept. The await can
+        # let the in-flight stream FINISH (and a streamed ingest enter
+        # decode): re-check the future after it — a race the outcome
+        # branches below must each resolve, never leak
         await _cancel_quietly(queue, ctx.id)
         if deadline_first or dl.expired(ctx.deadline):
+            _discard_streamed(fut)
             raise dl.expire("decode_kv_wait", ctx.deadline)
+        if fut.done() and not fut.cancelled() \
+                and fut.exception() is None:
+            # the arrival won the race against the tombstone write:
+            # serve the completed transfer instead of discarding it
+            return fut.result()
         log.warning("remote prefill for %s timed out after %.0fs; "
                     "prefilling locally", ctx.id, remote_timeout)
         return None
@@ -156,22 +313,71 @@ async def push_kv_error(client: Client, decode_worker_id: int,
 
 class KvReceiver:
     """Decode-worker side: collects streamed KV for requests this worker
-    parked while their prefill ran remotely."""
+    parked while their prefill ran remotely.
 
-    def __init__(self) -> None:
+    Two ingest modes per request:
+
+    - **streamed** (``DYN_KV_STREAM`` + an ingest handle registered via
+      :meth:`expect`): layer pairs are forwarded to the engine the moment
+      they complete, the future resolves to the ingest handle once the
+      final scatter is enqueued, and any mid-stream failure aborts the
+      engine-side ingest (pool pages released unseen) before the waiter
+      is failed over to local prefill;
+    - **buffered** (legacy / no handle / handle declined the geometry):
+      the full [L,T,Hkv,Dh] arrays assemble in host memory and the future
+      resolves to ``(k, v, first_token, first_logprob)`` after the last
+      part, exactly the old contract.
+    """
+
+    def __init__(self, worker_id: int = 0) -> None:
         self._pending: Dict[str, asyncio.Future] = {}
+        self._ingests: Dict[str, object] = {}
+        self._dst = f"{worker_id:x}" if worker_id else str(os.getpid())
 
-    def expect(self, request_id: str) -> asyncio.Future:
+    def expect(self, request_id: str,
+               ingest: Optional[object] = None) -> asyncio.Future:
         """Register interest; the future resolves to
-        (k, v, first_token, first_logprob) when the KV arrives."""
+        (k, v, first_token, first_logprob) — or to ``ingest`` itself when
+        the arrival was streamed straight into the engine through it."""
         fut = asyncio.get_running_loop().create_future()
         self._pending[request_id] = fut
+        if ingest is not None:
+            self._ingests[request_id] = ingest
         return fut
 
     def abandon(self, request_id: str) -> None:
+        """Waiter gave up (timeout / deadline / client stop) or is done
+        consuming. A BEGUN-but-unfinished ingest must be aborted HERE,
+        before the caller's local-prefill fallback resubmits the same
+        seq_id: the abort rides the engine's FIFO inbox ahead of the
+        resubmit, so the half-streamed pool sequence is released first
+        (``KvIngest.abort`` is a no-op for finished/never-begun ingests,
+        so the success path's abandon leaves the live stream alone)."""
+        ingest = self._ingests.pop(request_id, None)
+        if ingest is not None:
+            try:
+                ingest.abort()
+            except Exception:  # noqa: BLE001 - cleanup must not mask
+                log.exception("kv ingest abort failed for %s", request_id)
         fut = self._pending.pop(request_id, None)
         if fut is not None and not fut.done():
             fut.cancel()
+
+    def _fail(self, rid: str, ingest, exc: KvStreamError) -> None:
+        """Torn-stream cleanup: abort the engine-side ingest FIRST (the
+        partially-scattered pool pages release before any waiter can
+        race a local prefill into the same engine), then fail the waiter
+        over to local prefill and count the reason."""
+        if ingest is not None:
+            try:
+                ingest.abort()
+            except Exception:  # noqa: BLE001 - cleanup must not mask
+                log.exception("kv ingest abort failed for %s", rid)
+        stage_metrics().kv_stream_fallbacks.inc(exc.reason)
+        fut = self._pending.pop(rid, None)
+        self._ingests.pop(rid, None)
+        if fut is not None and not fut.done():
+            fut.set_exception(exc)
 
     async def handler(self, request: StreamingRequest, ctx: Context):
         meta = request.meta
@@ -179,6 +385,7 @@ class KvReceiver:
         if meta.get("error"):
             async for _ in request.parts:
                 pass
+            self._ingests.pop(rid, None)
             fut = self._pending.pop(rid, None)
             if fut is not None and not fut.done():
                 fut.set_exception(RemotePrefillError(meta["error"]))
@@ -187,39 +394,83 @@ class KvReceiver:
         L, T = meta["layers"], meta["tokens"]
         H, D = meta["kv_heads"], meta["head_dim"]
         dtype = np.dtype(meta["dtype"])
-        k = np.empty((L, T, H, D), dtype)
-        v = np.empty((L, T, H, D), dtype)
-        i = 0
+        fut = self._pending.get(rid)
+        ingest = self._ingests.get(rid) if stream_enabled() else None
+        if ingest is not None and (fut is None or fut.done()
+                                   or not ingest.begin(meta)):
+            # waiter gone, or the engine declined the stream's geometry:
+            # assemble buffered (the legacy path validates/fails later)
+            ingest = None
+        k = v = None
+        if ingest is None:
+            k = np.empty((L, T, H, D), dtype)
+            v = np.empty((L, T, H, D), dtype)
+
+            def sink(layer: int, ka: np.ndarray, va: np.ndarray) -> None:
+                k[layer] = ka
+                v[layer] = va
+        else:
+            def sink(layer: int, ka: np.ndarray, va: np.ndarray) -> None:
+                ingest.layer(layer, ka, va)
+        stream = LayerStream(L, sink)
         nbytes = 0
         t0 = time.monotonic()
         recv_span = get_tracer().start_span(
             "kv.receive", parent=extract_wire(meta.get("trace"), rid),
-            request_id=rid, tokens=T, layers=L)
+            request_id=rid, tokens=T, layers=L,
+            streamed=ingest is not None)
         try:
             async for part in request.parts:
-                layer, is_v = divmod(i, 2)
-                if layer >= L:
-                    raise ValueError(f"kv stream for {rid}: too many parts")
-                arr = np.frombuffer(part, dtype).reshape(T, H, D)
-                (v if is_v else k)[layer] = arr
-                i += 1
+                if fut is not None and fut.done():
+                    # the waiter gave up mid-stream (deadline / client
+                    # stop): abort the ingest and drain without feeding —
+                    # no further pool writes for a request nobody owns
+                    raise KvStreamError("abandoned",
+                                        f"waiter for {rid} gone")
+                stream.feed(np.frombuffer(part, dtype).reshape(T, H, D))
                 nbytes += len(part)
-            if i != 2 * L:
-                raise ValueError(
-                    f"kv stream for {rid}: got {i}/{2 * L} parts")
-        except BaseException:
+            stream.close()
+        except KvStreamError as e:
             get_tracer().finish(recv_span, status="error")
+            self._fail(rid, ingest, e)
+            yield {"ok": False, "error": str(e)}
+            return
+        except BaseException as e:
+            # transport tear (donor death mid-push): same cleanup, then
+            # propagate so the plane surfaces the broken stream
+            get_tracer().finish(recv_span, status="error")
+            self._fail(rid, ingest, KvStreamError("torn", str(e)))
             raise
         if recv_span is not None:
             recv_span.attrs["bytes"] = nbytes
         get_tracer().finish(recv_span)
         stage = stage_metrics()
-        stage.kv_transfer.observe("recv", value=time.monotonic() - t0)
+        elapsed = time.monotonic() - t0
+        stage.kv_transfer.observe("recv", value=elapsed)
         stage.kv_transfer_bytes.inc("recv", amount=nbytes)
+        observe_pair_bw(meta.get("src") or ANON_SRC, self._dst,
+                        nbytes, elapsed)
+        self._ingests.pop(rid, None)
         fut = self._pending.pop(rid, None)
         if fut is None or fut.done():
+            if ingest is not None:
+                # fully-arrived stream whose waiter vanished between the
+                # last part and here: the ingest must not enter decode
+                try:
+                    ingest.abort()
+                except Exception:  # noqa: BLE001
+                    log.exception("kv ingest abort failed for %s", rid)
             log.warning("unexpected KV for request %s (client gone?)", rid)
             yield {"ok": False, "error": "no pending request"}
             return
-        fut.set_result((k, v, meta["first_token"], meta["first_logprob"]))
-        yield {"ok": True, "tokens": T}
+        if ingest is not None:
+            # the final scatter is ENQUEUED (engine thread drains the
+            # command queue); resolve now — decode's first step chains on
+            # the pool arrays by data dependency, no sync needed here
+            ingest.finish(meta["first_token"], meta["first_logprob"])
+            stage.kv_stream_ingests.inc()
+            fut.set_result(ingest)
+        else:
+            fut.set_result((k, v, meta["first_token"],
+                            meta["first_logprob"]))
+        yield {"ok": True, "tokens": T, "streamed": ingest is not None}

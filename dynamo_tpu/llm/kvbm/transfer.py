@@ -37,6 +37,12 @@ class CopyStream:
         self._gather_all = jax.jit(
             lambda pool, pages: jnp.transpose(pool[:, :, pages],
                                               (2, 0, 1, 3, 4)))
+        # device-resident [n, L, Hkv, page, Dh] blocks -> pool pages, one
+        # dispatch per pool: the h2d happened earlier (prefetch staging),
+        # this is the d2d consume on admission's critical path
+        self._scatter_blocks = jax.jit(
+            lambda pool, pages, vals: pool.at[:, :, pages].set(
+                jnp.moveaxis(vals, 0, 2)), donate_argnums=0)
 
     # ------------------------------------------------------------------
     def d2h_pages(self, k_pool, v_pool, pages: Sequence[int],
@@ -75,4 +81,15 @@ class CopyStream:
                                          jnp.asarray(k[:, l], dt))
             v_pool = self._scatter_layer(v_pool, l, idx,
                                          jnp.asarray(v[:, l], dt))
+        return k_pool, v_pool
+
+    def scatter_blocks(self, k_pool, v_pool, pages: Sequence[int],
+                       k_blocks: Sequence, v_blocks: Sequence):
+        """Scatter already-on-device [L, Hkv, page, Dh] blocks (the h2d
+        prefetch staging buffer) into pool pages — pure device-to-device,
+        so a prefetched tier hit costs admission no host transfer at all.
+        Returns the new pools."""
+        idx = jnp.asarray(list(pages), jnp.int32)
+        k_pool = self._scatter_blocks(k_pool, idx, jnp.stack(k_blocks))
+        v_pool = self._scatter_blocks(v_pool, idx, jnp.stack(v_blocks))
         return k_pool, v_pool

@@ -134,6 +134,11 @@ class PagedEngine:
                                    pcfg.prefetch)
         self.chunk = cfg.prefill_chunk
         self.chunk_pages = -(-self.chunk // self.page)
+        # decode chaining: N tokens per host fetch, the dense path's
+        # packed multi-step discipline — each sampled token feeds the
+        # next forward as a device array, ONE packed fetch per window
+        self.decode_chain = max(1, int(_env_float(
+            "DYN_KVPAGE_DECODE_STEPS", cfg.decode_steps or 4)))
         if pcfg.budget < self.chunk_pages + 2:
             raise ValueError(
                 f"kvpage budget of {pcfg.budget} pages cannot hold a "
@@ -545,35 +550,77 @@ class PagedEngine:
         if fin is not None:
             self._release(seq)
 
+    def _window(self, seq: _PagedSeq) -> int:
+        """Decode tokens to chain before the next host fetch: bounded by
+        the chain knob, the request's remaining token budget and the
+        paged context ceiling — overshoot past a mid-window EOS is the
+        only speculative work (its writes die with the released pages)."""
+        n = self.decode_chain
+        if seq.request.stop.max_tokens:
+            n = min(n, seq.request.stop.max_tokens - seq.generated)
+        n = min(n, self.pcfg.max_context - len(seq.prompt) - seq.generated)
+        return max(1, n)
+
     def _decode_step(self, seq: _PagedSeq, out: List) -> None:
         from ...engine.engine import StepOutput
 
         t_disp = time.perf_counter()
-        pos = seq.total_len
-        self._ensure_resident(seq, pos + 1)
+        N = self._window(seq)
+        pos0 = seq.total_len
+        # residency for the whole window up front: first_res (and thus
+        # every token's read/write indexing) stays fixed across the
+        # chained dispatches
+        self._ensure_resident(seq, pos0 + N)
         if len(seq.resident) > self.pcfg.budget:
             self._demote(seq, self.pcfg.budget - 1)
+        prg = self.programs
+        packed_list: List[jax.Array] = []
         tokens = np.asarray([[seq.last_token]], np.int32)
-        positions = np.asarray([[pos]], np.int32)
-        write_idx = np.asarray([[self._slot(seq, pos)]], np.int32)
-        S = self._bucket_hot(pos + 1 - seq.first_res * self.page)
-        read_idx, read_pos, read_valid = self._hot_read(seq, pos + 1, S)
-        x = self._forward(seq, tokens, positions, write_idx,
-                          read_idx, read_pos, read_valid)
-        seq.tokseq.append(int(seq.last_token))
-        seq.total_len = pos + 1
-        tok, lp = self._sample(seq, x, 0)
+        S_max = 0
+        for i in range(N):
+            pos = pos0 + i
+            positions = np.asarray([[pos]], np.int32)
+            write_idx = np.asarray([[self._slot(seq, pos)]], np.int32)
+            S = self._bucket_hot(pos + 1 - seq.first_res * self.page)
+            S_max = max(S_max, S)
+            read_idx, read_pos, read_valid = self._hot_read(
+                seq, pos + 1, S)
+            x = self._forward(seq, tokens, positions, write_idx,
+                              read_idx, read_pos, read_valid)
+            packed, seq.key, seq.counts = prg.head(
+                self.core.params, x, np.asarray([0], np.int32),
+                seq.temp, seq.top_p, seq.top_k, seq.key, seq.counts,
+                seq.freq_pen, seq.pres_pen)
+            packed_list.append(packed)
+            # chain: the sampled token feeds the next forward ON DEVICE —
+            # no host round-trip between window steps
+            tokens = packed[:, 0:1].astype(jnp.int32)
+        # dynalint: ok(host-sync) THE designed paged-lane fetch, now one
+        # packed (token, logprob) batch per N-token window instead of per
+        # token — stop/stream detection runs host-side on the batch
+        arrs = [np.asarray(p) for p in packed_list]
         from ...utils.roofline import decode_cost
 
-        fl, by, tk = decode_cost(self.core.costs, [pos], 1)
-        self._account("decode", S, fl, by, tk,
+        fl = by = tk = 0.0
+        fin = None
+        for i, arr in enumerate(arrs):
+            seq.tokseq.append(int(seq.last_token))
+            seq.total_len = pos0 + i + 1
+            tok, lp = int(arr[0, 0]), float(arr[0, 1])
+            f, b, t = decode_cost(self.core.costs, [pos0 + i], 1)
+            fl, by, tk = fl + f, by + b, tk + t
+            seq.generated += 1
+            seq.last_token = tok
+            seq.cum_logprob += lp
+            fin = self._finish(seq, tok)
+            out.append(StepOutput(seq.seq_id, tok, seq.cum_logprob, fin,
+                                  token_logprob=lp))
+            if fin is not None:
+                # mid-window stop: tokens past it are discarded; their
+                # page writes/sampler state die with the release below
+                break
+        self._account("decode", S_max, fl, by, tk,
                       time.perf_counter() - t_disp)
-        seq.generated += 1
-        seq.last_token = tok
-        seq.cum_logprob += lp
-        fin = self._finish(seq, tok)
-        out.append(StepOutput(seq.seq_id, tok, seq.cum_logprob, fin,
-                              token_logprob=lp))
         if fin is not None:
             self._release(seq)
 

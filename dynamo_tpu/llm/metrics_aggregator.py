@@ -23,6 +23,7 @@ import json
 import logging
 import math
 import os
+import re
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -52,10 +53,52 @@ def metrics_key(namespace: str, component: str, worker_id: int) -> str:
     return f"{METRICS_PREFIX}{namespace}/{component}/{worker_id:x}"
 
 
+def stage_slices() -> int:
+    """``DYN_STAGE_SLICES``: worker-stable sub-prefix slices of the
+    stage keyspace (``worker_id mod slices``). Regional aggregators
+    rendezvous-own SLICES and read only theirs per tick — a region tick
+    is O(owned slice), not O(fleet). Must agree fleet-wide (publishers
+    and aggregators hash with the same modulus)."""
+    from ..utils.knobs import env_float
+
+    return max(1, int(env_float("DYN_STAGE_SLICES", 16, minimum=1.0)))
+
+
+def stage_slice_of(worker_id: int) -> int:
+    return worker_id % stage_slices()
+
+
+def stage_slice_prefix(namespace: str, slice_idx: int) -> str:
+    """Every stage dump of one slice — the aggregator's per-tick read
+    unit."""
+    return f"{STAGE_PREFIX}{namespace}/s{slice_idx:02x}/"
+
+
 def stage_key(namespace: str, component: str, worker_id: int) -> str:
     """Store key a worker refreshes its per-stage latency histogram dump
-    under (utils.prometheus.StageMetrics state; lease-bound like above)."""
-    return f"{STAGE_PREFIX}{namespace}/{component}/{worker_id:x}"
+    under (utils.prometheus.StageMetrics state; lease-bound like above).
+    The ``s{slice:02x}`` segment is a pure function of the worker id, so
+    the key stays stable across aggregator membership churn while
+    letting an owner scan just its slices."""
+    return (f"{STAGE_PREFIX}{namespace}/s{stage_slice_of(worker_id):02x}/"
+            f"{component}/{worker_id:x}")
+
+
+_SLICE_SEG = re.compile(r"^s[0-9a-f]{2,}$")   # :02x pads, never truncates
+
+
+def split_stage_key(rest: str) -> tuple:
+    """``(component, widhex)`` from the post-``{ns}/`` remainder of a
+    stage BASE key. Tolerates the pre-slice legacy layout (no ``sNN``
+    segment) so FLAT readers and the ``_store`` dump keep parsing —
+    note the regional aggregator's owned-slice scan reads only sliced
+    keys by construction: the slice layout (like ``DYN_STAGE_SLICES``
+    itself) is a fleet-wide flag day, publishers and aggregators
+    upgrade together."""
+    parts = rest.split("/")
+    if len(parts) >= 3 and _SLICE_SEG.match(parts[0]):
+        return parts[1], parts[2]
+    return parts[0], (parts[1] if len(parts) > 1 else "")
 
 
 def stage_delta_key(namespace: str, component: str, worker_id: int) -> str:
@@ -338,7 +381,9 @@ async def fetch_stage_states_ex(store, namespace: Optional[str] = None,
         items = [(k, v) for k, v in items
                  if stage_base_key(k).rsplit("/", 1)[-1]
                  != f"{exclude_worker:x}"]
-    return [(doc.get("component") or key[len(STAGE_PREFIX):].split("/")[1],
+    return [(doc.get("component")
+             or split_stage_key(
+                 key[len(STAGE_PREFIX):].split("/", 1)[-1])[0],
              metrics)
             for key, (doc, metrics) in merge_stage_items(items).items()], \
         None

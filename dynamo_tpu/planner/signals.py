@@ -309,6 +309,7 @@ class SignalCollector:
         aggregators' R pre-merged records replace the N-worker scan
         entirely (flat fallback when no fresh region exists)."""
         from ..llm.metrics_aggregator import (merge_stage_items,
+                                              split_stage_key,
                                               stage_base_key)
         from ..runtime.scale.regions import fetch_region_states
 
@@ -326,7 +327,7 @@ class SignalCollector:
         valid: Dict[str, str] = {}   # base_key -> component
         for key, _value in items:
             base = stage_base_key(key)
-            comp, _, widhex = base[len(prefix):].partition("/")
+            comp, widhex = split_stage_key(base[len(prefix):])
             try:
                 wid = int(widhex, 16)
             except ValueError:

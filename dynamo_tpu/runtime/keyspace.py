@@ -95,12 +95,17 @@ _ALL: List[KeyFamily] = [
         constants=("METRICS_PREFIX",), shard=SHARD_TELEMETRY),
     KeyFamily(
         name="metrics-stage",
-        pattern="metrics_stage/{ns}/{component}/{worker_id:x}[/delta]",
+        pattern="metrics_stage/{ns}/s{wid mod DYN_STAGE_SLICES:02x}/"
+                "{component}/{worker_id:x}[/delta]",
         owner="llm/metrics_aggregator.py", lifecycle=LEASE,
         description="per-stage Prometheus registry snapshots merged "
                     "cluster-wide by the metrics aggregator (full "
-                    "snapshot + coalesced since-last-full delta key)",
-        prefix="metrics_stage/", helpers=("stage_key", "stage_delta_key"),
+                    "snapshot + coalesced since-last-full delta key); "
+                    "the worker-stable slice segment lets a regional "
+                    "aggregator read only its rendezvous-owned slices "
+                    "per tick instead of scanning the fleet",
+        prefix="metrics_stage/",
+        helpers=("stage_key", "stage_delta_key", "stage_slice_prefix"),
         constants=("STAGE_PREFIX",), shard=SHARD_TELEMETRY),
     KeyFamily(
         name="metrics-store",

@@ -268,48 +268,42 @@ class RegionalAggregator:
         return self
 
     def owns(self, worker_id: int) -> bool:
-        return rendezvous_owner(worker_id,
+        """Ownership is rendezvous over the worker's stage SLICE (its
+        worker-stable ``metrics_stage/`` sub-prefix), so the stage scan
+        below can read exactly the owned slices and nothing else while
+        ForwardPassMetrics filtering agrees with it."""
+        from ...llm.metrics_aggregator import stage_slice_of
+
+        return rendezvous_owner(stage_slice_of(worker_id),
                                 sorted(self._peers)) == self._member
+
+    def owned_slices(self) -> List[int]:
+        from ...llm.metrics_aggregator import stage_slices
+
+        members = sorted(self._peers)
+        return [s for s in range(stage_slices())
+                if rendezvous_owner(s, members) == self._member]
 
     # -- one tick ------------------------------------------------------
     async def tick(self) -> RegionRecord:
         from ...llm.metrics_aggregator import (METRICS_PREFIX,
                                                STAGE_PREFIX,
                                                merge_stage_items,
-                                               stage_base_key)
+                                               split_stage_key,
+                                               stage_base_key,
+                                               stage_slice_prefix)
         from ...utils.prometheus import merge_state_dumps, stage_metrics
 
         t0 = time.perf_counter()
-        prefix = f"{STAGE_PREFIX}{self.namespace}/"
-        items = list(await self.store.get_prefix(prefix))
-        # ownership filter FIRST, on the raw keys: the JSON decode +
-        # full/delta overlay below is the expensive part, and running
-        # it over unowned dumps would duplicate that work R times
-        # across the aggregator set instead of dividing it
-        comp_states: Dict[str, List[Dict]] = {}
-        comp_ids: Dict[str, Set[int]] = {}
-        owned_items = []
-        for key, value in items:
-            base = stage_base_key(key)
-            comp, _, widhex = base[len(prefix):].partition("/")
-            try:
-                wid = int(widhex, 16)
-            except ValueError:
-                log.warning("malformed stage key %s", key)
-                continue
-            if not self.owns(wid):
-                continue
-            owned_items.append((key, value))
-            # liveness must not depend on payload health: a live worker
-            # mid-write still counts as a replica (same rule as the
-            # flat collector)
-            comp_ids.setdefault(comp, set()).add(wid)
-        for base, (doc, metrics) in merge_stage_items(
-                owned_items).items():
-            comp, _, _widhex = base[len(prefix):].partition("/")
-            comp_states.setdefault(doc.get("component") or comp,
-                                   []).append(metrics)
+        ns_prefix = f"{STAGE_PREFIX}{self.namespace}/"
+        # FPM scan FIRST: the round-trip also drains any pending peer-
+        # membership watch deliveries on this connection (a peer's
+        # ``regions/`` put strictly precedes our request on the wire), so
+        # the slice-ownership computed below reflects the membership as
+        # of this tick — the ordering the pre-slice code got implicitly
+        # from awaiting the full stage scan before filtering
         fpm: Dict[str, Dict[str, Dict]] = {}
+        fpm_raw: Dict[str, Dict[int, bytes]] = {}
         fpm_prefix = f"{METRICS_PREFIX}{self.namespace}/"
         for key, value in await self.store.get_prefix(fpm_prefix):
             comp, _, widhex = key[len(fpm_prefix):].partition("/")
@@ -318,13 +312,52 @@ class RegionalAggregator:
             except ValueError:
                 log.warning("malformed metrics key %s", key)
                 continue
-            if not self.owns(wid):
-                continue
-            try:
-                fpm.setdefault(comp, {})[f"{wid:x}"] = json.loads(
-                    value.decode())
-            except ValueError:
-                log.warning("malformed metrics payload at %s", key)
+            # raw bytes only here: the ownership filter below runs before
+            # any JSON decode, so each aggregator decodes its N/R share
+            # of the fleet's payloads, not all N
+            fpm_raw.setdefault(comp, {})[wid] = value
+        # read ONLY the owned slices: each is a worker-stable sub-prefix
+        # of the stage keyspace, so a region tick's store read (and the
+        # JSON decode + full/delta overlay below, the expensive part) is
+        # O(owned workers) — membership churn re-homes whole slices
+        # without any publisher writing a new key
+        comp_states: Dict[str, List[Dict]] = {}
+        comp_ids: Dict[str, Set[int]] = {}
+        owned_items = []
+        # the slice reads are independent: fetch them concurrently (one
+        # round-trip's latency, not owned-slice-count of them)
+        slice_reads = await asyncio.gather(*(
+            self.store.get_prefix(stage_slice_prefix(self.namespace, s))
+            for s in self.owned_slices()))
+        for items in slice_reads:
+            for key, value in items:
+                base = stage_base_key(key)
+                comp, widhex = split_stage_key(base[len(ns_prefix):])
+                try:
+                    wid = int(widhex, 16)
+                except ValueError:
+                    log.warning("malformed stage key %s", key)
+                    continue
+                owned_items.append((key, value))
+                # liveness must not depend on payload health: a live
+                # worker mid-write still counts as a replica (same rule
+                # as the flat collector)
+                comp_ids.setdefault(comp, set()).add(wid)
+        for base, (doc, metrics) in merge_stage_items(
+                owned_items).items():
+            comp, _widhex = split_stage_key(base[len(ns_prefix):])
+            comp_states.setdefault(doc.get("component") or comp,
+                                   []).append(metrics)
+        for comp, rows in fpm_raw.items():
+            for wid, value in rows.items():
+                if not self.owns(wid):
+                    continue
+                try:
+                    fpm.setdefault(comp, {})[f"{wid:x}"] = json.loads(
+                        value.decode())
+                except ValueError:
+                    log.warning("malformed metrics payload for %s/%x",
+                                comp, wid)
         components: Dict[str, Dict] = {}
         for comp in set(comp_ids) | set(fpm) | set(comp_states):
             components[comp] = {

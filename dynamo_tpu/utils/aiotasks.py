@@ -63,3 +63,22 @@ async def cancel_all(store: Set["asyncio.Task"]) -> None:
         # logged any non-cancel exception; this await only reaps
         except (asyncio.CancelledError, Exception):
             pass
+
+
+def spawn_blocking(fn, *args, name: Optional[str] = None):
+    """Run a blocking callable on the default executor as a RETAINED
+    future — concurrent with whatever the caller awaits next — reaping
+    (and logging) any failure instead of leaving a GC'd "exception never
+    retrieved" warning. The best-effort overlap helper behind the h2d
+    prefetch call sites; the callable owns its own fallback semantics."""
+    fut = asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+    def _done(t) -> None:
+        if t.cancelled():
+            return
+        exc = t.exception()
+        if exc is not None:
+            log.error("blocking task %s died: %r", name or fn, exc)
+
+    fut.add_done_callback(_done)
+    return fut

@@ -213,6 +213,10 @@ _ALL: List[Knob] = [
     _k("DYN_KVPAGE_MAX_CONTEXT", "int", "131072", "kvpage",
        "context ceiling of the paged lane, tokens (the dense path's "
        "max_context still governs normal requests)"),
+    _k("DYN_KVPAGE_DECODE_STEPS", "int", "4", "kvpage",
+       "paged-lane decode tokens chained on-device per host fetch "
+       "(sampled token feeds the next forward without a round-trip; "
+       "1 = per-token synchronous as before)"),
     # -------------------------------------------------------------- engine
     _k("DYN_PROFILE_DIR", "str", "", "engine",
        "capture an XLA profile of the first working iterations into "
@@ -235,6 +239,11 @@ _ALL: List[Knob] = [
     _k("DYN_METRICS_FULL_EVERY", "int", "10", "metrics",
        "stage-metrics pushes per full snapshot (the rest ship only "
        "changed metrics)"),
+    _k("DYN_STAGE_SLICES", "int", "16", "metrics",
+       "worker-stable sub-prefix slices of the metrics_stage/ keyspace "
+       "(worker_id mod slices); regional aggregators rendezvous-own "
+       "slices and read only theirs per tick instead of scanning the "
+       "full prefix (must agree fleet-wide)"),
     # --------------------------------------------------------------- store
     _k("DYN_STORE_METRICS_INTERVAL", "float", "2.0", "store",
        "seconds between the store server's self-telemetry dumps into its "
@@ -276,6 +285,13 @@ _ALL: List[Knob] = [
        "bounded shared prefill queue depth (0 = unbounded)"),
     _k("DYN_PREFILL_QUEUE_MAX_BATCH", "int", "max/2", "disagg",
        "batch-priority share of the prefill queue"),
+    _k("DYN_KV_STREAM", "bool", "1", "disagg",
+       "layer-streamed disagg KV ingestion: each arriving layer's device "
+       "scatter is enqueued while later layers are in flight (0 = legacy "
+       "full-arrival import; the bench A/B switch)"),
+    _k("DYN_KV_BW_ALPHA", "float", "0.3", "disagg",
+       "EWMA weight of a new per-pair KV-transfer bandwidth observation "
+       "(llm_kv_pair_bw_bytes_per_s)"),
     # -------------------------------------------------------------- router
     _k("DYN_ROUTER_FAST_FAIL", "bool", "0", "router",
        "fail saturated scheduling with a typed 503 instead of "
@@ -297,6 +313,14 @@ _ALL: List[Knob] = [
     _k("DYN_KV_CLUSTER_PEER_WEIGHT", "float", "0.5", "router",
        "score value of a free peer-held block relative to a local block "
        "(discounted further by estimated transfer time)"),
+    _k("DYN_ROUTER_TRANSFER_WEIGHT", "float", "1.0", "router",
+       "logit penalty per expected KV-transfer second of a placement "
+       "(bytes-to-move x measured per-pair bandwidth; 0 = transfer-cost "
+       "term off)"),
+    _k("DYN_H2D_PREFETCH_BLOCKS", "int", "32", "router",
+       "device staging blocks for placement-driven h2d prefetch of "
+       "matched tier prefixes while a request queues at the slot gate "
+       "(0 = prefetch off, admission uploads synchronously as before)"),
     # ----------------------------------------------------------------- llm
     _k("DYN_TOKEN_ECHO_DELAY_MS", "float", "10", "llm",
        "echo-engine per-token pacing, milliseconds (0 = as fast as "

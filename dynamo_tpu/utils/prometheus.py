@@ -570,6 +570,42 @@ class StageMetrics:
             "dyn_kv_cluster_fetch_seconds",
             "Peer prefix fetch duration, request out to blocks deposited",
             (), buckets=LATENCY_BUCKETS_FAST + (2.5, 10.0))
+        # layer-streamed KV ingestion (llm/kv_transfer.py streamed mode):
+        # each arriving layer's device scatter is enqueued while later
+        # layers are still in flight; a torn stream (donor death, codec
+        # violation, abandoned waiter) degrades to counted local prefill
+        # with the partially-written pool pages released unseen
+        self.kv_stream_ingests = r.counter(
+            "dyn_kv_stream_ingests_total",
+            "Remote-prefill KV streams ingested layer-by-layer into the "
+            "decode pool (scatters overlapped with arrival)", ())
+        self.kv_stream_fallbacks = r.counter(
+            "dyn_kv_stream_fallbacks_total",
+            "Streamed KV ingests aborted mid-stream (torn transfer / "
+            "codec violation / abandoned waiter) — pool pages released, "
+            "request fell back to local prefill", ("reason",))
+        # per-(src,dst)-pair KV transfer bandwidth: EWMA observed by the
+        # RECEIVER of every disagg push / cluster fetch — the
+        # TransferCostModel's pair-aware input (src "q" = unknown sender,
+        # e.g. the anonymous prefill-worker pool)
+        self.kv_pair_bw = r.gauge(
+            "llm_kv_pair_bw_bytes_per_s",
+            "Observed KV transfer bandwidth per (src,dst) worker pair, "
+            "exponentially weighted", ("src", "dst"))
+        # placement-driven h2d prefetch (engine/engine.py stage_prefetch):
+        # matched host/disk-tier prefix blocks uploaded to a device
+        # staging buffer while the request still waits in the slot-gate
+        # queue, consumed by admission's restore as a d2d scatter
+        self.prefetch_h2d_hits = r.counter(
+            "dyn_prefetch_h2d_hits_total",
+            "Tier-resident prefix blocks admission restored from the "
+            "prefetched device staging buffer (no h2d on the critical "
+            "path)", ())
+        self.prefetch_h2d_stalls = r.counter(
+            "dyn_prefetch_h2d_stalls_total",
+            "Tier-resident prefix blocks admission had to upload "
+            "synchronously although a prefetch had been requested "
+            "(prefetch incomplete or staging evicted)", ())
         # KV paging plane (llm/kvpage/): the virtual-memory counters —
         # demotions (d2h seal-and-demote), page-ins (async staged h2d),
         # faults (synchronous inline page-ins: the number that must stay

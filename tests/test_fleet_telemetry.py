@@ -203,7 +203,8 @@ async def test_delta_batch_merge_equivalence():
     # delta payloads really are deltas: only the changed metric ships
     c.inc("500")
     assert await pub.publish() == "delta"
-    delta_doc = json.loads(store.kv["metrics_stage/ns/comp/ab/delta"])
+    from dynamo_tpu.llm.metrics_aggregator import stage_delta_key
+    delta_doc = json.loads(store.kv[stage_delta_key("ns", "comp", 0xab)])
     assert set(delta_doc["metrics"]) == {"t_requests_total"}
     await assert_merged_equals_full()
 

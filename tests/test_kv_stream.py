@@ -1,0 +1,406 @@
+"""Layer-streamed disagg KV ingestion (llm/kv_transfer.py streamed mode
++ engine stream-inject): codec validation through the shared assembler,
+token parity with the buffered import and with local prefill, and —
+the safety half of the tentpole — every torn-stream shape (donor death
+at layer l of 2·L parts, over-count, out-of-order layer index, waiter
+abandoned mid-stream) degrading to a counted local-prefill fallback
+with NO partial pool writes visible to attention: pages released,
+nothing sealed, nothing registered."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.llm.kv_transfer import (KvReceiver, KvStreamError,
+                                        LayerStream, RemotePrefillError,
+                                        await_remote_kv, observe_pair_bw)
+from dynamo_tpu.llm.protocols.common import BackendInput, StopConditions
+from dynamo_tpu.models import llama
+from dynamo_tpu.runtime.component import StreamingRequest
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils.prometheus import stage_metrics
+
+PROMPT = list(range(1, 97))
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from dynamo_tpu.engine.engine import JaxEngine, JaxEngineConfig
+
+    eng = JaxEngine(JaxEngineConfig(
+        model=llama.preset("tiny-byte"), max_batch=2, max_context=256,
+        page_size=16, prefill_chunk=64, decode_steps=2))
+    yield eng
+    eng.shutdown()
+
+
+def _bi(max_tokens=4):
+    return BackendInput(token_ids=list(PROMPT),
+                        stop=StopConditions(max_tokens=max_tokens,
+                                            ignore_eos=True))
+
+
+async def _donor_kv(engine):
+    """Real prompt KV + first token from the same engine (the prefill
+    worker's half of the transfer)."""
+    return await engine.prefill_extract(_bi(), Context("donor-extract"))
+
+
+def _meta(rid, k, tok, logp, src="abc"):
+    L, T, H, D = k.shape
+    return {"request_id": rid, "first_token": int(tok),
+            "first_logprob": float(logp), "layers": L, "tokens": T,
+            "kv_heads": H, "head_dim": D, "dtype": str(k.dtype),
+            "src": src}
+
+
+async def _drive(receiver, meta, parts):
+    acks = []
+    async for ack in receiver.handler(StreamingRequest(meta, parts),
+                                      Context()):
+        acks.append(ack)
+    return acks
+
+
+def _full_parts(k, v):
+    async def parts():
+        for layer in range(k.shape[0]):
+            yield k[layer].tobytes()
+            yield v[layer].tobytes()
+    return parts()
+
+
+def _pool_clean(core, seq_id, free_before):
+    """No trace of the sequence may survive a torn stream."""
+    assert seq_id not in core.pool.seqs
+    assert seq_id not in core._stream_injects
+    assert core.pool.free_pages == free_before
+
+
+# ---------------------------------------------------------------------------
+# the shared assembler (pure)
+# ---------------------------------------------------------------------------
+
+def test_layer_stream_codec_validation():
+    got = []
+    ls = LayerStream(2, lambda l, k, v: got.append((l, k, v)))
+    ls.feed("k0")
+    assert got == []                       # k buffered until its v lands
+    ls.feed("v0")
+    assert [g[0] for g in got] == [0]
+    with pytest.raises(KvStreamError) as ei:
+        ls.close()                         # truncated at layer 1
+    assert ei.value.reason == "truncated"
+    ls.feed_layer(1, "k1", "v1")
+    ls.close()
+    assert [g[0] for g in got] == [0, 1]
+    with pytest.raises(KvStreamError) as ei:
+        ls.feed("extra")
+    assert ei.value.reason == "over_count"
+
+    # explicit layer indices are strictly in-order: a skip is torn
+    ls2 = LayerStream(3, lambda *a: None)
+    ls2.feed_layer(0, "k", "v")
+    with pytest.raises(KvStreamError) as ei:
+        ls2.feed_layer(2, "k", "v")
+    assert ei.value.reason == "out_of_order"
+
+
+# ---------------------------------------------------------------------------
+# happy path: streamed ingest == buffered import == local prefill
+# ---------------------------------------------------------------------------
+
+async def test_streamed_ingest_token_parity(engine):
+    stage = stage_metrics()
+    n0 = stage.kv_stream_ingests.get()
+    k, v, tok, logp = await _donor_kv(engine)
+    local = []
+    async for out in engine.generate(_bi(), Context("local-ref")):
+        local.extend(out.token_ids)
+
+    rec = KvReceiver(worker_id=0xd1)
+    ctx = Context("streamed-1")
+    ingest = engine.kv_ingest(_bi(), ctx.id)
+    fut = rec.expect(ctx.id, ingest=ingest)
+    acks = await _drive(rec, _meta(ctx.id, k, tok, logp),
+                        _full_parts(k, v))
+    assert acks == [{"ok": True, "tokens": len(PROMPT), "streamed": True}]
+    got = await fut
+    assert got is ingest                   # resolved to the handle
+    toks = []
+    async for out in engine.generate_streamed(_bi(), ctx, ingest):
+        toks.extend(out.token_ids)
+    assert toks == local == [tok] + local[1:]
+    assert stage.kv_stream_ingests.get() == n0 + 1
+    # the per-pair bandwidth EWMA observed this arrival
+    assert stage.kv_pair_bw.get("abc", f"{0xd1:x}") > 0
+
+
+async def test_stream_disabled_falls_back_to_buffered(engine, monkeypatch):
+    monkeypatch.setenv("DYN_KV_STREAM", "0")
+    k, v, tok, logp = await _donor_kv(engine)
+    rec = KvReceiver(worker_id=0xd2)
+    ctx = Context("buffered-1")
+    ingest = engine.kv_ingest(_bi(), ctx.id)
+    fut = rec.expect(ctx.id, ingest=ingest)
+    acks = await _drive(rec, _meta(ctx.id, k, tok, logp),
+                        _full_parts(k, v))
+    assert acks[0]["streamed"] is False
+    got = await fut
+    kk, vv, t2, l2 = got                   # the legacy tuple contract
+    np.testing.assert_array_equal(kk, k)
+    assert (t2, l2) == (tok, logp)
+
+
+# ---------------------------------------------------------------------------
+# torn streams: counted local-prefill fallback, no partial pool writes
+# ---------------------------------------------------------------------------
+
+async def test_donor_death_mid_stream(engine):
+    """Donor dies at layer l of 2·L parts: the waiter fails over to a
+    typed RemotePrefillError (local prefill), the half-scattered pages
+    release, and nothing was ever sealed or registered."""
+    stage = stage_metrics()
+    fb0 = stage.kv_stream_fallbacks.get("torn")
+    k, v, tok, logp = await _donor_kv(engine)
+    core = engine.core
+    free0 = core.pool.free_pages
+    hashes0 = dict(core.pool.blocks._by_hash)
+
+    rec = KvReceiver(worker_id=0xd3)
+    ctx = Context("torn-1")
+    ingest = engine.kv_ingest(_bi(), ctx.id)
+    fut = rec.expect(ctx.id, ingest=ingest)
+
+    async def dying_parts():
+        yield k[0].tobytes()
+        yield v[0].tobytes()
+        yield k[1].tobytes()
+        raise ConnectionResetError("donor died")
+
+    with pytest.raises(ConnectionResetError):
+        await _drive(rec, _meta(ctx.id, k, tok, logp), dying_parts())
+    with pytest.raises(KvStreamError) as ei:
+        await fut
+    assert ei.value.reason == "torn"
+    assert isinstance(ei.value, RemotePrefillError)   # typed fallback
+    assert stage.kv_stream_fallbacks.get("torn") == fb0 + 1
+    await asyncio.sleep(0.3)               # engine thread drains the abort
+    _pool_clean(core, ctx.id, free0)
+    assert core.pool.blocks._by_hash == hashes0       # nothing registered
+    # the engine is unharmed: the fallback local prefill serves normally
+    toks = []
+    async for out in engine.generate(_bi(), Context("after-torn")):
+        toks.extend(out.token_ids)
+    assert len(toks) == 4
+
+
+async def test_truncated_stream_counted(engine):
+    """Donor closes cleanly but early (got < 2·L parts)."""
+    stage = stage_metrics()
+    fb0 = stage.kv_stream_fallbacks.get("truncated")
+    k, v, tok, logp = await _donor_kv(engine)
+    free0 = engine.core.pool.free_pages
+    rec = KvReceiver(worker_id=0xd4)
+    ctx = Context("trunc-1")
+    ingest = engine.kv_ingest(_bi(), ctx.id)
+    fut = rec.expect(ctx.id, ingest=ingest)
+
+    async def short_parts():
+        yield k[0].tobytes()
+        yield v[0].tobytes()
+
+    acks = await _drive(rec, _meta(ctx.id, k, tok, logp), short_parts())
+    assert acks[0]["ok"] is False and "truncated" in acks[0]["error"]
+    with pytest.raises(KvStreamError):
+        await fut
+    assert stage.kv_stream_fallbacks.get("truncated") == fb0 + 1
+    await asyncio.sleep(0.3)
+    _pool_clean(engine.core, ctx.id, free0)
+
+
+async def test_overcount_stream_counted(engine):
+    stage = stage_metrics()
+    fb0 = stage.kv_stream_fallbacks.get("over_count")
+    k, v, tok, logp = await _donor_kv(engine)
+    free0 = engine.core.pool.free_pages
+    rec = KvReceiver(worker_id=0xd5)
+    ctx = Context("over-1")
+    ingest = engine.kv_ingest(_bi(), ctx.id)
+    fut = rec.expect(ctx.id, ingest=ingest)
+
+    async def extra_parts():
+        for layer in range(k.shape[0]):
+            yield k[layer].tobytes()
+            yield v[layer].tobytes()
+        yield k[0].tobytes()               # one part too many
+
+    acks = await _drive(rec, _meta(ctx.id, k, tok, logp), extra_parts())
+    assert acks[0]["ok"] is False and "over_count" in acks[0]["error"]
+    with pytest.raises(KvStreamError):
+        await fut
+    assert stage.kv_stream_fallbacks.get("over_count") == fb0 + 1
+    await asyncio.sleep(0.3)
+    _pool_clean(engine.core, ctx.id, free0)
+
+
+async def test_waiter_timeout_mid_stream_aborts_ingest(engine):
+    """The decode-side wait expires while layers are still arriving:
+    await_remote_kv returns None (=> local prefill), abandons the
+    receiver entry, and the handler aborts the ingest at the next part —
+    no further pool writes for a request nobody owns."""
+
+    class _Queue:
+        async def cancel(self, rid):
+            pass
+
+    stage = stage_metrics()
+    fb0 = stage.kv_stream_fallbacks.get("abandoned")
+    k, v, tok, logp = await _donor_kv(engine)
+    free0 = engine.core.pool.free_pages
+    rec = KvReceiver(worker_id=0xd6)
+    ctx = Context("expiry-1")
+    ingest = engine.kv_ingest(_bi(), ctx.id)
+    fut = rec.expect(ctx.id, ingest=ingest)
+
+    release = asyncio.Event()
+
+    async def stalling_parts():
+        yield k[0].tobytes()
+        yield v[0].tobytes()
+        await release.wait()               # unbounded-ok: test stub
+        yield k[1].tobytes()
+        yield v[1].tobytes()
+
+    drive = asyncio.ensure_future(
+        _drive(rec, _meta(ctx.id, k, tok, logp), stalling_parts()))
+    await asyncio.sleep(0.1)               # meta + layer 0 land
+    got = await await_remote_kv(ctx, fut, _Queue(), rec,
+                                remote_timeout=0.2)
+    assert got is None                     # timed out => local prefill
+    assert not ingest.began                # abandon aborted the ingest
+    # the worker's actual fallback: local prefill under the SAME seq_id.
+    # The abandon-time abort rode the engine inbox ahead of this submit,
+    # so admission's pool.create must not collide with the half-streamed
+    # sequence — and the late-arriving tail below must not tear down
+    # THIS request's output queue
+    toks = []
+    async for out in engine.generate(_bi(), ctx):
+        toks.extend(out.token_ids)
+        if len(toks) == 1:
+            release.set()                  # the tail arrives mid-retry
+    assert len(toks) == 4
+    acks = await drive
+    assert acks[0]["ok"] is False and "abandoned" in acks[0]["error"]
+    assert stage.kv_stream_fallbacks.get("abandoned") == fb0 + 1
+    await asyncio.sleep(0.3)
+    _pool_clean(engine.core, ctx.id, free0)
+
+
+async def test_geometry_mismatch_declines_stream(engine):
+    """A donor running different model geometry must not stream into
+    the pool: the ingest declines at begin and the buffered path's
+    validation owns the failure."""
+    k, v, tok, logp = await _donor_kv(engine)
+    rec = KvReceiver(worker_id=0xd7)
+    ctx = Context("geom-1")
+    ingest = engine.kv_ingest(_bi(), ctx.id)
+    fut = rec.expect(ctx.id, ingest=ingest)
+    meta = _meta(ctx.id, k, tok, logp)
+    meta["layers"] = meta["layers"] + 1    # foreign model
+    assert ingest.begin(meta) is False
+    assert not ingest.began
+    rec.abandon(ctx.id)
+    assert fut.cancelled()
+
+
+def test_observe_pair_bw_ewma():
+    stage = stage_metrics()
+    observe_pair_bw("s1", "t1", 1000, 1.0)
+    first = stage.kv_pair_bw.get("s1", "t1")
+    assert first == pytest.approx(1000.0)
+    observe_pair_bw("s1", "t1", 3000, 1.0)
+    second = stage.kv_pair_bw.get("s1", "t1")
+    assert 1000.0 < second < 3000.0        # EWMA, not last-write-wins
+    observe_pair_bw("s1", "t1", 0, 1.0)    # degenerate inputs ignored
+    assert stage.kv_pair_bw.get("s1", "t1") == second
+
+
+# ---------------------------------------------------------------------------
+# the timeout/arrival race: the tombstone write yields the loop, so the
+# stream can complete WHILE the waiter is giving up — every outcome branch
+# must consume or discard the resolved ingest, never orphan it
+# ---------------------------------------------------------------------------
+
+class _Discardable:
+    def __init__(self):
+        self.discarded = 0
+
+    def discard(self):
+        self.discarded += 1
+
+
+class _RacingQueue:
+    """queue.cancel resolves the future mid-tombstone — the exact window
+    the race lives in."""
+
+    def __init__(self, fut, result):
+        self.fut, self.result = fut, result
+
+    async def cancel(self, rid):
+        if not self.fut.done():
+            self.fut.set_result(self.result)
+
+
+async def test_timeout_race_consumes_late_arrival():
+    """Plain-timeout branch: an arrival completing during the tombstone
+    write is SERVED, not dropped (and certainly not resubmitted as a
+    colliding local prefill)."""
+    rec = KvReceiver(worker_id=0xe1)
+    ctx = Context("race-consume")
+    marker = _Discardable()
+    fut = rec.expect(ctx.id)
+    got = await await_remote_kv(ctx, fut, _RacingQueue(fut, marker), rec,
+                                remote_timeout=0.05)
+    assert got is marker                   # the race winner is consumed
+    assert marker.discarded == 0
+
+
+async def test_deadline_race_discards_late_arrival():
+    """Deadline branch: the 504 stands, but the resolved ingest (whose
+    sequence already entered decode) is explicitly discarded — no
+    orphaned slot decoding into a queue nobody reads."""
+    from dynamo_tpu.runtime import deadline as dl
+    import time
+
+    rec = KvReceiver(worker_id=0xe2)
+    ctx = Context("race-discard", deadline=time.time() + 0.05)
+    marker = _Discardable()
+    fut = rec.expect(ctx.id)
+    with pytest.raises(dl.DeadlineExceeded):
+        await await_remote_kv(ctx, fut, _RacingQueue(fut, marker), rec,
+                              remote_timeout=60.0)
+    assert marker.discarded == 1
+
+
+async def test_ingest_discard_cancels_entered_sequence(engine):
+    """KvIngest.discard on a FINISHED ingest cancels the decoding
+    sequence and releases its slot/pages instead of leaking them until
+    max_tokens."""
+    k, v, tok, logp = await _donor_kv(engine)
+    rec = KvReceiver(worker_id=0xe3)
+    ctx = Context("discard-1")
+    ingest = engine.kv_ingest(_bi(max_tokens=512), ctx.id)
+    fut = rec.expect(ctx.id, ingest=ingest)
+    await _drive(rec, _meta(ctx.id, k, tok, logp), _full_parts(k, v))
+    assert (await fut) is ingest and ingest.finished
+    ingest.discard()
+    for _ in range(100):                   # engine thread reaps the cancel
+        await asyncio.sleep(0.05)
+        if ctx.id not in engine.core.by_seq \
+                and ctx.id not in engine.core.pool.seqs:
+            break
+    assert ctx.id not in engine.core.by_seq
+    assert ctx.id not in engine.core.pool.seqs
+    assert ctx.id not in engine._queues    # no dict leak
