@@ -12,6 +12,7 @@ from . import fire_forget         # noqa: F401
 from . import host_sync           # noqa: F401
 from . import knob_drift          # noqa: F401
 from . import lock_discipline     # noqa: F401
+from . import loop_blocking_path  # noqa: F401
 from . import metrics_catalog     # noqa: F401
 from . import recompile_hazard    # noqa: F401
 from . import silent_except       # noqa: F401

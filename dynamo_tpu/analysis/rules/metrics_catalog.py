@@ -11,7 +11,11 @@ catalog in ``docs/observability.md`` two-way:
 - every metric-shaped doc token (``dyn_*`` / ``llm_*``, minus wildcard
   families and histogram exposition suffixes) must be registered —
   documented metrics no code exports are exactly how operators end up
-  alerting on series that never appear.
+  alerting on series that never appear;
+- the **type** column of a catalog table row (``counter`` / ``gauge`` /
+  ``histogram``, optionally followed by a label list) must match the
+  register method actually used — a doc claiming ``gauge`` for a
+  counter sends operators writing ``rate()`` over resets the wrong way.
 
 The collection functions are module-level so the legacy standalone CLI
 (and its pinned test asserting specific registered names) can reuse them
@@ -63,6 +67,60 @@ def registered_in_module(mod: Module) -> Dict[str, List[str]]:
     return out
 
 
+def registered_types_in_module(mod: Module) -> Dict[str, Set[str]]:
+    """{metric name: {register method kinds}} for one parsed module —
+    the same literal-first-argument scan as :func:`registered_in_module`,
+    keeping the ``counter``/``gauge``/``histogram`` method instead of the
+    site. A set because nothing stops two files registering one name
+    through different methods (itself a bug the mismatch check surfaces
+    against the doc's single claimed type)."""
+    out: Dict[str, Set[str]] = {}
+    aliases: Dict[str, str] = {}   # local alias name -> register method
+    for node in mod.nodes():
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr in REGISTER_METHODS):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    aliases[t.id] = node.value.attr
+    for node in mod.nodes():
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else "")
+        kind = name if name in REGISTER_METHODS else aliases.get(name)
+        if kind is None or not node.args:
+            continue
+        arg0 = node.args[0]
+        if isinstance(arg0, ast.Constant) and isinstance(
+                arg0.value, str) and DOC_TOKEN.fullmatch(arg0.value):
+            out.setdefault(arg0.value, set()).add(kind)
+    return out
+
+
+def documented_types(doc_path: str) -> Dict[str, str]:
+    """{metric name: claimed type} from the catalog tables: rows shaped
+    ``| `name` | type ... | ...`` where the type cell LEADS with
+    ``counter``/``gauge``/``histogram`` (label lists and prose after it
+    are fine). Non-table mentions carry no type claim and are skipped."""
+    out: Dict[str, str] = {}
+    with open(doc_path, "r", encoding="utf-8") as f:
+        for line in f:
+            if not line.lstrip().startswith("|"):
+                continue
+            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            if len(cells) < 2:
+                continue
+            m = DOC_TOKEN.fullmatch(cells[0].strip("`"))
+            if m is None:
+                continue
+            claimed = cells[1].split()[0].rstrip(",") if cells[1] else ""
+            if claimed in REGISTER_METHODS:
+                out[m.group(0)] = claimed
+    return out
+
+
 def documented_tokens(doc_path: str) -> Set[str]:
     with open(doc_path, "r", encoding="utf-8") as f:
         text = f.read()
@@ -73,9 +131,27 @@ def documented_tokens(doc_path: str) -> Set[str]:
 
 
 def catalog_findings(registered: Dict[str, List[str]],
-                     documented: Set[str], rule: str = "metrics-catalog"
+                     documented: Set[str], rule: str = "metrics-catalog",
+                     registered_kinds: Dict[str, Set[str]] = None,
+                     claimed_types: Dict[str, str] = None
                      ) -> List[Finding]:
     findings: List[Finding] = []
+    # type column vs register method (only for names both sides know;
+    # presence mismatches are reported by the two-way checks below)
+    for name in sorted(claimed_types or {}):
+        kinds = (registered_kinds or {}).get(name)
+        claimed = claimed_types[name]
+        if not kinds or claimed in kinds:
+            continue
+        where = registered.get(name, [f"{DOC_REL}:0"])[0]
+        path, _, line = where.rpartition(":")
+        findings.append(Finding(
+            rule=rule, path=path, line=int(line),
+            message=(f"metric {name!r} is documented as {claimed!r} but "
+                     f"registered as {'/'.join(sorted(kinds))} — fix the "
+                     f"type column in docs/observability.md (or the "
+                     f"registration)"),
+            key=f"type-mismatch:{name}"))
     for name in sorted(registered):
         if name not in documented:
             where = registered[name][0]
@@ -110,15 +186,19 @@ class MetricsCatalogRule(Rule):
 
     def check_repo(self, modules: List[Module], repo: str) -> List[Finding]:
         registered: Dict[str, List[str]] = {}
+        kinds: Dict[str, Set[str]] = {}
         for mod in modules:
             if not mod.rel.startswith(CODE_PREFIX):
                 continue
             for name, sites in registered_in_module(mod).items():
                 registered.setdefault(name, []).extend(sites)
+            for name, ks in registered_types_in_module(mod).items():
+                kinds.setdefault(name, set()).update(ks)
         doc_path = os.path.join(repo, DOC_REL)
         if not os.path.exists(doc_path):
             return [Finding(rule=self.name, path=DOC_REL, line=0,
                             message="docs/observability.md is missing",
                             key="doc:missing")]
         return catalog_findings(registered, documented_tokens(doc_path),
-                                rule=self.name)
+                                rule=self.name, registered_kinds=kinds,
+                                claimed_types=documented_types(doc_path))

@@ -56,6 +56,13 @@ async def run_aggregator(args, *, ready_event=None,
         drt = await DistributedRuntime(store_host=host,
                                        store_port=int(port)).connect()
     tracing.configure(component="aggregator")
+    # flight recorder + watchdog + incident coordination: a capture
+    # beacon gets this aggregator's merge-loop view of the window too
+    from .. import obs
+
+    obs_handle = await obs.start_process(
+        "aggregator", store=drt.store, namespace=args.namespace,
+        proc_label=f"aggregator:{drt.worker_id:x}")
     interval = args.interval if args.interval is not None \
         else region_interval()
     agg = await RegionalAggregator(drt.store, args.namespace,
@@ -71,6 +78,7 @@ async def run_aggregator(args, *, ready_event=None,
                                drt.worker_id, drt.lease)
     agg._drt = drt            # keeps the runtime alive with the daemon
     agg._own_drt = own_drt
+    agg._obs_handle = obs_handle
 
     async def publish_loop():
         while True:
@@ -98,6 +106,7 @@ async def amain(args) -> None:
     finally:
         await agg.stop()
         agg._pub_task.cancel()
+        await agg._obs_handle.stop()
         if agg._own_drt:
             await agg._drt.close()
 

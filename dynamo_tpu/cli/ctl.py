@@ -88,6 +88,31 @@ def parse_args(argv=None):
     frem.add_argument("--namespace", default="dynamo")
     flist = fsub.add_parser("list")
     flist.add_argument("--namespace", default="dynamo")
+
+    # incident plane: flight-recorder capture beacons + assembled bundles
+    inc = sub.add_parser("incident")
+    isub = inc.add_subparsers(dest="action", required=True)
+    icap = isub.add_parser("capture",
+                           help="publish a manual capture beacon: every "
+                                "live process dumps its rings")
+    icap.add_argument("--namespace", default="dynamo")
+    icap.add_argument("--reason", default="manual")
+    icap.add_argument("--trace-id", default=None,
+                      help="retro-assemble this trace into the bundle "
+                           "(sampled-out spans included)")
+    icap.add_argument("--window", type=float, default=30.0,
+                      help="seconds of ring history before now to freeze")
+    ils = isub.add_parser("ls")
+    ils.add_argument("--namespace", default="dynamo")
+    ishow = isub.add_parser("show")
+    ishow.add_argument("incident_id")
+    ishow.add_argument("--namespace", default="dynamo")
+    iexp = isub.add_parser("export")
+    iexp.add_argument("incident_id")
+    iexp.add_argument("--namespace", default="dynamo")
+    iexp.add_argument("-o", "--out", default=None,
+                      help="output file (default <incident_id>.json); "
+                           "feed to `tracectl --bundle`")
     return p.parse_args(argv)
 
 
@@ -118,6 +143,8 @@ async def run(args) -> int:
     host, port = args.store.split(":")
     store = await make_store_client(host, int(port)).connect()
     try:
+        if args.plane == "incident":
+            return await run_incident(store, args)
         if args.plane == "fleet":
             from ..fleet.registry import (FleetModelSpec, fetch_fleet_status,
                                           list_fleet_models,
@@ -210,6 +237,49 @@ async def run(args) -> int:
         return 0
     finally:
         await store.close()
+
+
+async def run_incident(store, args) -> int:
+    from ..obs import incidents as _incidents
+
+    if args.action == "capture":
+        beacon = await _incidents.publish_beacon(
+            store, args.namespace, args.reason, window_s=args.window,
+            trace_id=args.trace_id, by="ctl")
+        print(f"incident {beacon['id']} captured: every live process is "
+              f"dumping its rings\n  inspect: ctl incident show "
+              f"{beacon['id']}")
+        return 0
+    if args.action == "ls":
+        beacons = await _incidents.list_incidents(store, args.namespace)
+        if not beacons:
+            print(f"(no live incidents in {args.namespace!r})")
+            return 0
+        import time as _time
+        for b in beacons:
+            age = _time.time() - b.get("at", 0.0)
+            tid = b.get("trace_id") or "-"
+            print(f"{b['id']:<40} {b['reason']:<16} age={age:>6.0f}s "
+                  f"trace={tid}  by={b.get('by', '?')}")
+        return 0
+    bundle = await _incidents.fetch_bundle(store, args.namespace,
+                                           args.incident_id)
+    if bundle is None:
+        print(f"no incident {args.incident_id!r} (expired or never "
+              f"captured)")
+        return 1
+    if args.action == "show":
+        for line in _incidents.bundle_summary(bundle):
+            print(line)
+        return 0
+    # export: the offline bundle tracectl --bundle consumes
+    out = args.out or f"{args.incident_id}.json"
+    with open(out, "w") as f:
+        json.dump(bundle, f, indent=1, sort_keys=True)
+    print(f"incident {args.incident_id} -> {out} "
+          f"({len(bundle['processes'])} process dumps, "
+          f"{len(bundle['trace'])} trace spans)")
+    return 0
 
 
 def main() -> None:

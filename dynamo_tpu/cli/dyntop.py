@@ -196,6 +196,14 @@ class ClusterSnapshotter:
                 store_shards[name] = st
             for fam, idx in self.store.fam_map.items():
                 shard_of_family[fam] = self.store.shard_names[idx]
+        # live incident beacons (flight-recorder capture plane)
+        incidents: List[Dict] = []
+        try:
+            from ..obs.incidents import list_incidents
+
+            incidents = await list_incidents(self.store, self.namespace)
+        except Exception:  # noqa: BLE001 - incident plane optional
+            pass
         burn = self.slo.observe(states) if self.slo.objectives else {}
         overload = {
             "brownout": brownout_level_from_states(states),
@@ -223,6 +231,7 @@ class ClusterSnapshotter:
             "compiles": _compile_totals(states),
             "slo_burn": burn,
             "overload": overload,
+            "incidents": incidents,
         }
 
 
@@ -546,6 +555,14 @@ def render(snap: Dict, store_detail: bool = False) -> str:
             f"overload: brownout=L{lvl} ({LEVEL_NAMES.get(lvl, '?')})  "
             f"shed={int(ov.get('shed_total', 0))}  "
             f"admit_q={int(ov.get('admission_depth', 0))}")
+    inc = snap.get("incidents") or []
+    if inc:
+        latest = inc[0]           # list_incidents sorts newest first
+        age = time.time() - latest.get("at", 0.0)
+        lines.append(
+            f"incidents: {len(inc)} live  latest={latest.get('id', '?')} "
+            f"({latest.get('reason', '?')}, {age:.0f}s ago)  "
+            f"-> ctl incident show {latest.get('id', '?')}")
     lines.append(
         f"{'worker':>10} {'comp':<9} {'slots':>7} {'kv%':>5} {'hit%':>5} "
         f"{'mfu%':>6} {'mbu%':>6} {'GB/s':>7} {'spec%':>6} {'brk':>4}")

@@ -189,6 +189,20 @@ async def run_http(args, *, ready_event=None,
 
     svc.stage_worker_id = drt.worker_id   # /metrics skips our own dump
     pub_ns = getattr(args, "namespace", None) or "dynamo"
+
+    # flight recorder + watchdog + incident coordination: the frontend's
+    # rings hold the request-edge spans and its store-health transitions;
+    # on a capture beacon it also contributes the router's live decision-
+    # ring slice (the frontend already knows how to fetch it)
+    from .. import obs
+
+    obs_handle = await obs.start_process(
+        "http", store=drt.store, namespace=pub_ns,
+        proc_label=f"http:{drt.worker_id:x}")
+    if args.router_component:
+        obs_handle.manager.add_source("router_decisions",
+                                      frontend.fetch_router_decisions)
+    svc._obs_handle = obs_handle   # stopped by HttpService.stop()
     # fleet brownout level (utils/overload.py): watch the store key the
     # controller publishes so THIS frontend's admission gate applies the
     # active degradation level — the level is fleet state, not local state

@@ -75,6 +75,16 @@ async def run_prefill_worker(args, *,
     # /v1/traces stitches them); histogram dumps refresh under our lease
     tracing.configure(component="prefill_worker")
     span_sink = await tracing.StoreSpanSink(drt.store).start()
+
+    # flight recorder + watchdog + incident coordination (see cli/worker):
+    # a prefill stall or torn push shows up in THIS process's rings, and a
+    # beacon raised anywhere in the cluster captures our slice too
+    from .. import obs
+
+    obs_handle = await obs.start_process(
+        "prefill_worker", store=drt.store, namespace=args.namespace,
+        proc_label=f"prefill_worker:{drt.worker_id:x}",
+        span_sink=span_sink, install_signal=token is not None)
     from ..llm.metrics_aggregator import StagePublisher
 
     publisher = StagePublisher(drt.store, args.namespace,
@@ -200,6 +210,7 @@ async def run_prefill_worker(args, *,
     finally:
         stage_task.cancel()
         queue.close()   # cancel parked per-priority pulls
+        await obs_handle.stop()
         try:
             await span_sink.stop()   # final flush: short-lived runs
         except Exception:            # (max_jobs) must not lose spans

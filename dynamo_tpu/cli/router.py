@@ -66,6 +66,16 @@ async def run_router(args, *, ready_event=None,
                     exc_info=True)
     await svc.start()
     await svc.serve(drt.namespace(args.namespace).component(args.component))
+    # flight recorder + watchdog + incident coordination: an incident
+    # bundle gets this router's decision-ring slice — WHY the wedged /
+    # torn-stream request landed on that worker is part of the black box
+    from .. import obs
+
+    obs_handle = await obs.start_process(
+        "router", store=drt.store, namespace=args.namespace,
+        proc_label=f"router:{drt.worker_id:x}")
+    obs_handle.manager.add_source("router_decisions",
+                                  lambda: svc.decisions(0))
     # publish this process's stage registry (dyn_kv_cluster_hits_total,
     # histogram series the audit plane reads) onto the standard
     # metrics_stage/ merge path — a router that only *made* decisions
@@ -94,6 +104,7 @@ async def run_router(args, *, ready_event=None,
             await asyncio.sleep(3600)
     finally:
         stage_task.cancel()
+        await obs_handle.stop()
         await svc.stop()
         if own:
             await drt.close()

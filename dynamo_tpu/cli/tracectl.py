@@ -5,6 +5,8 @@ pretty-print it as an ASCII waterfall (or save Chrome trace-event JSON).
         [--url http://127.0.0.1:8080] [--chrome out.json] [--json]
     python -m dynamo_tpu.cli.tracectl --list [--url ...]
     python -m dynamo_tpu.cli.tracectl decisions [--limit N] [--json]
+    python -m dynamo_tpu.cli.tracectl --bundle incident.json \
+        [--chrome out.json] [--json]
 
 The request id is the ``x-request-id`` response header every frontend
 response carries. ``--chrome`` writes Perfetto-loadable trace-event JSON
@@ -13,6 +15,12 @@ response carries. ``--chrome`` writes Perfetto-loadable trace-event JSON
 ``decisions`` prints the KV router's decision audit
 (``GET /v1/router/decisions``): one line per routed request with the
 chosen worker and each candidate's overlap/cache_usage/load score terms.
+
+``--bundle FILE`` consumes an exported incident bundle
+(``ctl incident export``) entirely OFFLINE — no frontend needed: the
+retro-assembled trace renders as the usual waterfall, ``--chrome`` emits
+Perfetto JSON from it, and the per-process ring/stall summary prints
+alongside.
 """
 
 from __future__ import annotations
@@ -125,12 +133,50 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="dump the raw span JSON instead of the waterfall")
     p.add_argument("--chrome", default=None, metavar="FILE",
                    help="write Chrome trace-event JSON to FILE")
+    p.add_argument("--bundle", default=None, metavar="FILE",
+                   help="read an exported incident bundle instead of a "
+                        "frontend (offline; see `ctl incident export`)")
     return p.parse_args(argv)
+
+
+def run_bundle(args) -> int:
+    """Offline incident-bundle mode: summary + trace waterfall (or
+    --chrome / --json) from the exported file alone."""
+    from ..obs.incidents import bundle_summary
+    from ..utils.tracing import Span, merge_spans, to_chrome_trace
+
+    with open(args.bundle) as f:
+        bundle = json.load(f)
+    if args.json:
+        print(json.dumps(bundle["trace"], indent=2))
+        return 0
+    if args.chrome:
+        # the trigger's retro-assembled trace plus EVERY process's ring
+        # spans: a manual/SIGUSR2 capture has no trigger trace, but its
+        # rings still hold the last window of activity per process
+        groups = [[Span.from_dict(d) for d in bundle.get("trace", [])]]
+        for snap in bundle.get("processes", {}).values():
+            ring = snap.get("rings", {}).get("spans", {}).get("items", [])
+            groups.append([Span.from_dict(d) for d in ring])
+        chrome = to_chrome_trace(merge_spans(*groups))
+        with open(args.chrome, "w") as f:
+            json.dump(chrome, f)
+        print(f"wrote {len(chrome.get('traceEvents', []))} events to "
+              f"{args.chrome} (load in https://ui.perfetto.dev)")
+        return 0
+    for line in bundle_summary(bundle):
+        print(line)
+    if bundle.get("trace"):
+        print()
+        print(render_timeline(bundle["trace"]))
+    return 0
 
 
 def run(args) -> int:
     base = args.url.rstrip("/")
     try:
+        if args.bundle:
+            return run_bundle(args)
         if args.list:
             data = _fetch_json(f"{base}/v1/traces")
             for tid in data.get("traces", []):
@@ -164,7 +210,7 @@ def run(args) -> int:
     except urllib.error.HTTPError as e:
         print(f"error: {e.code} {e.reason} for {e.url}", file=sys.stderr)
         return 1
-    except OSError as e:
+    except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
 

@@ -145,6 +145,18 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
     tracing.configure(component="decode_worker")
     span_sink = await tracing.StoreSpanSink(drt.store).start()
 
+    # flight recorder + hang watchdog + incident coordination: the rings
+    # mirror every finished span (head-sampled-out ones included), the
+    # watchdog turns wedged decode dispatches / transfers / drains into
+    # stall:* spans, and any cluster beacon freezes our rings into the
+    # coordinated bundle. SIGUSR2 = manual capture (real process only).
+    from .. import obs
+
+    obs_handle = await obs.start_process(
+        "decode_worker", store=drt.store, namespace=args.namespace,
+        proc_label=f"decode_worker:{drt.worker_id:x}",
+        span_sink=span_sink, install_signal=token is not None)
+
     # --- engine -------------------------------------------------------
     card = _build_card(args)
 
@@ -425,6 +437,7 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
         # caller may repurpose after this worker exits (shared-drt case)
         drt.store.on_lease_lost = None
         mtask.cancel()
+        await obs_handle.stop()
         try:
             await span_sink.stop()
         except Exception:

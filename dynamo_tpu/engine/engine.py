@@ -39,6 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..llm.model_card import ModelDeploymentCard
 from ..llm.protocols.common import BackendInput, EngineOutput, FinishReason
 from ..models import llama
+from ..obs import flightrec as _flightrec
 from ..parallel.mesh import AXIS_TP, serving_mesh
 from ..runtime.engine import AsyncEngine, Context
 from .cache import OutOfPages, PagePool
@@ -1940,6 +1941,11 @@ class EngineCore:
                                "lengths": [phys for _, _, phys in active],
                                "compiled": self._take_compiled_flag(),
                                "dispatched_at": time.perf_counter()})
+        # flight recorder: the hang watchdog judges "a dispatch in flight
+        # with no fetch completing for N x the EWMA step time" off this
+        _flightrec.hb_begin("engine.decode", stall="decode")
+        _flightrec.note_event("engine.dispatch", depth=len(self._inflight),
+                              batch=len(active), steps=S)
 
     def _run_decode_program(self, S: int, tokens, page_tables, lengths,
                             fresh, active_mask):
@@ -2176,11 +2182,18 @@ class EngineCore:
             # dispatches overlap compute, which this deliberately reflects)
             elapsed = time.perf_counter() - rec["dispatched_at"]
             self.stage.decode_step.observe(value=elapsed / N)
+            # after the blocking fetch (a wedged device shows up THERE):
+            # feed the watchdog's step-time EWMA and balance hb_begin
+            _flightrec.hb_done("engine.decode", elapsed / N)
+            _flightrec.note_event("engine.step", s=round(elapsed, 6), n=N,
+                                  compiled=bool(rec.get("compiled")))
             if not rec.get("compiled"):
                 from ..utils.roofline import decode_cost
 
                 fl, by, tk = decode_cost(self.costs, rec["lengths"], N)
                 self.goodput.account(fl, by, elapsed, tk)
+        else:
+            _flightrec.hb_done("engine.decode")
         outs: List[StepOutput] = []
         for i, slot, _ in rec["active"]:
             if self.slots[i] is not slot:

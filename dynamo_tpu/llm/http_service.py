@@ -144,6 +144,8 @@ class HttpService:
         app.router.add_get("/v1/traces", self._list_traces)
         app.router.add_get("/v1/traces/{request_id}", self._get_trace)
         app.router.add_get("/v1/router/decisions", self._router_decisions)
+        app.router.add_get("/v1/incidents", self._list_incidents)
+        app.router.add_get("/v1/incidents/{incident_id}", self._get_incident)
         app.router.add_get("/health", self._health)
         app.router.add_get("/metrics", self._metrics)
         return app
@@ -163,6 +165,9 @@ class HttpService:
         pub = getattr(self, "_stage_pub_task", None)
         if pub is not None:          # discovery-mode stage publish loop
             pub.cancel()
+        obs_h = getattr(self, "_obs_handle", None)
+        if obs_h is not None:        # discovery-mode flight-recorder plane
+            await obs_h.stop()
         if self._runner:
             await self._runner.cleanup()
 
@@ -241,6 +246,33 @@ class HttpService:
                              "instance, or none discovered yet)")
         return web.json_response({"decisions": decisions,
                                   "count": len(decisions)})
+
+    async def _list_incidents(self, _req: web.Request) -> web.Response:
+        """Live incident beacons (flight-recorder capture coordination) —
+        the same view ``ctl incident ls`` renders. 404 without a store."""
+        if self.store is None:
+            return _err(404, "no store configured on this frontend")
+        from ..obs import incidents as _incidents
+
+        ns = self.namespace or "dynamo"
+        beacons = await _incidents.list_incidents(self.store, ns)
+        return web.json_response({"incidents": beacons,
+                                  "count": len(beacons)})
+
+    async def _get_incident(self, req: web.Request) -> web.Response:
+        """One assembled incident bundle: manifest + per-process ring
+        dumps + the trigger's retro-assembled trace."""
+        if self.store is None:
+            return _err(404, "no store configured on this frontend")
+        from ..obs import incidents as _incidents
+
+        iid = req.match_info["incident_id"]
+        ns = self.namespace or "dynamo"
+        bundle = await _incidents.fetch_bundle(self.store, ns, iid)
+        if bundle is None:
+            return _err(404, f"no incident {iid!r} (expired or never "
+                             f"captured)")
+        return web.json_response(bundle)
 
     async def _models(self, _req: web.Request) -> web.Response:
         now = int(time.time())

@@ -40,6 +40,8 @@ from typing import AsyncIterator, Callable, Dict, Optional, Tuple
 import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
 import numpy as np
 
+from ..obs import flightrec as _flightrec
+from ..obs import incidents as _incidents
 from ..runtime.component import Client, StreamingRequest
 from ..runtime.engine import Context
 from ..utils.knobs import env_float
@@ -87,6 +89,10 @@ def observe_pair_bw(src: str, dst: str, nbytes: int,
         cur = bw if prev is None else alpha * bw + (1.0 - alpha) * prev
         _pair_bw[(src, dst)] = cur
     stage_metrics().kv_pair_bw.set(src, dst, value=cur)
+    # EWMA snapshot into the flight-recorder ring: an incident bundle
+    # shows what bandwidth the placement signals were actually seeing
+    _flightrec.note_event("kv.pair_bw", src=src, dst=dst,
+                          bw=round(cur), sample_bw=round(bw))
 
 
 # ---------------------------------------------------------------------------
@@ -374,6 +380,10 @@ class KvReceiver:
             except Exception:  # noqa: BLE001 - cleanup must not mask
                 log.exception("kv ingest abort failed for %s", rid)
         stage_metrics().kv_stream_fallbacks.inc(exc.reason)
+        _flightrec.note_event("kv.torn", rid=rid, reason=exc.reason)
+        # a torn disagg stream is an incident trigger: every process that
+        # touched this request freezes and dumps its rings
+        _incidents.trigger("torn_stream", trace_id=rid, cause=exc.reason)
         fut = self._pending.pop(rid, None)
         self._ingests.pop(rid, None)
         if fut is not None and not fut.done():
@@ -419,6 +429,12 @@ class KvReceiver:
             "kv.receive", parent=extract_wire(meta.get("trace"), rid),
             request_id=rid, tokens=T, layers=L,
             streamed=ingest is not None)
+        # watchdog heartbeat: an in-flight stream making no layer
+        # progress inside the budget is a wedged transfer (stall:transfer)
+        hb_name = f"kv.recv:{rid}"
+        _flightrec.hb_begin(
+            hb_name, stall="transfer", trace_id=rid,
+            budget=env_float("DYN_WATCHDOG_TRANSFER", 5.0, minimum=0.1))
         try:
             async for part in request.parts:
                 if fut is not None and fut.done():
@@ -429,6 +445,7 @@ class KvReceiver:
                                         f"waiter for {rid} gone")
                 stream.feed(np.frombuffer(part, dtype).reshape(T, H, D))
                 nbytes += len(part)
+                _flightrec.hb_progress(hb_name)
             stream.close()
         except KvStreamError as e:
             get_tracer().finish(recv_span, status="error")
@@ -441,6 +458,8 @@ class KvReceiver:
             get_tracer().finish(recv_span, status="error")
             self._fail(rid, ingest, KvStreamError("torn", str(e)))
             raise
+        finally:
+            _flightrec.hb_end(hb_name)
         if recv_span is not None:
             recv_span.attrs["bytes"] = nbytes
         get_tracer().finish(recv_span)

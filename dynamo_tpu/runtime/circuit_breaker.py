@@ -93,6 +93,13 @@ class InstanceBreaker:
                 log.warning("instance %x circuit OPEN after %d consecutive "
                             "failures (cooldown %.1fs)", iid, e.failures,
                             self.cooldown)
+                # breaker trip = incident trigger: freeze fleet rings
+                # around the moment the instance went dark (no-op in
+                # processes without an incident manager)
+                from ..obs import incidents as _incidents
+
+                _incidents.trigger("breaker_trip", instance=f"{iid:x}",
+                                   failures=e.failures)
         self._export(iid)
 
     def record_success(self, iid: int) -> None:

@@ -231,6 +231,19 @@ _ALL: List[KeyFamily] = [
         prefix="regions/", helpers=("region_key", "regions_prefix"),
         constants=("REGIONS_PREFIX",), shard=SHARD_TELEMETRY),
     KeyFamily(
+        name="incidents",
+        pattern="incidents/{ns}/(beacon|bundle/{id})/...",
+        owner="obs/incidents.py", lifecycle=TTL,
+        description="coordinated incident capture: beacons (the "
+                    "manifest every process watches — any trigger "
+                    "freezes fleet-wide ring dumps) and per-process "
+                    "flight-recorder dumps under the bundle prefix; "
+                    "both expire with their DYN_INCIDENT_TTL lease",
+        prefix="incidents/",
+        helpers=("incident_beacon_key", "incident_beacon_prefix",
+                 "incident_dump_key", "incident_dump_prefix"),
+        constants=("INCIDENT_PREFIX",), shard=SHARD_TELEMETRY),
+    KeyFamily(
         name="deployments",
         pattern="deploy/deployments/{ns}/{name}",
         owner="deploy/crd.py", lifecycle=PERSISTENT,

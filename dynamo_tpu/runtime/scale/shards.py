@@ -268,14 +268,16 @@ class ShardedStoreClient:
                 log.exception("on_session_replayed callback")
 
     async def lease_grant(self, ttl: float = 5.0,
-                          auto_keepalive: bool = True) -> int:
+                          auto_keepalive: bool = True,
+                          bind: bool = True) -> int:
         lid = await self.shards[0].lease_grant(
-            ttl, auto_keepalive=auto_keepalive)
+            ttl, auto_keepalive=auto_keepalive, bind=bind)
         mirrors = {0: lid}
         try:
             for i, sh in enumerate(self.shards[1:], 1):
                 mirrors[i] = await sh.lease_grant(
-                    ttl, auto_keepalive=auto_keepalive, reuse=lid)
+                    ttl, auto_keepalive=auto_keepalive, reuse=lid,
+                    bind=bind)
         except Exception:
             # half-granted liveness is worse than no lease: roll back
             for i, mid in mirrors.items():

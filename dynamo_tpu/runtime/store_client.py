@@ -27,6 +27,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Set, Tuple
 
+from ..obs import flightrec as _flightrec
 from ..utils import faults
 from .wire import FrameReader, write_frame
 
@@ -193,6 +194,7 @@ class StoreClient:
             self.closed.set()
             return
         log.warning("store connection lost (%s); reconnecting", why)
+        _flightrec.note_event("store.conn_lost", why=why)
         if self._reconnect_task is None or self._reconnect_task.done():
             self._reconnect_task = asyncio.create_task(
                 self._reconnect_loop(), name="store-reconnect")
@@ -276,6 +278,7 @@ class StoreClient:
                 stage.store_reconnects.inc("ok")
                 log.info("store session re-established (attempt %d)",
                          attempt)
+                _flightrec.note_event("store.reconnected", attempt=attempt)
                 self._connected.set()
                 if self.on_session_replayed is not None:
                     try:
@@ -473,14 +476,20 @@ class StoreClient:
     # -- leases ----------------------------------------------------------
     async def lease_grant(self, ttl: float = 5.0,
                           auto_keepalive: bool = True,
-                          reuse: Optional[int] = None) -> int:
+                          reuse: Optional[int] = None,
+                          bind: bool = True) -> int:
         """Grant a lease; ``reuse`` asks the server for a SPECIFIC id —
         how a sharded store mirrors one session lease onto every shard
         (and how session replay preserves identity). A server that
-        cannot honor it returns its own id; the caller must check."""
+        cannot honor it returns its own id; the caller must check.
+        ``bind=False`` grants an orphan lease that survives this
+        connection's death and expires only by TTL — for keys that must
+        outlive their producer (incident bundles, trace spans)."""
         kw = {"ttl": ttl}
         if reuse is not None:
             kw["reuse"] = int(reuse)
+        if not bind:
+            kw["bind"] = False
         r = await self._call("lease_grant", **kw)
         lease = r["lease"]
         if auto_keepalive:
@@ -497,6 +506,8 @@ class StoreClient:
         # the reference (etcd.rs:55-76 — lease loss cancels the worker's
         # token): notify so the shell can shut down for a clean restart.
         log.warning("lease %x lost (%s); keepalive stopping", lease, why)
+        _flightrec.note_event("store.lease_lost", lease=f"{lease:x}",
+                              why=why)
         self._session_leases.pop(lease, None)
         if self.on_lease_lost is not None:
             try:

@@ -424,6 +424,11 @@ class StoreServer:
     # -- leases ----------------------------------------------------------
     async def _op_lease_grant(self, conn, m):
         ttl = float(m.get("ttl", DEFAULT_TTL))
+        # bind=False grants an ORPHAN lease: no owning connection, expires
+        # only by TTL. For data meant to outlive its producer — incident
+        # beacons/ring dumps, trace spans (a crashed worker's black box
+        # must survive the crash that made it interesting).
+        bind = bool(m.get("bind", True))
         reuse = m.get("reuse")
         if reuse is not None:
             # session re-establishment: a reconnecting client re-grants its
@@ -438,10 +443,11 @@ class StoreServer:
                 old = lease.owner
                 if old is not None and old is not conn:
                     old.leases.discard(lid)
-                lease.owner = conn
+                lease.owner = conn if bind else None
                 lease.ttl = ttl
                 lease.expires = time.monotonic() + ttl
-                conn.leases.add(lid)
+                if bind:
+                    conn.leases.add(lid)
                 return {"lease": lid, "ttl": ttl}
         else:
             lid = next(self._lease_ids)
@@ -450,8 +456,9 @@ class StoreServer:
             while lid in self._leases:
                 lid = next(self._lease_ids)
         self._leases[lid] = _Lease(lid, ttl, time.monotonic() + ttl,
-                                   owner=conn)
-        conn.leases.add(lid)
+                                   owner=conn if bind else None)
+        if bind:
+            conn.leases.add(lid)
         return {"lease": lid, "ttl": ttl}
 
     async def _op_lease_keepalive(self, conn, m):

@@ -146,6 +146,19 @@ class Worker:
             cancel_wait.cancel()
 
     async def _shutdown(self, app_task: asyncio.Task) -> None:
+        # flight-recorder heartbeat over the whole drain: a drain that
+        # outlives grace*1.25 (natural window + flush window + slack) is a
+        # wedged stream, and the watchdog turns it into a stall:drain span
+        from ..obs import flightrec as _flightrec
+
+        _flightrec.hb_begin("worker.drain", stall="drain",
+                            budget=self.grace * 1.25 + 1.0)
+        try:
+            await self._shutdown_inner(app_task)
+        finally:
+            _flightrec.hb_end("worker.drain")
+
+    async def _shutdown_inner(self, app_task: asyncio.Task) -> None:
         # 0. become invisible FIRST: deregister endpoints (lease revoke) so
         # the watch plane routes new work elsewhere, and flag draining so
         # queue-pull loops stop taking jobs — all before any stream is

@@ -231,6 +231,43 @@ _ALL: List[Knob] = [
     _k("DYN_TRACE_SAMPLE", "float", "1.0", "tracing",
        "trace-id-consistent head-sampling fraction exported to the store "
        "span sink; error/deadline/breaker traces are always kept"),
+    # --------------------------------------- flight recorder / watchdog
+    _k("DYN_FLIGHTREC", "bool", "1", "tracing",
+       "always-on flight recorder: per-process black-box rings dumped "
+       "into incident bundles (0 = record nothing)"),
+    _k("DYN_FLIGHTREC_SPANS", "int", "2048", "tracing",
+       "flight-recorder span ring capacity (every finished span, "
+       "including head-sampled-out ones)"),
+    _k("DYN_FLIGHTREC_EVENTS", "int", "4096", "tracing",
+       "flight-recorder event ring capacity (engine step timings, gate "
+       "waits, transfer EWMA snapshots, store health transitions)"),
+    _k("DYN_FLIGHTREC_LOGTAIL", "int", "256", "tracing",
+       "flight-recorder structured-log tail capacity"),
+    _k("DYN_WATCHDOG", "bool", "1", "tracing",
+       "hang watchdog: stall:* span emission + incident triggers "
+       "(0 = heartbeats are recorded but never judged)"),
+    _k("DYN_WATCHDOG_INTERVAL", "float", "0.25", "tracing",
+       "watchdog poll period, seconds (its own tick lag is the "
+       "event-loop-stall probe)"),
+    _k("DYN_WATCHDOG_MULT", "float", "8.0", "tracing",
+       "stall threshold as a multiple of an activity's EWMA unit time "
+       "(a decode dispatch exceeding mult x EWMA step time is wedged)"),
+    _k("DYN_WATCHDOG_FLOOR", "float", "1.0", "tracing",
+       "absolute floor, seconds, under the EWMA-multiple threshold — a "
+       "noisy sub-millisecond EWMA must not yield false stalls"),
+    _k("DYN_WATCHDOG_TRANSFER", "float", "5.0", "tracing",
+       "no-layer-progress budget for an in-flight disagg KV stream, "
+       "seconds, before stall:transfer fires"),
+    _k("DYN_WATCHDOG_LOOP_STALL", "float", "1.0", "tracing",
+       "event-loop stall threshold: watchdog tick lateness, seconds"),
+    _k("DYN_INCIDENT_TTL", "float", "3600", "tracing",
+       "incident beacon + bundle lease TTL, seconds"),
+    _k("DYN_INCIDENT_COOLDOWN", "float", "30", "tracing",
+       "triggers raised within this many seconds of a live beacon "
+       "attach to that incident instead of opening a new one"),
+    _k("DYN_INCIDENT_WINDOW", "float", "30", "tracing",
+       "ring-slice window dumped into a bundle, seconds before the "
+       "trigger"),
     # ------------------------------------------------------------- metrics
     _k("DYN_METRICS_PUSH_INTERVAL", "float", "0", "metrics",
        "min seconds between a worker's stage-metrics store writes "

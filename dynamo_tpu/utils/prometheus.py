@@ -654,6 +654,27 @@ class StageMetrics:
             "dyn_queue_until_boot_total",
             "Scale-from-zero requests parked at HTTP ingress by outcome "
             "(parked|served|expired|overflow)", ("model", "outcome"))
+        # flight-recorder plane (obs/): black-box ring health, watchdog
+        # stall detections, and incident-bundle coordination — the
+        # eviction counter is how a bundle consumer tells a quiet window
+        # from a ring too small to cover it
+        self.flightrec_evicted = r.counter(
+            "dyn_flightrec_evicted_total",
+            "Flight-recorder ring entries evicted before any incident "
+            "captured them (spans|events|logtail)", ("ring",))
+        self.watchdog_stalls = r.counter(
+            "dyn_watchdog_stalls_total",
+            "Hang-watchdog stall detections by kind (decode|transfer|"
+            "drain|event_loop); each also emits a never-sampled "
+            "stall:* span", ("kind",))
+        self.incidents_captured = r.counter(
+            "dyn_incidents_captured_total",
+            "Incident capture beacons published, by trigger reason",
+            ("reason",))
+        self.incident_dumps = r.counter(
+            "dyn_incident_dumps_total",
+            "Flight-recorder ring dumps this process contributed to "
+            "incident bundles", ())
 
     def clear_worker(self, worker: str) -> None:
         """Drop every per-worker gauge series for ``worker`` (pid). Wired

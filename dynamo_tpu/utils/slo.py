@@ -189,6 +189,7 @@ class SloMonitor:
         self.breaches: collections.deque = collections.deque(
             maxlen=max_breaches)
         self._last_burn: Dict[str, Dict[float, float]] = {}
+        self._burning: Dict[Tuple[str, float], bool] = {}
 
     def observe(self, states: List[Tuple[str, Dict]],
                 now: Optional[float] = None) -> Dict[str, Dict[float, float]]:
@@ -216,6 +217,20 @@ class SloMonitor:
                 if burn > 1.0:
                     self.breaches.append(
                         Breach(o.name, w, burn, time.time()))
+                    # incident trigger on the breach EDGE only (sustained
+                    # burn keeps appending breaches but must not re-open
+                    # beacons every tick). Passive monitors (dyntop,
+                    # gauge=None) observe without triggering.
+                    if (self.gauge is not None
+                            and not self._burning.get((o.name, w))):
+                        from ..obs import incidents as _incidents
+
+                        _incidents.trigger(
+                            "slo_burn", slo=o.name, window=w,
+                            burn=round(burn, 3))
+                    self._burning[(o.name, w)] = True
+                else:
+                    self._burning[(o.name, w)] = False
         self._last_burn = out
         return out
 

@@ -496,6 +496,13 @@ class StoreSpanSink:
             self._force_trace(span.trace_id, exclude=span.span_id)
         self._enqueue(span)
 
+    def force_trace(self, trace_id: str) -> None:
+        """Retro-export ``trace_id`` regardless of the sampling decision:
+        spans of it still in the local ring are enqueued now, later ones
+        are force-retained. The incident plane (obs/incidents.py) calls
+        this so a bundle's trace is complete even at 1% head sampling."""
+        self._force_trace(trace_id)
+
     def _force_trace(self, trace_id: str, exclude: str = "") -> None:
         self._forced.add(trace_id)
         self._forced_order.append(trace_id)
@@ -528,8 +535,11 @@ class StoreSpanSink:
         # spans.
         now = time.monotonic()
         if self._lease is None or now - self._lease_born > self.ttl / 2:
+            # unbound: exported spans must survive the producing worker's
+            # death until their TTL — that is when they matter most
             self._lease = await self.store.lease_grant(ttl=self.ttl,
-                                                       auto_keepalive=False)
+                                                       auto_keepalive=False,
+                                                       bind=False)
             self._lease_born = now
         lease = self._lease
         batch: List[Span] = []

@@ -502,9 +502,14 @@ class Server {
       if (tv->t == Value::T::Double) ttl = tv->d;
       else if (tv->t == Value::T::Int) ttl = static_cast<double>(tv->i);
     }
+    // bind=false grants an ORPHAN lease: not tied to this connection,
+    // expires only by TTL — incident beacons/dumps and trace spans must
+    // outlive the process (often short-lived or crashing) that wrote them
+    const Value* bv = m.get("bind");
+    bool bind = !(bv && bv->t == Value::T::Bool && !bv->b);
     int64_t lid = next_lease_id_++;
     leases_[lid] = Lease{lid, ttl, now_s() + ttl, {}};
-    c->leases.insert(lid);
+    if (bind) c->leases.insert(lid);
     Value r = Value::map();
     r.set("lease", Value::integer(lid));
     r.set("ttl", Value::real(ttl));

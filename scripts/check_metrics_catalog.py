@@ -27,8 +27,8 @@ if REPO not in sys.path:
 from dynamo_tpu.analysis.core import Module, iter_python_files  # noqa: E402
 from dynamo_tpu.analysis.rules import metrics_catalog as _rule  # noqa: E402
 
-__all__ = ["CODE_ROOT", "DOC", "registered_metrics", "documented_tokens",
-           "run", "main"]
+__all__ = ["CODE_ROOT", "DOC", "registered_metrics", "registered_types",
+           "documented_tokens", "documented_types", "run", "main"]
 
 CODE_ROOT = os.path.join(REPO, "dynamo_tpu")
 DOC = os.path.join(REPO, "docs", "observability.md")
@@ -47,22 +47,43 @@ def registered_metrics(root: str = CODE_ROOT) -> Dict[str, List[str]]:
     return out
 
 
+def registered_types(root: str = CODE_ROOT) -> Dict[str, Set[str]]:
+    """{metric_name: {register methods}} — the type side of the catalog
+    check (``counter``/``gauge``/``histogram``)."""
+    out: Dict[str, Set[str]] = {}
+    for path in iter_python_files([root]):
+        try:
+            mod = Module(path, repo=REPO)
+        except SyntaxError:
+            continue
+        for name, kinds in _rule.registered_types_in_module(mod).items():
+            out.setdefault(name, set()).update(kinds)
+    return out
+
+
 def documented_tokens(doc: str = DOC) -> Set[str]:
     return _rule.documented_tokens(doc)
 
 
+def documented_types(doc: str = DOC) -> Dict[str, str]:
+    return _rule.documented_types(doc)
+
+
 def run() -> List[str]:
     findings = _rule.catalog_findings(registered_metrics(),
-                                      documented_tokens())
+                                      documented_tokens(),
+                                      registered_kinds=registered_types(),
+                                      claimed_types=documented_types())
     out: List[str] = []
     for f in findings:
+        name = f.key.split(":", 1)[1]
         if f.key.startswith("undocumented:"):
-            name = f.key.split(":", 1)[1]
             out.append(
                 f"undocumented metric {name!r} (registered at "
                 f"{f.path}:{f.line}) — add it to docs/observability.md")
+        elif f.key.startswith("type-mismatch:"):
+            out.append(f.message)
         else:
-            name = f.key.split(":", 1)[1]
             out.append(
                 f"documented metric {name!r} is not registered anywhere "
                 f"under dynamo_tpu/ — stale catalog entry (or a typo)")

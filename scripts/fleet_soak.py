@@ -722,8 +722,26 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
                         if d.get("spans"):
                             http_retr["found"] += 1
 
+        # watchdog false-positive lane: every serving process in this rig
+        # (echo workers, router, aggregators, the in-driver frontend) runs
+        # the flight-recorder watchdog, and any stall it fires publishes
+        # an incident beacon — a CLEAN soak must end with zero stall
+        # incidents. Beacons are the cheap proxy for stall spans: a stall
+        # span cannot exist without its beacon (the watchdog triggers the
+        # incident plane on every firing).
+        from dynamo_tpu.obs.incidents import list_incidents
+        beacons = await list_incidents(store, NAMESPACE)
+        stall_beacons = [b for b in beacons
+                         if str(b.get("reason", "")).startswith("stall_")]
+        watchdog_lane = {
+            "incident_beacons": len(beacons),
+            "stall_incidents": len(stall_beacons),
+            "reasons": sorted({b.get("reason", "?") for b in beacons}),
+        }
+
         knee = find_knee(steps_out, a.knee_mult)
         verdicts = {
+            "watchdog_clean": not stall_beacons,
             "completed": len(steps_out) == a.steps,
             "curve_non_empty": all(
                 s["store"]["ops"] > 0 and s["beacon_lag"]["events"] > 0
@@ -750,6 +768,7 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
             "error_traces": retr,
             "http_error_traces": http_retr,
             "traffic": traffic_stats,
+            "watchdog": watchdog_lane,
             "verdicts": verdicts,
         }
     finally:

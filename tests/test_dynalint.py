@@ -1064,7 +1064,7 @@ def test_changed_mode_scopes_per_file_keeps_repo_rules(tmp_path, capsys,
     monkeypatch.setattr(cli, "changed_files", lambda: [target])
     assert cli.main(["--changed"]) == 0
     out = capsys.readouterr().out
-    assert "13 rules" in out
+    assert "14 rules" in out
 
 
 def test_full_tree_wall_time_within_budget_all_rules_registered():
@@ -1076,9 +1076,9 @@ def test_full_tree_wall_time_within_budget_all_rules_registered():
     assert res.elapsed_s < 10.0, f"dynalint took {res.elapsed_s:.1f}s"
     for rule in ("host-sync", "recompile-hazard", "tracer-leak",
                  "store-key-drift", "wire-field-drift",
-                 "await-holding-lock"):
+                 "await-holding-lock", "loop-blocking-path"):
         assert rule in res.rules_run
-    assert len(res.rules_run) == 13
+    assert len(res.rules_run) == 14
 
 
 def test_host_sync_statement_level_closure_scanned(tmp_path):

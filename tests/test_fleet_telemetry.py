@@ -48,7 +48,7 @@ class FakeStore:
         return sorted((k, v) for k, v in self.kv.items()
                       if k.startswith(prefix))
 
-    async def lease_grant(self, ttl=5.0, auto_keepalive=True):
+    async def lease_grant(self, ttl=5.0, auto_keepalive=True, bind=True):
         if self.fail:
             raise ConnectionError("store down")
         self._leases += 1
@@ -289,6 +289,7 @@ def test_classify_key_covers_every_registered_family():
         "faults": "faults/store.connect",
         "overload": "overload/dynamo/brownout",
         "traces": "traces/req-1/span-2",
+        "incidents": "incidents/dynamo/beacon/inc-1",
         "planner": "planner/dynamo/state",
         "disagg-config": "disagg/dynamo/echo",
         "prefill-queue": "dynamo.prefill",
@@ -417,6 +418,9 @@ def _assert_artifact_schema(art, expect_steps):
     # forced error traces are retrievable at sample=0.01
     assert art["error_traces"]["checked"] > 0
     assert art["error_traces"]["found"] == art["error_traces"]["checked"]
+    # watchdog false-positive lane: a clean soak fires zero stalls
+    assert art["verdicts"]["watchdog_clean"]
+    assert art["watchdog"]["stall_incidents"] == 0
 
 
 def test_fleet_soak_mini(tmp_path):

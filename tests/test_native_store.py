@@ -144,6 +144,18 @@ async def test_native_lease_expiry_and_disconnect():
         await asyncio.sleep(0.5)
         assert await c2.get("w/y") is None
 
+        # ... but an UNBOUND (bind=False) lease survives its grantor's
+        # death and expires only by TTL — the incident-bundle contract
+        c3b = await StoreClient(port=port).connect()
+        orphan = await c3b.lease_grant(ttl=1.5, auto_keepalive=False,
+                                       bind=False)
+        await c3b.put("w/z", b"vz", lease=orphan)
+        await c3b.close()
+        await asyncio.sleep(0.5)
+        assert await c2.get("w/z") == b"vz"     # producer died, key lives
+        await asyncio.sleep(1.5)
+        assert await c2.get("w/z") is None      # TTL still enforced
+
         # unacked queue message requeues when its consumer dies
         c4 = await StoreClient(port=port).connect()
         await c2.q_push("qq", b"work")

@@ -149,6 +149,7 @@ FAMILY_SAMPLES = {
     "faults": "faults/store.connect",
     "overload": "overload/ns/brownout",
     "traces": "traces/tid/sid",
+    "incidents": "incidents/ns/beacon/inc-1",
     "planner": "planner/ns/state",
     "kv-cluster": "kv_cluster/ns/backend/ab12",
     "disagg-config": "disagg/ns/echo",
@@ -218,7 +219,8 @@ class FakeShard:
         return sorted((k, v[0]) for k, v in self.kv.items()
                       if k.startswith(prefix))
 
-    async def lease_grant(self, ttl=5.0, auto_keepalive=True, reuse=None):
+    async def lease_grant(self, ttl=5.0, auto_keepalive=True, reuse=None,
+                          bind=True):
         self._check("lease_grant", reuse)
         lid = reuse if reuse is not None else 777
         self.leases.append(lid)
@@ -249,7 +251,7 @@ def _sharded(dead=()):
 async def test_shard_routing_covers_every_family():
     sc, shards = _sharded()
     expect = {"metrics": 1, "metrics-stage": 1, "metrics-store": 1,
-              "fleet-soak": 1, "regions": 1, "traces": 2,
+              "fleet-soak": 1, "regions": 1, "incidents": 1, "traces": 2,
               "prefill-queue": 2, "prefill-cancel": 2}
     for fam, key in FAMILY_SAMPLES.items():
         want = expect.get(fam, 0)
