@@ -50,6 +50,7 @@ LEGACY_SCOPE = [
     "dynamo_tpu/llm/kv_cluster",
     "dynamo_tpu/llm/kvpage",
     "dynamo_tpu/fleet",
+    "dynamo_tpu/llm/resume.py",
     "dynamo_tpu/cli/aggregator.py",
     "scripts/overload_soak.py",
     "scripts/fleet_soak.py",

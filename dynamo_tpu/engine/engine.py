@@ -43,7 +43,8 @@ from ..obs import flightrec as _flightrec
 from ..parallel.mesh import AXIS_TP, serving_mesh
 from ..runtime.engine import AsyncEngine, Context
 from .cache import OutOfPages, PagePool
-from .sampling import STATIC_K, SamplingState, apply_penalties, sample
+from .sampling import (STATIC_K, SamplingState, apply_penalties,
+                       resume_seed, sample)
 
 log = logging.getLogger("dynamo_tpu.engine")
 
@@ -215,6 +216,10 @@ class StepOutput:
     error_code: int = 500
     error_stage: Optional[str] = None
     error_reason: Optional[str] = None
+    # admission's sealed-prefix restore length, set on a sequence's FIRST
+    # output only (None elsewhere) — rides to EngineOutput.
+    # kv_prefix_hit_tokens
+    prefix_hit: Optional[int] = None
 
 
 class EngineCore:
@@ -524,6 +529,9 @@ class EngineCore:
         self._inflight: Deque[Dict[str, Any]] = collections.deque()
         self._deferred_release: List[str] = []
         self._pending_seeds: List[Tuple[int, int]] = []
+        # seq_id -> admission's prefix-restore length, consumed by step()'s
+        # tagging post-pass on the sequence's first output
+        self._pending_prefix_hit: Dict[str, int] = {}
         # --- layer-streamed KV injection (disagg receive path) --------
         # seq_id -> in-flight stream-inject state: pool pages are leased
         # at begin (unsealed, unregistered — invisible to attention and
@@ -1210,6 +1218,19 @@ class EngineCore:
 
     # ------------------------------------------------------------------
     def step(self) -> List[StepOutput]:
+        """One engine iteration (see :meth:`_step`), plus the prefix-hit
+        tagging post-pass: a sequence's FIRST output carries admission's
+        sealed-prefix restore length (``StepOutput.prefix_hit``), the
+        client-observable proof of the KV re-attach path on resumes."""
+        out = self._step()
+        if self._pending_prefix_hit:
+            for so in out:
+                hit = self._pending_prefix_hit.pop(so.seq_id, None)
+                if hit is not None:
+                    so.prefix_hit = hit
+        return out
+
+    def _step(self) -> List[StepOutput]:
         """Run one engine iteration.
 
         Steady-state decode is PIPELINED: a dispatch's sampled tokens are
@@ -1583,7 +1604,20 @@ class EngineCore:
             slot.prefill_done = matched
         self.last_prefix_hit = matched
         self.prefix_hit_tokens += matched
+        # surfaced on this sequence's FIRST StepOutput (step()'s tagging
+        # post-pass) -> EngineOutput.kv_prefix_hit_tokens at the facade
+        self._pending_prefix_hit[seq_id] = matched
         self.prefix_query_tokens += len(prompt)
+        if getattr(req, "resume_pos", 0):
+            # mid-stream resume: the restored prefix IS the KV re-attach —
+            # everything past `matched` (including the dead worker's
+            # emitted tail) is teacher-forced prefill recompute. Counted
+            # in blocks so the soak can assert the re-attach path (not
+            # full re-prefill) was taken in the donor-alive arm.
+            from ..utils.prometheus import stage_metrics
+
+            stage_metrics().resume_kv_reattach_blocks.inc(
+                amount=matched // self.pool.page_size)
         self._load_sampling(slot_idx, req)
         return slot_idx, slot
 
@@ -1620,8 +1654,14 @@ class EngineCore:
         if req.sampling.seed is not None:
             # deferred to the next prefill dispatch: keeps EVERY device op
             # at a mirrorable dispatch point (multi-host lockstep) and
-            # batches the key writes
-            self._pending_seeds.append((slot_idx, int(req.sampling.seed)))
+            # batches the key writes. A resumed request folds its resume
+            # position into the seed: the emitted prefix is replayed
+            # verbatim (forced tokens, no draws), and the continuation
+            # gets a fresh deterministic stream instead of re-issuing the
+            # dead worker's already-consumed draws.
+            self._pending_seeds.append((slot_idx, resume_seed(
+                int(req.sampling.seed),
+                int(getattr(req, "resume_pos", 0) or 0))))
 
     def _apply_pending_seeds(self) -> List[Tuple[int, int]]:
         applied, self._pending_seeds = self._pending_seeds, []
@@ -2573,6 +2613,9 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                     cum_log_prob=so.logprob,
                     logprobs=[{str(so.token): so.token_logprob}],
                     finish_reason=so.finish,
+                    # first output only: admission's sealed-prefix restore
+                    # length (a resumed stream's re-attach proof)
+                    kv_prefix_hit_tokens=so.prefix_hit,
                 )
                 if so.finish is not None:
                     return

@@ -19,6 +19,25 @@ import numpy as np
 STATIC_K = 64
 
 
+def resume_seed(seed: int, resume_pos: int) -> int:
+    """Deterministic per-resume-position seed fold (mid-stream failover,
+    llm/resume.py). A resumed request replays its emitted tokens verbatim
+    as forced prefix, but the dead worker's RNG draws at those positions
+    are unreplayable — continuing from the ORIGINAL seed's key would
+    re-issue draws the stream already consumed. Folding the resume
+    position in gives the continuation a fresh, deterministic stream:
+    the same (seed, resume_pos) always resumes identically, and
+    resume_pos == 0 is the identity (an un-resumed request's key chain
+    is untouched)."""
+    if not resume_pos:
+        return seed
+    # splitmix64-style mix, stable across processes/platforms
+    x = (seed ^ (resume_pos * 0x9E3779B97F4A7C15)) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
+
+
 @dataclass
 class SamplingState:
     """Per-slot device vectors (length = max_batch)."""

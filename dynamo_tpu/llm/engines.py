@@ -33,14 +33,22 @@ class EchoCoreEngine(AsyncEngine[BackendInput, EngineOutput]):
     async def generate(self, request: BackendInput,
                        context: Context) -> AsyncIterator[EngineOutput]:
         delay = self._delay if self._delay is not None else _delay_s()
+        # mid-stream resume (llm/resume.py): the request's tail carries the
+        # resume_pos tokens a dead instance already emitted. The echo
+        # source is the ORIGINAL prompt (strip that tail), and emission
+        # continues from position resume_pos — never re-emitting — so a
+        # resumed echo stream is byte-identical to an unkilled one.
+        pos = int(request.resume_pos or 0)
+        src = request.token_ids[:len(request.token_ids) - pos] if pos \
+            else request.token_ids
         budget = request.stop.max_tokens
         if budget is None:
-            budget = len(request.token_ids)
-        n = min(budget, len(request.token_ids))
-        if n <= 0:
+            budget = len(src)
+        n = min(pos + budget, len(src))
+        if n <= pos:
             yield EngineOutput(token_ids=[], finish_reason=FinishReason.LENGTH)
             return
-        for i in range(n):
+        for i in range(pos, n):
             if context.is_stopped:
                 yield EngineOutput(token_ids=[], finish_reason=FinishReason.CANCELLED)
                 return
@@ -48,7 +56,7 @@ class EchoCoreEngine(AsyncEngine[BackendInput, EngineOutput]):
                 await asyncio.sleep(delay)
             last = i == n - 1
             yield EngineOutput(
-                token_ids=[request.token_ids[i]],
+                token_ids=[src[i]],
                 finish_reason=FinishReason.LENGTH if last else None,
             )
 

@@ -183,7 +183,8 @@ class KvRouterService:
             blocks, _bb(src), src=src, dst=dst)
         return ov
 
-    async def route(self, token_ids, lora_id: int = 0) -> Dict:
+    async def route(self, token_ids, lora_id: int = 0,
+                    exclude=None) -> Dict:
         # hash the prompt chain ONCE; the indexer and the cluster index
         # query the same salted chain
         hashes = compute_seq_hashes(token_ids, self.indexer.block_size,
@@ -197,7 +198,8 @@ class KvRouterService:
         wid = await self.scheduler.schedule_or_wait(token_ids, overlaps,
                                                     salt=lora_id,
                                                     fast_fail=fast_fail,
-                                                    cluster=cluster)
+                                                    cluster=cluster,
+                                                    exclude=exclude)
         resp = {"worker_id": wid,
                 "overlap_blocks": overlaps.scores.get(wid, 0)}
         # stamp the donor score_candidates elected for the chosen worker
@@ -208,7 +210,11 @@ class KvRouterService:
         chosen = self.scheduler.last_choice
         if (cluster is not None and chosen is not None
                 and chosen["worker_id"] == wid
-                and chosen.get("kv_donor") is not None):
+                and chosen.get("kv_donor") is not None
+                # a donor the caller excluded is a dead instance whose
+                # registry delete is still in flight: stamping it would
+                # burn the fetch timeout on a resume's critical path
+                and (not exclude or chosen["kv_donor"] not in exclude)):
             from ...utils.prometheus import stage_metrics
 
             stage_metrics().kv_cluster_hits.inc()
@@ -224,7 +230,8 @@ class KvRouterService:
                     endpoint_name: str = "route") -> None:
         async def handler(request, ctx):
             yield await self.route(request["token_ids"],
-                                   int(request.get("lora_id", 0)))
+                                   int(request.get("lora_id", 0)),
+                                   exclude=request.get("exclude"))
 
         await component.endpoint(endpoint_name).serve(handler)
 
@@ -326,7 +333,7 @@ class FleetKvRouter:
         return None
 
     async def route(self, token_ids, lora_id: int = 0,
-                    model: Optional[str] = None) -> Dict:
+                    model: Optional[str] = None, exclude=None) -> Dict:
         svc = self._pick(model)
         if svc is None:
             raise EngineError(
@@ -334,7 +341,7 @@ class FleetKvRouter:
                 f"(fleet registry: {sorted(self.routers) or 'empty'})",
                 503, stage="router", reason="unknown_model",
                 retry_after=1.0)
-        return await svc.route(token_ids, lora_id)
+        return await svc.route(token_ids, lora_id, exclude=exclude)
 
     def decisions(self, limit: int = 0, model: Optional[str] = None):
         """Merged audit across models (each entry carries its ``model``
@@ -352,7 +359,8 @@ class FleetKvRouter:
         async def handler(request, ctx):
             yield await self.route(request["token_ids"],
                                    int(request.get("lora_id", 0)),
-                                   model=request.get("model"))
+                                   model=request.get("model"),
+                                   exclude=request.get("exclude"))
 
         await component.endpoint(endpoint_name).serve(handler)
 

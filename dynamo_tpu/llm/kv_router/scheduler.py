@@ -283,14 +283,26 @@ class KvScheduler:
 
     def schedule(self, tokens: Sequence[int],
                  overlaps: OverlapScores, salt: int = 0,
-                 cluster=None) -> Optional[int]:
+                 cluster=None, exclude=None) -> Optional[int]:
+        endpoints = self.endpoints
+        if exclude:
+            # mid-stream failover re-election: score everyone EXCEPT the
+            # instances the resume layer declared dead. If that vetoes the
+            # whole candidate set (single-worker pool, stall not death),
+            # stand down like breaker.filter — the worker-side resume
+            # supersede guard makes landing on the excluded instance safe,
+            # whereas refusing to route manufactures a total outage.
+            kept = {w: m for w, m in endpoints.workers.items()
+                    if w not in set(exclude)}
+            if kept:
+                endpoints = ProcessedEndpoints(kept)
         candidates = score_candidates(tokens, self.block_size, overlaps,
-                                      self.endpoints, cluster=cluster)
+                                      endpoints, cluster=cluster)
         if self.selector is not None:
-            wid = self.selector(tokens, self.block_size, overlaps, self.endpoints)
+            wid = self.selector(tokens, self.block_size, overlaps, endpoints)
         else:
             wid = default_selector(tokens, self.block_size, overlaps,
-                                   self.endpoints, candidates=candidates)
+                                   endpoints, candidates=candidates)
         self.last_choice = next(
             (c for c in candidates if c["worker_id"] == wid), None) \
             if wid is not None else None
@@ -327,7 +339,7 @@ class KvScheduler:
                                timeout_s: float = 30.0,
                                salt: int = 0,
                                fast_fail: Optional[bool] = None,
-                               cluster=None) -> int:
+                               cluster=None, exclude=None) -> int:
         """Wait for capacity when all workers are saturated — unless
         ``fast_fail`` (param, or ``DYN_ROUTER_FAST_FAIL``, or a brownout
         level above normal at the router service) is active: then a fully
@@ -339,7 +351,7 @@ class KvScheduler:
         deadline = asyncio.get_event_loop().time() + timeout_s
         while True:
             wid = self.schedule(tokens, overlaps, salt=salt,
-                                cluster=cluster)
+                                cluster=cluster, exclude=exclude)
             if fast_fail:
                 why = self._all_unavailable(tokens, overlaps, wid)
                 if why is not None:

@@ -93,6 +93,15 @@ class BackendInput:
     # the router actually scored (the donor may have sealed more since).
     kv_donor: int = 0
     kv_donor_blocks: int = 0
+    # Mid-stream resume (llm/resume.py): number of tokens at the TAIL of
+    # ``token_ids`` that were already emitted to the client by a previous
+    # (now dead) worker. The engine treats the full sequence as prefix —
+    # restoring surviving KV / teacher-forcing the tail, never re-emitting
+    # those tokens — and generation continues from position len(token_ids).
+    # Sampled requests re-seed their RNG stream as a function of
+    # (seed, resume_pos) so a resumed stream never replays the dead
+    # worker's draws against a different KV state.
+    resume_pos: int = 0
     # VLM: normalized pixel arrays ([3, H, W]; the engine's vision tower
     # encodes them at prefill). On the wire each image travels as
     # {"b64": base64 raw bytes, "shape": [...], "dtype": "..."} — nested
@@ -154,6 +163,7 @@ class BackendInput:
             no_spec=bool(d.get("no_spec", False)),
             kv_donor=int(d.get("kv_donor", 0)),
             kv_donor_blocks=int(d.get("kv_donor_blocks", 0)),
+            resume_pos=int(d.get("resume_pos", 0)),
             images=images,
         )
 
@@ -202,6 +212,9 @@ class EngineOutput:
             logprobs=d.get("logprobs"),
             finish_reason=FinishReason(fr) if fr else None,
             error=d.get("error"),
+            error_code=d.get("error_code"),
+            error_stage=d.get("error_stage"),
+            error_reason=d.get("error_reason"),
             kv_prefix_hit_tokens=d.get("kv_prefix_hit_tokens"),
             index=d.get("index", 0),
         )

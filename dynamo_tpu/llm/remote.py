@@ -69,6 +69,31 @@ class RemoteCoreEngine(AsyncEngine[BackendInput, EngineOutput]):
 
     async def generate(self, request: BackendInput,
                        context: Context) -> AsyncIterator[EngineOutput]:
+        from . import resume
+
+        if resume.max_attempts() > 0:
+            # mid-stream failover: a transport break / inter-frame stall
+            # re-enters _dispatch_once with the dead instance excluded and
+            # the emitted tokens folded into the resume prefix — the
+            # detokenizer above this engine sees one continuous stream
+            async for item in resume.run(self._dispatch_once, request,
+                                         context,
+                                         breaker=self.worker_client.breaker):
+                yield item
+            return
+        async for item in self._dispatch_once(request, context, set(), 0,
+                                              None):
+            yield item
+
+    async def _dispatch_once(self, request: BackendInput, context: Context,
+                             exclude: set, resume_no: int,
+                             on_instance) -> AsyncIterator[EngineOutput]:
+        """One routed attempt: consult the router (minus ``exclude``), pin
+        to the elected worker, stream the response. The resume layer calls
+        this repeatedly under one context id; ``resume_no`` rides the wire
+        envelope so a zombie context of a lower ordinal yields server-side,
+        and ``on_instance`` reports who was chosen (the blame target when
+        the stream later breaks)."""
         mode = "random"
         instance_id = None
         if self.router_client is not None and self.router_client.instances:
@@ -80,7 +105,9 @@ class RemoteCoreEngine(AsyncEngine[BackendInput, EngineOutput]):
                         {"token_ids": request.token_ids,
                          "lora_id": request.kv_salt or request.lora_id,
                          **({"model": self.model_name}
-                            if self.model_name else {})},
+                            if self.model_name else {}),
+                         **({"exclude": sorted(exclude)}
+                            if exclude else {})},
                         context.child()):
                     wid = resp.get("worker_id")
                     if wid is not None and wid in self.worker_client.instances:
@@ -95,9 +122,15 @@ class RemoteCoreEngine(AsyncEngine[BackendInput, EngineOutput]):
                     break
             except EngineError:
                 log.warning("router unavailable; falling back to random")
+        # the router's scheduler stands down when exclusion would veto the
+        # whole pool; the random fallback needs the same stand-down here
+        ex = exclude
+        if ex and not (set(self.worker_client.instances) - ex):
+            ex = set()
         async for item in self.worker_client.generate(
                 request.to_dict(), context, mode=mode,
-                instance_id=instance_id):
+                instance_id=instance_id, exclude=ex, resume=resume_no,
+                on_instance=on_instance):
             yield EngineOutput.from_dict(item)
 
 

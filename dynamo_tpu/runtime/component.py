@@ -36,8 +36,8 @@ from .engine import AsyncEngine, Context, EngineError
 from .store_client import StoreClient
 from .wire import (CODE_KEY, CONTEXT_ID_KEY, CTYPE_KEY, ENDPOINT_KEY,
                    KIND_KEY, MESSAGE_KEY, PRIORITY_KEY, REASON_KEY,
-                   RETRY_AFTER_KEY, STAGE_KEY, STREAMING_KEY, TRACE_KEY,
-                   FrameReader, attach_trace, extract_trace,
+                   RESUME_KEY, RETRY_AFTER_KEY, STAGE_KEY, STREAMING_KEY,
+                   TRACE_KEY, FrameReader, attach_trace, extract_trace,
                    unpack_two_part, write_frame)
 
 log = logging.getLogger("dynamo_tpu.runtime")
@@ -336,16 +336,32 @@ class DistributedRuntime:
             request = payload  # raw bytes pass through untouched (KV plane)
         else:
             request = json.loads(payload.decode()) if payload else None
+        resume_no = int(control.get(RESUME_KEY) or 0)
         if ctx_id is not None and ctx_id in self._active:
-            # duplicate-context guard: a client's stale-connection retry
-            # re-sent a request whose original is still executing (the
-            # connection died mid-request) — fail cleanly instead of
-            # double-executing a non-idempotent handler
-            await write_frame(writer, [{
-                KIND_KEY: "error", CODE_KEY: 409,
-                MESSAGE_KEY: f"context {ctx_id} is already executing "
-                             f"(duplicate delivery)"}, None])
-            return None
+            stale = self._active[ctx_id]
+            if resume_no > stale.resume_no:
+                # mid-stream failover (llm/resume.py): the client declared
+                # the active context dead (its stream broke) and re-entered
+                # with a higher attempt ordinal — possibly on this same
+                # worker when it merely wedged. The old handler is a zombie
+                # whose output nobody consumes: kill it and serve the
+                # resume. Its finally-pop is identity-conditional, so it
+                # cannot reap the replacement's _active entry.
+                log.warning("context %s superseded by resume attempt %d "
+                            "(stale attempt %d killed)", ctx_id, resume_no,
+                            stale.resume_no)
+                stale.kill()
+                del self._active[ctx_id]
+            else:
+                # duplicate-context guard: a client's stale-connection retry
+                # re-sent a request whose original is still executing (the
+                # connection died mid-request) — fail cleanly instead of
+                # double-executing a non-idempotent handler
+                await write_frame(writer, [{
+                    KIND_KEY: "error", CODE_KEY: 409,
+                    MESSAGE_KEY: f"context {ctx_id} is already executing "
+                                 f"(duplicate delivery)"}, None])
+                return None
         req_deadline = control.get(dl.DEADLINE_KEY)
         if dl.expired(req_deadline):
             # the request died in transit/queueing: refuse to burn compute
@@ -355,6 +371,7 @@ class DistributedRuntime:
             return None
         ctx = Context(ctx_id, deadline=req_deadline,
                       priority=control.get(PRIORITY_KEY) or "interactive")
+        ctx.resume_no = resume_no
         self._active[ctx.id] = ctx
         from ..utils.logging_ext import request_id_var
         from ..utils.tracing import current_span_var, get_tracer
@@ -449,7 +466,10 @@ class DistributedRuntime:
                 # already surfaced as the request's stop/kill outcome
                 except Exception:
                     pass
-            self._active.pop(ctx.id, None)
+            if self._active.get(ctx.id) is ctx:
+                # identity-conditional: a resume attempt may have superseded
+                # this context and installed its own under the same id
+                del self._active[ctx.id]
             if span_token is not None:
                 current_span_var.reset(span_token)
             tracer.finish(srv_span, status=srv_status)
@@ -658,11 +678,21 @@ class Client:
     async def generate(self, request: Any, context: Optional[Context] = None,
                        mode: str = "random",
                        instance_id: Optional[int] = None,
-                       parts: Optional[AsyncIterator[bytes]] = None
+                       parts: Optional[AsyncIterator[bytes]] = None,
+                       exclude: Optional[set] = None,
+                       resume: int = 0,
+                       on_instance: Optional[Callable[[int], None]] = None
                        ) -> AsyncIterator[Any]:
         """Issue a request; yields response items (the remote stream).
         With ``parts`` set, streams the binary chunks after the request header
-        (server handler receives a :class:`StreamingRequest`)."""
+        (server handler receives a :class:`StreamingRequest`).
+
+        ``exclude`` seeds the per-call failed set (instances a resume layer
+        already declared dead); ``resume`` stamps the mid-stream-failover
+        attempt ordinal on the envelope (``RESUME_KEY``) so a zombie context
+        of the same id yields server-side; ``on_instance`` is called with
+        the chosen instance id once the first exchange succeeds — the hook a
+        resume layer uses to know WHO to blame when the stream later breaks."""
         ctx = context or Context()
         dl.check(ctx.deadline, f"rpc_dispatch:{self.endpoint.name}")
         # serialize BEFORE any socket exists: a non-serializable request
@@ -685,6 +715,8 @@ class Client:
             base_control[PRIORITY_KEY] = ctx.priority
         if parts is not None:
             base_control[STREAMING_KEY] = True
+        if resume:
+            base_control[RESUME_KEY] = int(resume)
         # client span around the whole exchange; its context rides the wire
         # so the server's rpc span parents under it. No ambient span (bare
         # client) => the request id becomes the trace id, matching the
@@ -737,7 +769,7 @@ class Client:
         # delivered the frame before erroring) surfaces, except the
         # same-instance stale-pool retry whose duplicate-context guard
         # de-dupes server-side. direct mode never fails over.
-        failed: set = set()
+        failed: set = set(exclude or ())
         try:
             while True:
                 iid, info = self._pick(mode, instance_id, failed)
@@ -849,6 +881,8 @@ class Client:
                         live["writer"] = writer
                 if refused_mid_exchange:
                     continue
+                if on_instance is not None:
+                    on_instance(iid)
                 break
         except BaseException:
             stopper.cancel()

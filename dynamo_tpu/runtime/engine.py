@@ -34,13 +34,18 @@ class Context:
     Reference capability: ``AsyncEngineContext`` (lib/runtime/src/engine.rs:71-109).
     """
 
-    __slots__ = ("id", "deadline", "priority", "_stopped", "_killed",
-                 "_children")
+    __slots__ = ("id", "deadline", "priority", "resume_no", "_stopped",
+                 "_killed", "_children")
 
     def __init__(self, id: Optional[str] = None,
                  deadline: Optional[float] = None,
                  priority: str = "interactive"):
         self.id: str = id or uuid.uuid4().hex
+        # mid-stream failover attempt ordinal (llm/resume.py): attempt N of
+        # a broken stream re-enters the plane under the SAME id with
+        # resume_no = N, superseding a zombie context of a lower ordinal
+        # at the worker's duplicate-context guard
+        self.resume_no: int = 0
         # absolute wall-clock (time.time()) end-to-end deadline; rides the
         # wire envelope so every hop can refuse work nobody awaits anymore
         self.deadline: Optional[float] = deadline

@@ -33,7 +33,8 @@ log = logging.getLogger("dynamo_tpu.native_dataplane")
 _REQUEST_CB = ctypes.CFUNCTYPE(None, ctypes.c_int64, ctypes.c_char_p,
                                ctypes.c_char_p, ctypes.c_char_p,
                                ctypes.POINTER(ctypes.c_uint8),
-                               ctypes.c_uint64, ctypes.c_int)
+                               ctypes.c_uint64, ctypes.c_int,
+                               ctypes.c_int64)
 _PART_CB = ctypes.CFUNCTYPE(None, ctypes.c_int64,
                             ctypes.POINTER(ctypes.c_uint8),
                             ctypes.c_uint64, ctypes.c_int)
@@ -99,12 +100,12 @@ class NativeDataPlane:
     # C-thread callbacks: copy data out, hop onto the asyncio loop
     # ------------------------------------------------------------------
     def _on_request(self, sid, endpoint, ctx_id, ctype, payload, length,
-                    streaming):
+                    streaming, resume):
         data = ctypes.string_at(payload, length) if length else b""
         self.loop.call_soon_threadsafe(
             self._begin, sid, (endpoint or b"").decode(),
             (ctx_id or b"").decode() or None, (ctype or b"").decode(),
-            data, bool(streaming))
+            data, bool(streaming), int(resume))
 
     def _on_part(self, sid, data, length, is_end):
         chunk = ctypes.string_at(data, length) if length else b""
@@ -151,7 +152,8 @@ class NativeDataPlane:
 
     # ------------------------------------------------------------------
     def _begin(self, sid: int, endpoint: str, ctx_id: Optional[str],
-               ctype: str, payload: bytes, streaming: bool) -> None:
+               ctype: str, payload: bytes, streaming: bool,
+               resume: int = 0) -> None:
         if streaming:
             # register the part queue NOW: part/end callbacks already queued
             # behind this one on the loop must find it (the _run coroutine
@@ -161,6 +163,7 @@ class NativeDataPlane:
         # behind this callback must find it, or the control is lost and the
         # handler runs to completion against a dead client
         ctx = Context(ctx_id)
+        ctx.resume_no = resume
         self._contexts[sid] = ctx
         # retained handle: _run catches transport errors itself, but a bug
         # BEFORE its try (or a cancelled loop) must still surface instead
@@ -184,11 +187,23 @@ class NativeDataPlane:
             reject(404, f"no endpoint {endpoint!r}")
             return
         # the _begin-created Context uses ctx.id == wire ctx_id (or a fresh
-        # one); a duplicate in-flight id is a stale-retry double delivery
-        if ctx.id in drt._active:
-            reject(409, f"context {ctx.id} is already executing "
-                        f"(duplicate delivery)")
-            return
+        # one); a duplicate in-flight id is a stale-retry double delivery —
+        # unless it carries a higher resume ordinal (llm/resume.py): then
+        # the active context is a zombie whose stream broke client-side,
+        # and the resume attempt supersedes it (same semantics as the
+        # asyncio server's guard in component.py)
+        stale = drt._active.get(ctx.id)
+        if stale is not None:
+            if ctx.resume_no > stale.resume_no:
+                log.warning("context %s superseded by resume attempt %d "
+                            "(stale attempt %d killed)", ctx.id,
+                            ctx.resume_no, stale.resume_no)
+                stale.kill()
+                del drt._active[ctx.id]
+            else:
+                reject(409, f"context {ctx.id} is already executing "
+                            f"(duplicate delivery)")
+                return
         request: Any
         try:
             if ctype == "bin":

@@ -58,6 +58,14 @@ TRACE_KEY = "trace"
 # planes that drop unknown fields degrade to the protective default.
 PRIORITY_KEY = "priority"
 
+# Optional resume-attempt ordinal on request control headers (llm/resume.py
+# mid-stream failover). Attempt N of a broken stream re-enters the plane
+# under the SAME context_id with resume = N (first dispatch omits it /
+# sends 0): a worker holding a still-active context of that id yields to
+# the higher ordinal instead of answering the duplicate-context 409 — the
+# original handler is a zombie whose client already gave up on it.
+RESUME_KEY = "resume"
+
 # error-frame fields (runtime/component.py error_control/error_from_control)
 MESSAGE_KEY = "message"          # human-readable error text
 CODE_KEY = "code"                # http-ish status carried by EngineError
@@ -81,6 +89,8 @@ WIRE_FIELDS = {
              "stitching",
     "priority": "overload class: interactive | batch (absent => "
                 "interactive)",
+    "resume": "mid-stream failover attempt ordinal; a higher ordinal "
+              "supersedes an active context of the same id",
     "message": "error frame: human-readable text",
     "code": "error frame: http-ish status code",
     "stage": "error frame: pipeline stage that shed/expired the request",

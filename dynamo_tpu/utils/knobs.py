@@ -118,6 +118,12 @@ _ALL: List[Knob] = [
        "(0 disables)"),
     _k("DYN_CB_COOLDOWN", "float", "5.0", "runtime",
        "breaker OPEN hold before the half-open probe, seconds"),
+    _k("DYN_RESUME_MAX", "int", "2", "runtime",
+       "mid-stream failover budget: resume attempts per stream after a "
+       "transport break or stall (0 disables resumable streams)"),
+    _k("DYN_RESUME_STALL", "float", "30.0", "runtime",
+       "inter-frame stall budget, seconds; a stream silent this long is "
+       "treated as a break and resumed (0 disables stall detection)"),
     _k("DYN_REQUEST_TIMEOUT", "float", "", "runtime",
        "default end-to-end request deadline when the client sends none, "
        "seconds"),

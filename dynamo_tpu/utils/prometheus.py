@@ -570,6 +570,26 @@ class StageMetrics:
             "dyn_kv_cluster_fetch_seconds",
             "Peer prefix fetch duration, request out to blocks deposited",
             (), buckets=LATENCY_BUCKETS_FAST + (2.5, 10.0))
+        # mid-stream failover (llm/resume.py): a broken stream re-enters
+        # the router under the same context id and a new worker continues
+        # from the next token — the client sees a pause, not a 503
+        self.stream_resumes = r.counter(
+            "dyn_stream_resumes_total",
+            "Mid-stream failover attempts by outcome: resumed (a new "
+            "worker continued the stream), exhausted (DYN_RESUME_MAX "
+            "spent -> typed 503 resume_exhausted), expired (original "
+            "deadline passed mid-retry -> 504)", ("outcome",))
+        self.resume_kv_reattach_blocks = r.counter(
+            "dyn_resume_kv_reattach_blocks_total",
+            "Sealed KV blocks a resumed request re-attached at admission "
+            "(cluster-fetched or tier-restored) instead of re-prefilling "
+            "— zero on a resume means the full-local-prefill fallback "
+            "path was taken", ())
+        self.resume_latency = r.histogram(
+            "dyn_resume_latency_seconds",
+            "Client-visible pause per successful resume: stream break "
+            "detected to first frame from the replacement worker",
+            (), buckets=LATENCY_BUCKETS_FAST + (2.5, 10.0))
         # layer-streamed KV ingestion (llm/kv_transfer.py streamed mode):
         # each arriving layer's device scatter is enqueued while later
         # layers are still in flight; a torn stream (donor death, codec

@@ -13,7 +13,8 @@
 //   embedder                      libdynamo_dataplane.so
 //   --------                      ----------------------
 //   dp_start(host, port, cbs) --> bind + epoll thread
-//       <-- on_request(sid, endpoint, ctx_id, ctype, payload, streaming)
+//       <-- on_request(sid, endpoint, ctx_id, ctype, payload, streaming,
+//                      resume)
 //       <-- on_part(sid, data, is_end)        (client-streamed requests)
 //       <-- on_control(sid, STOP|KILL|GONE)
 //   dp_send(sid, frame_bytes)  --> queued on the stream's connection
@@ -53,7 +54,7 @@ extern "C" {
 typedef void (*dp_request_cb)(int64_t sid, const char* endpoint,
                               const char* ctx_id, const char* ctype,
                               const uint8_t* payload, uint64_t len,
-                              int streaming);
+                              int streaming, int64_t resume);
 typedef void (*dp_part_cb)(int64_t sid, const uint8_t* data, uint64_t len,
                            int is_end);
 typedef void (*dp_control_cb)(int64_t sid, int kind);  // 0 stop 1 kill 2 gone
@@ -330,6 +331,10 @@ struct Server {
       const Value* cid = control.get("context_id");
       const Value* ct = control.get("ctype");
       const Value* st = control.get("streaming");
+      // mid-stream failover attempt ordinal (wire.py RESUME_KEY): the
+      // embedder's duplicate-context guard needs it to let a higher
+      // ordinal supersede a zombie context of the same id
+      const Value* rs = control.get("resume");
       {
         std::lock_guard<std::mutex> g(mu_);
         c->streaming = st && st->t == Value::T::Bool && st->b;
@@ -339,7 +344,8 @@ struct Server {
                    cid && cid->t == Value::T::Str ? cid->s.c_str() : "",
                    ct && ct->t == Value::T::Str ? ct->s.c_str() : "",
                    reinterpret_cast<const uint8_t*>(payload.s.data()),
-                   payload.s.size(), c->streaming ? 1 : 0);
+                   payload.s.size(), c->streaming ? 1 : 0,
+                   rs && rs->t == Value::T::Int ? rs->i : 0);
     } else if (kind == "part") {
       int64_t sid = cur_sid_of(c);
       if (sid && on_part)

@@ -468,6 +468,294 @@ async def model_kill_soak(duration: float, n_workers: int,
         procs.stop()
 
 
+async def _midkill_echo_arm(duration: float, n_workers: int,
+                            concurrency: int, request_deadline: float,
+                            logdir: str, rng) -> dict:
+    """Echo arm of the mid-stream-kill soak: waves of concurrent streams
+    with a kill -9 landing at a random token index inside each wave. The
+    frontend-side resume layer (llm/resume.py) must absorb every break:
+    zero client-visible failures, and every stream's token sequence
+    byte-identical to the unkilled reference (for echo, the prompt
+    itself) — duplicated or dropped tokens across the splice are the
+    failure mode under test."""
+    from dynamo_tpu.llm.protocols.common import BackendInput
+    from dynamo_tpu.llm.remote import RemoteCoreEngine
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.utils.prometheus import stage_metrics
+
+    store_port = _free_port()
+    procs = Procs(logdir, store_port)
+    procs.start_store()
+    for _ in range(n_workers):
+        procs.start_worker()
+
+    drt = await DistributedRuntime(store_port=store_port,
+                                   advertise_host="127.0.0.1").connect()
+    client = await (drt.namespace(NAMESPACE).component("backend")
+                    .endpoint("generate").client().start())
+    await client.wait_for_instances(n_workers, timeout=30)
+    engine = RemoteCoreEngine(client)
+    stage = stage_metrics()
+    base = {k: stage.stream_resumes.get(k)
+            for k in ("resumed", "exhausted", "expired")}
+
+    # 120 tokens at DYN_TOKEN_ECHO_DELAY_MS=5 ~= 0.6s per stream: the
+    # kill delay below lands inside the stream, at a random token index
+    prompt = list(range(1, 121))
+    counts = {"submitted": 0, "ok": 0, "mismatch": 0, "failed": 0,
+              "hung": 0}
+    kills = 0
+
+    async def one_stream() -> None:
+        counts["submitted"] += 1
+        req = BackendInput(token_ids=list(prompt))
+        ctx = Context(deadline=time.time() + request_deadline)
+        got = []
+
+        async def run():
+            async for item in engine.generate(req, ctx):
+                got.extend(item.token_ids)
+
+        try:
+            await asyncio.wait_for(run(), request_deadline + 10.0)
+            counts["ok" if got == prompt else "mismatch"] += 1
+        except asyncio.TimeoutError:
+            counts["hung"] += 1
+        except Exception as e:  # noqa: BLE001 - any error is a verdict
+            counts["failed"] += 1
+            print(f"midkill[echo]: client-visible failure: "
+                  f"{type(e).__name__}: {e}", flush=True)
+
+    stop_at = time.monotonic() + duration
+    max_waves = max(6, int(duration / 1.2))
+    verdicts = {}
+    try:
+        for _wave in range(max_waves):
+            streams = [asyncio.create_task(one_stream())
+                       for _ in range(concurrency)]
+            # mid-stream, by construction: the streams above are a few
+            # to a few-dozen frames in when the SIGKILL lands
+            await asyncio.sleep(rng.uniform(0.1, 0.4))
+            if len(procs.workers) >= 2:
+                victim = rng.choice(list(procs.workers))
+                print(f"midkill[echo]: kill -9 worker{victim}", flush=True)
+                procs.kill_worker(victim)
+                kills += 1
+            await asyncio.gather(*streams)
+            await asyncio.to_thread(procs.start_worker)
+            resumed = stage.stream_resumes.get("resumed") - base["resumed"]
+            if time.monotonic() >= stop_at and kills and resumed:
+                break
+        resumes = {k: stage.stream_resumes.get(k) - base[k]
+                   for k in base}
+        verdicts = {
+            "zero_client_visible_failures":
+                counts["failed"] == 0 and counts["hung"] == 0,
+            "zero_dup_or_dropped_tokens": counts["mismatch"] == 0,
+            "all_streams_completed":
+                counts["submitted"] > 0
+                and counts["ok"] == counts["submitted"],
+            "killed_mid_stream": kills >= 1,
+            "streams_resumed": resumes["resumed"] >= 1,
+        }
+        return {"workers": n_workers, "concurrency": concurrency,
+                "stream_tokens": len(prompt), "kills": kills,
+                "resume_outcomes": resumes, **counts,
+                "verdicts": verdicts}
+    finally:
+        try:
+            await drt.close()
+        # dynalint: ok(swallowed-exception) harness teardown after the
+        # verdict is already computed; procs.stop() below reaps anyway
+        except Exception:
+            pass
+        if not verdicts or not all(verdicts.values()):
+            procs.dump()
+        procs.stop()
+
+
+async def _midkill_jax_arm(request_deadline: float, logdir: str,
+                           rng) -> dict:
+    """Donor-alive arm: three real jax (tiny-byte) workers with cluster
+    KV sharing on. Worker A runs the unkilled greedy reference (sealing
+    prompt+output into its host tier and publishing its cluster registry
+    record); the measured stream is pinned to victim B and kill -9'd at
+    a random token index; the resume attempt lands on cold worker C with
+    A stamped as donor — exactly what the router's post-death donor
+    election produces. PASS iff the spliced stream is token-identical to
+    A's reference AND the first post-resume frame proves the KV
+    re-attach (kv_prefix_hit_tokens >= one page: C held nothing of this
+    prompt, so any hit is the cluster fetch, not recompute)."""
+    import json as _json
+
+    from dynamo_tpu.llm import resume
+    from dynamo_tpu.llm.protocols.common import (BackendInput, EngineOutput,
+                                                 StopConditions)
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.utils.prometheus import stage_metrics
+
+    ea = {"preset": "tiny-byte", "max_batch": 2, "max_context": 256,
+          "prefill_chunk": 32, "page_size": 8, "host_cache_blocks": 64}
+    store_port = _free_port()
+    procs = Procs(
+        logdir, store_port,
+        worker_extra=["--engine", "jax",
+                      "--extra-engine-args", _json.dumps(ea)],
+        env_extra={"DYN_KV_CLUSTER": "1",
+                   "DYN_KV_CLUSTER_PUBLISH_INTERVAL": "0.3"})
+    procs.start_store()
+
+    drt = await DistributedRuntime(store_port=store_port,
+                                   advertise_host="127.0.0.1").connect()
+    client = await (drt.namespace(NAMESPACE).component("backend")
+                    .endpoint("generate").client().start())
+    ids, widx = [], []
+    for i in range(3):
+        widx.append(await asyncio.to_thread(procs.start_worker))
+        await client.wait_for_instances(i + 1, timeout=60)
+        ids.append((set(client.instance_ids()) - set(ids)).pop())
+    a_id, b_id, c_id = ids
+
+    page = ea["page_size"]
+    max_toks = 32
+    prompt = [(17 * i + 3) % 251 + 1 for i in range(48)]
+    warm_prompt = [(23 * i + 7) % 251 + 1 for i in range(48)]
+
+    def payload(toks):
+        return BackendInput(token_ids=list(toks),
+                            stop=StopConditions(max_tokens=max_toks,
+                                                ignore_eos=True))
+
+    async def direct(req, iid):
+        got = []
+        ctx = Context(deadline=time.time() + request_deadline)
+        async for item in client.generate(req.to_dict(), ctx,
+                                          mode="direct", instance_id=iid):
+            got.extend(EngineOutput.from_dict(item).token_ids)
+        return got
+
+    stage = stage_metrics()
+    resumed0 = stage.stream_resumes.get("resumed")
+    state = {"killed_at": None, "resume_at": None, "reattach_hit": None,
+             "attempts": 0}
+    got = []
+    verdicts = {}
+    try:
+        # A's run IS the unkilled greedy reference (params are seed-
+        # deterministic across workers) and doubles as the donor warm:
+        # prompt+output seal, write-through mirrors them to A's host
+        # tier, the registry record publishes under A's lease
+        ref_tokens = await direct(payload(prompt), a_id)
+        # compile warm B and C with same-bucket content so the measured
+        # stream never pauses on a first-request XLA compile
+        await direct(payload(warm_prompt), b_id)
+        await direct(payload(warm_prompt), c_id)
+        await asyncio.sleep(1.5)   # registry publish + metrics beats
+
+        kill_at = rng.randint(6, 16)
+
+        async def dispatch(req, ctx, exclude, attempt, on_instance):
+            state["attempts"] = attempt + 1
+            if attempt == 0:
+                target = b_id
+            else:
+                target = c_id
+                state["resume_at"] = len(got)
+                # what the router's post-death donor election stamps in
+                # production (route() excludes the dead instance): A is
+                # the surviving owner of the sealed prefix
+                req.kv_donor = a_id
+                req.kv_donor_blocks = len(prompt) // page
+            async for item in client.generate(
+                    req.to_dict(), ctx, mode="direct", instance_id=target,
+                    exclude=exclude, resume=attempt,
+                    on_instance=on_instance):
+                yield EngineOutput.from_dict(item)
+
+        async def killer():
+            while True:
+                if len(got) >= kill_at:
+                    procs.kill_worker(widx[1])
+                    state["killed_at"] = len(got)
+                    print(f"midkill[jax]: kill -9 victim at token "
+                          f"{len(got)}", flush=True)
+                    return
+                await asyncio.sleep(0.003)
+
+        ctx = Context(deadline=time.time() + request_deadline)
+        ktask = asyncio.create_task(killer())
+        try:
+            async for item in resume.run(dispatch, payload(prompt), ctx):
+                if (state["resume_at"] is not None
+                        and state["reattach_hit"] is None):
+                    state["reattach_hit"] = item.kv_prefix_hit_tokens or 0
+                if item.token_ids:
+                    got.extend(item.token_ids)
+        finally:
+            ktask.cancel()
+
+        resumed = stage.stream_resumes.get("resumed") - resumed0
+        hit = state["reattach_hit"] or 0
+        verdicts = {
+            "reference_complete": len(ref_tokens) == max_toks,
+            "killed_mid_stream":
+                state["killed_at"] is not None
+                and 0 < state["killed_at"] < max_toks,
+            "stream_resumed": resumed >= 1 and state["attempts"] >= 2,
+            "tokens_identical_to_unkilled_reference": got == ref_tokens,
+            # C was cold on this prompt: a >= one-page hit on the resume
+            # attempt's admission can only be the cluster re-attach
+            "kv_reattach_taken": hit >= page,
+        }
+        return {"engine": ea, "prompt_tokens": len(prompt),
+                "max_tokens": max_toks,
+                "killed_at_token": state["killed_at"],
+                "resumed_at_token": state["resume_at"],
+                "dispatch_attempts": state["attempts"],
+                "post_resume_prefix_hit_tokens": hit,
+                "reference_tokens": ref_tokens, "stream_tokens": got,
+                "verdicts": verdicts}
+    finally:
+        try:
+            await drt.close()
+        # dynalint: ok(swallowed-exception) harness teardown after the
+        # verdict is already computed; procs.stop() below reaps anyway
+        except Exception:
+            pass
+        if not verdicts or not all(verdicts.values()):
+            procs.dump()
+        procs.stop()
+
+
+async def midstream_kill_soak(duration: float, n_workers: int,
+                              concurrency: int, request_deadline: float,
+                              logdir: str) -> dict:
+    """Mid-stream failover soak (docs/robustness.md#resumable-streams):
+    kill -9 decode workers at random token indices under live streams.
+    The echo arm proves the splice contract at volume; the jax arm
+    proves the KV re-attach path on a real engine with a surviving
+    donor. Artifact: bench_points/midstream_kill_soak.json."""
+    rng = random.Random(23)
+    result = {
+        "echo_arm": await _midkill_echo_arm(
+            duration, n_workers, concurrency, request_deadline,
+            logdir, rng),
+        # first-touch XLA compiles on CPU dominate the jax arm's warm
+        # runs; the measured stream itself finishes in seconds
+        "jax_donor_arm": await _midkill_jax_arm(
+            max(request_deadline, 120.0), logdir, rng),
+    }
+    result["verdicts"] = {
+        **{f"echo_{k}": v
+           for k, v in result["echo_arm"]["verdicts"].items()},
+        **{f"jax_{k}": v
+           for k, v in result["jax_donor_arm"]["verdicts"].items()},
+    }
+    return result
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(prog="chaos_soak")
     ap.add_argument("--duration", type=float, default=30.0)
@@ -487,7 +775,37 @@ def main() -> int:
                     help="mixed-model blast-radius scenario: kill an "
                          "entire model pool mid-traffic; the surviving "
                          "model's success + latency must stay flat")
+    ap.add_argument("--mid-stream-kill", action="store_true",
+                    help="mid-stream failover scenario: kill -9 decode "
+                         "workers at random token indices; streams must "
+                         "resume with zero client-visible failures, "
+                         "byte-identical tokens, and (jax arm) a cluster "
+                         "KV re-attach instead of full recompute")
     a = ap.parse_args()
+    if a.mid_stream_kill:
+        import json as _json
+
+        logdir = tempfile.mkdtemp(prefix="midstream_kill_soak_")
+        print(f"mid-stream-kill soak: {a.duration}s echo arm, "
+              f"{a.workers} workers, logs {logdir}", flush=True)
+        result = asyncio.run(midstream_kill_soak(
+            a.duration, a.workers, a.concurrency, a.request_deadline,
+            logdir))
+        out = os.path.join(REPO, "bench_points",
+                           "midstream_kill_soak.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            _json.dump(result, f, indent=2, sort_keys=True)
+        print(_json.dumps(result["verdicts"], indent=2, sort_keys=True),
+              flush=True)
+        print(f"artifact: {out}", flush=True)
+        failed = [k for k, ok in result["verdicts"].items() if not ok]
+        if failed:
+            print(f"FAIL: {failed}", flush=True)
+            return 1
+        print("PASS: every killed stream resumed, token-identical, "
+              "KV re-attached", flush=True)
+        return 0
     if a.model_kill:
         import json as _json
 

@@ -1,0 +1,489 @@
+"""Mid-stream failover (llm/resume.py): resumable generation.
+
+Covers the resume loop against scripted dispatchers (greedy token-identity
+pin, budget exhaustion, deadline expiry, stall detection + breaker feed),
+the echo engine's resume math, the worker-side resume-supersede guard over
+a real runtime, router-side exclusion/stand-down, and the engine-level
+greedy pin + KV re-attach accounting on the tiny jax model.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.llm import resume
+from dynamo_tpu.llm.engines import EchoCoreEngine
+from dynamo_tpu.llm.protocols.common import (
+    BackendInput,
+    EngineOutput,
+    FinishReason,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context, EngineError
+from dynamo_tpu.utils.prometheus import stage_metrics
+
+
+# ---------------------------------------------------------------------------
+# Scripted dispatchers: a "worker fleet" as a closure
+# ---------------------------------------------------------------------------
+
+def make_dispatch(source, kills=None, record=None, stalls=None):
+    """A dispatch whose attempt N emits ``source[resume_pos:]`` one token per
+    frame plus a separate finish frame. ``kills[N]`` breaks attempt N with a
+    transport-class 503 after that many frames; ``stalls[N]`` hangs instead.
+    ``record`` collects (token_ids, resume_pos, max_tokens, exclude,
+    resume_no) per attempt."""
+    kills = kills or {}
+    stalls = stalls or {}
+
+    async def dispatch(request, context, exclude, resume_no, on_instance):
+        if record is not None:
+            record.append((list(request.token_ids), request.resume_pos,
+                           request.stop.max_tokens, set(exclude), resume_no))
+        iid = 0xA0 + resume_no
+        if on_instance is not None:
+            on_instance(iid)
+        pos = int(request.resume_pos or 0)
+        budget = request.stop.max_tokens
+        end = len(source) if budget is None else min(pos + budget, len(source))
+        for n, i in enumerate(range(pos, end)):
+            if stalls.get(resume_no) is not None and n >= stalls[resume_no]:
+                await asyncio.sleep(60)    # unbounded-ok: wedged-worker stub
+            if kills.get(resume_no) is not None and n >= kills[resume_no]:
+                raise EngineError("connection reset mid-stream", 503)
+            yield EngineOutput(token_ids=[source[i]])
+        if kills.get(resume_no) is not None and end - pos <= kills[resume_no]:
+            # budget spent exactly at the kill point: the finish frame is
+            # what dies with the connection
+            raise EngineError("connection reset mid-stream", 503)
+        yield EngineOutput(finish_reason=FinishReason.LENGTH)
+
+    return dispatch
+
+
+async def collect(agen):
+    toks, finish = [], None
+    async for item in agen:
+        toks.extend(item.token_ids)
+        if item.finish_reason is not None:
+            finish = item.finish_reason
+    return toks, finish
+
+
+def req(n_prompt=8, max_tokens=None, **kw):
+    return BackendInput(token_ids=list(range(100, 100 + n_prompt)),
+                        stop=StopConditions(max_tokens=max_tokens,
+                                            ignore_eos=True), **kw)
+
+
+# ---------------------------------------------------------------------------
+# The resume loop
+# ---------------------------------------------------------------------------
+
+async def test_greedy_token_identity_across_kill():
+    """A stream killed mid-flight and resumed yields exactly the tokens the
+    unkilled run would have: no duplicates, no holes, one finish frame."""
+    source = list(range(16))
+    stage = stage_metrics()
+    resumed0 = stage.stream_resumes.get("resumed")
+    record = []
+    reference, _ = await collect(make_dispatch(source)(
+        req(max_tokens=16), Context(), set(), 0, None))
+
+    toks, finish = await collect(resume.run(
+        make_dispatch(source, kills={0: 5}, record=record),
+        req(max_tokens=16), Context()))
+    assert toks == reference == source
+    assert finish == FinishReason.LENGTH
+    assert stage.stream_resumes.get("resumed") == resumed0 + 1
+
+    # the resume request re-entered with prompt+emitted as the prefix,
+    # the spent budget deducted, and the dead instance excluded
+    assert len(record) == 2
+    tokens2, pos2, max2, excl2, ordinal2 = record[1]
+    assert tokens2 == list(range(100, 108)) + source[:5]
+    assert pos2 == 5 and max2 == 11
+    assert excl2 == {0xA0} and ordinal2 == 1
+
+
+async def test_two_kills_two_resumes():
+    source = list(range(12))
+    record = []
+    toks, finish = await collect(resume.run(
+        make_dispatch(source, kills={0: 4, 1: 3}, record=record),
+        req(max_tokens=12), Context()))
+    assert toks == source and finish == FinishReason.LENGTH
+    assert [r[1] for r in record] == [0, 4, 7]          # resume positions
+    assert record[2][3] == {0xA0, 0xA1}                 # both corpses excluded
+
+
+async def test_resume_budget_exhausted_typed_503(monkeypatch):
+    monkeypatch.setenv("DYN_RESUME_MAX", "2")
+    stage = stage_metrics()
+    ex0 = stage.stream_resumes.get("exhausted")
+    record = []
+    with pytest.raises(EngineError) as ei:
+        await collect(resume.run(
+            make_dispatch(list(range(12)), kills={0: 2, 1: 1, 2: 1},
+                          record=record),
+            req(max_tokens=12), Context()))
+    assert ei.value.code == 503
+    assert ei.value.reason == "resume_exhausted"
+    assert ei.value.stage == resume.RESUME_STAGE
+    assert len(record) == 3                             # 1 original + 2 resumes
+    assert stage.stream_resumes.get("exhausted") == ex0 + 1
+
+
+async def test_resume_respects_original_deadline(monkeypatch):
+    """A resume never restarts the clock: a break with the original
+    end-to-end deadline already spent is a 504 naming this stage."""
+    monkeypatch.setenv("DYN_RESUME_MAX", "5")
+    stage = stage_metrics()
+    exp0 = stage.stream_resumes.get("expired")
+    record = []
+    with pytest.raises(EngineError) as ei:
+        await collect(resume.run(
+            make_dispatch(list(range(12)), kills={0: 3}, record=record),
+            req(max_tokens=12), Context(deadline=time.time() - 0.5)))
+    assert ei.value.code == 504
+    assert ei.value.stage == resume.RESUME_STAGE
+    assert len(record) == 1                             # no futile re-dispatch
+    assert stage.stream_resumes.get("expired") == exp0 + 1
+
+
+async def test_typed_failures_are_never_resumed():
+    """Sheds / fast-fails / quota rejects carry a machine reason — they are
+    decisions, not deaths, and must propagate on the first attempt."""
+    record = []
+
+    async def shedding(request, context, exclude, resume_no, on_instance):
+        record.append(resume_no)
+        raise EngineError("saturated", 503, stage="router",
+                          reason="fast_fail")
+        yield  # pragma: no cover - makes this an async generator
+
+    with pytest.raises(EngineError) as ei:
+        await collect(resume.run(shedding, req(max_tokens=4), Context()))
+    assert ei.value.reason == "fast_fail"
+    assert record == [0]
+
+
+async def test_stall_resumes_and_feeds_breaker(monkeypatch):
+    """A wedged worker never errors the socket: the inter-frame stall budget
+    declares the break, the instance takes a circuit-breaker hit (transport
+    breaks are counted inside Client.generate; stalls only here), and the
+    stream completes elsewhere."""
+    monkeypatch.setenv("DYN_RESUME_STALL", "0.2")
+    source = list(range(8))
+    hits = []
+
+    class FakeBreaker:
+        def record_failure(self, iid):
+            hits.append(iid)
+
+    toks, finish = await collect(resume.run(
+        make_dispatch(source, stalls={0: 3}),
+        req(max_tokens=8), Context(), breaker=FakeBreaker()))
+    assert toks == source and finish == FinishReason.LENGTH
+    assert hits == [0xA0]
+
+
+async def test_lost_finish_frame_synthesizes_length():
+    """The dead worker emitted the whole token budget but its finish frame
+    died with the connection: the resume layer closes the stream itself
+    instead of dispatching a zero-budget request."""
+    record = []
+    toks, finish = await collect(resume.run(
+        make_dispatch(list(range(8)), kills={0: 4}, record=record),
+        req(max_tokens=4), Context()))
+    assert toks == list(range(4))
+    assert finish == FinishReason.LENGTH
+    assert len(record) == 1                             # no second dispatch
+
+
+def test_resume_request_shape():
+    orig = req(n_prompt=4, max_tokens=10)
+    orig.sampling = SamplingOptions(temperature=0.7, seed=123)
+    orig.stop.min_tokens = 6
+    orig.kv_donor = 0xBEEF
+    orig.kv_donor_blocks = 3
+    r = resume._resume_request(orig, list(orig.token_ids), [7, 8, 9], 10, 6)
+    assert r.token_ids == list(range(100, 104)) + [7, 8, 9]
+    assert r.resume_pos == 3
+    assert r.stop.max_tokens == 7 and r.stop.min_tokens == 3
+    assert r.kv_donor == 0 and r.kv_donor_blocks == 0   # stale stamp cleared
+    assert r.sampling.seed == 123                       # seed rides along
+    assert orig.stop.max_tokens == 10                   # original untouched
+
+
+def test_resumable_classification():
+    assert resume.resumable(EngineError("reset", 503))
+    assert resume.resumable(EngineError("bad frame", 502))
+    assert not resume.resumable(EngineError("shed", 503, reason="fast_fail"))
+    assert not resume.resumable(EngineError("expired", 504, reason="deadline"))
+    assert not resume.resumable(EngineError("dup", 409))
+    assert not resume.resumable(ValueError("reset"))
+
+
+def test_resume_disabled_knob(monkeypatch):
+    monkeypatch.setenv("DYN_RESUME_MAX", "0")
+    assert resume.max_attempts() == 0
+    monkeypatch.delenv("DYN_RESUME_MAX")
+    assert resume.max_attempts() == 2
+
+
+# ---------------------------------------------------------------------------
+# Echo engine resume math
+# ---------------------------------------------------------------------------
+
+async def test_echo_resume_continues_byte_identical():
+    eng = EchoCoreEngine(delay_s=0)
+    prompt = list(range(50, 58))
+    ref, _ = await collect(eng.generate(
+        BackendInput(token_ids=list(prompt), stop=StopConditions()),
+        Context()))
+    assert ref == prompt
+    # killed after 3: the resume request carries prompt + emitted
+    r = BackendInput(token_ids=list(prompt) + prompt[:3],
+                     stop=StopConditions())
+    r.resume_pos = 3
+    cont, finish = await collect(eng.generate(r, Context()))
+    assert prompt[:3] + cont == ref
+    assert finish == FinishReason.LENGTH
+
+
+async def test_echo_resume_zero_budget_is_length():
+    eng = EchoCoreEngine(delay_s=0)
+    prompt = [1, 2, 3]
+    r = BackendInput(token_ids=prompt + prompt, stop=StopConditions())
+    r.resume_pos = 3                                    # everything emitted
+    toks, finish = await collect(eng.generate(r, Context()))
+    assert toks == [] and finish == FinishReason.LENGTH
+
+
+# ---------------------------------------------------------------------------
+# RNG re-seeding
+# ---------------------------------------------------------------------------
+
+def test_resume_seed_fold():
+    from dynamo_tpu.engine.sampling import resume_seed
+
+    assert resume_seed(42, 0) == 42                     # identity at origin
+    assert resume_seed(42, 7) == resume_seed(42, 7)     # deterministic
+    assert resume_seed(42, 7) != resume_seed(42, 8)     # position-dependent
+    assert resume_seed(42, 7) != resume_seed(43, 7)     # seed-dependent
+    assert 0 <= resume_seed(2**63, 2**31) < 2**64
+
+
+# ---------------------------------------------------------------------------
+# Wire round-trip
+# ---------------------------------------------------------------------------
+
+def test_engine_output_error_triple_roundtrip():
+    out = EngineOutput(finish_reason=FinishReason.ERROR, error="boom",
+                       error_code=503, error_stage="router",
+                       error_reason="fast_fail")
+    back = EngineOutput.from_dict(out.to_dict())
+    assert back.error_code == 503
+    assert back.error_stage == "router"
+    assert back.error_reason == "fast_fail"
+
+
+def test_backend_input_resume_pos_roundtrip():
+    r = req(max_tokens=4)
+    r.resume_pos = 9
+    assert BackendInput.from_dict(r.to_dict()).resume_pos == 9
+    assert BackendInput.from_dict({"token_ids": [1]}).resume_pos == 0
+
+
+# ---------------------------------------------------------------------------
+# Router re-election: exclusion + stand-down
+# ---------------------------------------------------------------------------
+
+def test_scheduler_excludes_dead_instance_and_stands_down():
+    from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+    from dynamo_tpu.llm.kv_router.protocols import (ForwardPassMetrics,
+                                                    KvCacheEvent,
+                                                    KvStoredEvent,
+                                                    RouterEvent, StoredBlock)
+    from dynamo_tpu.llm.kv_router.scheduler import KvScheduler
+    from dynamo_tpu.llm.tokens import compute_seq_hashes
+
+    def metrics():
+        return ForwardPassMetrics(request_active_slots=0,
+                                  request_total_slots=8,
+                                  kv_active_blocks=0, kv_total_blocks=100,
+                                  num_requests_waiting=0)
+
+    sched = KvScheduler(block_size=4)
+    sched.update_endpoints({1: metrics(), 2: metrics()})
+    tokens = list(range(16))
+    idx = KvIndexer(block_size=4)
+    idx.apply_sync(RouterEvent(2, KvCacheEvent(
+        event_id=1,
+        stored=KvStoredEvent(
+            blocks=[StoredBlock(block_hash=h, tokens_hash=h ^ 1)
+                    for h in compute_seq_hashes(tokens, 4)],
+            parent_hash=None))))
+    overlaps = idx.find_matches(compute_seq_hashes(tokens, 4))
+    assert sched.schedule(tokens, overlaps) == 2        # overlap wins...
+    assert sched.schedule(tokens, overlaps, exclude={2}) == 1   # ...unless dead
+    # excluding everyone stands down to the full pool (the supersede guard
+    # makes re-dispatch to a blamed instance safe) instead of an outage
+    assert sched.schedule(tokens, overlaps, exclude={1, 2}) is not None
+
+
+# ---------------------------------------------------------------------------
+# Worker-side resume-supersede guard (real runtime)
+# ---------------------------------------------------------------------------
+
+async def test_resume_ordinal_supersedes_zombie_context():
+    """Attempt N+1 re-enters under the SAME context id: a worker still
+    holding the wedged attempt kills it and serves; a plain duplicate
+    (no higher ordinal) still 409s."""
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+
+    store = StoreServer()
+    port = await store.start()
+    drt = cdrt = None
+    try:
+        drt = await DistributedRuntime(store_port=port,
+                                       advertise_host="127.0.0.1").connect()
+        cdrt = await DistributedRuntime(store_port=port,
+                                        advertise_host="127.0.0.1").connect()
+
+        async def handler(request, ctx):
+            n = int(request.get("n", 0))
+            for i in range(n):
+                if ctx.is_killed or ctx.is_stopped:
+                    return
+                yield {"i": i}
+                await asyncio.sleep(0.02)
+
+        ep = drt.namespace("dyn").component("backend").endpoint("generate")
+        await ep.serve(handler)
+        client = await cdrt.namespace("dyn").component("backend") \
+            .endpoint("generate").client().start()
+        for _ in range(100):
+            if client.instances:
+                break
+            await asyncio.sleep(0.05)
+
+        # wedge attempt 0: start a long stream and abandon it mid-flight
+        agen = client.generate({"n": 1000}, Context(id="ctx-resume"))
+        it = agen.__aiter__()
+        await asyncio.wait_for(it.__anext__(), 5.0)
+
+        # a duplicate delivery with no resume ordinal is still refused
+        with pytest.raises(EngineError) as ei:
+            async for _ in client.generate({"n": 3},
+                                           Context(id="ctx-resume")):
+                pass
+        assert ei.value.code == 409
+
+        # attempt 1 supersedes: the zombie dies, the new attempt serves
+        got = []
+        async for frame in client.generate({"n": 3},
+                                           Context(id="ctx-resume"),
+                                           resume=1):
+            got.append(frame["i"])
+        assert got == [0, 1, 2]
+        await agen.aclose()
+    finally:
+        if cdrt is not None:
+            await cdrt.close()
+        if drt is not None:
+            await drt.close()
+        await store.stop()
+
+
+# ---------------------------------------------------------------------------
+# Engine-level: greedy pin + KV re-attach accounting (tiny jax model)
+# ---------------------------------------------------------------------------
+
+async def test_engine_resume_greedy_pin_and_kv_reattach():
+    """On the real engine: (a) decode-side sealing write-through mirrors
+    decode-generated pages to the host tier, (b) a resumed request's
+    teacher-forced prefix pins greedy continuation token-identical to the
+    unkilled run, (c) the surviving sealed prefix re-attaches (prefix hit,
+    not recompute) and is surfaced on the first StepOutput + counted."""
+    jax = pytest.importorskip("jax")  # noqa: F841 - environment gate
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+    from dynamo_tpu.models import llama
+
+    stage = stage_metrics()
+    reattach0 = stage.resume_kv_reattach_blocks.get()
+
+    core = await asyncio.to_thread(
+        EngineCore, JaxEngineConfig(
+            model=llama.preset("tiny-byte"), tp=1, page_size=8, max_batch=2,
+            max_context=128, prefill_chunk=32, host_cache_blocks=16,
+            cluster_writethrough=True))
+
+    def run(seq_id, tokens, max_tokens, resume_pos=0):
+        bi = BackendInput(token_ids=list(tokens),
+                          stop=StopConditions(max_tokens=max_tokens,
+                                              ignore_eos=True))
+        bi.resume_pos = resume_pos
+        core.submit(seq_id, bi)
+        got = []
+        for _ in range(400):
+            for so in core.step():
+                if so.seq_id == seq_id:
+                    got.append(so)
+                    if so.finish is not None:
+                        return got
+        raise AssertionError("did not finish")
+
+    prompt = list(range(1, 21))                         # 2.5 pages of 8
+    ref = await asyncio.to_thread(run, "ref", prompt, 12)
+    ref_tokens = [so.token for so in ref]
+    assert len(ref_tokens) == 12
+    # the write-through ratchet stages seal -> pending -> armed -> buffered
+    # across the TOPS of subsequent steps; run() returns on the finish
+    # frame, so drive a few idle steps (the serving facade keeps stepping)
+    # to let decode-sealed pages drain to the host tier
+    await asyncio.to_thread(lambda: [core.step() for _ in range(4)])
+    # prefill sealed pages 0-1; page 2 completes during decode and must be
+    # mirrored by the same write-through discipline (page 3's seal can land
+    # on the finishing step, whose d2h is a pre-existing tail case)
+    assert core.tiered.stats()["host_blocks"] >= 3, \
+        "decode-side sealing did not write through to the host tier"
+
+    # the "replacement worker" (same core: its tiers survived) resumes at
+    # token 5 with prompt + emitted as the teacher-forced prefix
+    cont = await asyncio.to_thread(
+        run, "res", prompt + ref_tokens[:5], 7, 5)
+    assert [so.token for so in cont] == ref_tokens[5:], \
+        "greedy resume is not token-identical to the unkilled run"
+    # re-attach, not re-prefill: sealed blocks restored at admission and
+    # surfaced on the stream's first output for the soak to assert on
+    assert core.last_prefix_hit >= 8
+    assert cont[0].prefix_hit == core.last_prefix_hit
+    assert stage.resume_kv_reattach_blocks.get() >= reattach0 + 1
+
+
+# ---------------------------------------------------------------------------
+# multi-process kill -9 soak lane (excluded from tier-1)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_midstream_kill_soak_lane():
+    """scripts/chaos_soak.py --mid-stream-kill: real worker processes,
+    real SIGKILLs at random token indices; every stream must resume
+    token-identical and the jax arm must take the cluster KV re-attach."""
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "scripts/chaos_soak.py", "--mid-stream-kill",
+         "--duration", "12", "--workers", "3"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
