@@ -40,6 +40,10 @@ SCOPE = [
     # documented paging budget), and a new un-reasoned sync still fails
     "dynamo_tpu/llm/kvpage",
     "dynamo_tpu/llm/kvbm/transfer.py",
+    # the model-mobility swap path enqueues h2d weight slabs async and
+    # barriers exactly once per swap (the annotated cutover); any other
+    # sync it grows is a serving-path regression
+    "dynamo_tpu/fleet/mobility",
 ]
 
 

@@ -83,6 +83,14 @@ def parse_args(argv=None):
     fadd.add_argument("--worker-args", default="",
                       help="extra args for spawned workers, "
                            "space-separated")
+    fadd.add_argument("--swap-group", default="",
+                      help="model-mobility swap class: models sharing a "
+                           "group hot-swap into each other on preemption "
+                           "(in-place weight swap, no cold spawn)")
+    fadd.add_argument("--prewarm", action="store_true",
+                      help="every worker in the namespace stages this "
+                           "model's weights into its host cache (wake "
+                           "by swap even across swap groups)")
     frem = fsub.add_parser("remove")
     frem.add_argument("name")
     frem.add_argument("--namespace", default="dynamo")
@@ -166,13 +174,17 @@ async def run(args) -> int:
                     tenants=dict(parse_tenant_quota(t)
                                  for t in args.tenant),
                     card=card,
-                    extra_args=[a for a in args.worker_args.split() if a])
+                    extra_args=[a for a in args.worker_args.split() if a],
+                    swap_group=args.swap_group, prewarm=args.prewarm)
                 await put_fleet_model(store, args.namespace, spec)
                 print(f"fleet add {args.name}: component="
                       f"{spec.component} chips/replica={spec.chips_per_replica} "
                       f"replicas=[{spec.min_replicas},{spec.max_replicas}] "
                       f"priority={spec.priority} "
-                      f"tenants={sorted(spec.tenants) or '-'}")
+                      f"tenants={sorted(spec.tenants) or '-'}"
+                      + (f" swap_group={spec.swap_group}"
+                         if spec.swap_group else "")
+                      + (" prewarm" if spec.prewarm else ""))
             elif args.action == "remove":
                 await remove_fleet_model(store, args.namespace, args.name)
                 print(f"fleet remove {args.name}: the planner drains its "
@@ -185,6 +197,10 @@ async def run(args) -> int:
                           f"{args.namespace!r})")
                 for s in specs:
                     st = status.get(s.name, {})
+                    wake = ""
+                    if st.get("wake_path"):
+                        wake = (f" wake={st['wake_path']}"
+                                f"/{st.get('wake_seconds', '?')}s")
                     print(f"{s.name:<24} {s.component:<20} "
                           f"state={st.get('state', 'unreconciled'):<10} "
                           f"replicas={st.get('replicas', '?')}/"
@@ -192,7 +208,9 @@ async def run(args) -> int:
                           f"chips={st.get('chips', '?')} "
                           f"prio={s.priority} "
                           f"burn={st.get('burn', '?')} "
-                          f"tenants={sorted(s.tenants) or '-'}")
+                          f"tenants={sorted(s.tenants) or '-'}"
+                          + (f" group={s.swap_group}"
+                             if s.swap_group else "") + wake)
             return 0
         if args.plane == "disagg":
             from ..llm.disagg import (DisaggConfig, disagg_config_key,

@@ -493,18 +493,27 @@ def render(snap: Dict, store_detail: bool = False) -> str:
         lines.append("fleet:")
         lines.append(
             f"  {'model':<20} {'comp':<18} {'state':<11} {'repl':>9} "
-            f"{'chips':>5} {'prio':>4} {'burn':>6} {'unsrv':>5}")
+            f"{'chips':>5} {'prio':>4} {'burn':>6} {'unsrv':>5} "
+            f"{'wake':>10}")
         for name in sorted(fleet):
             f = fleet[name]
             repl = (f"{f.get('replicas', '?')}->{f.get('target', '?')}"
                     if f.get("target") is not None
                     else str(f.get("replicas", "?")))
+            # last wake path (model mobility): swap = in-place weight
+            # swap (seconds-scale), cold = full boot
+            wake = "-"
+            if f.get("wake_path"):
+                secs = f.get("wake_seconds")
+                wake = (f"{f['wake_path']}/{secs:.1f}s"
+                        if isinstance(secs, (int, float))
+                        else str(f["wake_path"]))
             lines.append(
                 f"  {name:<20} {f.get('component', '?'):<18} "
                 f"{f.get('state', '?'):<11} {repl:>9} "
                 f"{f.get('chips', 0):>5} {f.get('priority', 0):>4} "
                 f"{float(f.get('burn') or 0.0):>6.2f} "
-                f"{int(f.get('unserved') or 0):>5}")
+                f"{int(f.get('unserved') or 0):>5} {wake:>10}")
     cl = snap.get("cluster") or {}
     if any(cl.values()):
         th, tm = cl.get("tier_hits", 0), cl.get("tier_misses", 0)

@@ -212,6 +212,8 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
     # 429 naming this stage instead of queueing into a deadline burn
     gate = overload.gate_from_env()
     endpoint = component.endpoint("generate")
+    engine_ref = None         # set on the simple path (model mobility)
+    served = None
     if getattr(args, "enable_disagg", False) and core is not None:
         # decode worker with conditional remote prefill (SURVEY §3.2):
         # long cold prompts go to the shared queue; KV comes back on the
@@ -376,8 +378,16 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
 
         await endpoint.serve(generate_handler)
     else:
-        served = (engine if gate is None
-                  else overload.SlotGatedEngine(engine, gate))
+        # model mobility (simple path only: no disagg/cluster/multihost —
+        # those keep the plain cold-spawn wake): handlers stream through
+        # an EngineRef so a cold-reload fallback can rebind the engine
+        if core is not None and not multihost and cluster is None:
+            from ..fleet.mobility import EngineRef
+
+            engine_ref = EngineRef(engine)
+        base = engine_ref if engine_ref is not None else engine
+        served = (base if gate is None
+                  else overload.SlotGatedEngine(base, gate))
         if cluster is not None:
             # prefetch wraps OUTSIDE the slot gate: the peer fetch overlaps
             # the queue wait instead of holding a slot while blocks
@@ -398,9 +408,81 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
     stage_pub = StagePublisher(drt.store, args.namespace, args.component,
                                drt.worker_id, drt.lease)
 
+    # --- model mobility agent (simple path only) ---------------------
+    mobility = None
+    if engine_ref is not None:
+        from ..fleet.mobility import MobilityAgent
+
+        async def _reregister(payload):
+            """Post-swap identity change: fresh lease (prepare_drain
+            revoked the old one), serve ``generate`` under the new
+            model's component, re-advertise the model, and move the
+            metrics/KV-event identity along."""
+            nonlocal component, card, stage_pub
+            import os
+
+            drt.lease = await drt.store.lease_grant(
+                ttl=float(os.environ.get("DYN_LEASE_TTL", "10.0")))
+            drt.worker_id = drt.lease
+            drt.draining.clear()
+            if token is not None:
+                drt.store.on_lease_lost = _lease_lost
+            args.component = payload.get("component") or args.component
+            args.model_path = payload.get("model_path") or args.model_path
+            args.model_name = payload.get("model") or args.model_name
+            component = ns.component(args.component)
+            pub.worker_id = drt.worker_id
+            card = _build_card(args)
+            await serve_core_engine(component.endpoint("generate"),
+                                    served)
+            if args.register_model:
+                ep_path = component.endpoint("generate").path
+                await register_model(drt.store, card, ep_path,
+                                     model_type="chat", lease=drt.lease)
+                await register_model(drt.store, card, ep_path,
+                                     model_type="completion",
+                                     lease=drt.lease)
+            stage_pub = StagePublisher(drt.store, args.namespace,
+                                       args.component, drt.worker_id,
+                                       drt.lease)
+            log.info("worker %x re-registered as %s (%s)",
+                     drt.worker_id, args.model_name, args.component)
+
+        async def _cold_reload(new_cfg):
+            """Typed swap-fallback: rebuild the engine off-loop (the
+            weight load can exceed the lease TTL) and re-attach the KV
+            event hooks. The EngineRef rebinding is the agent's job."""
+            nonlocal engine, core
+            from ..engine.engine import JaxEngine
+
+            old = engine_ref.engine
+
+            def _build():
+                try:
+                    old.shutdown()
+                except Exception:  # noqa: BLE001 - the reload must
+                    log.exception("engine shutdown during reload")
+                return JaxEngine(new_cfg)
+
+            new_engine = await asyncio.get_running_loop(
+                ).run_in_executor(None, _build)
+            engine = new_engine
+            core = new_engine.core
+            core.pool.on_block_sealed = pub.block_stored
+            core.pool.on_blocks_removed = pub.blocks_removed
+            return new_engine
+
+        mobility = await MobilityAgent(
+            drt, args.namespace, args.component, engine_ref,
+            reregister=_reregister, cold_reload=_cold_reload,
+            model_name=args.model_name or "").start()
+
     async def metrics_loop():
-        key = metrics_key(args.namespace, args.component, drt.worker_id)
         while True:
+            # recomputed per beat: a model swap moves this worker to a
+            # new component + lease mid-life
+            key = metrics_key(args.namespace, args.component,
+                              drt.worker_id)
             if core is not None:
                 m = ForwardPassMetrics(**core.utilization())
             else:
@@ -457,6 +539,8 @@ async def run_worker(args, *, ready_event: Optional[asyncio.Event] = None,
                 await cluster.stop()   # cancel publisher, drop registry key
             except Exception:
                 log.warning("kv-cluster detach failed", exc_info=True)
+        if mobility is not None:
+            mobility.cache.close()     # drop pinned host weight trees
         if core is not None:
             try:
                 engine.shutdown()   # joins the engine thread, clears gauges

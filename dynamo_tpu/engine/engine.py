@@ -2420,6 +2420,18 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
                     except Exception as e:
                         log.exception("prefill_extract failed")
                         loop.call_soon_threadsafe(_set_exception, fut, e)
+                elif kind == "swap":
+                    # model-mobility hot-swap: runs on the engine thread
+                    # (single-threaded core contract) post-drain; typed
+                    # SwapError propagates to the agent's fallback path
+                    host_params, new_cfg, loop, fut = payload
+                    from ..fleet.mobility.swap import hot_swap
+                    try:
+                        res = hot_swap(self.core, host_params, new_cfg)
+                        loop.call_soon_threadsafe(_set_result, fut, res)
+                    except Exception as e:
+                        log.exception("weight hot-swap failed")
+                        loop.call_soon_threadsafe(_set_exception, fut, e)
             if not self.core.has_work:
                 # idle: keep the windowed goodput gauges honest (they
                 # decay to 0 as the last burst ages out of the window)
@@ -2529,6 +2541,18 @@ class JaxEngine(AsyncEngine[BackendInput, EngineOutput]):
         fut: asyncio.Future = loop.create_future()
         self._inbox.put(("prefill_extract", context.id,
                          (request, loop, fut)))
+        self._wake.set()
+        return await fut
+
+    async def swap_weights(self, host_params, new_cfg):
+        """Model-mobility hot-swap: post the in-place weight overwrite to
+        the engine thread and await its :class:`~dynamo_tpu.fleet.
+        mobility.swap.SwapOutcome`. The caller must have drained first
+        (``has_work`` False); a typed ``SwapError`` propagates here when
+        the sibling's shape signature does not match."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._inbox.put(("swap", "", (host_params, new_cfg, loop, fut)))
         self._wake.set()
         return await fut
 

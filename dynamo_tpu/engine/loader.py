@@ -42,8 +42,11 @@ def _get(tensors: Dict[str, Any], name: str) -> np.ndarray:
     return t
 
 
-def load_llama_params(path: str, cfg: LlamaConfig,
-                      shardings: Dict[str, Any]) -> Dict[str, Any]:
+def load_llama_params_host(path: str, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Build the stacked host-numpy param tree from a safetensors dir
+    WITHOUT any device placement — the weight-mobility cache pins these
+    trees in host RAM so a later hot-swap pays only the h2d, and
+    :func:`load_llama_params` device_puts the same tree at cold load."""
     tensors = _open_all(path)
     L, D, Hq, Hkv, Dh = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
                          cfg.num_kv_heads, cfg.head_dim)
@@ -124,7 +127,12 @@ def load_llama_params(path: str, cfg: LlamaConfig,
                          pfx.rsplit("model.", 1)[0] + "lm_head.weight")
              if k in tensors), f"{pfx}lm_head.weight")
         params["lm_head"] = _get(tensors, head).astype(dt).T
+    return params
 
+
+def load_llama_params(path: str, cfg: LlamaConfig,
+                      shardings: Dict[str, Any]) -> Dict[str, Any]:
+    params = load_llama_params_host(path, cfg)
     from .engine import global_put
 
     return jax.tree.map(lambda a, s: global_put(a, s), params, shardings)

@@ -60,6 +60,10 @@ class PoolClaim:
     min_replicas: int
     priority: int = 0
     burn: float = 0.0         # worst SLO burn (0 = within budget)
+    #: model-mobility swap class ("" = not swap-capable): a victim whose
+    #: swap_group matches the beneficiary's hands its chips over by
+    #: in-place weight swap — seconds instead of drain + cold spawn
+    swap_group: str = ""
 
     @property
     def rank(self) -> Tuple:
@@ -143,11 +147,21 @@ class ChipArbiter:
             snapshot, left0 = dict(granted), left
             drained: List[PoolClaim] = []
             while left < hot.chips_per_replica:
+                # eligible victims, coldest first; among them, a hot-swap
+                # sibling (same non-empty swap_group) is preferred — its
+                # chips hand over by in-place weight swap, which costs
+                # seconds, while any other victim costs drain + cold
+                # spawn. Entitlement order is preserved WITHIN each class
+                # so the preference never drains a hotter sibling when an
+                # equally-preemptible colder one exists.
+                eligible = [v for v in reversed(paying)
+                            if v.model != hot.model
+                            and granted[v.model] > v.min_replicas
+                            and self._outranks(hot, v)]
                 victim = next(
-                    (v for v in reversed(paying)    # coldest first
-                     if v.model != hot.model
-                     and granted[v.model] > v.min_replicas
-                     and self._outranks(hot, v)), None)
+                    (v for v in eligible
+                     if v.swap_group and v.swap_group == hot.swap_group),
+                    eligible[0] if eligible else None)
                 if victim is None:
                     break
                 granted[victim.model] -= 1

@@ -28,12 +28,15 @@ PRESERVE's overlap argument applied to scale-to-zero.
 
 from __future__ import annotations
 
+import json
 import logging
+import time
 from typing import Dict, List, Optional
 
 from ..planner.policy import HOLD, SCALE_DOWN, SCALE_UP, Decision
 from ..planner.signals import PoolSignals
 from .arbiter import SUPPRESSED_CHIP_BUDGET, ChipArbiter, PoolClaim
+from .mobility.keys import mobility_prefetch_key, mobility_wake_prefix
 from .registry import (
     STATE_BOOTING,
     STATE_DRAINING,
@@ -60,6 +63,9 @@ class FleetPlane:
         self.arbiter = arbiter or ChipArbiter(total_chips)
         self.worker_env = dict(worker_env or {})
         self._last_targets: Dict[str, int] = {}
+        # per-component prefetch hints last written (change-gated so the
+        # store sees one write per hint change, not one per tick)
+        self._last_hints: Dict[str, str] = {}
 
     async def start(self) -> "FleetPlane":
         await self.registry.start()
@@ -115,6 +121,41 @@ class FleetPlane:
         if set_pool is not None:
             for name, s in specs.items():
                 set_pool(name, self.pool_spec(s))
+        await self.publish_prefetch_hints()
+
+    async def publish_prefetch_hints(self) -> None:
+        """Per-component weight-prefetch hints under ``mobility/``: each
+        model's workers stage (a) every sibling sharing its non-empty
+        ``swap_group`` — the models a preemption could swap in — and
+        (b) every ``prewarm`` model (``ctl fleet add --prewarm``).
+        Change-gated: the hint key is written only when its content
+        moves, and deleted when a component's hint set empties or the
+        model leaves the registry."""
+        specs = self.registry.snapshot()
+        live: Dict[str, str] = {}
+        for name, s in specs.items():
+            hints = []
+            for other, o in sorted(specs.items()):
+                if other == name or not o.model_path:
+                    continue
+                if ((s.swap_group and o.swap_group == s.swap_group)
+                        or o.prewarm):
+                    hints.append({"model": other,
+                                  "model_path": o.model_path})
+            key = mobility_prefetch_key(self.namespace, s.component)
+            live[key] = json.dumps({"models": hints}) if hints else ""
+        stale = [k for k in self._last_hints if k not in live]
+        for key, blob in live.items():
+            if self._last_hints.get(key) == blob:
+                continue
+            if blob:
+                await self.store.put(key, blob.encode())
+            else:
+                await self.store.delete(key)
+            self._last_hints[key] = blob
+        for key in stale:
+            if self._last_hints.pop(key, ""):
+                await self.store.delete(key)
 
     # ------------------------------------------------------------------
     def arbitrate(self, decisions: List[Decision],
@@ -132,7 +173,8 @@ class FleetPlane:
                 model=d.pool, want=d.target, current=d.current,
                 chips_per_replica=s.chips_per_replica,
                 min_replicas=s.min_replicas, priority=s.priority,
-                burn=sig.slo_pressure if sig is not None else 0.0))
+                burn=sig.slo_pressure if sig is not None else 0.0,
+                swap_group=s.swap_group))
         if not claims:
             return decisions
         grants = self.arbiter.grant(claims)
@@ -155,6 +197,67 @@ class FleetPlane:
         return decisions
 
     # ------------------------------------------------------------------
+    async def actuate_swaps(self, decisions: List[Decision],
+                            connector) -> None:
+        """Convert same-``swap_group`` chip handoffs into in-place weight
+        swaps. Runs between :meth:`arbitrate` and actuation: when the
+        arbitrated plan scales model B up while scaling its hot-swap
+        sibling A down, one of A's workers is told (via the connector's
+        ``swap_pool``) to overwrite its weights in place and re-register
+        as B — seconds instead of drain + cold spawn. The paired
+        decisions are annotated (``swap_in``/``swap_out``) so the
+        connector neither spawns a worker the swap already provides nor
+        SIGTERMs the worker that is leaving by swap. At most one swap per
+        donor component per tick (the command key holds a single
+        claim-by-delete record); the planner reconverges across ticks.
+        Connectors without ``swap_pool`` (Kube, Null) fall back to the
+        plain spawn/drain path untouched."""
+        swap_pool = getattr(connector, "swap_pool", None)
+        if swap_pool is None:
+            return
+        specs = self.registry.snapshot()
+
+        def _swappable(d, action):
+            s = specs.get(d.pool)
+            return (s is not None and s.swap_group
+                    and d.action == action and not d.dry_run)
+
+        ups = [d for d in decisions if _swappable(d, SCALE_UP)]
+        downs = [d for d in decisions if _swappable(d, SCALE_DOWN)]
+        for up in ups:
+            s_up = specs[up.pool]
+            if not s_up.model_path:
+                continue        # nothing to stage — echo/test pools
+            for down in downs:
+                s_down = specs[down.pool]
+                if s_down.swap_group != s_up.swap_group:
+                    continue
+                need = (up.target - up.current
+                        - getattr(up, "swap_in", 0))
+                give = (down.current - down.target
+                        - getattr(down, "swap_out", 0))
+                if need <= 0 or give <= 0:
+                    continue
+                payload = {
+                    "model": s_up.name,
+                    "component": s_up.component,
+                    "model_path": s_up.model_path,
+                    "from": down.pool,
+                    "issued_at": time.time(),
+                }
+                issued = await swap_pool(
+                    self.store, self.namespace, down.pool,
+                    s_down.component, payload)
+                if not issued:
+                    continue
+                up.swap_in = getattr(up, "swap_in", 0) + issued
+                down.swap_out = getattr(down, "swap_out", 0) + issued
+                note = (f"swap {down.pool}->{up.pool} "
+                        f"(group {s_up.swap_group})")
+                up.reason = f"{up.reason}; {note}" if up.reason else note
+                log.info("fleet mobility: %s", note)
+
+    # ------------------------------------------------------------------
     @staticmethod
     def model_state(replicas: int, target: int) -> str:
         if target > replicas:
@@ -169,6 +272,13 @@ class FleetPlane:
         for d in decisions:
             if d.pool in self.registry.models:
                 self._last_targets[d.pool] = d.target
+        wakes: Dict[str, Dict] = {}
+        for key, value in await drt.store.get_prefix(
+                mobility_wake_prefix(self.namespace)):
+            try:
+                wakes[key.rsplit("/", 1)[1]] = json.loads(value.decode())
+            except (ValueError, json.JSONDecodeError):
+                log.warning("skipping malformed wake record %s", key)
         for name, spec in self.registry.snapshot().items():
             sig = signals.get(name)
             replicas = sig.replicas if sig is not None else 0
@@ -184,5 +294,12 @@ class FleetPlane:
                 "burn": round(sig.slo_pressure, 3) if sig else 0.0,
                 "unserved": sig.unserved if sig else 0.0,
             }
+            wake = wakes.get(name)
+            if wake:
+                # last observed wake (published by the worker's mobility
+                # agent): how this model most recently came up —
+                # "swap" (in-place weight swap) or "cold" (full boot)
+                status["wake_path"] = wake.get("path")
+                status["wake_seconds"] = wake.get("seconds")
             await publish_fleet_status(drt.store, self.namespace, name,
                                        status, lease=drt.lease)

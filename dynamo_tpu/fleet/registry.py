@@ -92,6 +92,14 @@ class FleetModelSpec:
     card: Optional[Dict[str, Any]] = None
     #: extra worker argv (planner LocalConnector pass-through)
     extra_args: List[str] = field(default_factory=list)
+    #: model-mobility swap class: models sharing a non-empty swap_group
+    #: are hot-swap siblings — a preemption can hand a victim's chips to
+    #: the beneficiary by in-place weight swap instead of spawn + drain,
+    #: and workers prefetch siblings' weights into the host cache
+    swap_group: str = ""
+    #: prewarm hint (``ctl fleet add --prewarm``): every worker in the
+    #: namespace stages this model's weights even across swap groups
+    prewarm: bool = False
 
     def __post_init__(self) -> None:
         # the name is a store-key path segment, a metric label, a pool
@@ -127,6 +135,8 @@ class FleetModelSpec:
             "tenants": {t: q.to_dict() for t, q in self.tenants.items()},
             "card": self.card,
             "extra_args": list(self.extra_args),
+            "swap_group": self.swap_group,
+            "prewarm": self.prewarm,
         }
 
     @classmethod

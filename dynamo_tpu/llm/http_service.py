@@ -294,8 +294,12 @@ class HttpService:
                         "id": name, "object": "model", "created": now,
                         "owned_by": "dynamo_tpu"})
                     row["state"] = st.get("state", "unknown")
+                    # wake_path/wake_seconds: how this model last came
+                    # up — "swap" (in-place weight swap, seconds-scale)
+                    # or "cold" (full boot) — and what it cost
                     for fld in ("replicas", "target", "component",
-                                "chips", "priority"):
+                                "chips", "priority", "wake_path",
+                                "wake_seconds"):
                         if st.get(fld) is not None:
                             row[fld] = st[fld]
             except Exception:

@@ -373,6 +373,21 @@ class TieredKvCache:
                 "misses": self.misses,
             }
 
+    def clear(self) -> None:
+        """Drop every resident block (host and disk) and all pins. The
+        model-swap cutover calls this: block hashes are content-only
+        (tokens + lora salt, no model identity), so KV computed under the
+        outgoing model would silently alias same-token prefixes of the
+        incoming one if left resident."""
+        with self._lock:
+            for tier in (self.host, self.disk):
+                if tier is None:
+                    continue
+                for h in list(tier._slot_of):
+                    tier.pop(h)
+            self._set_block_gauges()
+        self._fire_change()
+
     def close(self) -> None:
         """Release the disk tier's spill files (engine shutdown)."""
         with self._lock:

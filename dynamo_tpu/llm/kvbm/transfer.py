@@ -43,6 +43,22 @@ class CopyStream:
         self._scatter_blocks = jax.jit(
             lambda pool, pages, vals: pool.at[:, :, pages].set(
                 jnp.moveaxis(vals, 0, 2)), donate_argnums=0)
+        # weight-mobility h2d: overwrite a contiguous layer-group slab of a
+        # stacked [L, ...] param leaf in place (donated — the swap reuses
+        # the engine's existing device buffers instead of doubling HBM).
+        # One program per (leaf shape, group size); NOT routed through
+        # instrument_compile on purpose: swap-path helper compiles must not
+        # perturb the dyn_compiled_programs flatness contract.
+        self._scatter_slab = jax.jit(
+            lambda buf, start, vals: jax.lax.dynamic_update_slice(
+                buf, vals, (start,) + (0,) * (vals.ndim - 1)),
+            donate_argnums=0)
+
+    def h2d_param_slab(self, buf, start: int, vals):
+        """Scatter an already-on-device layer-group chunk ``vals``
+        ([G, ...]) into the stacked param leaf ``buf`` ([L, ...]) at layer
+        ``start``, donating the old buffer. Returns the new leaf."""
+        return self._scatter_slab(buf, np.int32(start), vals)
 
     # ------------------------------------------------------------------
     def d2h_pages(self, k_pool, v_pool, pages: Sequence[int],

@@ -99,6 +99,14 @@ class LocalConnector:
         # a stale external estimate can wedge scale-up (set >= worst-case
         # worker bring-up; engine weight loads can take minutes)
         self.boot_grace = boot_grace
+        # model-mobility swap-wakes in flight per BENEFICIARY pool
+        # (monotonic issue times). A swap-wake is incoming capacity — the
+        # spawn loop must not double-provision it — but it is NOT a
+        # process boot: the worker already exists with an old started_at,
+        # so routing it through the pending-boot arithmetic (which gates
+        # on process age) would either miscount it or wedge. Tracked
+        # separately and pruned by the same boot_grace age cap.
+        self._swapping: Dict[str, List[float]] = {}
 
     # ------------------------------------------------------------------
     def _default_argv(self, pool: str, spec: PoolSpec) -> List[str]:
@@ -181,6 +189,60 @@ class LocalConnector:
         self._reapers.append(asyncio.create_task(reap()))
 
     # ------------------------------------------------------------------
+    # model mobility: in-place weight swap instead of spawn + drain
+    async def swap_pool(self, store, namespace: str, from_pool: str,
+                        from_component: str, payload: Dict) -> int:
+        """Issue one SIGUSR1-style swap command: a worker of
+        ``from_component`` should overwrite its weights in place with
+        ``payload["model"]``'s and re-register under that model's
+        component. ``store`` is the fleet plane's async store client
+        (this connector's own ``self.store`` is just an address string).
+        The command key holds a single claim-by-delete record, so at
+        most one swap per donor component is in flight at a time — a
+        still-pending command from an earlier tick is left alone and 0
+        is returned (the plane falls back to plain spawn/drain for the
+        remainder). Returns the number of swaps issued (0 or 1)."""
+        import json as _json
+
+        from ..fleet.mobility.keys import mobility_swap_key
+        key = mobility_swap_key(namespace, from_component)
+        if await store.get(key):
+            return 0
+        await store.put(key, _json.dumps(payload).encode())
+        self.note_swap(from_pool, payload["model"])
+        return 1
+
+    def note_swap(self, from_pool: str, to_pool: str) -> None:
+        """Accounting for one issued swap: move the donor pool's oldest
+        owned process record to the beneficiary (the process keeps
+        running and will serve the new component — draining
+        ``from_pool`` later must not SIGTERM a worker that left it, and
+        its chip allocation now belongs to ``to_pool``), and mark the
+        wake in flight so ``apply`` neither spawns over it nor counts it
+        as a pending process boot."""
+        alive = self.live_owned(from_pool)
+        if alive:
+            moved = min(alive, key=lambda o: o.started_at)
+            self.owned[from_pool].remove(moved)
+            self.owned.setdefault(to_pool, []).append(moved)
+        # else: an externally started worker swaps away; from_pool's
+        # registered count drops on its own and apply's external
+        # estimate revises itself down (ext = min(ext, current))
+        self._swapping.setdefault(to_pool, []).append(time.monotonic())
+
+    def _live_swaps(self, pool: str) -> int:
+        """Swap-wakes still plausibly in flight for ``pool`` (age-capped
+        by boot_grace so a failed swap cannot suppress spawns forever)."""
+        now = time.monotonic()
+        keep = [t for t in self._swapping.get(pool, ())
+                if now - t < self.boot_grace]
+        if keep:
+            self._swapping[pool] = keep
+        else:
+            self._swapping.pop(pool, None)
+        return len(keep)
+
+    # ------------------------------------------------------------------
     async def apply(self, pool: str, target: int, decision) -> None:
         spec = self.pools.get(pool)
         if spec is None:
@@ -188,6 +250,10 @@ class LocalConnector:
             return
         current = decision.current
         alive = self.live_owned(pool)
+        if current >= target:
+            # capacity arrived (a swap landed and re-registered, or a
+            # plain boot finished): in-flight wake markers are spent
+            self._swapping.pop(pool, None)
         if target > current:
             # pending = owned processes alive but not yet registered (still
             # booting). Spawning target-current every tick would overshoot
@@ -204,10 +270,17 @@ class LocalConnector:
             # an owned worker was registered — the estimate can't tell
             # those apart and would otherwise wedge scale-up forever)
             now = time.monotonic()
+            # swap-wakes are counted OUTSIDE the boot arithmetic: the
+            # swapping worker is an old process (never "young") whose
+            # registration is still under its old pool, so without the
+            # separate ledger the spawn loop would double-provision
+            # every swap with a cold boot
+            swapping = self._live_swaps(pool)
             young = sum(1 for o in alive
                         if now - o.started_at < self.boot_grace)
-            pending = min(max(len(alive) - owned_registered, 0), young)
-            for _ in range(target - current - pending):
+            pending = min(
+                max(len(alive) - swapping - owned_registered, 0), young)
+            for _ in range(target - current - pending - swapping):
                 try:
                     self._spawn(pool, spec)
                 except AllocationError:
@@ -215,10 +288,14 @@ class LocalConnector:
                                 # naturally on the next evaluation
         elif target < current:
             # newest-first: baseline (externally started / oldest) workers
-            # are the last to go, and never workers we don't own
-            shrink = min(current - target, len(alive))
+            # are the last to go, and never workers we don't own.
+            # Replicas leaving by swap (note_swap already moved their
+            # ownership to the beneficiary) are part of the shrink — do
+            # not SIGTERM extra workers to cover them.
+            swap_out = getattr(decision, "swap_out", 0)
+            shrink = min(max(current - target - swap_out, 0), len(alive))
             victims = sorted(alive, key=lambda o: -o.started_at)[:shrink]
-            if shrink < current - target:
+            if shrink < current - target - swap_out:
                 log.info("planner: %s scale-down to %d limited to %d owned "
                          "worker(s); externally started workers are not "
                          "drainable from here", pool, target, shrink)

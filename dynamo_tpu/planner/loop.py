@@ -238,6 +238,12 @@ class Planner:
             decisions = self.core.evaluate(signals, now)
             if self.fleet is not None:
                 decisions = self.fleet.arbitrate(decisions, signals)
+                if not self.config.dry_run:
+                    # same-swap-group chip handoffs become in-place
+                    # weight swaps (decision pairs annotated so the
+                    # connector's spawn/drain arithmetic skips them)
+                    await self.fleet.actuate_swaps(decisions,
+                                                   self.connector)
             # scale-ups actuate BEFORE scale-downs: a booting worker's
             # weight load overlaps the donor pool's drain, so a chip
             # handoff between models costs one boot, not boot + drain in

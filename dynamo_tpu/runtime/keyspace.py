@@ -151,6 +151,22 @@ _ALL: List[KeyFamily] = [
         helpers=("fleet_status_key", "fleet_status_prefix"),
         constants=("FLEET_STATUS_PREFIX",)),
     KeyFamily(
+        name="mobility",
+        pattern="mobility/{ns}/(prefetch|swap)/{component}"
+                " | mobility/{ns}/wake/{model}",
+        owner="fleet/mobility/keys.py", lifecycle=PERSISTENT,
+        description="model-mobility control plane: per-component weight "
+                    "prefetch hints (arbiter swap-group siblings + `ctl "
+                    "fleet add --prewarm`), SIGUSR1-style swap commands "
+                    "one worker of the component claims-by-delete, and "
+                    "per-model last-wake records (path swap|cold, "
+                    "seconds) read by /v1/models, dyntop and the soak "
+                    "wake lane",
+        prefix="mobility/",
+        helpers=("mobility_prefetch_key", "mobility_prefix",
+                 "mobility_swap_key", "mobility_wake_key",
+                 "mobility_wake_prefix")),
+    KeyFamily(
         name="faults",
         pattern="faults/{point}",
         owner="utils/faults.py", lifecycle=PERSISTENT,

@@ -554,6 +554,10 @@ class StoreClient:
                     recoverable = (self.reconnect.enabled
                                    and not self._closing)
                     if e.code == "lease_not_found":
+                        if lease not in self._session_leases:
+                            # deliberately revoked between beats (drain /
+                            # swap identity handoff) — not a loss
+                            return
                         if recoverable and not self._connected.is_set():
                             # replay in flight: the re-grant hasn't landed
                             if not await self._await_session(lease):
@@ -586,6 +590,16 @@ class StoreClient:
 
     async def lease_revoke(self, lease: int) -> None:
         self._session_leases.pop(lease, None)
+        # a deliberate revoke must also stop the lease's keepalive loop:
+        # an orphaned beat would see lease_not_found on a healthy
+        # connection and fire on_lease_lost — fatal to a process that
+        # revoked one identity to adopt another (model-mobility swap)
+        for t in self._keepalive_tasks:
+            if t.get_name() == f"lease-{lease}":
+                t.cancel()
+        self._keepalive_tasks = [t for t in self._keepalive_tasks
+                                 if not t.done()
+                                 and t.get_name() != f"lease-{lease}"]
         for key in [k for k, (_, lse) in self._lease_puts.items()
                     if lse == lease]:
             self._lease_puts.pop(key, None)

@@ -187,6 +187,17 @@ _ALL: List[Knob] = [
        "SLO-burn advantage a model needs before the chip arbiter "
        "preempts another model's live replicas (hysteresis against "
        "replica thrash; higher priority classes preempt regardless)"),
+    _k("DYN_WEIGHT_CACHE_BYTES", "int", str(32 << 30), "multi_model",
+       "per-worker pinned host-RAM weight cache budget (model "
+       "mobility): sibling checkpoints prefetch here while the "
+       "incumbent serves, so a hot-swap pays only the h2d stream"),
+    _k("DYN_SWAP_GROUP_LAYERS", "int", "4", "multi_model",
+       "layers per h2d group during a weight hot-swap (each group is "
+       "one donated in-place slab scatter on the engine's existing "
+       "device buffers)"),
+    _k("DYN_SWAP_DRAIN_TIMEOUT", "float", "120", "multi_model",
+       "seconds a swap command waits for in-flight streams to drain "
+       "before falling back to a counted full reload (never a hang)"),
     # -------------------------------------------------------------- faults
     _k("DYN_FAULTS", "csv", "", "faults",
        "fault-injection table armed at process start, "

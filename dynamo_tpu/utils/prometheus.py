@@ -465,6 +465,23 @@ class StageMetrics:
         self.compiled_programs = r.counter(
             "dyn_compiled_programs",
             "Bucket programs compiled", ("kind",))   # prefill|decode|verify|draft
+        # model-mobility plane (fleet/mobility/): weight prefetch + hot
+        # swap — a swap that recompiles or silently reloads cold defeats
+        # the seconds-scale wake contract, so both are first-class series
+        self.weight_cache_bytes = r.gauge(
+            "dyn_weight_cache_bytes",
+            "Host-RAM weight-cache residency by pin state "
+            "(LRU budget: DYN_WEIGHT_CACHE_BYTES)", ("state",))
+        self.model_swaps = r.counter(
+            "dyn_model_swaps_total",
+            "Model swap attempts by outcome (swap = in-place, reload = "
+            "typed full-reload fallback)",
+            ("outcome",))   # swap|reload|shape_mismatch|error
+        self.model_wake_seconds = r.histogram(
+            "dyn_model_wake_seconds",
+            "Model wake latency from swap command (or spawn) to serving "
+            "registration, by wake path", ("path",),   # swap|cold
+            buckets=(0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 45.0, 90.0, 180.0))
         # SLO burn rates (utils/slo.py): whoever runs an SloMonitor in this
         # process exports through here and the stage-metrics merge path
         self.slo_burn = r.gauge(

@@ -815,6 +815,108 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
         procs.stop()
 
 
+# ---------------------------------------------------------------------------
+# wake lane: model-mobility swap wake vs cold boot (fleet/mobility/)
+# ---------------------------------------------------------------------------
+def run_wake_lane(a) -> Dict[str, Any]:
+    """Measure the two model-wake paths on a real (tiny, CPU) engine:
+
+    - **cold**: EngineCore construction + safetensors weight load + the
+      first compiled token — what a spawn-from-zero wake costs;
+    - **swap**: in-place ``hot_swap`` from a warm host
+      :class:`WeightCache` + the first token through the REUSED compiled
+      programs — what the mobility plane's wake costs.
+
+    Verdicts: swap p50 must beat cold p50 by >= 3x (the PR's acceptance
+    floor; on real fleets the gap is larger — cold adds process boot and
+    checkpoint download on top) and the compiled-program caches must stay
+    flat across every swap (a recompiling swap is a cold boot in
+    disguise). Artifact: ``bench_points/model_wake.json``.
+    """
+    import tempfile as _tempfile
+
+    import jax
+
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+    from dynamo_tpu.engine.loader import (load_llama_params_host,
+                                          save_llama_params)
+    from dynamo_tpu.fleet.mobility import WeightCache, hot_swap
+    from dynamo_tpu.llm.protocols.common import (BackendInput,
+                                                 StopConditions)
+    from dynamo_tpu.models import llama
+
+    def cfg(path):
+        return JaxEngineConfig(
+            model=llama.preset("tiny-byte", tie_embeddings=False),
+            tp=1, page_size=8, max_batch=4, max_context=128,
+            prefill_chunk=32, params_path=path)
+
+    def first_token(core, seq):
+        core.submit(seq, BackendInput(
+            token_ids=[5, 6, 7, 8], stop=StopConditions(max_tokens=1)))
+        for _ in range(500):
+            for so in core.step():
+                if so.finish is not None:
+                    return so.token
+        raise RuntimeError("engine produced no token")
+
+    ckpt_dir = _tempfile.mkdtemp(prefix="wake_lane_")
+    mcfg = llama.preset("tiny-byte", tie_embeddings=False)
+    paths = []
+    for i, seed in enumerate((3, 7)):
+        p = os.path.join(ckpt_dir, f"ckpt{i}")
+        save_llama_params(p, llama.init_params(mcfg, jax.random.PRNGKey(seed)),
+                          mcfg)
+        paths.append(p)
+
+    # ---- cold lane: ctor + weight load + first compiled token --------
+    cold: List[float] = []
+    for i in range(a.wake_reps):
+        t0 = time.monotonic()
+        core = EngineCore(cfg(paths[i % 2]))
+        first_token(core, f"cold{i}")
+        cold.append(time.monotonic() - t0)
+        del core
+
+    # ---- swap lane: warm cache, in-place swap, first token -----------
+    cache = WeightCache(capacity_bytes=1 << 30)
+    for p in paths:
+        cache.put(p, load_llama_params_host(p, mcfg))
+    core = EngineCore(cfg(paths[0]))
+    first_token(core, "warm")            # incumbent serving, compiles warm
+    programs = (len(core._decode_fns), len(core._prefill_batch_fns),
+                len(core._verify_fns))
+    swap: List[float] = []
+    for i in range(a.wake_reps):
+        target = paths[(i + 1) % 2]      # alternate between the siblings
+        t0 = time.monotonic()
+        hot_swap(core, cache.get(target), cfg(target))
+        first_token(core, f"swap{i}")
+        swap.append(time.monotonic() - t0)
+    programs_after = (len(core._decode_fns), len(core._prefill_batch_fns),
+                      len(core._verify_fns))
+    cache.close()
+
+    cold_p50 = _percentile(cold, 0.50) or 0.0
+    swap_p50 = _percentile(swap, 0.50) or 0.0
+    result = {
+        "bench": "model_wake",
+        "reps": a.wake_reps,
+        "cold": {"p50_s": round(cold_p50, 4),
+                 "samples_s": [round(s, 4) for s in cold]},
+        "swap": {"p50_s": round(swap_p50, 4),
+                 "samples_s": [round(s, 4) for s in swap]},
+        "speedup": round(cold_p50 / swap_p50, 2) if swap_p50 else None,
+        "compiled_programs": {"before": list(programs),
+                              "after": list(programs_after)},
+        "verdicts": {
+            "swap_3x_faster": swap_p50 * 3.0 <= cold_p50,
+            "programs_flat": programs_after == programs,
+        },
+    }
+    return result
+
+
 def main(argv=None) -> int:
     from dynamo_tpu.utils.dynconfig import EnvDefaultsParser
 
@@ -844,6 +946,12 @@ def main(argv=None) -> int:
                     help="dynstore processes (2 = telemetry shard, "
                          "3 = + traces shard; DYN_STORE_SHARDS armed "
                          "fleet-wide)")
+    ap.add_argument("--wake-lane", action="store_true",
+                    help="run the model-mobility wake bench instead of "
+                         "the ramp: in-place swap wake vs cold engine "
+                         "boot -> bench_points/model_wake.json")
+    ap.add_argument("--wake-reps", type=int, default=3,
+                    help="wake-lane repetitions per path")
     ap.add_argument("--out", default=os.path.join(
         REPO, "bench_points", "fleet_soak.json"))
     # internal probe-mode flags (the driver spawns itself with these)
@@ -858,6 +966,25 @@ def main(argv=None) -> int:
             asyncio.run(run_observer_probe(a.store, a.probe_out))
         except KeyboardInterrupt:
             pass
+        return 0
+    if a.wake_lane:
+        if a.out == os.path.join(REPO, "bench_points", "fleet_soak.json"):
+            a.out = os.path.join(REPO, "bench_points", "model_wake.json")
+        result = run_wake_lane(a)
+        os.makedirs(os.path.dirname(a.out), exist_ok=True)
+        with open(a.out, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+        print(json.dumps({"cold_p50_s": result["cold"]["p50_s"],
+                          "swap_p50_s": result["swap"]["p50_s"],
+                          "speedup": result["speedup"],
+                          "verdicts": result["verdicts"]},
+                         indent=2, sort_keys=True), flush=True)
+        print(f"artifact: {a.out}", flush=True)
+        failed = [k for k, ok in result["verdicts"].items() if not ok]
+        if failed:
+            print(f"FAIL: {failed}", flush=True)
+            return 1
+        print("PASS: swap wake beats cold boot, programs flat", flush=True)
         return 0
     if a.mode == "hier" and a.out == os.path.join(
             REPO, "bench_points", "fleet_soak.json"):

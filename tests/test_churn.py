@@ -141,6 +141,29 @@ async def test_reconnect_window_exhaustion_fires_lease_lost():
         await c.close()
 
 
+async def test_deliberate_revoke_never_fires_lease_lost():
+    """The model-mobility identity handoff: revoke lease A, grant lease B,
+    keep serving. Lease A's orphaned keepalive beat must not read the
+    revoke as a LOSS and kill the freshly swapped worker (the callback is
+    re-armed by then)."""
+    store = RestartableStore()
+    port = await store.start()
+    c = await StoreClient(port=port, reconnect=FAST).connect()
+    lost = asyncio.Event()
+    try:
+        old = await c.lease_grant(ttl=0.3)    # beats every 0.1s
+        await c.lease_revoke(old)
+        new = await c.lease_grant(ttl=0.3)
+        c.on_lease_lost = lambda lease: lost.set()   # swap re-arms it
+        await asyncio.sleep(1.0)              # several orphaned beats
+        assert not lost.is_set()
+        await c.put("swap/alive", b"x", lease=new)
+        assert await c.get("swap/alive") == b"x"
+    finally:
+        await c.close()
+        await store.stop()
+
+
 async def test_lease_regrant_preserves_id_and_keys():
     store = RestartableStore()
     port = await store.start()

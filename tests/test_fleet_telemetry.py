@@ -300,6 +300,7 @@ def test_classify_key_covers_every_registered_family():
         "fleet-soak": "fleet/fleet/beacon",
         "fleet-models": "fleet_models/dynamo/llama-8b",
         "fleet-status": "fleet_status/dynamo/llama-8b",
+        "mobility": "mobility/dynamo/swap/backend-llama-8b",
         "kv-cluster": "kv_cluster/dynamo/backend/1a2b",
         "regions": "regions/dynamo/1a2b",
     }

@@ -1,0 +1,619 @@
+"""Model mobility plane: weight prefetch + in-place hot-swap.
+
+Covers the PR's contracts end to end:
+
+- :class:`WeightCache` LRU/pin/budget semantics and background prefetch;
+- the shape-signature gate (``swap_signature``) that decides program reuse;
+- hot-swap e2e on a real tiny engine: greedy output token-identical to a
+  cold-booted engine of the target checkpoint AND zero new compiled
+  bucket programs across the swap;
+- drain ordering (a busy core refuses the swap, typed);
+- the typed full-reload fallback in :class:`MobilityAgent`;
+- the arbiter's swap-sibling victim preference;
+- :class:`LocalConnector` swap accounting (swap-wakes are incoming
+  capacity, not process boots; swap-outs shrink without SIGTERM);
+- :class:`FleetPlane` prefetch-hint publication and swap actuation.
+"""
+
+import asyncio
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+from dynamo_tpu.fleet.arbiter import ChipArbiter, PoolClaim
+from dynamo_tpu.fleet.mobility import (
+    EngineRef,
+    MobilityAgent,
+    SwapError,
+    SwapOutcome,
+    WeightCache,
+    hot_swap,
+    mobility_prefetch_key,
+    mobility_swap_key,
+    mobility_wake_key,
+    swap_signature,
+)
+from dynamo_tpu.fleet.plane import FleetPlane
+from dynamo_tpu.fleet.registry import FleetModelSpec
+from dynamo_tpu.llm.protocols.common import BackendInput, StopConditions
+from dynamo_tpu.models import llama
+
+NS = "mobns"
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def _tree(fill: float, mb: int = 1):
+    """A host param tree of ``mb`` MiB."""
+    return {"w": np.full((mb, 256, 1024), fill, np.float32)}
+
+
+MB = 1 << 20
+
+
+class FakeStore:
+    """get/put/delete/get_prefix/watch_prefix — enough for the agent,
+    the plane and the connector's swap command."""
+
+    def __init__(self):
+        self.kv = {}
+        self.puts = []
+        self.deletes = []
+        self._watchers = []
+
+    async def get(self, key):
+        return self.kv.get(key)
+
+    async def put(self, key, value, lease=None):
+        self.kv[key] = value
+        self.puts.append((key, value))
+        for prefix, cb in self._watchers:
+            if key.startswith(prefix):
+                await cb(key, value, False)
+
+    async def delete(self, key):
+        self.deletes.append(key)
+        return self.kv.pop(key, None) is not None
+
+    async def get_prefix(self, prefix):
+        return sorted((k, v) for k, v in self.kv.items()
+                      if k.startswith(prefix))
+
+    async def watch_prefix(self, prefix, cb):
+        self._watchers.append((prefix, cb))
+        return await self.get_prefix(prefix)
+
+
+class FakeDrt:
+    def __init__(self, store):
+        self.store = store
+        self._active = {}
+        self.worker_id = 0xBEEF
+        self.drains = 0
+
+    async def prepare_drain(self):
+        self.drains += 1
+
+
+# ---------------------------------------------------------------------------
+# WeightCache units
+# ---------------------------------------------------------------------------
+def test_cache_lru_eviction_order():
+    c = WeightCache(capacity_bytes=2 * MB,
+                    loader=lambda p, cfg: _tree(0.0))
+    assert c.put("a", _tree(1.0)) and c.put("b", _tree(2.0))
+    assert c.get("a") is not None          # touch: a becomes MRU
+    assert c.put("c", _tree(3.0))          # evicts b (LRU), not a
+    assert "a" in c and "c" in c and "b" not in c
+    assert c.resident_bytes == 2 * MB
+
+
+def test_cache_pin_blocks_eviction_and_oversize_put_drops():
+    c = WeightCache(capacity_bytes=2 * MB)
+    c.put("inc", _tree(1.0))
+    c.pin("inc")
+    # a 2-MiB insert would need the pinned entry's bytes — must drop the
+    # NEW tree, never evict the pinned incumbent
+    assert not c.put("big", _tree(9.0, mb=2))
+    assert "inc" in c and "big" not in c
+    c.unpin("inc")
+    assert c.put("big", _tree(9.0, mb=2))
+    assert "inc" not in c
+
+
+def test_cache_prefetch_background_and_load_now():
+    loads = []
+
+    def loader(path, cfg):
+        loads.append(path)
+        return _tree(4.0)
+
+    c = WeightCache(capacity_bytes=8 * MB, loader=loader)
+    try:
+        assert c.prefetch("ckpt", cfg=None)
+        assert not c.prefetch("ckpt", cfg=None)   # queued: idempotent
+        for _ in range(200):
+            if "ckpt" in c:
+                break
+            import time
+            time.sleep(0.01)
+        assert "ckpt" in c and loads == ["ckpt"]
+        assert not c.prefetch("ckpt", cfg=None)   # resident: idempotent
+        # load_now returns the resident tree without a second load
+        assert c.load_now("ckpt", cfg=None) is not None
+        assert loads == ["ckpt"]
+    finally:
+        c.close()
+    assert c.resident_bytes == 0
+
+
+def test_cache_load_now_failure_is_none_not_raise():
+    def loader(path, cfg):
+        raise FileNotFoundError(path)
+
+    c = WeightCache(capacity_bytes=MB, loader=loader)
+    assert c.load_now("gone", cfg=None) is None
+    assert c.load_errors == 1
+
+
+# ---------------------------------------------------------------------------
+# shape-signature gate
+# ---------------------------------------------------------------------------
+def _cfg(**kw):
+    d = dict(model=llama.preset("tiny-byte", tie_embeddings=False),
+             tp=1, page_size=8, max_batch=4, max_context=128,
+             prefill_chunk=32)
+    d.update(kw)
+    return JaxEngineConfig(**d)
+
+
+def test_swap_signature_ignores_weight_identity():
+    a = _cfg(params_path="/ckpt/a", preset="x", seed=1)
+    b = _cfg(params_path="/ckpt/b", preset="y", seed=2)
+    assert swap_signature(a) == swap_signature(b)
+
+
+def test_swap_signature_covers_model_and_geometry():
+    base = _cfg()
+    other_model = _cfg(
+        model=llama.preset("tiny-byte", tie_embeddings=False,
+                           hidden_size=128))
+    assert swap_signature(base) != swap_signature(other_model)
+    assert swap_signature(base) != swap_signature(_cfg(max_batch=8))
+    assert swap_signature(base) != swap_signature(_cfg(page_size=16))
+
+
+# ---------------------------------------------------------------------------
+# hot-swap e2e: token parity with a cold boot, zero new programs
+# ---------------------------------------------------------------------------
+def _save_ckpt(tmp_path, name, seed):
+    from dynamo_tpu.engine.loader import save_llama_params
+
+    mcfg = llama.preset("tiny-byte", tie_embeddings=False)
+    params = llama.init_params(mcfg, __import__("jax").random.PRNGKey(seed))
+    path = str(tmp_path / name)
+    save_llama_params(path, params, mcfg)
+    return path
+
+
+def _greedy(core, seq, prompt, n=8):
+    core.submit(seq, BackendInput(token_ids=list(prompt),
+                                  stop=StopConditions(max_tokens=n)))
+    toks = []
+    for _ in range(500):
+        for so in core.step():
+            toks.append(so.token)
+            if so.finish is not None:
+                return toks
+    raise AssertionError("did not finish")
+
+
+def _program_counts(core):
+    return (len(core._decode_fns), len(core._prefill_batch_fns),
+            len(core._verify_fns))
+
+
+def test_hot_swap_gates_parity_and_flat_programs(tmp_path):
+    """One engine pair exercises the whole swap contract: the typed
+    refusals (busy core, geometry mismatch, tree mismatch), then the
+    successful in-place swap — token-identical to a cold boot of the
+    target checkpoint, with zero new compiled bucket programs."""
+    path_a = _save_ckpt(tmp_path, "a", seed=3)
+    path_b = _save_ckpt(tmp_path, "b", seed=7)
+    prompt = [5, 6, 7, 8, 9]
+
+    cold_b = EngineCore(_cfg(params_path=path_b))
+    want = _greedy(cold_b, "ref", prompt)
+
+    core = EngineCore(_cfg(params_path=path_a))
+    got_a = _greedy(core, "pre", prompt)
+    assert got_a != want    # different checkpoints actually differ
+
+    # ---- refusals, all typed -----------------------------------------
+    core.submit("busy", BackendInput(token_ids=[1, 2, 3],
+                                     stop=StopConditions(max_tokens=64)))
+    core.step()
+    with pytest.raises(SwapError) as ei:
+        hot_swap(core, {}, _cfg(params_path=path_a))
+    assert ei.value.reason == "not_drained"
+    core.cancel("busy")
+    for _ in range(50):
+        if not core.has_work:
+            break
+        core.step()
+
+    with pytest.raises(SwapError) as ei:
+        hot_swap(core, {}, _cfg(max_batch=8, params_path=path_b))
+    assert ei.value.reason == "shape_mismatch"
+
+    from dynamo_tpu.engine.loader import load_llama_params_host
+
+    host_b = load_llama_params_host(path_b, core.cfg.model)
+    # matching signature but a params tree that differs structurally
+    partial = dict(host_b)
+    partial.pop("lm_head")
+    with pytest.raises(SwapError) as ei:
+        hot_swap(core, partial, _cfg(params_path=path_b))
+    assert ei.value.reason == "shape_mismatch"
+
+    # ---- the swap ----------------------------------------------------
+    before = _program_counts(core)
+    # group_layers=1 forces the layer-group slab path (tiny-byte L=2)
+    out = hot_swap(core, host_b, _cfg(params_path=path_b), group_layers=1)
+    assert out.path == "swap" and out.groups > 0
+    assert core.cfg.params_path == path_b
+    # the compiled bucket programs were REUSED — the wake contract
+    assert _program_counts(core) == before
+    assert _greedy(core, "post", prompt) == want
+    assert _program_counts(core) == before
+
+
+# ---------------------------------------------------------------------------
+# MobilityAgent: claim, drain ordering, typed fallback, wake record
+# ---------------------------------------------------------------------------
+class StubCfg:
+    model = None
+
+    def __init__(self, path):
+        self.params_path = path
+
+
+class StubEngine:
+    core = None
+
+    def __init__(self, fail_reason=None):
+        self.fail_reason = fail_reason
+        self.swapped = []
+
+    async def swap_weights(self, host, new_cfg):
+        if self.fail_reason:
+            raise SwapError(self.fail_reason, "stub")
+        self.swapped.append(new_cfg.params_path)
+        return SwapOutcome("swap", 0.01, new_cfg.params_path)
+
+
+def _agent(store, engine, **kw):
+    drt = FakeDrt(store)
+    events = {"reregister": [], "reload": []}
+
+    async def reregister(payload):
+        events["reregister"].append(payload)
+
+    async def cold_reload(new_cfg):
+        events["reload"].append(new_cfg.params_path)
+        return StubEngine()
+
+    cache = WeightCache(capacity_bytes=8 * MB,
+                        loader=lambda p, cfg: _tree(1.0))
+    agent = MobilityAgent(
+        drt, NS, "backend-a", EngineRef(engine),
+        reregister=reregister,
+        cold_reload=kw.pop("cold_reload", cold_reload),
+        cache=cache, model_name="a",
+        cfg_builder=lambda model, path: StubCfg(path))
+    return agent, drt, events
+
+
+async def test_agent_swap_command_end_to_end():
+    store = FakeStore()
+    engine = StubEngine()
+    agent, drt, events = _agent(store, engine)
+    await agent.start()
+
+    payload = {"model": "b", "component": "backend-b",
+               "model_path": "/ckpt/b", "from": "a"}
+    await store.put(mobility_swap_key(NS, "backend-a"),
+                    json.dumps(payload).encode())
+    await asyncio.gather(*agent._tasks)
+
+    assert drt.drains == 1                      # drained before the swap
+    assert engine.swapped == ["/ckpt/b"]
+    assert events["reregister"] == [payload]
+    assert events["reload"] == []
+    # claim-by-delete: the command key is gone
+    assert mobility_swap_key(NS, "backend-a") in store.deletes
+    # the agent followed its new identity
+    assert agent.component == "backend-b" and agent.model_name == "b"
+    wake = json.loads(store.kv[mobility_wake_key(NS, "b")])
+    assert wake["path"] == "swap" and wake["seconds"] >= 0
+    agent.cache.close()
+
+
+async def test_agent_typed_fallback_reloads_cold():
+    store = FakeStore()
+    agent, drt, events = _agent(store, StubEngine("shape_mismatch"))
+    await agent.start()
+    await store.put(
+        mobility_swap_key(NS, "backend-a"),
+        json.dumps({"model": "b", "component": "backend-b",
+                    "model_path": "/ckpt/b"}).encode())
+    await asyncio.gather(*agent._tasks)
+
+    assert events["reload"] == ["/ckpt/b"]      # counted full reload
+    assert events["reregister"]                 # wake still completes
+    assert isinstance(agent.engine_ref.engine, StubEngine)
+    wake = json.loads(store.kv[mobility_wake_key(NS, "b")])
+    assert wake["path"] == "cold"
+    agent.cache.close()
+
+
+async def test_agent_no_cold_reload_keeps_identity():
+    store = FakeStore()
+    agent, drt, events = _agent(store, StubEngine("shape_mismatch"),
+                                cold_reload=None)
+    await agent.start()
+    await store.put(
+        mobility_swap_key(NS, "backend-a"),
+        json.dumps({"model": "b", "model_path": "/ckpt/b"}).encode())
+    await asyncio.gather(*agent._tasks)
+    # the swap failed with no fallback: the worker keeps serving a
+    assert agent.component == "backend-a" and not events["reregister"]
+    assert mobility_wake_key(NS, "b") not in store.kv
+    agent.cache.close()
+
+
+async def test_agent_prefetch_hint_stages_siblings():
+    store = FakeStore()
+    agent, drt, events = _agent(store, StubEngine())
+    await agent.start()
+    await store.put(
+        mobility_prefetch_key(NS, "backend-a"),
+        json.dumps({"models": [
+            {"model": "b", "model_path": "/ckpt/b"},
+            {"model": "c", "model_path": "/ckpt/c"}]}).encode())
+    for _ in range(200):
+        if "/ckpt/b" in agent.cache and "/ckpt/c" in agent.cache:
+            break
+        await asyncio.sleep(0.01)
+    assert "/ckpt/b" in agent.cache and "/ckpt/c" in agent.cache
+    agent.cache.close()
+
+
+# ---------------------------------------------------------------------------
+# arbiter: swap-sibling victim preference
+# ---------------------------------------------------------------------------
+def test_arbiter_prefers_swap_sibling_victim():
+    arb = ChipArbiter(4, preempt_margin=0.5)
+    # both victims preemptible; "colder" is coldest (the default pick)
+    # but "sib" shares hot's swap group — the drain must land on sib
+    g = arb.grant([
+        PoolClaim("colder", 2, 2, 1, 1, burn=0.0),
+        PoolClaim("sib", 2, 2, 1, 1, burn=0.2, swap_group="llama"),
+        PoolClaim("hot", 1, 0, 1, 0, burn=5.0, swap_group="llama")])
+    assert g["hot"][0] == 1
+    assert g["sib"][0] == 1 and "yielded to hot" in g["sib"][1]
+    assert g["colder"][0] == 2
+
+
+def test_arbiter_no_sibling_falls_back_to_coldest():
+    arb = ChipArbiter(4, preempt_margin=0.5)
+    g = arb.grant([
+        PoolClaim("colder", 2, 2, 1, 1, burn=0.0, swap_group="other"),
+        PoolClaim("warm", 2, 2, 1, 1, burn=0.2),
+        PoolClaim("hot", 1, 0, 1, 0, burn=5.0, swap_group="llama")])
+    assert g["hot"][0] == 1
+    assert g["colder"][0] == 1 and g["warm"][0] == 2
+
+
+# ---------------------------------------------------------------------------
+# LocalConnector swap accounting
+# ---------------------------------------------------------------------------
+class FakeProc:
+    pid = 0
+
+    def __init__(self):
+        self.signals = []
+
+    def poll(self):
+        return None
+
+    def wait(self):
+        return 0
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+
+
+def _connector(**kw):
+    from dynamo_tpu.planner.connectors import LocalConnector, PoolSpec
+
+    c = LocalConnector("127.0.0.1:0", NS,
+                       {"a": PoolSpec(component="backend-a", chips=0),
+                        "b": PoolSpec(component="backend-b", chips=0)},
+                       **kw)
+    c._spawn_calls = []
+    c._spawn = lambda pool, spec: c._spawn_calls.append(pool)
+    return c
+
+
+def _owned(started_at):
+    from dynamo_tpu.planner.connectors import _Owned
+
+    return _Owned(FakeProc(), None, "/dev/null", started_at)
+
+
+@dataclasses.dataclass
+class Dec:
+    current: int
+    swap_out: int = 0
+
+
+async def test_swap_pool_issues_once_per_component():
+    store = FakeStore()
+    c = _connector()
+    payload = {"model": "b", "model_path": "/ckpt/b"}
+    assert await c.swap_pool(store, NS, "a", "backend-a", payload) == 1
+    assert store.kv[mobility_swap_key(NS, "backend-a")]
+    # an unclaimed command from an earlier tick blocks a second issue
+    assert await c.swap_pool(store, NS, "a", "backend-a", payload) == 0
+    assert c._live_swaps("b") == 1
+
+
+async def test_note_swap_moves_oldest_owned_to_beneficiary():
+    c = _connector()
+    old, new = _owned(10.0), _owned(20.0)
+    c.owned["a"] = [old, new]
+    c.note_swap("a", "b")
+    assert c.owned["a"] == [new] and c.owned["b"] == [old]
+    # draining pool a must never SIGTERM the departed worker
+    await c.apply("a", 1, Dec(current=2, swap_out=1))
+    assert old.proc.signals == [] and new.proc.signals == []
+    # without the swap_out annotation the shrink would SIGTERM one
+    await c.apply("a", 0, Dec(current=1))
+    assert new.proc.signals
+
+
+async def test_swap_wake_suppresses_spawn_but_is_not_a_boot():
+    c = _connector(boot_grace=60.0)
+    c.note_swap("a", "b")      # externally started donor: nothing owned
+    # b: target 1, current 0, one swap-wake in flight -> no spawn
+    await c.apply("b", 1, Dec(current=0))
+    assert c._spawn_calls == []
+    # capacity arrived (swap registered): the marker is spent
+    await c.apply("b", 1, Dec(current=1))
+    assert "b" not in c._swapping
+    # and a further scale-up spawns normally
+    await c.apply("b", 2, Dec(current=1))
+    assert c._spawn_calls == ["b"]
+
+
+async def test_stale_swap_wake_ages_out():
+    c = _connector(boot_grace=0.0)     # everything is instantly stale
+    c.note_swap("a", "b")
+    await c.apply("b", 1, Dec(current=0))
+    # the failed swap no longer suppresses the cold spawn
+    assert c._spawn_calls == ["b"]
+
+
+# ---------------------------------------------------------------------------
+# FleetPlane: prefetch hints + swap actuation
+# ---------------------------------------------------------------------------
+def _plane(store, specs):
+    plane = FleetPlane(store, NS, total_chips=8)
+    plane.registry.models = {s.name: s for s in specs}
+    return plane
+
+
+def _spec(name, group="", prewarm=False, path=None):
+    return FleetModelSpec(name=name, engine="jax", model_path=path,
+                          swap_group=group, prewarm=prewarm)
+
+
+async def test_prefetch_hints_follow_swap_groups_and_prewarm():
+    store = FakeStore()
+    plane = _plane(store, [
+        _spec("a", group="g", path="/ckpt/a"),
+        _spec("b", group="g", path="/ckpt/b"),
+        _spec("c", path="/ckpt/c", prewarm=True),
+        _spec("d")])
+    await plane.publish_prefetch_hints()
+    hints = json.loads(store.kv[mobility_prefetch_key(NS, "backend-a")])
+    assert [m["model"] for m in hints["models"]] == ["b", "c"]
+    hints_d = json.loads(store.kv[mobility_prefetch_key(NS, "backend-d")])
+    assert [m["model"] for m in hints_d["models"]] == ["c"]  # prewarm only
+    writes = len(store.puts)
+    await plane.publish_prefetch_hints()      # change-gated: no rewrite
+    assert len(store.puts) == writes
+    # model leaves: its component's hint key is deleted
+    del plane.registry.models["b"]
+    await plane.publish_prefetch_hints()
+    assert mobility_prefetch_key(NS, "backend-b") not in store.kv
+    hints = json.loads(store.kv[mobility_prefetch_key(NS, "backend-a")])
+    assert [m["model"] for m in hints["models"]] == ["c"]
+
+
+class SwapConnector:
+    def __init__(self):
+        self.calls = []
+
+    async def swap_pool(self, store, ns, from_pool, from_component,
+                        payload):
+        self.calls.append((from_pool, from_component, payload))
+        return 1
+
+
+def _dec(pool, current, target, action):
+    from dynamo_tpu.planner.policy import Decision
+
+    return Decision(pool=pool, current=current, proposed=target,
+                    target=target, action=action, reason="", policy="t")
+
+
+async def test_actuate_swaps_pairs_group_siblings():
+    from dynamo_tpu.planner.policy import SCALE_DOWN, SCALE_UP
+
+    store = FakeStore()
+    plane = _plane(store, [
+        _spec("a", group="g", path="/ckpt/a"),
+        _spec("b", group="g", path="/ckpt/b"),
+        _spec("c", path="/ckpt/c")])
+    conn = SwapConnector()
+    up = _dec("b", 0, 1, SCALE_UP)
+    down = _dec("a", 2, 1, SCALE_DOWN)
+    other = _dec("c", 2, 1, SCALE_DOWN)       # not in the group: untouched
+    await plane.actuate_swaps([up, down, other], conn)
+    assert len(conn.calls) == 1
+    from_pool, from_component, payload = conn.calls[0]
+    assert (from_pool, from_component) == ("a", "backend-a")
+    assert payload["model"] == "b" and payload["model_path"] == "/ckpt/b"
+    assert payload["component"] == "backend-b"
+    assert up.swap_in == 1 and down.swap_out == 1
+    assert "swap a->b" in up.reason
+    # a second pass finds need satisfied: no duplicate command
+    await plane.actuate_swaps([up, down, other], conn)
+    assert len(conn.calls) == 1
+
+
+async def test_actuate_swaps_requires_swap_capable_connector():
+    store = FakeStore()
+    plane = _plane(store, [_spec("a", group="g", path="/ckpt/a"),
+                           _spec("b", group="g", path="/ckpt/b")])
+    from dynamo_tpu.planner.policy import SCALE_DOWN, SCALE_UP
+
+    # object() has no swap_pool: the plain spawn/drain path, no throw
+    await plane.actuate_swaps(
+        [_dec("b", 0, 1, SCALE_UP), _dec("a", 2, 1, SCALE_DOWN)],
+        object())
+
+
+async def test_status_carries_wake_record():
+    store = FakeStore()
+    plane = _plane(store, [_spec("b", group="g", path="/ckpt/b")])
+    await store.put(mobility_wake_key(NS, "b"),
+                    json.dumps({"path": "swap", "seconds": 2.5}).encode())
+
+    class Drt:
+        def __init__(self):
+            self.store = store
+            self.lease = None
+
+    await plane.publish_status(Drt(), [], {})
+    from dynamo_tpu.fleet.registry import fleet_status_key
+
+    status = json.loads(store.kv[fleet_status_key(NS, "b")])
+    assert status["wake_path"] == "swap"
+    assert status["wake_seconds"] == 2.5

@@ -146,6 +146,7 @@ FAMILY_SAMPLES = {
     "fleet-soak": "fleet/ns/beacon",
     "fleet-models": "fleet_models/ns/echo",
     "fleet-status": "fleet_status/ns/echo",
+    "mobility": "mobility/ns/swap/backend-echo",
     "faults": "faults/store.connect",
     "overload": "overload/ns/brownout",
     "traces": "traces/tid/sid",
