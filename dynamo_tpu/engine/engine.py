@@ -136,6 +136,7 @@ class JaxEngineConfig:
     kvpage_seg_pages: Optional[int] = None   # blocks per staging segment
     kvpage_prefetch: Optional[int] = None    # segments prefetched ahead
     kvpage_max_context: Optional[int] = None  # paged context ceiling
+    kvpage_batch: Optional[int] = None       # concurrent decode lanes
 
     @classmethod
     def from_card(cls, card: ModelDeploymentCard, tensor_parallel: int = 1,

@@ -2,34 +2,46 @@
 of the attention pass that needs them.
 
 The paged forward consumes cold blocks as (layer, segment) items in a
-fully deterministic order — the runner publishes that order as a
-:class:`PageinPlan` before each chunk/token forward. A background thread
-walks the plan, assembling each segment's host staging buffer (per-layer
-``peek_layer`` copies out of the tier — deliberately NOT ``lookup``, so
-page-in traffic never perturbs the LRU order that serves admission
-restores) up to ``prefetch`` segments ahead of the consumer. The h2d
+fully deterministic order — the runner publishes that order, PER LANE,
+as a :class:`PageinPlan` before each chunk/window forward. A background
+thread walks the installed plans, assembling each segment's host staging
+buffer (per-layer ``peek_layer`` copies out of the tier — deliberately
+NOT ``lookup``, so page-in traffic never perturbs the LRU order that
+serves admission restores) up to ``prefetch`` segments ahead of each
+lane's consumer cursor.
+
+With several decode lanes active the assembler ROUND-ROBINS one item at
+a time across the lanes that still have claimable work: a lane with a
+32x-budget context cannot starve a short-context neighbour, because
+backpressure is per lane (``claimed - taken < prefetch``) — each lane
+keeps its own double-buffer ahead of the forward, no more. The h2d
 upload itself is issued by the runner (it owns the device queue), so by
 the time attention for segment *s* dispatches, segment *s+1* is already
-assembled and its upload enqueued: page-in overlaps compute.
+assembled and its upload enqueued: page-in overlaps compute, across
+lanes as well as within one.
 
 ``take`` is the fault boundary: an item the thread already finished is
 an async page-in (``dyn_kvpage_pageins_total``); an item that has to be
 assembled inline on the engine thread — prefetch disabled, or a plan the
 thread has not reached — is a *page fault*
 (``dyn_kvpage_faults_total``): a counted synchronous upload, never a
-crash. Time spent blocked on a scheduled-but-unfinished item lands in
-the ``dyn_kvpage_pagein_wait_seconds`` histogram; in steady-state decode
+crash. Faults are per take and therefore per LANE: one lane missing its
+prefetch degrades that lane's take to a synchronous assembly while the
+other lanes' prefetched buffers stay valid and their cursors untouched.
+Time spent blocked on a scheduled-but-unfinished item lands in the
+``dyn_kvpage_pagein_wait_seconds`` histogram; in steady-state decode
 both the fault counter and that histogram should sit at zero, which the
 long-context bench lane asserts.
 """
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -71,12 +83,27 @@ class _Assembled:
     error: Optional[Exception] = None
 
 
+@dataclass
+class _LaneSched:
+    """One lane's plan walk: the assembler's claim cursor (``next``) and
+    the consumer's take cursor (``taken``) bound each other through the
+    per-lane prefetch window."""
+
+    plan: Optional[PageinPlan] = None
+    order: List[ItemKey] = field(default_factory=list)
+    built: Dict[ItemKey, _Assembled] = field(default_factory=dict)
+    next: int = 0                 # thread's claim cursor into order
+    taken: int = 0                # consumer's cursor (backpressure)
+
+
 class PageScheduler:
     """Prefetches cold-block staging buffers ahead of the paged forward.
 
-    Single consumer (the engine thread) + one assembler thread; the tier
-    handles its own locking (``peek_layer`` copies under the tier lock),
-    so the scheduler only guards its plan/ready bookkeeping.
+    Single consumer (the engine thread) + one assembler thread shared by
+    every lane; the tier handles its own locking (``peek_layer`` copies
+    under the tier lock), so the scheduler only guards its plan/ready
+    bookkeeping. Lane 0 is the default so single-lane callers never name
+    a lane.
     """
 
     def __init__(self, tiered, seg_pages: int, prefetch: int = 2):
@@ -87,75 +114,89 @@ class PageScheduler:
         self.pageins = 0
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
-        self._plan: Optional[PageinPlan] = None
-        self._order: List[ItemKey] = []
-        self._built: Dict[ItemKey, _Assembled] = {}
-        self._next = 0                # thread's cursor into _order
-        self._taken = 0               # consumer's cursor (backpressure)
+        self._lanes: Dict[int, _LaneSched] = {}
+        self._rr = -1                 # last lane the assembler served
         self._gen = 0
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        #: (lane, item) claim order, for interleave tests/debugging
+        self.claim_log: Deque[Tuple[int, ItemKey]] = collections.deque(
+            maxlen=1024)
 
     # ------------------------------------------------------------------
-    def begin(self, plan: PageinPlan) -> None:
-        """Install the next forward's page-in order; the assembler starts
-        on it immediately (prefetch permitting)."""
+    def begin(self, plan: PageinPlan, lane: int = 0) -> None:
+        """Install one lane's next-forward page-in order; the assembler
+        starts on it immediately (per-lane prefetch permitting)."""
         with self._wake:
             self._gen += 1
             plan.generation = self._gen
-            self._plan = plan
-            self._order = plan.items()
-            self._built = {}
-            self._next = 0
-            self._taken = 0
+            st = self._lanes.setdefault(lane, _LaneSched())
+            st.plan = plan
+            st.order = plan.items()
+            st.built = {}
+            st.next = 0
+            st.taken = 0
             self._wake.notify_all()
-        if (self.prefetch > 0 and self._order and self._thread is None
+        if (self.prefetch > 0 and st.order and self._thread is None
                 and not self._closed):
             self._thread = threading.Thread(
                 target=self._run, name="kvpage-prefetch", daemon=True)
             self._thread.start()
 
-    def take(self, key: ItemKey) -> Tuple[np.ndarray, np.ndarray, int]:
-        """The staging buffer for one plan item: (k, v, n_valid_blocks).
-        Prefetched items count as page-ins (time blocked on an in-flight
-        assembly lands in the wait histogram); an item the assembler will
-        never deliver — prefetch disabled, thread gone — is assembled
-        inline: a counted synchronous page fault."""
+    def end_lane(self, lane: int) -> None:
+        """Drop a lane's plan state (its sequence released); in-flight
+        assemblies for it finish into discarded entries."""
+        with self._wake:
+            self._lanes.pop(lane, None)
+            self._wake.notify_all()
+
+    def take(self, key: ItemKey, lane: int = 0
+             ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """The staging buffer for one lane's plan item:
+        (k, v, n_valid_blocks). Prefetched items count as page-ins (time
+        blocked on an in-flight assembly lands in the wait histogram); an
+        item the assembler will never deliver — prefetch disabled, thread
+        gone — is assembled inline: a counted synchronous page fault,
+        isolated to this lane (no other lane's cursors move)."""
         stage = stage_metrics()
         t0 = time.perf_counter()
         with self._wake:
-            ent = self._built.pop(key, None)
-            if (ent is None and self.prefetch > 0
+            st = self._lanes.get(lane)
+            ent = st.built.pop(key, None) if st is not None else None
+            if (ent is None and st is not None and self.prefetch > 0
                     and self._thread is not None):
-                # the assembler claims items strictly in plan order; if it
-                # has not reached this one yet, it is about to — wait for
-                # the claim instead of duplicating the work inline
+                # the assembler claims a lane's items strictly in plan
+                # order; if it has not reached this one yet, it is about
+                # to — wait for the claim instead of duplicating the
+                # work inline
                 try:
-                    idx = self._order.index(key)
+                    idx = st.order.index(key)
                 except ValueError:
                     idx = -1
                 while (ent is None and idx >= 0 and not self._closed
-                       and self._plan is not None and self._next <= idx):
+                       and st.plan is not None and st.next <= idx):
                     self._wake.wait(0.05)
-                    ent = self._built.pop(key, None)
+                    ent = st.built.pop(key, None)
                 if ent is None:
-                    ent = self._built.pop(key, None)
+                    ent = st.built.pop(key, None)
             if ent is not None:
-                self._taken += 1
+                st.taken += 1
                 self._wake.notify_all()   # a prefetch slot freed up
         if ent is None:
             # the assembler will never deliver this item: synchronous
             # page-in on the engine thread
             self.faults += 1
             stage.kvpage_faults.inc()
-            plan = self._plan
+            plan = st.plan if st is not None else None
             if plan is None:
-                raise KvPageMiss(f"take({key}) with no active plan")
+                raise KvPageMiss(
+                    f"take({key}) on lane {lane} with no active plan")
             ent = self._assemble(plan.hashes(key), layer=key[0])
             stage.kvpage_pagein_wait.observe(
                 value=time.perf_counter() - t0)
             with self._wake:
-                self._taken += 1
+                if st is not None:
+                    st.taken += 1
                 self._wake.notify_all()
             return ent.k, ent.v, ent.n_valid
         ent.ready.wait()
@@ -198,22 +239,46 @@ class PageScheduler:
         return _Assembled(np.stack(ks), np.stack(vs), n,
                           ready=_DONE)
 
+    def _claimable(self, st: _LaneSched) -> bool:
+        return (st.plan is not None and st.next < len(st.order)
+                and st.next - st.taken < self.prefetch)
+
+    def _pick_lane(self) -> Optional[int]:
+        """Next lane to assemble for: round-robin starting after the
+        last-served lane, skipping lanes that are plan-done or at their
+        prefetch ceiling. One item per pick is the fairness unit."""
+        lanes = sorted(self._lanes)
+        if not lanes:
+            return None
+        start = 0
+        for i, ln in enumerate(lanes):
+            if ln > self._rr:
+                start = i
+                break
+        for i in range(len(lanes)):
+            ln = lanes[(start + i) % len(lanes)]
+            if self._claimable(self._lanes[ln]):
+                return ln
+        return None
+
     def _run(self) -> None:
         while True:
             with self._wake:
-                while not self._closed and (
-                        self._plan is None
-                        or self._next >= len(self._order)
-                        or self._next - self._taken >= self.prefetch):
+                ln = self._pick_lane()
+                while not self._closed and ln is None:
                     self._wake.wait()
+                    ln = self._pick_lane()
                 if self._closed:
                     return
-                key = self._order[self._next]
+                self._rr = ln
+                st = self._lanes[ln]
+                key = st.order[st.next]
                 ent = _Assembled(None, None, 0)  # placeholder until built
-                self._built[key] = ent
-                self._next += 1
+                st.built[key] = ent
+                st.next += 1
+                self.claim_log.append((ln, key))
                 self._wake.notify_all()   # a consumer may await the claim
-                hashes = self._plan.hashes(key)
+                hashes = st.plan.hashes(key)
             try:
                 built = self._assemble(hashes, layer=key[0])
                 ent.k, ent.v, ent.n_valid = built.k, built.v, built.n_valid

@@ -6,12 +6,14 @@ programs decompose each layer's attention into *partial* passes with
 online-softmax accumulators (the flash-attention recurrence, applied
 across dispatches instead of across kernel tiles):
 
-- :meth:`PagedPrograms.attn_hot` attends over the device-resident tail
-  (read through the pool, causally masked);
-- :meth:`PagedPrograms.attn_cold` attends over one staged segment of
-  demoted blocks uploaded h2d into a scratch buffer (all cold positions
-  strictly precede every query, so only the padding-validity mask
-  applies);
+- the ``attn_hot`` program attends over the device-resident tail
+  (read through the pool, causally masked, window-masked on sliding
+  layers);
+- the ``attn_cold`` program attends over one staged segment of demoted
+  blocks per lane, uploaded h2d into a shared [B, ...] staging slot (all
+  cold positions strictly precede every query, so causality is free;
+  sliding layers additionally window-mask against each lane's own
+  segment positions);
 - :meth:`PagedPrograms.layer_out` normalizes the merged accumulators and
   finishes the layer (o-proj, residual, FFN).
 
@@ -21,18 +23,26 @@ between partial passes only the tiny per-chunk activations and the f32
 through a fixed pair of staging slots regardless of context length.
 Exactness: softmax reassociation is the only difference from the dense
 path — accumulation stays f32 end to end, and the long-context bench
-lane pins token-identity against an unpaged run.
+lane pins token-identity against an unpaged run. Batching is exact too:
+masked/padded positions contribute exactly ``0.0`` to the f32 sums and
+sampling is row-independent, so each lane's token stream is
+byte-identical at any batch width (``tests/test_kvpage.py`` pins B=4
+against B=1 against the dense engine).
 
 The layer index rides every program as a TRACED scalar (stacked layer
-params are gathered with it), so the whole layer stack replays TWO
-compiled variants per program (prefill-chunk and decode shapes), not 2*L.
-That is also why models with per-layer static structure (sliding-window
-layers, dual-base rope) are excluded from paging at config time.
+params are gathered with it), so the whole layer stack replays a
+constant number of compiled variants, not O(L). Models with per-layer
+STATIC structure (sliding-window masks, dual-base rope) compile one
+variant per layer *class* instead: the window span and rope-table choice
+are closure constants of the class's programs (mirroring the dense
+path's ``flash_for`` per-class kernel cache), which is what lifted the
+former sliding-window/dual-rope exclusions — Gemma2/3-style models have
+exactly two classes, so the program count stays constant.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,7 +53,7 @@ from ...models.llama import NEG_INF
 
 def _merge(o0, m0, d0, o1, m1, d1):
     """Online-softmax merge of two partial-attention accumulators.
-    Shapes: o [1, Hkv, G, T, Dh] f32; m, d [1, Hkv, G, T] f32."""
+    Shapes: o [B, Hkv, G, T, Dh] f32; m, d [B, Hkv, G, T] f32."""
     m = jnp.maximum(m0, m1)
     a0 = jnp.exp(m0 - m)
     a1 = jnp.exp(m1 - m)
@@ -54,9 +64,11 @@ def _merge(o0, m0, d0, o1, m1, d1):
 def _partial_attend(cfg, q, k, v, mask):
     """Unnormalized attention stats for one KV span.
 
-    q: [1, T, Hq, Dh]; k, v: [1, S, Hkv, Dh]; mask: [1, T, S] bool.
-    Returns (o [1,Hkv,G,T,Dh], m [1,Hkv,G,T], d [1,Hkv,G,T]), all f32.
-    Scores mirror :func:`llama.attend` (scale then softcap then mask)."""
+    q: [B, T, Hq, Dh]; k, v: [B, S, Hkv, Dh]; mask: [B, T, S] bool.
+    Returns (o [B,Hkv,G,T,Dh], m [B,Hkv,G,T], d [B,Hkv,G,T]), all f32.
+    Scores mirror :func:`llama.attend` (scale then softcap then mask).
+    Rows whose mask is all-False yield (0, NEG_INF, 0): an exact no-op
+    under :func:`_merge`, which is what makes padded lanes free."""
     Hq = cfg.num_heads
     Hkv = cfg.num_kv_heads
     G = Hq // Hkv
@@ -79,7 +91,10 @@ def _partial_attend(cfg, q, k, v, mask):
 
 class PagedPrograms:
     """The compiled-program surface of the paged path, built once per
-    engine. All programs take batch dim 1 (the paged lane runs solo)."""
+    engine. All programs take a leading batch dim (1 for prefill-chunk
+    dispatches, the lane count for batched decode); per-layer-static
+    model structure selects a compiled variant via
+    :attr:`layer_programs`."""
 
     def __init__(self, cfg, mesh, rep_sharding, kv_sharding):
         self.cfg = cfg
@@ -87,69 +102,133 @@ class PagedPrograms:
         rep, kv = rep_sharding, kv_sharding
         page = cfg.page_size
 
+        # Layer classes: the per-layer STATIC attention structure.
+        # (window span, local-rope?) — full-attention layers are
+        # (None, False); Gemma2/3 sliding layers carry their window and
+        # (gemma3) the local-theta rope table. Each distinct class gets
+        # its own compiled qkv/attn_hot/attn_cold variants with the
+        # statics baked in as closure constants; the layer index stays
+        # traced WITHIN a class.
+        classes: List[Tuple[Optional[int], bool]] = []
+        layer_cls: List[int] = []
+        for l in range(m.num_layers):
+            if m.layer_sliding(l):
+                key = (int(m.sliding_window),
+                       m.rope_local_theta is not None)
+            else:
+                key = (None, False)
+            if key not in classes:
+                classes.append(key)
+            layer_cls.append(classes.index(key))
+        self.classes = classes
+        #: per-layer window span (None = full attention), for the
+        #: runner's page-in plan clamping
+        self.windows: List[Optional[int]] = [
+            classes[c][0] for c in layer_cls]
+
         def embed(params, tokens):
             return llama._embed(params, m, tokens)
 
         self.embed = jax.jit(embed, out_shardings=rep)
 
-        def qkv(params, l, x, positions, k_pool, v_pool, write_idx):
-            lp = params["layers"]
-            h = llama.rms_norm(x, lp["ln1"][l], m.rms_eps, m.norm_offset)
-            q = jnp.einsum("btd,dhk->bthk", h, lp["wq"][l])
-            k = jnp.einsum("btd,dhk->bthk", h, lp["wk"][l])
-            v = jnp.einsum("btd,dhk->bthk", h, lp["wv"][l])
-            if m.attention_bias:
-                q = q + lp["bq"][l]
-                k = k + lp["bk"][l]
-                v = v + lp["bv"][l]
-            if m.qk_norm:
-                q = llama.rms_norm(q, lp["ln_q"][l], m.rms_eps,
+        def make_qkv(local: bool):
+            def qkv(params, l, x, positions, k_pool, v_pool, write_idx):
+                lp = params["layers"]
+                h = llama.rms_norm(x, lp["ln1"][l], m.rms_eps,
                                    m.norm_offset)
-                k = llama.rms_norm(k, lp["ln_k"][l], m.rms_eps,
-                                   m.norm_offset)
-            cos, sin = llama.rope_tables(m, positions)
-            q = llama.apply_rope(q, cos, sin)
-            k = llama.apply_rope(k, cos, sin)
-            B, T = positions.shape
-            flat_w = write_idx.reshape(-1)
-            wp, wo = flat_w // page, flat_w % page
-            k_pool = k_pool.at[l, :, wp, wo].set(
-                k.reshape(B * T, *k.shape[2:]))
-            v_pool = v_pool.at[l, :, wp, wo].set(
-                v.reshape(B * T, *v.shape[2:]))
-            return q, k_pool, v_pool
+                q = jnp.einsum("btd,dhk->bthk", h, lp["wq"][l])
+                k = jnp.einsum("btd,dhk->bthk", h, lp["wk"][l])
+                v = jnp.einsum("btd,dhk->bthk", h, lp["wv"][l])
+                if m.attention_bias:
+                    q = q + lp["bq"][l]
+                    k = k + lp["bk"][l]
+                    v = v + lp["bv"][l]
+                if m.qk_norm:
+                    q = llama.rms_norm(q, lp["ln_q"][l], m.rms_eps,
+                                       m.norm_offset)
+                    k = llama.rms_norm(k, lp["ln_k"][l], m.rms_eps,
+                                       m.norm_offset)
+                cos, sin = llama.rope_tables(m, positions, local=local)
+                q = llama.apply_rope(q, cos, sin)
+                k = llama.apply_rope(k, cos, sin)
+                B, T = positions.shape
+                flat_w = write_idx.reshape(-1)
+                wp, wo = flat_w // page, flat_w % page
+                k_pool = k_pool.at[l, :, wp, wo].set(
+                    k.reshape(B * T, *k.shape[2:]))
+                v_pool = v_pool.at[l, :, wp, wo].set(
+                    v.reshape(B * T, *v.shape[2:]))
+                return q, k_pool, v_pool
 
-        self.qkv = jax.jit(qkv, donate_argnums=(4, 5),
+            return jax.jit(qkv, donate_argnums=(4, 5),
                            out_shardings=(rep, kv, kv))
 
-        def attn_hot(q, l, k_pool, v_pool, read_idx, read_pos, read_valid,
-                     positions):
-            rp, ro = read_idx // page, read_idx % page
-            k_ctx = k_pool[l, :, rp[0], ro[0]][None]    # [1, S, Hkv, Dh]
-            v_ctx = v_pool[l, :, rp[0], ro[0]][None]
-            mask = (read_valid[:, None, :]
-                    & (read_pos[:, None, :] <= positions[:, :, None]))
-            return _partial_attend(m, q, k_ctx, v_ctx, mask)
+        def make_attn_hot(window: Optional[int]):
+            def attn_hot(q, l, k_pool, v_pool, read_idx, read_pos,
+                         read_valid, positions):
+                rp, ro = read_idx // page, read_idx % page
+                # advanced indices split by the Hkv slice: batch dims in
+                # front -> [B, S, Hkv, Dh], each lane reading its own slots
+                k_ctx = k_pool[l, :, rp, ro]
+                v_ctx = v_pool[l, :, rp, ro]
+                mask = (read_valid[:, None, :]
+                        & (read_pos[:, None, :] <= positions[:, :, None]))
+                if window is not None:
+                    # dense-path sliding rule: keys strictly within the
+                    # last `window` positions of each query
+                    mask = mask & (read_pos[:, None, :]
+                                   > positions[:, :, None] - window)
+                return _partial_attend(m, q, k_ctx, v_ctx, mask)
 
-        self.attn_hot = jax.jit(attn_hot, out_shardings=(rep, rep, rep))
+            return jax.jit(attn_hot, out_shardings=(rep, rep, rep))
 
-        def attn_cold(q, k_seg, v_seg, seg_valid, o, m_, d):
-            # k_seg/v_seg: [n, Hkv, page, Dh] staged blocks; every cold
-            # position strictly precedes every query position, so only the
-            # padding-validity mask applies
-            n = k_seg.shape[0]
-            k_ctx = jnp.transpose(k_seg, (0, 2, 1, 3)).reshape(
-                1, n * page, k_seg.shape[1], k_seg.shape[3])
-            v_ctx = jnp.transpose(v_seg, (0, 2, 1, 3)).reshape(
-                1, n * page, v_seg.shape[1], v_seg.shape[3])
-            T = q.shape[1]
-            mask = jnp.broadcast_to(seg_valid[None, None, :],
-                                    (1, T, n * page))
-            o1, m1, d1 = _partial_attend(m, q, k_ctx, v_ctx, mask)
-            return _merge(o, m_, d, o1, m1, d1)
+        def make_attn_cold(window: Optional[int]):
+            def attn_cold(q, positions, kv_seg, meta, o, m_, d):
+                # kv_seg: [2, B, n, Hkv, page, Dh] — one staged segment
+                # PER LANE (k stacked over v so the whole slot is ONE
+                # h2d transfer). meta: [B, 2] int32 = (valid blocks,
+                # first token position) per lane; the validity and
+                # position vectors are rebuilt on device from those two
+                # scalars — cold segments are contiguous pinned-prefix
+                # runs, so a prefix-block count and a start offset carry
+                # everything the mask needs. Rows whose lane has no
+                # segment at this step ride along with meta (0, 0):
+                # all-invalid, an exact no-op under _merge. Cold
+                # positions strictly precede every query, so only
+                # validity (and, on sliding layers, each lane's own
+                # window against the rebuilt positions) masks.
+                k_seg, v_seg = kv_seg[0], kv_seg[1]
+                B, n = k_seg.shape[0], k_seg.shape[1]
+                k_ctx = jnp.transpose(k_seg, (0, 1, 3, 2, 4)).reshape(
+                    B, n * page, k_seg.shape[2], k_seg.shape[4])
+                v_ctx = jnp.transpose(v_seg, (0, 1, 3, 2, 4)).reshape(
+                    B, n * page, v_seg.shape[2], v_seg.shape[4])
+                iota = jnp.arange(n * page, dtype=jnp.int32)
+                seg_valid = (iota // page)[None, :] < meta[:, 0:1]
+                seg_pos = meta[:, 1:2] + iota[None, :]
+                T = q.shape[1]
+                mask = jnp.broadcast_to(seg_valid[:, None, :],
+                                        (B, T, n * page))
+                if window is not None:
+                    # mirrors ops/attention.py's dense sliding rule
+                    # `kp > qp - window` — keep the two in lockstep
+                    mask = mask & (seg_pos[:, None, :]
+                                   > positions[:, :, None] - window)
+                o1, m1, d1 = _partial_attend(m, q, k_ctx, v_ctx, mask)
+                return _merge(o, m_, d, o1, m1, d1)
 
-        self.attn_cold = jax.jit(attn_cold, donate_argnums=(4, 5, 6),
-                                 out_shardings=(rep, rep, rep))
+            return jax.jit(attn_cold, donate_argnums=(4, 5, 6),
+                           out_shardings=(rep, rep, rep))
+
+        qkv_c = {loc: make_qkv(loc) for loc in {c[1] for c in classes}}
+        hot_c = {w: make_attn_hot(w) for w in {c[0] for c in classes}}
+        cold_c = {w: make_attn_cold(w) for w in {c[0] for c in classes}}
+        #: per-layer (qkv, attn_hot, attn_cold, window) dispatch table —
+        #: layers of the same class share the same compiled callables
+        self.layer_programs = [
+            (qkv_c[classes[c][1]], hot_c[classes[c][0]],
+             cold_c[classes[c][0]], classes[c][0])
+            for c in layer_cls]
 
         def layer_out(params, l, x, o, m_, d):
             lp = params["layers"]
@@ -164,16 +243,23 @@ class PagedPrograms:
         self.layer_out = jax.jit(layer_out, out_shardings=rep)
 
         def head(params, x, last_i, temp, top_p, top_k, key, counts,
-                 freq_pen, pres_pen):
+                 freq_pen, pres_pen, active):
             from ...engine.sampling import apply_penalties, sample
             xs = jnp.take_along_axis(
                 x, last_i[:, None, None].astype(jnp.int32), axis=1)
-            logits = llama._lm_head(xs, params, m)[:, 0]       # [1, V]
+            logits = llama._lm_head(xs, params, m)[:, 0]       # [B, V]
             lg = apply_penalties(logits, counts, freq_pen, pres_pen)
             tok, logp, new_key = sample(lg, temp, top_p, top_k, key)
-            counts = counts.at[jnp.arange(1), tok].add(1)
+            B = tok.shape[0]
+            # inactive rows (padded decode lanes) must not perturb the
+            # lane-persistent sampling state: their penalty counts stay
+            # put and their PRNG keys do not advance, so a lane's draws
+            # are independent of which OTHER lanes shared its windows
+            counts = counts.at[jnp.arange(B), tok].add(
+                active.astype(jnp.int32))
+            new_key = jnp.where(active, new_key, key)
             # token ids < 2^24 are exact in f32: one packed (token,
-            # logprob) array = one host fetch per sampled token
+            # logprob) array = one host fetch per sampled window
             packed = jnp.stack([tok.astype(jnp.float32), logp], -1)
             return packed, new_key, counts
 
@@ -184,13 +270,10 @@ class PagedPrograms:
     @staticmethod
     def validate(cfg) -> Optional[str]:
         """Why this engine config cannot run the paged path (None = ok).
-        The constraints are exactly the per-layer-static model features
-        the traced-layer-index programs cannot express."""
+        Sliding-window and dual-base-rope models compile per layer-class
+        variants and ARE servable; what remains excluded is structure the
+        segmented forward itself cannot express."""
         m = cfg.model
-        if m.sliding_window is not None:
-            return "sliding-window models (per-layer window pattern)"
-        if m.rope_local_theta is not None:
-            return "dual-base rope models (per-layer rope tables)"
         if m.num_experts:
             return "MoE models"
         if m.vision is not None:

@@ -13,20 +13,36 @@ The virtual-memory model (docs/long_context.md):
   the writing dispatch by data dependency (a one-hop version of the
   cluster write-through's two-step ratchet: here the runner owns the
   issue order, so it demotes the moment the write is in the queue).
-- **Decode over a windowed working set.** Attention runs hot-first over
-  the resident tail through the pool, then merges one staged cold
-  segment at a time (``programs.attn_cold``), while the
-  :class:`~.pager.PageScheduler` assembles the next segment ahead of
-  need and the runner enqueues its h2d upload before dispatching the
-  current segment's attention — double-buffered, never blocking
-  dispatch. Faults degrade to counted synchronous uploads.
+- **Batched decode over windowed working sets.** Up to
+  ``DYN_KVPAGE_BATCH`` sequences decode CONCURRENTLY, each owning an
+  equal share of the device page budget (its lane). One window step runs
+  hot-window attention for every lane in a single dispatch (each lane
+  reads its own resident slots through the pool), then merges cold
+  segments lane-stacked into a shared ``[B, ...]`` staging slot — one
+  h2d upload per (layer, segment step) covers every lane, and the
+  :class:`~.pager.PageScheduler` round-robins segment assembly across
+  lanes so each keeps its own prefetch double-buffer: one lane's page-in
+  overlaps the other lanes' attention dispatches. Faults degrade to
+  counted synchronous uploads on the faulting lane only. Sampling state
+  (PRNG key, penalty counts) is a per-lane row of persistent ``[B]``
+  arrays, masked so padded rows never advance — every lane's token
+  stream is byte-identical to a batch-1 run and to the dense engine.
 - **Prefix reuse for free.** Demoted blocks carry their chained sequence
   hashes, so a repeated long prompt pins matching tier blocks at
   admission and skips recomputing them; at release the pins drop and the
   blocks become ordinary LRU tier content (servable to cluster peers).
 
-The paged lane runs ONE sequence at a time (batch dim 1): long-context
-requests queue behind each other rather than thrash one device budget.
+Scheduling: ``advance()`` performs one unit of work per engine step —
+one prefill chunk (lanes round-robin) or one chained decode window
+across every decode-ready lane, prefill FIRST when both kinds of work
+exist: a window costs nearly the same at one lane as at full occupancy
+(uploads and dispatches are lane-stacked), so filling an admitted lane
+before decoding maximizes window occupancy and the newcomer's TTFT,
+while the decode stall stays bounded by admission (at most ``batch``
+resident prefills). Admission is byte-honest across lanes: every admitted
+request's working set is reserved against the host tier up front (the
+unpinned remainder counts until the lane has demoted it), so N lanes
+cannot jointly over-commit what single-lane admission would refuse.
 """
 
 from __future__ import annotations
@@ -36,7 +52,7 @@ import logging
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +80,7 @@ class PagedConfig:
     seg_pages: int              # blocks per cold staging segment
     prefetch: int               # segments assembled ahead (0 = sync)
     max_context: int            # paged-lane context ceiling, tokens
+    batch: int                  # concurrent paged decode lanes
 
     @classmethod
     def resolve(cls, cfg) -> Optional["PagedConfig"]:
@@ -79,9 +96,10 @@ class PagedConfig:
             prefetch = int(_env_float("DYN_KVPAGE_PREFETCH", 2))
         max_ctx = cfg.kvpage_max_context or int(
             _env_float("DYN_KVPAGE_MAX_CONTEXT", 131072))
+        batch = cfg.kvpage_batch or int(_env_float("DYN_KVPAGE_BATCH", 1))
         return cls(budget=int(budget), seg_pages=max(1, int(seg)),
                    prefetch=max(0, int(prefetch)),
-                   max_context=int(max_ctx))
+                   max_context=int(max_ctx), batch=max(1, int(batch)))
 
 
 @dataclass
@@ -90,34 +108,29 @@ class _PagedSeq:
     request: BackendInput
     prompt: List[int]
     tokseq: TokenSequence
+    lane: int = 0               # row in the batched decode dispatch
     # device pages for blocks [first_res, first_res + len(resident));
     # the resident span is always the contiguous tail of the context
     resident: List[int] = field(default_factory=list)
     first_res: int = 0
     pinned: List[int] = field(default_factory=list)   # demoted block hashes
+    reserve_blocks: int = 0     # admission reservation (working set)
+    seed: int = 0
     total_len: int = 0          # tokens written to the KV (pool or tier)
     prefill_done: int = 0
     generated: int = 0
     last_token: int = 0
     cum_logprob: float = 0.0
     cancelled: bool = False
-    # per-sequence device sampling state (the paged lane does not occupy
-    # an engine slot, so it carries its own key/penalty counts)
-    key: Optional[jax.Array] = None
-    counts: Optional[jax.Array] = None
-    temp: Optional[np.ndarray] = None
-    top_p: Optional[np.ndarray] = None
-    top_k: Optional[np.ndarray] = None
-    freq_pen: Optional[np.ndarray] = None
-    pres_pen: Optional[np.ndarray] = None
 
 
 class PagedEngine:
     """The paged lane of one :class:`~...engine.engine.EngineCore`.
 
     Driven from the engine thread: ``advance()`` performs exactly one
-    unit of work (one prefill chunk or one decode token) so paged and
-    normal traffic interleave at engine-step granularity.
+    unit of work (one prefill chunk or one chained decode window across
+    all decode-ready lanes) so paged and normal traffic interleave at
+    engine-step granularity.
     """
 
     def __init__(self, core, pcfg: PagedConfig):
@@ -139,19 +152,41 @@ class PagedEngine:
         # next forward as a device array, ONE packed fetch per window
         self.decode_chain = max(1, int(_env_float(
             "DYN_KVPAGE_DECODE_STEPS", cfg.decode_steps or 4)))
-        if pcfg.budget < self.chunk_pages + 2:
+        # every lane gets an equal share of the device budget; the
+        # total leased across lanes never exceeds ``budget``, so the
+        # byte-honesty story of the serial lane carries over verbatim
+        self.batch = pcfg.batch
+        self.lane_budget = pcfg.budget // self.batch
+        if self.lane_budget < self.chunk_pages + 2:
             raise ValueError(
-                f"kvpage budget of {pcfg.budget} pages cannot hold a "
-                f"prefill chunk ({self.chunk_pages} pages) plus the hot "
-                f"tail; need >= {self.chunk_pages + 2}")
+                f"kvpage budget of {pcfg.budget} pages split over "
+                f"{self.batch} lanes gives {self.lane_budget} pages per "
+                f"lane, which cannot hold a prefill chunk "
+                f"({self.chunk_pages} pages) plus the hot tail; need "
+                f">= {self.batch * (self.chunk_pages + 2)} total")
         from ...models.llama import kv_block_bytes
         self.block_bytes = kv_block_bytes(m, self.page)
         # hot-window residency ceilings: during prefill the in-flight
-        # chunk's pages ride inside the budget
-        self.hot_keep = max(1, pcfg.budget - self.chunk_pages - 1)
-        self.active: Optional[_PagedSeq] = None
-        self.queue: Deque[Tuple[str, BackendInput]] = collections.deque()
+        # chunk's pages ride inside the lane's budget share
+        self.hot_keep = max(1, self.lane_budget - self.chunk_pages - 1)
+        self.lanes: List[Optional[_PagedSeq]] = [None] * self.batch
+        self.queue: Deque[Tuple[str, BackendInput, int]] = \
+            collections.deque()
         self._worker = str(os.getpid())
+        # prefill/decode alternation + prefill lane fairness cursors
+        self._prefill_rr = 0
+        # lane-persistent sampling state: one row per lane. Rows are
+        # (re)initialized at lane start; padded rows in a batched head
+        # are masked inactive so they never advance (see programs.head)
+        vocab = m.vocab_size
+        self._keys = jax.random.split(
+            jax.random.key(int(cfg.seed)), self.batch)
+        self._counts = jnp.zeros((self.batch, vocab), jnp.int32)
+        self._temp = np.zeros(self.batch, np.float32)
+        self._top_p = np.ones(self.batch, np.float32)
+        self._top_k = np.zeros(self.batch, np.int32)
+        self._freq = np.zeros(self.batch, np.float32)
+        self._pres = np.zeros(self.batch, np.float32)
         # goodput accounting: paged dispatches feed the engine's shared
         # GoodputMeter so MFU/MBU stop under-reporting on long-context
         # traffic. The paged programs compile per (kind, hot-bucket)
@@ -161,40 +196,64 @@ class PagedEngine:
         # _take_compiled_flag.
         self._accounted_shapes: set = set()
         # hot-span shape buckets (page multiples, powers of two) keep the
-        # attn_hot program count logarithmic in the budget
+        # attn_hot program count logarithmic in the per-lane budget
         self.s_hot_buckets: List[int] = []
         b = self.page
-        while b < pcfg.budget * self.page:
+        while b < self.lane_budget * self.page:
             self.s_hot_buckets.append(b)
             b *= 2
-        self.s_hot_buckets.append(pcfg.budget * self.page)
+        self.s_hot_buckets.append(self.lane_budget * self.page)
 
     # ------------------------------------------------------------------
     @property
     def has_work(self) -> bool:
-        return self.active is not None or bool(self.queue)
+        return any(s is not None for s in self.lanes) or bool(self.queue)
+
+    @property
+    def active(self) -> Optional[_PagedSeq]:
+        """The first occupied lane (legacy single-lane introspection)."""
+        for seq in self.lanes:
+            if seq is not None:
+                return seq
+        return None
 
     def resident_bytes(self) -> Tuple[float, float]:
-        """(device bytes, pinned host bytes) of the paged working set."""
-        seq = self.active
-        if seq is None:
-            return 0.0, 0.0
-        return (float(len(seq.resident) * self.block_bytes),
-                float(len(seq.pinned) * self.block_bytes))
+        """(device bytes, pinned host bytes) of ALL lanes' working
+        sets."""
+        dev = host = 0
+        for seq in self.lanes:
+            if seq is None:
+                continue
+            dev += len(seq.resident)
+            host += len(seq.pinned)
+        return (float(dev * self.block_bytes),
+                float(host * self.block_bytes))
 
     def close(self) -> None:
         self.pager.close()
 
     def cancel(self, seq_id: str) -> None:
-        if self.active is not None and self.active.seq_id == seq_id:
-            self.active.cancelled = True
-        else:
-            self.queue = collections.deque(
-                (s, r) for s, r in self.queue if s != seq_id)
+        for seq in self.lanes:
+            if seq is not None and seq.seq_id == seq_id:
+                seq.cancelled = True
+                return
+        self.queue = collections.deque(
+            (s, r, b) for s, r, b in self.queue if s != seq_id)
 
     # ------------------------------------------------------------------
     # admission
     # ------------------------------------------------------------------
+    def _reserved_unpinned(self) -> int:
+        """Admitted-but-not-yet-pinned working-set blocks: queued
+        requests in full, plus each lane's remaining demotable span.
+        This is the ledger that keeps N-lane admission byte-honest —
+        what concurrent lanes WILL pin is charged before they pin it."""
+        r = sum(b for _, _, b in self.queue)
+        for seq in self.lanes:
+            if seq is not None:
+                r += max(0, seq.reserve_blocks - len(seq.pinned))
+        return r
+
     def try_route(self, seq_id: str, req: BackendInput):
         """Accept the request into the paged lane (None) or explain why
         not (a typed ERROR StepOutput the engine emits as-is)."""
@@ -223,73 +282,99 @@ class PagedEngine:
                                           - prompt_len)
         blocks = -(-(prompt_len + max_new) // self.page)
         host = self.core.tiered.host
+        reserved = self._reserved_unpinned()
         # byte-honest admission: the pinned working set must fit the host
-        # tier next to what is already pinned, or this one request would
+        # tier next to what is already pinned AND what every admitted
+        # lane/queued request will still pin, or this one request would
         # evict the pool's (and its neighbors') working sets
-        if blocks + len(host.pinned) + 1 > host.num_blocks:
+        if blocks + len(host.pinned) + reserved + 1 > host.num_blocks:
             return err(
                 f"paged working set of {blocks} KV blocks "
                 f"({blocks * self.block_bytes / 1e6:.0f} MB) does not fit "
                 f"the host tier ({host.num_blocks} blocks, "
-                f"{len(host.pinned)} already pinned)", 503,
+                f"{len(host.pinned)} already pinned, {reserved} reserved "
+                f"by admitted lanes)", 503,
                 "kvpage_capacity")
-        self.queue.append((seq_id, req))
+        self.queue.append((seq_id, req, blocks))
         return None
 
     # ------------------------------------------------------------------
     # engine-step driver
     # ------------------------------------------------------------------
     def advance(self) -> List:
-        """One unit of paged work: start a queued sequence, advance one
-        prefill chunk, or decode one token."""
+        """One unit of paged work: start queued sequences into free
+        lanes, then one prefill chunk (lanes round-robin) or one chained
+        decode window across every decode-ready lane — prefill first
+        when both kinds of work exist (see module docstring)."""
         from ...engine.engine import StepOutput
 
         out: List[StepOutput] = []
-        seq = self.active
-        if seq is not None and seq.cancelled:
-            out.append(StepOutput(seq.seq_id, seq.last_token,
-                                  seq.cum_logprob, FinishReason.CANCELLED))
-            self._release(seq)
-            seq = None
-        if seq is None:
-            if not self.queue:
-                return out
-            seq_id, req = self.queue.popleft()
-            seq = self._start(seq_id, req)
-        try:
-            if seq.prefill_done < len(seq.prompt):
+        for seq in list(self.lanes):
+            if seq is not None and seq.cancelled:
+                out.append(StepOutput(seq.seq_id, seq.last_token,
+                                      seq.cum_logprob,
+                                      FinishReason.CANCELLED))
+                self._release(seq)
+        for lane in range(self.batch):
+            if self.lanes[lane] is None and self.queue:
+                seq_id, req, blocks = self.queue.popleft()
+                self._start(lane, seq_id, req, blocks)
+        prefilling = [s for s in self.lanes
+                      if s is not None and s.prefill_done < len(s.prompt)]
+        decoding = [(s.lane, s) for s in self.lanes
+                    if s is not None and s.prefill_done >= len(s.prompt)]
+        # prefill-first: a decode window costs nearly the same at one
+        # lane as at full occupancy (staging uploads and dispatches are
+        # lane-stacked), so decoding while an admitted lane still
+        # prefills squanders the shared slots. Filling the lane first
+        # maximizes window occupancy AND its TTFT; the ITL stall for
+        # running decodes is bounded by admission (at most ``batch``
+        # resident prefills, no queue jump past a busy lane).
+        do_prefill = bool(prefilling)
+        if do_prefill:
+            seq = prefilling[self._prefill_rr % len(prefilling)]
+            self._prefill_rr += 1
+            try:
                 self._prefill_chunk(seq, out)
-            else:
-                self._decode_step(seq, out)
-        except Exception as e:  # noqa: BLE001 - a paged failure must kill
-            # THIS request, never the engine: letting it escape would hit
-            # step()'s catch-all, which errors every DENSE sequence and
-            # never releases the paged lane — the engine would then retry
-            # the same broken state forever. Capacity pressure is a
-            # retryable 503; a KvPageMiss (pin discipline violated — a
-            # data-loss bug, not load) and anything unexpected are 500s
-            # with distinct reasons so dashboards can tell them apart.
-            log.exception("paged sequence %s failed", seq.seq_id)
-            if isinstance(e, (OutOfBlocks, OutOfTierSpace)):
-                code, reason = 503, "kvpage_capacity"
-            elif isinstance(e, KvPageMiss):
-                code, reason = 500, "kvpage_miss"
-            else:
-                code, reason = 500, "kvpage_internal"
-            out.append(StepOutput(
-                seq.seq_id, seq.last_token, seq.cum_logprob,
-                FinishReason.ERROR,
-                error=f"paged serving failed: {e}", error_code=code,
-                error_stage="engine", error_reason=reason))
-            self._release(seq)
+            except Exception as e:  # noqa: BLE001 - kill THIS request,
+                # never the engine (see _fail)
+                log.exception("paged sequence %s failed", seq.seq_id)
+                self._fail(seq, e, out)
+        elif decoding:
+            self._decode_window(decoding, out)
         return out
 
+    def _fail(self, seq: _PagedSeq, e: Exception, out: List) -> None:
+        """Emit the typed failure for ONE lane and release it: a paged
+        failure must kill this request, never the engine — letting it
+        escape would hit step()'s catch-all, which errors every DENSE
+        sequence and never releases the paged lanes. Capacity pressure
+        is a retryable 503; a KvPageMiss (pin discipline violated — a
+        data-loss bug, not load) and anything unexpected are 500s with
+        distinct reasons so dashboards can tell them apart."""
+        from ...engine.engine import StepOutput
+
+        if isinstance(e, (OutOfBlocks, OutOfTierSpace)):
+            code, reason = 503, "kvpage_capacity"
+        elif isinstance(e, KvPageMiss):
+            code, reason = 500, "kvpage_miss"
+        else:
+            code, reason = 500, "kvpage_internal"
+        out.append(StepOutput(
+            seq.seq_id, seq.last_token, seq.cum_logprob,
+            FinishReason.ERROR,
+            error=f"paged serving failed: {e}", error_code=code,
+            error_stage="engine", error_reason=reason))
+        self._release(seq)
+
     # ------------------------------------------------------------------
-    def _start(self, seq_id: str, req: BackendInput) -> _PagedSeq:
+    def _start(self, lane: int, seq_id: str, req: BackendInput,
+               blocks: int) -> _PagedSeq:
         prompt = list(req.token_ids)
         lora_id = getattr(req, "lora_id", 0)
         seq = _PagedSeq(seq_id, req, prompt,
-                        TokenSequence(self.page, lora_id=lora_id))
+                        TokenSequence(self.page, lora_id=lora_id),
+                        lane=lane, reserve_blocks=blocks)
         # prefix reuse against the tier: pin matching leading blocks and
         # skip recomputing them — they are cold context from token 0
         page = self.page
@@ -314,24 +399,21 @@ class PagedEngine:
         self.core.prefix_hit_tokens += matched * page
         self.core.prefix_query_tokens += len(prompt)
 
-        # sampling state (lane-of-one mirrors of SamplingState)
+        # sampling state: this lane's row of the persistent [B] arrays
         sp = req.sampling
         from ...engine.sampling import STATIC_K
-        seq.temp = np.asarray([float(sp.temperature or 0.0)], np.float32)
-        seq.top_p = np.asarray(
-            [float(sp.top_p if sp.top_p is not None else 1.0)], np.float32)
-        seq.top_k = np.asarray([int(min(sp.top_k or 0, STATIC_K))],
-                               np.int32)
-        seq.freq_pen = np.asarray([float(sp.frequency_penalty or 0.0)],
-                                  np.float32)
-        seq.pres_pen = np.asarray([float(sp.presence_penalty or 0.0)],
-                                  np.float32)
-        seed = sp.seed if sp.seed is not None else self.core.cfg.seed
-        seq.key = jax.vmap(jax.random.key)(jnp.asarray([int(seed)]))
-        seq.counts = jnp.zeros((1, self.core.cfg.model.vocab_size),
-                               jnp.int32)
-        self.active = seq
-        self._set_gauges(seq)
+        self._temp[lane] = float(sp.temperature or 0.0)
+        self._top_p[lane] = float(sp.top_p if sp.top_p is not None
+                                  else 1.0)
+        self._top_k[lane] = int(min(sp.top_k or 0, STATIC_K))
+        self._freq[lane] = float(sp.frequency_penalty or 0.0)
+        self._pres[lane] = float(sp.presence_penalty or 0.0)
+        seq.seed = int(sp.seed if sp.seed is not None
+                       else self.core.cfg.seed)
+        self._keys = self._keys.at[lane].set(jax.random.key(seq.seed))
+        self._counts = self._counts.at[lane].set(0)
+        self.lanes[lane] = seq
+        self._set_gauges()
         return seq
 
     def _release(self, seq: _PagedSeq) -> None:
@@ -342,13 +424,13 @@ class PagedEngine:
         for h in seq.pinned:
             tiered.unpin(h)
         seq.pinned = []
-        if self.active is seq:
-            self.active = None
-        g = stage_metrics().kvpage_resident_bytes
-        g.set("device", self._worker, value=0.0)
-        g.set("host", self._worker, value=0.0)
+        seq.reserve_blocks = 0
+        if self.lanes[seq.lane] is seq:
+            self.lanes[seq.lane] = None
+        self.pager.end_lane(seq.lane)
+        self._set_gauges()
 
-    def _set_gauges(self, seq: _PagedSeq) -> None:
+    def _set_gauges(self) -> None:
         dev, host = self.resident_bytes()
         g = stage_metrics().kvpage_resident_bytes
         g.set("device", self._worker, value=dev)
@@ -396,14 +478,16 @@ class PagedEngine:
         del seq.resident[:n]
         seq.first_res += n
         stage_metrics().kvpage_demotions.inc(amount=float(n))
-        self._set_gauges(seq)
+        self._set_gauges()
 
-    def _cold_segments(self, seq: _PagedSeq) -> List[Tuple[int, ...]]:
+    def _cold_segments(self, seq: _PagedSeq
+                       ) -> List[Tuple[int, Tuple[int, ...]]]:
         """The demoted prefix [0, first_res) grouped into staging
-        segments of ``seg_pages`` blocks."""
+        segments of ``seg_pages`` blocks: (start block, block hashes)."""
         hashes = seq.pinned
         sp = self.pcfg.seg_pages
-        return [tuple(hashes[i:i + sp]) for i in range(0, len(hashes), sp)]
+        return [(i, tuple(hashes[i:i + sp]))
+                for i in range(0, len(hashes), sp)]
 
     # ------------------------------------------------------------------
     # forward
@@ -425,67 +509,148 @@ class PagedEngine:
             return
         self.core.goodput.account(flops, bytes_, elapsed_s, tokens)
 
-    def _upload(self, key) -> Tuple[jax.Array, jax.Array, jax.Array]:
-        """Take one assembled staging segment and ENQUEUE its h2d upload;
-        returns device arrays the attention dispatch consumes."""
-        k, v, n = self.pager.take(key)
-        dt = self.core.cfg.model.dtype
-        valid = np.arange(self.pcfg.seg_pages * self.page) < n * self.page
-        return (jnp.asarray(k, dt), jnp.asarray(v, dt), jnp.asarray(valid))
+    def _build_plans(self, parts, positions: np.ndarray
+                     ) -> Dict[int, List[List[Tuple[int, Tuple[int, ...]]]]]:
+        """Per-row per-layer cold plans for one forward (or one whole
+        decode window), installed with the pager per lane. Sliding
+        layers drop segments wholly below their window at the FIRST
+        query position — later window steps only move the window
+        forward, so a clamped-in segment is at worst an all-masked
+        exact no-op for them."""
+        prg = self.programs
+        L = self.core.cfg.model.num_layers
+        page = self.page
+        plans: Dict[int, List[List[Tuple[int, Tuple[int, ...]]]]] = {}
+        for row, seq in parts:
+            segs = self._cold_segments(seq)
+            if not segs:
+                continue
+            p0 = int(positions[row, 0])     # first query position
+            per_layer = []
+            for l in range(L):
+                w = prg.windows[l]
+                if w is None:
+                    per_layer.append(segs)
+                else:
+                    per_layer.append(
+                        [sg for sg in segs
+                         if (sg[0] + len(sg[1])) * page - 1 > p0 - w])
+            plans[row] = per_layer
+            self.pager.begin(
+                PageinPlan([[sg[1] for sg in pl] for pl in per_layer]),
+                lane=seq.lane)
+        return plans
 
-    def _forward(self, seq: _PagedSeq, tokens: np.ndarray,
-                 positions: np.ndarray, write_idx: np.ndarray,
-                 read_idx: np.ndarray, read_pos: np.ndarray,
-                 read_valid: np.ndarray) -> jax.Array:
-        """The segmented forward: per layer, qkv+write, hot partial
-        attention through the pool, cold segments merged one staged
-        upload at a time (next segment's upload enqueued before the
-        current segment's attention dispatches), then the layer tail."""
+    def _upload_batch(self, parts, plans, B: int, l: int, s: int,
+                      cache: Optional[Dict] = None):
+        """Take every lane's (layer, step) staging segment and stack
+        them into the SHARED [2, B, ...] staging slot (k over v: the
+        whole slot is ONE h2d transfer, plus one tiny [B, 2] meta array
+        the device rebuilds the validity/position mask from), then
+        ENQUEUE its upload; returns the device arrays the batched
+        attention dispatch consumes. Lanes with no segment at this step
+        ride along masked-invalid (stale/zero slot values are multiplied
+        by exactly 0.0 in the partial attend, so sharing the slot is
+        exact). Within a decode window the assembled host buffers are
+        ``cache``d: cold segments cannot change between the window's
+        steps, so only the first step pays the pager takes — later
+        steps re-upload the same host staging slots (device staging
+        stays double-buffer bounded either way)."""
+        key = (l, s)
+        if cache is not None and key in cache:
+            kv_st, meta_dev = cache[key]
+        else:
+            sp, page = self.pcfg.seg_pages, self.page
+            kv_st = None
+            meta = np.zeros((B, 2), np.int32)
+            for row, seq in parts:
+                pl = plans.get(row)
+                if pl is None or s >= len(pl[l]):
+                    continue
+                start_blk, _hashes = pl[l][s]
+                k, v, n = self.pager.take((l, s), lane=seq.lane)
+                if kv_st is None:
+                    kv_st = np.zeros((2, B) + k.shape, k.dtype)
+                kv_st[0, row] = k
+                kv_st[1, row] = v
+                meta[row] = (n, start_blk * page)
+            # meta is step-invariant: its device array rides the cache,
+            # so later window steps re-upload ONLY the kv slot
+            meta_dev = jnp.asarray(meta)
+            if cache is not None:
+                cache[key] = (kv_st, meta_dev)
+        dt = self.core.cfg.model.dtype
+        return jnp.asarray(kv_st, dt), meta_dev
+
+    def _forward(self, parts, B: int, tokens, positions: np.ndarray,
+                 write_idx: np.ndarray, read_idx: np.ndarray,
+                 read_pos: np.ndarray, read_valid: np.ndarray,
+                 plans=None, cache: Optional[Dict] = None) -> jax.Array:
+        """The segmented forward over ``parts`` = [(row, seq)]: per
+        layer, qkv+write, hot partial attention through the pool (every
+        lane in one dispatch), cold segments merged one lane-stacked
+        staged upload at a time — the next step's upload enqueued before
+        the current step's attention dispatches — then the layer tail.
+        Per-layer-class programs come from :attr:`PagedPrograms.
+        layer_programs`. ``plans``/``cache`` let a decode window build
+        its page-in plan and host staging buffers ONCE and reuse them
+        across all chained steps; a plain prefill call plans inline."""
         core = self.core
         prg = self.programs
         L = core.cfg.model.num_layers
-        cold = self._cold_segments(seq)
-        if cold:
-            self.pager.begin(PageinPlan([list(cold)] * L))
+        if plans is None:
+            plans = self._build_plans(parts, positions)
         x = prg.embed(core.params, jnp.asarray(tokens))
         for l in range(L):
             li = np.int32(l)
-            q, core.k_pool, core.v_pool = prg.qkv(
+            qkv_fn, hot_fn, cold_fn, _w = prg.layer_programs[l]
+            q, core.k_pool, core.v_pool = qkv_fn(
                 core.params, li, x, positions, core.k_pool, core.v_pool,
                 write_idx)
-            o, m, d = prg.attn_hot(q, li, core.k_pool, core.v_pool,
-                                   read_idx, read_pos, read_valid,
-                                   positions)
-            if cold:
-                nxt = self._upload((l, 0))
-                for s in range(len(cold)):
+            o, m, d = hot_fn(q, li, core.k_pool, core.v_pool,
+                             read_idx, read_pos, read_valid, positions)
+            steps = max((len(plans[row][l]) for row in plans), default=0)
+            if steps:
+                nxt = self._upload_batch(parts, plans, B, l, 0, cache)
+                for s in range(steps):
                     cur = nxt
-                    nxt = (self._upload((l, s + 1))
-                           if s + 1 < len(cold) else None)
-                    o, m, d = prg.attn_cold(q, cur[0], cur[1], cur[2],
-                                            o, m, d)
+                    nxt = (self._upload_batch(parts, plans, B, l, s + 1,
+                                              cache)
+                           if s + 1 < steps else None)
+                    o, m, d = cold_fn(q, positions, cur[0], cur[1],
+                                      o, m, d)
             x = prg.layer_out(core.params, li, x, o, m, d)
         return x
 
-    def _sample(self, seq: _PagedSeq, x: jax.Array,
-                last_i: int) -> Tuple[int, float]:
+    def _sample_row(self, seq: _PagedSeq, x: jax.Array,
+                    last_i: int) -> Tuple[int, float]:
+        """Sample ONE lane's token from a B=1 dispatch (the prefill
+        tail): the lane's sampling-state rows round-trip through a
+        single-row head, so the draw is identical to a batched one."""
         prg = self.programs
-        packed, seq.key, seq.counts = prg.head(
+        ln = seq.lane
+        # the counts row must be a COPY: head donates its counts arg,
+        # and a whole-array slice can alias the persistent buffer
+        crow_in = jnp.array(self._counts[ln:ln + 1])
+        packed, krow, crow = prg.head(
             self.core.params, x, np.asarray([last_i], np.int32),
-            seq.temp, seq.top_p, seq.top_k, seq.key, seq.counts,
-            seq.freq_pen, seq.pres_pen)
+            self._temp[ln:ln + 1], self._top_p[ln:ln + 1],
+            self._top_k[ln:ln + 1], self._keys[ln:ln + 1],
+            crow_in, self._freq[ln:ln + 1],
+            self._pres[ln:ln + 1], np.ones(1, bool))
+        self._keys = self._keys.at[ln].set(krow[0])
+        self._counts = self._counts.at[ln].set(crow[0])
         # dynalint: ok(host-sync) THE designed paged-lane fetch: one
-        # packed (token, logprob) pair per sampled token — the paged
-        # path is synchronous per token by design (stop conditions and
-        # the next feed depend on it)
+        # packed (token, logprob) pair for the prefill-tail sample — stop
+        # conditions and the first decode feed depend on it host-side
         arr = np.asarray(packed)
         return int(arr[0, 0]), float(arr[0, 1])
 
     # ------------------------------------------------------------------
-    def _hot_read(self, seq: _PagedSeq, upto: int, padded: int
-                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def _hot_row(self, seq: _PagedSeq, upto: int, padded: int
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(slots, positions, valid) of static width ``padded`` covering
-        the resident span [first_res*page, upto)."""
+        the resident span [first_res*page, upto) of one lane."""
         start = seq.first_res * self.page
         n = upto - start
         slots = np.zeros(padded, np.int32)
@@ -497,7 +662,7 @@ class PagedEngine:
                      + t % self.page)
         pos[:n] = t
         valid[:n] = True
-        return slots[None], pos[None], valid[None]
+        return slots, pos, valid
 
     def _prefill_chunk(self, seq: _PagedSeq, out: List) -> None:
         from ...engine.engine import StepOutput
@@ -516,9 +681,12 @@ class PagedEngine:
         write_idx[0, :count] = [self._slot(seq, p)
                                 for p in range(start, start + count)]
         S = self._bucket_hot(start + count - seq.first_res * self.page)
-        read_idx, read_pos, read_valid = self._hot_read(
+        read_idx = np.zeros((1, S), np.int32)
+        read_pos = np.zeros((1, S), np.int32)
+        read_valid = np.zeros((1, S), bool)
+        read_idx[0], read_pos[0], read_valid[0] = self._hot_row(
             seq, start + count, S)
-        x = self._forward(seq, tokens, positions, write_idx,
+        x = self._forward([(0, seq)], 1, tokens, positions, write_idx,
                           read_idx, read_pos, read_valid)
         for t in prompt[start:start + count]:
             seq.tokseq.append(int(t))
@@ -534,7 +702,7 @@ class PagedEngine:
             self._account("prefill", S, fl, by, tk,
                           time.perf_counter() - t_disp)
             return
-        tok, lp = self._sample(seq, x, count - 1)
+        tok, lp = self._sample_row(seq, x, count - 1)
         from ...utils.roofline import prefill_cost
 
         fl, by, tk = prefill_cost(self.core.costs, [(start, count)])
@@ -561,68 +729,132 @@ class PagedEngine:
         n = min(n, self.pcfg.max_context - len(seq.prompt) - seq.generated)
         return max(1, n)
 
-    def _decode_step(self, seq: _PagedSeq, out: List) -> None:
+    def _decode_window(self, parts, out: List) -> None:
+        """One chained decode window across every decode-ready lane:
+        N = min over lanes of their window bound, so no lane oversteps
+        its token budget; each window step samples one token PER LANE
+        from a single batched dispatch chain, with ONE packed host fetch
+        at the end."""
         from ...engine.engine import StepOutput
 
         t_disp = time.perf_counter()
-        N = self._window(seq)
-        pos0 = seq.total_len
-        # residency for the whole window up front: first_res (and thus
-        # every token's read/write indexing) stays fixed across the
-        # chained dispatches
-        self._ensure_resident(seq, pos0 + N)
-        if len(seq.resident) > self.pcfg.budget:
-            self._demote(seq, self.pcfg.budget - 1)
+        N = min(self._window(seq) for _, seq in parts)
+        B = self.batch
+        # per-lane residency setup: a failure here (device pool pressure)
+        # is lane-local — nothing shared has been touched yet, so only
+        # the starved lane errors and the window proceeds without it
+        ready = []
+        for row, seq in parts:
+            try:
+                self._ensure_resident(seq, seq.total_len + N)
+                if len(seq.resident) > self.lane_budget:
+                    self._demote(seq, self.lane_budget - 1)
+                ready.append((row, seq))
+            except Exception as e:  # noqa: BLE001 - typed per-lane error
+                log.exception("paged sequence %s failed", seq.seq_id)
+                self._fail(seq, e, out)
+        if not ready:
+            return
+        parts = ready
         prg = self.programs
+        active = np.zeros(B, bool)
+        tokens = np.zeros((B, 1), np.int32)
+        for row, seq in parts:
+            active[row] = True
+            tokens[row, 0] = seq.last_token
         packed_list: List[jax.Array] = []
-        tokens = np.asarray([[seq.last_token]], np.int32)
         S_max = 0
-        for i in range(N):
-            pos = pos0 + i
-            positions = np.asarray([[pos]], np.int32)
-            write_idx = np.asarray([[self._slot(seq, pos)]], np.int32)
-            S = self._bucket_hot(pos + 1 - seq.first_res * self.page)
-            S_max = max(S_max, S)
-            read_idx, read_pos, read_valid = self._hot_read(
-                seq, pos + 1, S)
-            x = self._forward(seq, tokens, positions, write_idx,
-                              read_idx, read_pos, read_valid)
-            packed, seq.key, seq.counts = prg.head(
-                self.core.params, x, np.asarray([0], np.int32),
-                seq.temp, seq.top_p, seq.top_k, seq.key, seq.counts,
-                seq.freq_pen, seq.pres_pen)
-            packed_list.append(packed)
-            # chain: the sampled token feeds the next forward ON DEVICE —
-            # no host round-trip between window steps
-            tokens = packed[:, 0:1].astype(jnp.int32)
-        # dynalint: ok(host-sync) THE designed paged-lane fetch, now one
-        # packed (token, logprob) batch per N-token window instead of per
-        # token — stop/stream detection runs host-side on the batch
-        arrs = [np.asarray(p) for p in packed_list]
+        try:
+            # one page-in plan + one set of assembled host staging
+            # buffers serves every chained step: cold segments cannot
+            # change inside the window (demotion happens at window
+            # boundaries), so steps 2..N skip the pager entirely
+            pos0 = np.zeros((B, 1), np.int32)
+            for row, seq in parts:
+                pos0[row, 0] = seq.total_len
+            plans = self._build_plans(parts, pos0)
+            cache: Dict[Tuple[int, int], Tuple] = {}
+            for i in range(N):
+                positions = np.zeros((B, 1), np.int32)
+                write_idx = np.zeros((B, 1), np.int32)  # pad -> scratch
+                S = self.page
+                for row, seq in parts:
+                    pos = seq.total_len + i
+                    positions[row, 0] = pos
+                    write_idx[row, 0] = self._slot(seq, pos)
+                    S = max(S, pos + 1 - seq.first_res * self.page)
+                S = self._bucket_hot(S)
+                S_max = max(S_max, S)
+                read_idx = np.zeros((B, S), np.int32)
+                read_pos = np.zeros((B, S), np.int32)
+                read_valid = np.zeros((B, S), bool)
+                for row, seq in parts:
+                    (read_idx[row], read_pos[row],
+                     read_valid[row]) = self._hot_row(
+                        seq, seq.total_len + i + 1, S)
+                x = self._forward(parts, B, tokens, positions, write_idx,
+                                  read_idx, read_pos, read_valid,
+                                  plans=plans, cache=cache)
+                packed, self._keys, self._counts = prg.head(
+                    self.core.params, x, np.zeros(B, np.int32),
+                    self._temp, self._top_p, self._top_k, self._keys,
+                    self._counts, self._freq, self._pres, active)
+                packed_list.append(packed)
+                # chain: each lane's sampled token feeds its next forward
+                # ON DEVICE — no host round-trip between window steps
+                tokens = packed[:, 0:1].astype(jnp.int32)
+            # dynalint: ok(host-sync) THE designed paged-lane fetch: one
+            # packed (token, logprob) [N, B, 2] batch per chained window,
+            # covering every lane at once — stop/stream detection runs
+            # host-side on the batch
+            arr = np.asarray(jnp.stack(packed_list))
+        except Exception as e:  # noqa: BLE001 - window-fatal
+            # a failure inside the batched dispatch chain (pager miss,
+            # device error) cannot be attributed to one lane once shared
+            # sampling state has advanced: the whole window faults and
+            # every PARTICIPATING lane gets the typed error. Lanes still
+            # prefilling are untouched — their sampling rows are rebuilt
+            # below because the donated counts buffer may be gone.
+            log.exception("paged decode window failed (%d lanes)",
+                          len(parts))
+            vocab = self.core.cfg.model.vocab_size
+            self._counts = jnp.zeros((B, vocab), jnp.int32)
+            self._keys = jax.random.split(
+                jax.random.key(int(self.core.cfg.seed)), B)
+            for row, seq in parts:
+                self._fail(seq, e, out)
+            for seq in self.lanes:     # surviving lanes: pre-first-sample
+                if seq is not None:
+                    self._keys = self._keys.at[seq.lane].set(
+                        jax.random.key(seq.seed))
+            return
         from ...utils.roofline import decode_cost
 
         fl = by = tk = 0.0
-        fin = None
-        for i, arr in enumerate(arrs):
-            seq.tokseq.append(int(seq.last_token))
-            seq.total_len = pos0 + i + 1
-            tok, lp = int(arr[0, 0]), float(arr[0, 1])
-            f, b, t = decode_cost(self.core.costs, [pos0 + i], 1)
-            fl, by, tk = fl + f, by + b, tk + t
-            seq.generated += 1
-            seq.last_token = tok
-            seq.cum_logprob += lp
-            fin = self._finish(seq, tok)
-            out.append(StepOutput(seq.seq_id, tok, seq.cum_logprob, fin,
-                                  token_logprob=lp))
+        for row, seq in parts:
+            fin = None
+            pos0 = seq.total_len
+            for i in range(N):
+                seq.tokseq.append(int(seq.last_token))
+                seq.total_len = pos0 + i + 1
+                tok, lp = int(arr[i, row, 0]), float(arr[i, row, 1])
+                f, b, t = decode_cost(self.core.costs, [pos0 + i], 1)
+                fl, by, tk = fl + f, by + b, tk + t
+                seq.generated += 1
+                seq.last_token = tok
+                seq.cum_logprob += lp
+                fin = self._finish(seq, tok)
+                out.append(StepOutput(seq.seq_id, tok, seq.cum_logprob,
+                                      fin, token_logprob=lp))
+                if fin is not None:
+                    # mid-window stop: this lane's tokens past it are
+                    # discarded; their page writes/sampler state die with
+                    # the release below (other lanes commit all N)
+                    break
             if fin is not None:
-                # mid-window stop: tokens past it are discarded; their
-                # page writes/sampler state die with the release below
-                break
+                self._release(seq)
         self._account("decode", S_max, fl, by, tk,
                       time.perf_counter() - t_disp)
-        if fin is not None:
-            self._release(seq)
 
     def _finish(self, seq: _PagedSeq, token: int) -> Optional[FinishReason]:
         req = seq.request

@@ -95,7 +95,11 @@ def _flash_kernel(qpos_ref, kpos_ref, kval_ref, q_ref, k_ref, v_ref, o_ref,
 
         mask = ((kp <= qp) & (kv > 0))[None]               # [1, BT, BS]
         if window is not None:
-            # sliding layers: keys within the last `window` positions
+            # sliding layers: keys within the last `window` positions.
+            # The paged lane's per-layer-class cold programs
+            # (llm/kvpage/programs.py) apply this same `kp > qp - window`
+            # rule to staged segments — the two must stay in lockstep or
+            # paged and dense forwards diverge on Gemma2/3-style models.
             mask = mask & (kp > qp - window)[None]
 
         m_prev = m_scr[:]

@@ -234,6 +234,12 @@ _ALL: List[Knob] = [
        "paged-lane decode tokens chained on-device per host fetch "
        "(sampled token feeds the next forward without a round-trip; "
        "1 = per-token synchronous as before)"),
+    _k("DYN_KVPAGE_BATCH", "int", "1", "kvpage",
+       "concurrent paged decode lanes sharing the device budget: each "
+       "lane gets budget/batch pages and one batched dispatch serves a "
+       "window step for every lane, with cold segments lane-stacked "
+       "into shared staging slots (engine-config kvpage_batch "
+       "overrides; 1 = the serial lane)"),
     # -------------------------------------------------------------- engine
     _k("DYN_PROFILE_DIR", "str", "", "engine",
        "capture an XLA profile of the first working iterations into "

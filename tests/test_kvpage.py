@@ -3,6 +3,11 @@
 - paged serving is token-identical to the dense path at >= 16x the
   device page budget, with zero steady-state decode faults (the ISSUE 12
   acceptance pin, at tiny geometry so it stays tier-1 cheap)
+- batched decode lanes (kvpage_batch=4): four concurrent paged
+  sequences are byte-identical to the serial lane AND the dense path,
+  including a sliding-window model config (the ISSUE 19 pin)
+- PageScheduler multi-lane interleave: a skewed lane cannot starve a
+  neighbour, per-lane prefetch double-buffering, fault isolation
 - typed 400/503 admission errors (over-length without paging, paged-lane
   capacity) carry {code, stage, reason} end to end
 - PageScheduler prefetch/fault/miss semantics
@@ -14,6 +19,7 @@
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -205,6 +211,185 @@ def test_kvpage_config_validation():
 
 
 # ---------------------------------------------------------------------------
+# batched decode lanes (ISSUE 19): B=4 byte-identical to serial + dense
+# ---------------------------------------------------------------------------
+BATCH = 4
+BMAX = 5                                # crosses a decode-window boundary
+# four distinct long prompts, every one over the dense max_context so
+# all of them route to the paged lane; lengths differ so lanes finish
+# prefill (and EOS their windows) at different times
+BPROMPTS = [[(i * 11 + 5 + 37 * j) % 251 for i in range(280 + 23 * j)]
+            for j in range(BATCH)]
+
+
+@pytest.fixture(scope="module")
+def batched_core():
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+
+    # 16 pages split four ways: each lane gets exactly the floor
+    # (chunk_pages + 2 = 4), so hot_keep=1 maximises cold traffic
+    core = EngineCore(JaxEngineConfig(
+        model=_model(), max_batch=2, max_context=128, page_size=PAGE,
+        prefill_chunk=32, decode_steps=4, host_cache_blocks=160,
+        kvpage_budget=16, kvpage_seg_pages=2, kvpage_prefetch=2,
+        kvpage_max_context=4096, kvpage_batch=4))
+    yield core
+    core.close()
+
+
+@pytest.fixture(scope="module")
+def bref_tokens():
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+
+    core = EngineCore(JaxEngineConfig(
+        model=_model(), max_batch=2, max_context=512, page_size=PAGE,
+        prefill_chunk=32, decode_steps=4, kvpage_budget=0))
+    try:
+        ref = []
+        for j, p in enumerate(BPROMPTS):
+            core.submit(f"ref{j}", _req(p, max_tokens=BMAX))
+            ref.append([so.token for so in _drain(core)])
+        return ref
+    finally:
+        core.close()
+
+
+def _drain_multi(core, seq_ids, n=60000):
+    """Drain until EVERY id finished; returns {seq_id: tokens} and the
+    peak number of simultaneously occupied lanes."""
+    toks = {s: [] for s in seq_ids}
+    done, peak = set(), 0
+    for _ in range(n):
+        for so in core.step():
+            assert so.error is None, so.error
+            toks[so.seq_id].append(so.token)
+            if so.finish is not None:
+                done.add(so.seq_id)
+        peak = max(peak, sum(s is not None for s in core.kvpager.lanes))
+        if done == set(seq_ids):
+            return toks, peak
+    raise AssertionError(f"never finished: {set(seq_ids) - done}")
+
+
+def test_batched_paged_token_identity(batched_core, bref_tokens,
+                                      paged_core):
+    """Four concurrent lanes sharing one device pool produce the exact
+    token streams of (a) the dense engine and (b) the serial paged lane
+    — batching is a scheduling change, not a numerics change."""
+    core = batched_core
+    ids = [f"b{j}" for j in range(BATCH)]
+    for j, sid in enumerate(ids):
+        core.submit(sid, _req(BPROMPTS[j], max_tokens=BMAX))
+    toks, peak = _drain_multi(core, ids)
+    assert peak == BATCH                  # genuinely concurrent, not queued
+    for j, sid in enumerate(ids):
+        assert toks[sid] == bref_tokens[j], f"lane {j} diverged from dense"
+    assert core.kvpager.pager.pageins > 0
+    assert all(s is None for s in core.kvpager.lanes)     # all released
+    assert core.tiered.pinned_count() == 0
+    # the serial lane (batch=1 engine) agrees too, per prompt
+    for j, p in enumerate(BPROMPTS):
+        paged_core.submit(f"s{j}", _req(p, max_tokens=BMAX))
+        serial = [so.token for so in _drain(paged_core)]
+        assert serial == bref_tokens[j], f"serial lane diverged on {j}"
+
+
+def test_batched_admission_reserves_queued_lanes(batched_core):
+    """The admission ledger counts blocks every admitted-but-unpinned
+    request will still pin: a second giant request is refused while the
+    first is only queued, not once its pins already landed."""
+    kp = batched_core.kvpager
+    host = batched_core.tiered.host
+    big = _req(BPROMPTS[0][:64], max_tokens=(host.num_blocks // 2) * PAGE)
+    assert kp.try_route("ra", big) is None          # queued, reserves ~1/2
+    so = kp.try_route("rb", big)                    # ledger says no
+    assert so is not None
+    assert (so.error_code, so.error_reason) == (503, "kvpage_capacity")
+    assert "reserved by admitted lanes" in so.error
+    kp.cancel("ra")                                 # reservation released
+    assert kp.try_route("rc", big) is None
+    kp.cancel("rc")
+
+
+def test_batched_lane_budget_validation():
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+
+    # 16 pages across 8 lanes = 2/lane < chunk_pages + 2: refused with
+    # the per-lane arithmetic spelled out, not an opaque crash later
+    with pytest.raises(ValueError, match="prefill chunk"):
+        EngineCore(JaxEngineConfig(
+            model=_model(), max_batch=1, max_context=128, page_size=PAGE,
+            prefill_chunk=32, host_cache_blocks=64,
+            kvpage_budget=16, kvpage_batch=8))
+
+
+def test_sliding_window_model_serves_paged():
+    """tiny-gemma2 (interleaved sliding-window layers) through the paged
+    lane, batched, token-identical to its dense forward: the per
+    layer-class compiled programs carry the window mask and the plan
+    clamp skips segments wholly below the window without changing a
+    token (the lifted ISSUE-12 exclusion)."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.engine import EngineCore, JaxEngineConfig
+    from dynamo_tpu.models import llama
+
+    mcfg = llama.preset("tiny-gemma2", max_position=2048,
+                        dtype=jnp.float32)
+    prompts = [[(i * 5 + 11 + 29 * j) % 251 for i in range(90 + 9 * j)]
+               for j in range(2)]
+    dense = EngineCore(JaxEngineConfig(
+        model=mcfg, max_batch=2, max_context=512, page_size=8,
+        prefill_chunk=16, decode_steps=4, kvpage_budget=0))
+    try:
+        ref = []
+        for j, p in enumerate(prompts):
+            dense.submit(f"d{j}", _req(p, max_tokens=4))
+            ref.append([so.token for so in _drain(dense)])
+    finally:
+        dense.close()
+    paged = EngineCore(JaxEngineConfig(
+        model=mcfg, max_batch=2, max_context=64, page_size=8,
+        prefill_chunk=16, decode_steps=4, host_cache_blocks=128,
+        kvpage_budget=8, kvpage_seg_pages=2, kvpage_prefetch=2,
+        kvpage_max_context=2048, kvpage_batch=2))
+    try:
+        # two layer classes compiled: (window=8, local-rope?) + full
+        assert len(paged.kvpager.programs.classes) == 2
+        ids = [f"g{j}" for j in range(2)]
+        for j, sid in enumerate(ids):
+            paged.submit(sid, _req(prompts[j], max_tokens=4))
+        toks, peak = _drain_multi(paged, ids)
+        assert peak == 2
+        for j, sid in enumerate(ids):
+            assert toks[sid] == ref[j], f"sliding lane {j} diverged"
+        assert paged.kvpager.pager.pageins > 0
+    finally:
+        paged.close()
+
+
+def test_paged_validate_lifts_sliding_and_dual_rope():
+    """Sliding-window and dual-base-rope presets are servable now; MoE
+    stays excluded (structure the segmented forward cannot express)."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.llm.kvpage.programs import PagedPrograms
+    from dynamo_tpu.models import llama
+
+    class _Cfg:
+        pp = sp = 1
+
+        def __init__(self, model):
+            self.model = model
+
+    for preset in ("tiny-gemma2", "tiny-gemma3"):
+        m = llama.preset(preset, dtype=jnp.float32)
+        assert PagedPrograms.validate(_Cfg(m)) is None, preset
+    moe = llama.preset("tiny-moe")
+    assert PagedPrograms.validate(_Cfg(moe)) is not None
+
+
+# ---------------------------------------------------------------------------
 # PageScheduler semantics
 # ---------------------------------------------------------------------------
 def _tier(blocks=8, seeds=()):
@@ -252,6 +437,100 @@ def test_pager_miss_is_fatal_not_silent():
         ps.begin(PageinPlan([[(99,)]]))
         with pytest.raises(KvPageMiss):
             ps.take((0, 0))
+    finally:
+        ps.close()
+
+
+def _wait(cond, timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def test_pager_interleaves_lanes_without_starvation():
+    """A skewed lane (16 segments vs 4) cannot starve its neighbour:
+    backpressure is per lane, so the assembler parks the big lane at its
+    prefetch ceiling and keeps serving the small one."""
+    from dynamo_tpu.llm.kvpage.pager import PageinPlan, PageScheduler
+
+    tier = _tier(blocks=32, seeds=[(h, float(h)) for h in range(1, 25)])
+    ps = PageScheduler(tier, seg_pages=2, prefetch=2)
+    try:
+        big = PageinPlan([[(h,) for h in range(1, 17)]])     # 16 segs
+        small = PageinPlan([[(h,) for h in range(21, 25)]])  # 4 segs
+        ps.begin(big, lane=0)
+        ps.begin(small, lane=1)
+        # nothing taken yet: both lanes stall at the double-buffer
+        # ceiling — the 16-segment lane claimed no more than the
+        # 4-segment one
+        assert _wait(lambda: ps._lanes[0].next == 2
+                     and ps._lanes[1].next == 2)
+        time.sleep(0.05)                       # would-be runaway window
+        assert ps._lanes[0].next == 2
+        # draining the small lane lets IT finish while the big lane is
+        # still held at its ceiling (no starvation in either direction)
+        for s in range(4):
+            k, v, n = ps.take((0, s), lane=1)
+            assert n == 1
+            np.testing.assert_array_equal(
+                k[0], np.full(BLK[1:], float(21 + s), np.float32))
+        assert _wait(lambda: ps._lanes[1].next == 4)
+        assert ps._lanes[0].next == 2
+        assert ps.faults == 0
+        # the claim log shows both lanes served before either finished
+        lanes_seen = {ln for ln, _ in list(ps.claim_log)[:4]}
+        assert lanes_seen == {0, 1}
+        for s in range(16):                    # big lane still completes
+            ps.take((0, s), lane=0)
+        assert ps.faults == 0 and ps.pageins == 20
+    finally:
+        ps.close()
+
+
+def test_pager_fault_isolated_to_faulting_lane():
+    """A missing cold block in one lane's plan raises KvPageMiss on THAT
+    lane's take; the neighbour's prefetched takes all succeed and the
+    faulting lane recovers with a fresh plan."""
+    from dynamo_tpu.llm.kvpage.pager import (KvPageMiss, PageinPlan,
+                                             PageScheduler)
+
+    tier = _tier(blocks=16, seeds=[(h, float(h)) for h in range(1, 7)])
+    ps = PageScheduler(tier, seg_pages=2, prefetch=2)
+    try:
+        ps.begin(PageinPlan([[(99,), (1,)]]), lane=0)   # 99: not in tier
+        ps.begin(PageinPlan([[(2,), (3,), (4,)]]), lane=1)
+        with pytest.raises(KvPageMiss):
+            ps.take((0, 0), lane=0)
+        for s in range(3):                     # neighbour unaffected
+            k, v, n = ps.take((0, s), lane=1)
+            np.testing.assert_array_equal(
+                k[0], np.full(BLK[1:], float(2 + s), np.float32))
+        # the faulting lane is not poisoned: a new plan serves fine
+        ps.begin(PageinPlan([[(5,), (6,)]]), lane=0)
+        k, _, _ = ps.take((0, 0), lane=0)
+        np.testing.assert_array_equal(
+            k[0], np.full(BLK[1:], 5.0, np.float32))
+        ps.take((0, 1), lane=0)
+    finally:
+        ps.close()
+
+
+def test_pager_end_lane_drops_state():
+    from dynamo_tpu.llm.kvpage.pager import (KvPageMiss, PageinPlan,
+                                             PageScheduler)
+
+    tier = _tier(seeds=[(1, 1.0)])
+    ps = PageScheduler(tier, seg_pages=2, prefetch=2)
+    try:
+        ps.begin(PageinPlan([[(1,)]]), lane=3)
+        assert _wait(lambda: ps._lanes[3].next == 1)
+        ps.end_lane(3)                          # sequence released
+        assert 3 not in ps._lanes
+        with pytest.raises(KvPageMiss):         # no plan -> typed miss
+            ps.take((0, 0), lane=3)
     finally:
         ps.close()
 
@@ -531,3 +810,20 @@ def test_long_context_bench_lane_smoke(tmp_path):
     assert r["checks"]["all_exact"]
     assert r["checks"]["zero_decode_faults"]
     assert (tmp_path / "long_context_2x.json").exists()
+
+
+def test_long_context_batch_lane_smoke(tmp_path):
+    """Tiny batched A/B: the lane itself asserts BOTH paged arms are
+    token-exact vs the dense reference; the smoke only pins the artifact
+    shape, never the timing-sensitive speedup number."""
+    import bench_system
+
+    r = bench_system.long_context_batch_lane(
+        batch=2, multiple=2, budget_pages=12, page_size=8, seg_pages=2,
+        max_tokens=4, rounds=1, sliding=False,
+        points_dir=str(tmp_path))
+    assert r["checks"]["all_exact"]
+    assert r["batch"] == 2 and r["rounds"] == 1
+    assert r["serial"]["decode_tok_s"] and r["batched"]["decode_tok_s"]
+    assert r["paged_kernel"]
+    assert (tmp_path / "long_context_batch.json").exists()
