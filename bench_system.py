@@ -1317,13 +1317,153 @@ def disagg_stream_lane(prompt_tokens: int = 4096, num_layers: int = 16,
 
 
 # ---------------------------------------------------------------------------
+# link_congestion lane: a throttled wire crosses the ledger's radar
+# ---------------------------------------------------------------------------
+
+def link_congestion_lane(layers: int = 4, tokens: int = 512,
+                         kv_heads: int = 2, head_dim: int = 16,
+                         window_s: float = 2.0, slow_streams: int = 2,
+                         part_delay_ms: float = 300.0,
+                         points_dir: str = "bench_points") -> Dict[str, Any]:
+    """Byte-flow ledger detection lane (ISSUE-20): two donor->decode KV
+    streams through the REAL receive path (KvReceiver.handler, buffered
+    assembly), one throttled by per-part wire pacing and one unthrottled,
+    under the measured-peak capacity fallback. The throttled pair stays
+    busy the whole ``DYN_LINK_WINDOW`` so its window rate rides its own
+    peak — ``dyn_link_saturation`` pegs and a ``link.congested`` rising
+    edge lands in the counter AND the flight-recorder ring; the fast
+    pair moves the same bytes in a burst far below its peak and stays
+    quiet. The fold every surface shares (``flows_from_states``) must
+    show the congested link, and the fast arm's assembled arrays must
+    equal the donor's (the wire itself is byte-exact)."""
+    import asyncio
+
+    import numpy as np
+
+    from dynamo_tpu.llm.kv_transfer import KvReceiver
+    from dynamo_tpu.obs import flightrec
+    from dynamo_tpu.obs.flows import flows_from_states, link_name
+    from dynamo_tpu.runtime.component import StreamingRequest
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.utils.prometheus import stage_metrics
+
+    stage = stage_metrics()
+    rng = np.random.default_rng(20)
+    k = rng.standard_normal((layers, tokens, kv_heads, head_dim),
+                            dtype=np.float32)
+    v = rng.standard_normal((layers, tokens, kv_heads, head_dim),
+                            dtype=np.float32)
+    stream_bytes = int(k.nbytes + v.nbytes)
+    dst = f"{0xfa:x}"
+    arms = {"slow": {"src": "slowdonor", "delay": part_delay_ms / 1e3,
+                     "streams": slow_streams},
+            "fast": {"src": "fastdonor", "delay": 0.0, "streams": 1}}
+    ev0 = sum(1 for e in flightrec.flight_recorder().events.snapshot()
+              if e.get("kind") == "link.congested")
+    cong0 = {a: stage.link_congested.get(link_name(c["src"], dst))
+             for a, c in arms.items()}
+
+    async def run_lane() -> Dict[str, Any]:
+        rec = KvReceiver(worker_id=0xfa)
+        out: Dict[str, Any] = {}
+        for arm, c in arms.items():
+            async def paced_parts(delay=c["delay"]):
+                for layer in range(layers):
+                    for arr in (k[layer], v[layer]):
+                        if delay:
+                            await asyncio.sleep(delay)
+                        yield arr.tobytes()
+
+            for i in range(c["streams"]):
+                rid = f"link-{arm}-{i}"
+                meta = {"request_id": rid, "first_token": 1,
+                        "first_logprob": 0.0, "layers": layers,
+                        "tokens": tokens, "kv_heads": kv_heads,
+                        "head_dim": head_dim, "dtype": "float32",
+                        "src": c["src"]}
+                fut = rec.expect(rid)
+                t0 = time.perf_counter()
+
+                async def pump():
+                    async for _ in rec.handler(
+                            StreamingRequest(meta, paced_parts()),
+                            Context()):
+                        pass
+                pump_task = asyncio.ensure_future(pump())
+                kk, vv, _tok, _logp = await fut
+                await pump_task
+                elapsed = time.perf_counter() - t0
+            out[arm] = {
+                "streams": c["streams"],
+                "stream_bytes": stream_bytes,
+                "last_stream_s": round(elapsed, 4),
+                "wire_exact": bool(np.array_equal(kk, k)
+                                   and np.array_equal(vv, v)),
+                "saturation": round(stage.link_saturation.get(
+                    link_name(c["src"], dst)), 4),
+                "congested": int(stage.link_congested.get(
+                    link_name(c["src"], dst)) - cong0[arm]),
+            }
+        return out
+
+    os.environ["DYN_LINK_WINDOW"] = str(window_s)
+    try:
+        measured = asyncio.run(run_lane())
+    finally:
+        os.environ.pop("DYN_LINK_WINDOW", None)
+
+    edge_events = [
+        e for e in flightrec.flight_recorder().events.snapshot()
+        if e.get("kind") == "link.congested"][ev0:]
+    folded = flows_from_states([("bench", stage.registry.state_dump())])
+    slow_link = next((e for e in folded
+                      if (e["src"], e["dst"]) == ("slowdonor", dst)), {})
+    out: Dict[str, Any] = {
+        "workload": {"layers": layers, "tokens": tokens,
+                     "kv_heads": kv_heads, "head_dim": head_dim,
+                     "window_s": window_s,
+                     "part_delay_ms": part_delay_ms},
+        "arms": measured,
+        "flightrec_edges": [
+            {"link": e.get("link"), "sat": e.get("sat"),
+             "bw": e.get("bw"), "cap": e.get("cap")}
+            for e in edge_events],
+        "folded_slow_link": slow_link,
+    }
+    out["checks"] = {
+        "slow_congested": measured["slow"]["congested"] >= 1,
+        "slow_saturation": measured["slow"]["saturation"],
+        "slow_saturated": measured["slow"]["saturation"] >= 0.9,
+        "fast_clean": (measured["fast"]["congested"] == 0
+                       and measured["fast"]["saturation"] < 0.5),
+        "edge_in_flightrec": any(
+            e.get("link") == link_name("slowdonor", dst)
+            for e in edge_events),
+        "fold_shows_congestion": bool(slow_link.get("congested", 0) >= 1),
+        "wire_exact": (measured["slow"]["wire_exact"]
+                       and measured["fast"]["wire_exact"]),
+    }
+    os.makedirs(points_dir, exist_ok=True)
+    with open(os.path.join(points_dir, "link_congestion.json"),
+              "w") as f:
+        json.dump(out, f, indent=2)
+    # acceptance: the throttled link is detected on every surface the
+    # ledger feeds, the unthrottled one stays quiet, the wire is exact
+    for gate in ("slow_congested", "slow_saturated", "fast_clean",
+                 "edge_in_flightrec", "fold_shows_congestion",
+                 "wire_exact"):
+        assert out["checks"][gate], out["checks"]
+    return out
+
+
+# ---------------------------------------------------------------------------
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--pairs", default="routing,disagg,kv_cluster",
                     help="comma list: routing, disagg, kv_cluster, "
                          "long_context, long_context_batch, "
-                         "disagg_stream")
+                         "disagg_stream, link_congestion")
     ap.add_argument("--requests", type=int, default=100)
     ap.add_argument("--json", dest="json_out", default=None)
     args = ap.parse_args()
@@ -1347,6 +1487,8 @@ def main() -> None:
         out["long_context_batch"] = long_context_batch_lane()
     if "disagg_stream" in pairs:
         out["disagg_stream"] = disagg_stream_lane()
+    if "link_congestion" in pairs:
+        out["link_congestion"] = link_congestion_lane()
     if "disagg" in pairs:
         out["disagg"] = disagg_ab()
         if "skipped" not in out["disagg"]:

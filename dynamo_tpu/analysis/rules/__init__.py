@@ -9,6 +9,7 @@ lives in its own module; adding a rule = adding a module here with a
 from . import await_lock          # noqa: F401
 from . import blocking_async      # noqa: F401
 from . import fire_forget         # noqa: F401
+from . import flow_accounting     # noqa: F401
 from . import host_sync           # noqa: F401
 from . import knob_drift          # noqa: F401
 from . import lock_discipline     # noqa: F401

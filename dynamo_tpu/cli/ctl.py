@@ -121,6 +121,19 @@ def parse_args(argv=None):
     iexp.add_argument("-o", "--out", default=None,
                       help="output file (default <incident_id>.json); "
                            "feed to `tracectl --bundle`")
+
+    # byte-flow ledger: the per-link matrix every worker publishes
+    fl = sub.add_parser("flows",
+                        help="cluster byte-flow ledger: per-link bytes, "
+                             "bandwidth and saturation, hottest first")
+    fl.add_argument("--namespace", default="dynamo")
+    fl.add_argument("--limit", type=int, default=0,
+                    help="show at most N links (0 = all)")
+    fl.add_argument("--kind", default=None,
+                    help="only links that moved this flow kind "
+                         "(e.g. disagg_push, kvpage_pagein)")
+    fl.add_argument("--json", action="store_true", dest="as_json",
+                    help="raw JSON instead of the table")
     return p.parse_args(argv)
 
 
@@ -153,6 +166,8 @@ async def run(args) -> int:
     try:
         if args.plane == "incident":
             return await run_incident(store, args)
+        if args.plane == "flows":
+            return await run_flows(store, args)
         if args.plane == "fleet":
             from ..fleet.registry import (FleetModelSpec, fetch_fleet_status,
                                           list_fleet_models,
@@ -255,6 +270,40 @@ async def run(args) -> int:
         return 0
     finally:
         await store.close()
+
+
+async def run_flows(store, args) -> int:
+    """Fold every worker's published stage dump into the cluster's
+    per-link byte-flow matrix — the same data `dyntop` renders as
+    ``links:`` and the frontend serves at ``GET /v1/flows``."""
+    from ..llm.metrics_aggregator import fetch_stage_states
+    from ..obs.flows import flows_from_states, fmt_bytes
+
+    states = await fetch_stage_states(store, args.namespace)
+    links = flows_from_states(states)
+    if args.kind:
+        links = [e for e in links if args.kind in (e.get("kinds") or {})]
+    if args.limit > 0:
+        links = links[:args.limit]
+    if args.as_json:
+        print(json.dumps({"links": links, "count": len(links)},
+                         indent=1, sort_keys=True))
+        return 0
+    if not links:
+        print(f"(no flows published in {args.namespace!r})")
+        return 0
+    print(f"{'link':<28} {'bytes':>10} {'bw':>12} {'sat':>6} "
+          f"{'cong':>5}  kinds")
+    for e in links:
+        kinds = " ".join(
+            f"{k}={fmt_bytes(v)}" for k, v in sorted(
+                (e.get("kinds") or {}).items(), key=lambda kv: -kv[1]))
+        print(f"{e['src'] + '>' + e['dst']:<28} "
+              f"{fmt_bytes(float(e.get('bytes') or 0)):>10} "
+              f"{float(e.get('bw') or 0.0) / 1e6:>10.1f}MB "
+              f"{float(e.get('saturation') or 0.0):>6.2f} "
+              f"{int(e.get('congested') or 0):>5}  {kinds}")
+    return 0
 
 
 async def run_incident(store, args) -> int:

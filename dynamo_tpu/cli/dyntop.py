@@ -210,9 +210,12 @@ class ClusterSnapshotter:
             "shed_total": shed_totals(states),
             "admission_depth": admission_depth_total(states),
         }
+        from ..obs.flows import flows_from_states
+
         return {
             "cluster": cluster_kv_totals(states),
             "transfer": transfer_totals(states),
+            "links": flows_from_states(states),
             "paging": kvpage_totals(states),
             "fleet": fleet,
             "at": time.time(),
@@ -524,9 +527,15 @@ def render(snap: Dict, store_detail: bool = False) -> str:
             f"peer_hits={int(cl.get('hits', 0))}  "
             f"fetches={int(cl.get('fetches', 0))}  "
             f"fallbacks={int(cl.get('fallbacks', 0))}")
+    # links: the byte-flow ledger's top-talker matrix. The summary line
+    # absorbs the old transfer: line's counters; per-link rows render
+    # only when workers actually publish flows (older workers without a
+    # ledger degrade to the summary alone — absent entirely when nothing
+    # has moved, never a crash).
     tr = snap.get("transfer") or {}
-    if any(tr.values()):
-        line = (f"transfer: moved={tr.get('bytes', 0.0) / 1e6:.0f}MB  "
+    links = snap.get("links") or []
+    if any(tr.values()) or links:
+        line = (f"links: moved={tr.get('bytes', 0.0) / 1e6:.0f}MB  "
                 f"streamed={int(tr.get('stream_ingests', 0))}  "
                 f"stream_fallbacks={int(tr.get('stream_fallbacks', 0))}  "
                 f"prefetch_hits={int(tr.get('prefetch_hits', 0))}  "
@@ -536,6 +545,23 @@ def render(snap: Dict, store_detail: bool = False) -> str:
                      f"bw={tr.get('bw_min', 0.0) / 1e6:.0f}"
                      f"..{tr.get('bw_max', 0.0) / 1e6:.0f}MB/s")
         lines.append(line)
+        if links:
+            from ..obs.flows import fmt_bytes
+
+            lines.append(
+                f"  {'link':<24} {'bytes':>9} {'bw':>10} {'sat':>6} "
+                f"kinds")
+            for e in links[:6]:
+                sat = float(e.get("saturation") or 0.0)
+                flag = "!" if e.get("congested") else ""
+                kinds = ",".join(sorted(
+                    e.get("kinds") or {},
+                    key=lambda k: -e["kinds"][k])[:3])
+                lines.append(
+                    f"  {e['src'] + '>' + e['dst']:<24} "
+                    f"{fmt_bytes(float(e.get('bytes') or 0)):>9} "
+                    f"{float(e.get('bw') or 0.0) / 1e6:>8.1f}MB "
+                    f"{sat:>5.2f}{flag:<1} {kinds}")
     pg = snap.get("paging") or {}
     if any(pg.values()):
         lines.append(

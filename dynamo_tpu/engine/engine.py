@@ -67,6 +67,8 @@ def global_put(host_array, sharding) -> jax.Array:
     with the same host data)."""
     if all(d.process_index == jax.process_index()
            for d in sharding.device_set):
+        # dynalint: ok(flow-accounting) primitive wrapper — callers meter
+        # the tree-level flow (cold weight load, swap slab stream)
         return jax.device_put(host_array, sharding)
     return jax.make_array_from_callback(
         host_array.shape, sharding,
@@ -283,6 +285,10 @@ class EngineCore:
         else:
             params = llama.init_params(m, jax.random.PRNGKey(cfg.seed))
             self.params = jax.tree.map(
+                # dynalint: ok(flow-accounting) random-init placement (no
+                # checkpoint): init_params already materialized on device,
+                # the put is a resharding — checkpoint loads meter in the
+                # loader
                 lambda a, s: global_put(a, s), params, shardings)
 
         # --- vision tower (Gemma3 VLM) --------------------------------
@@ -1389,8 +1395,12 @@ class EngineCore:
         buf = list(dict.fromkeys(self._evict_buf + self._writethrough_buf))
         self._evict_buf, self._writethrough_buf = [], []
         pages = [p for _, p in buf]
+        t0 = time.perf_counter()
         k, v = self.copy_stream.d2h_pages(self.k_pool, self.v_pool, pages,
                                           pipeline=len(pages) > 4)
+        from ..obs.flows import record_flow
+        record_flow("d2h_writethrough", k.nbytes + v.nbytes,
+                    time.perf_counter() - t0)
         for i, (seq_hash, _) in enumerate(buf):
             self.tiered.offload(seq_hash, k[i], v[i])
 
@@ -1415,6 +1425,8 @@ class EngineCore:
             return 0
         dt = self.cfg.model.dtype
         staged = 0
+        nbytes = 0
+        t0 = time.perf_counter()
         for h in compute_seq_hashes(list(token_ids), self.page_size,
                                     lora_id=lora_id):
             if self.pool.blocks.contains(h):
@@ -1429,6 +1441,7 @@ class EngineCore:
             # overlapping the queue wait instead of gating first prefill
             k_dev = jnp.asarray(kv[0], dt)
             v_dev = jnp.asarray(kv[1], dt)
+            nbytes += kv[0].nbytes + kv[1].nbytes
             with self._h2d_stage_lock:
                 while len(self._h2d_stage) >= cap:
                     self._h2d_stage.pop(next(iter(self._h2d_stage)))
@@ -1441,6 +1454,10 @@ class EngineCore:
             staged += 1
             if staged >= cap:
                 break
+        if staged:
+            from ..obs.flows import record_flow
+            record_flow("h2d_prefetch", nbytes,
+                        time.perf_counter() - t0)
         return staged
 
     def _restore_prefix(self, seq_id: str, prompt: List[int]) -> int:
@@ -1480,8 +1497,12 @@ class EngineCore:
                 pages = [p for _, p in host_up]
                 ks = np.stack([fetched[h][0] for h, _ in host_up])
                 vs = np.stack([fetched[h][1] for h, _ in host_up])
+                t0 = time.perf_counter()
                 self.k_pool, self.v_pool = self.copy_stream.h2d_pages(
                     self.k_pool, self.v_pool, pages, ks, vs)
+                from ..obs.flows import record_flow
+                record_flow("h2d_prefetch", ks.nbytes + vs.nbytes,
+                            time.perf_counter() - t0, trace_id=seq_id)
                 stalls = 0
                 with self._h2d_stage_lock:
                     for h, _ in host_up:

@@ -14,6 +14,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import time
 from typing import Any, Dict
 
 import jax
@@ -134,8 +135,18 @@ def load_llama_params(path: str, cfg: LlamaConfig,
                       shardings: Dict[str, Any]) -> Dict[str, Any]:
     params = load_llama_params_host(path, cfg)
     from .engine import global_put
+    from ..obs.flows import record_flow
 
-    return jax.tree.map(lambda a, s: global_put(a, s), params, shardings)
+    t0 = time.perf_counter()
+    placed = jax.tree.map(lambda a, s: global_put(a, s), params, shardings)
+    # one flow for the whole cold load: puts are enqueued async, so this
+    # meters the enqueue wall-time, not the device copy — the swap path's
+    # barrier-bounded record is the honest h2d rate
+    record_flow("weight_prefetch",
+                sum(int(np.asarray(a).nbytes)
+                    for a in jax.tree.leaves(params)),
+                time.perf_counter() - t0)
+    return placed
 
 
 def save_llama_params(path: str, params: Dict[str, Any], cfg: LlamaConfig) -> None:

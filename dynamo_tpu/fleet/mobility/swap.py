@@ -136,14 +136,16 @@ def hot_swap(core, host_params, new_cfg, group_layers: Optional[int] = None
 
     L = core.cfg.model.num_layers
     groups = 0
+    slab_bytes = 0
     params = core.params
     layered = (core.cfg.pp == 1)
 
     def rewrite(old_leaf, path):
-        nonlocal groups
+        nonlocal groups, slab_bytes
         src = np.asarray(new_flat[path])
         if src.dtype != old_leaf.dtype:
             src = src.astype(old_leaf.dtype)
+        slab_bytes += src.nbytes
         if layered and path and path[0] == "layers" \
                 and old_leaf.shape[0] == L and L > group_layers:
             buf = old_leaf
@@ -160,6 +162,7 @@ def hot_swap(core, host_params, new_cfg, group_layers: Optional[int] = None
 
     import jax
 
+    t_enq = time.monotonic()
     flat_old, treedef = jax.tree_util.tree_flatten_with_path(params)
     new_leaves = [
         rewrite(leaf,
@@ -189,6 +192,10 @@ def hot_swap(core, host_params, new_cfg, group_layers: Optional[int] = None
     # dynalint: ok(host-sync) swap cutover barrier — blocks once per
     # model swap (the wake path's h2d stream), never on a request
     jax.block_until_ready(jax.tree.leaves(new_params))
+    # one flow for the whole weight stream: the barrier bounds it, so
+    # bytes/seconds is the swap's real h2d rate
+    from ...obs.flows import record_flow
+    record_flow("swap_slab", slab_bytes, time.monotonic() - t_enq)
     core.params = new_params
     core.cfg = dataclasses.replace(
         core.cfg, params_path=getattr(new_cfg, "params_path", None),

@@ -16,6 +16,7 @@ from __future__ import annotations
 import collections
 import logging
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -167,6 +168,7 @@ class WeightCache:
         got = self.get(path)
         if got is not None:
             return got
+        t0 = time.perf_counter()
         try:
             tree = self._loader(path, cfg)
         except Exception:  # noqa: BLE001 - a bad checkpoint must not raise
@@ -174,6 +176,11 @@ class WeightCache:
             self.load_errors += 1
             return None
         self.loads += 1
+        # the checkpoint read is a disk->host flow: weight prefetch shows
+        # up on the same link telemetry every other byte movement uses
+        from ...obs.flows import record_flow
+        record_flow("weight_prefetch", tree_nbytes(tree),
+                    time.perf_counter() - t0)
         self.put(path, tree)
         return tree
 

@@ -146,6 +146,7 @@ class HttpService:
         app.router.add_get("/v1/router/decisions", self._router_decisions)
         app.router.add_get("/v1/incidents", self._list_incidents)
         app.router.add_get("/v1/incidents/{incident_id}", self._get_incident)
+        app.router.add_get("/v1/flows", self._list_flows)
         app.router.add_get("/health", self._health)
         app.router.add_get("/metrics", self._metrics)
         return app
@@ -246,6 +247,32 @@ class HttpService:
                              "instance, or none discovered yet)")
         return web.json_response({"decisions": decisions,
                                   "count": len(decisions)})
+
+    async def _list_flows(self, req: web.Request) -> web.Response:
+        """The cluster's byte-flow ledger: per-link totals folded from
+        every worker's published stage dump (plus this process's own),
+        hottest link first — the same matrix ``dyntop`` renders as
+        ``links:`` and ``ctl flows`` prints."""
+        from ..obs.flows import flows_from_states
+
+        try:
+            limit = int(req.query.get("limit", "0"))
+        except ValueError:
+            return _err(400, "limit must be an integer")
+        states = [("http", self.stage.registry.state_dump())]
+        if self.store is not None:
+            try:
+                from .metrics_aggregator import fetch_stage_states
+
+                states += await fetch_stage_states(
+                    self.store, self.namespace,
+                    exclude_worker=self.stage_worker_id)
+            except Exception:
+                log.exception("stage dump scrape for /v1/flows failed")
+        links = flows_from_states(states)
+        if limit > 0:
+            links = links[:limit]
+        return web.json_response({"links": links, "count": len(links)})
 
     async def _list_incidents(self, _req: web.Request) -> web.Response:
         """Live incident beacons (flight-recorder capture coordination) —

@@ -25,12 +25,14 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import AsyncIterator, List, Optional, Sequence, Tuple
 
 import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
 import numpy as np
 
+from ...obs.flows import record_flow
 from ...runtime import deadline as dl
 from ...runtime.engine import Context
 from ...utils.knobs import env_float
@@ -49,11 +51,17 @@ def max_fetch_blocks() -> int:
     return int(env_float("DYN_KV_CLUSTER_MAX_BLOCKS", 0, minimum=0.0))
 
 
-def make_kv_fetch_handler(tiered):
-    """Donor endpoint handler over a :class:`TieredKvCache`."""
+def make_kv_fetch_handler(tiered, worker_id: int = 0):
+    """Donor endpoint handler over a :class:`TieredKvCache`.
+    ``worker_id`` is the donor's own lease id — the ledger's src
+    endpoint for the bytes this handler puts on the wire."""
+    src = f"{worker_id:x}" if worker_id else str(os.getpid())
 
     async def handler(request, ctx: Context) -> AsyncIterator:
         hashes = [int(h) for h in (request or {}).get("hashes", [])]
+        # receiver identity rides the request so the donor's tx flow
+        # names the pair it served (absent on old callers -> "q")
+        receiver = str((request or {}).get("receiver") or "q")
         cap = max_fetch_blocks()
         if cap:
             hashes = hashes[:cap]
@@ -81,9 +89,11 @@ def make_kv_fetch_handler(tiered):
                 nbytes += len(part)
                 yield part
         stage = stage_metrics()
-        stage.kv_transfer.observe("cluster_send",
-                                  value=time.monotonic() - t0)
+        elapsed = time.monotonic() - t0
+        stage.kv_transfer.observe("cluster_send", value=elapsed)
         stage.kv_transfer_bytes.inc("cluster_send", amount=nbytes)
+        record_flow("kv_fetch_tx", nbytes, elapsed,
+                    src=src, dst=receiver)
 
     return handler
 
@@ -103,7 +113,7 @@ async def fetch_prefix(client, donor_id: int, hashes: Sequence[int],
     implementation both receive paths share, and the observed
     (donor → this worker) bandwidth feeds the router's per-pair
     transfer-cost estimate."""
-    from ..kv_transfer import LayerStream, observe_pair_bw
+    from ..kv_transfer import LayerStream
 
     stage = stage_metrics()
     t0 = time.monotonic()
@@ -114,8 +124,10 @@ async def fetch_prefix(client, donor_id: int, hashes: Sequence[int],
     async with get_tracer().span("kv_cluster.fetch",
                                  donor=f"{donor_id:x}",
                                  blocks_requested=len(hashes)):
-        async for item in client.generate({"hashes": list(hashes)},
-                                          context, mode="direct",
+        req = {"hashes": list(hashes)}
+        if receiver_id:
+            req["receiver"] = f"{receiver_id:x}"
+        async for item in client.generate(req, context, mode="direct",
                                           instance_id=donor_id):
             if meta is None:
                 meta = item
@@ -149,9 +161,11 @@ async def fetch_prefix(client, donor_id: int, hashes: Sequence[int],
     stage.kv_transfer.observe("cluster_recv", value=elapsed)
     stage.kv_transfer_bytes.inc("cluster_recv", amount=nbytes)
     stage.kv_cluster_fetch_seconds.observe(value=elapsed)
-    observe_pair_bw(f"{donor_id:x}",
-                    f"{receiver_id:x}" if receiver_id else "0",
-                    nbytes, elapsed)
+    # ledger feeds observe_pair_bw itself: cluster-fetch traffic prices
+    # the (donor -> receiver) pair exactly like disagg streams do
+    record_flow("kv_fetch_rx", nbytes, elapsed, src=f"{donor_id:x}",
+                dst=f"{receiver_id:x}" if receiver_id else "0",
+                trace_id=context.id if context is not None else None)
     return out
 
 

@@ -252,6 +252,9 @@ class ClusterOverlap:
     pair_weight: Optional[Any] = None
     #: (src_wid, dst_wid, blocks) -> expected transfer seconds
     pair_seconds: Optional[Any] = None
+    #: (src_wid, dst_wid) -> ledger provenance of the bandwidth behind
+    #: the charged transfer term ("pair"|"into_dst"|"fleet"|"default")
+    pair_source: Optional[Any] = None
 
     @property
     def blocks(self) -> int:
@@ -268,6 +271,13 @@ class ClusterOverlap:
         if self.pair_seconds is not None and dst is not None:
             return float(self.pair_seconds(src, dst, blocks))
         return 0.0
+
+    def source_for(self, src: int, dst: Optional[int]) -> str:
+        """Ledger provenance of the bandwidth the charged transfer term
+        was priced from ('' without an armed cost model)."""
+        if self.pair_source is not None and dst is not None:
+            return str(self.pair_source(src, dst))
+        return ""
 
     def donor_for(self, worker_id: Optional[int], local_blocks: int
                   ) -> Tuple[Optional[int], int]:
@@ -434,21 +444,31 @@ class TransferCostModel:
             return None
         return wid if isinstance(wid, str) else f"{wid:x}"
 
-    def bandwidth(self, src=None, dst=None) -> float:
-        """Best-informed bytes/s for a (src, dst) movement: the exact
-        pair's EWMA; else the mean of observed pairs INTO ``dst`` (a
-        disagg push's source is the anonymous prefill pool); else the
-        fleet-wide rate; else the optimistic default."""
+    def bandwidth_info(self, src=None, dst=None) -> Tuple[float, str]:
+        """Best-informed bytes/s for a (src, dst) movement plus its
+        ledger provenance: ``"pair"`` (the exact pair's EWMA — fed by
+        every flow kind the byte-flow ledger records over that pair),
+        ``"into_dst"`` (mean of observed pairs INTO ``dst``; a disagg
+        push's source is the anonymous prefill pool), ``"fleet"`` (the
+        fleet-wide differentiated rate) or ``"default"`` (nothing
+        measured yet). The provenance string is stamped into the
+        router's decision ring so a charged transfer term is auditable
+        back to what the ledger had actually seen."""
         s, d = self._hex(src), self._hex(dst)
         if s is not None and d is not None:
             bw = self.pair_bw.get((s, d))
             if bw:
-                return bw
+                return bw, "pair"
         if d is not None:
             into = [bw for (_, dk), bw in self.pair_bw.items() if dk == d]
             if into:
-                return sum(into) / len(into)
-        return self.bytes_per_s or self.DEFAULT_BYTES_PER_S
+                return sum(into) / len(into), "into_dst"
+        if self.bytes_per_s:
+            return self.bytes_per_s, "fleet"
+        return self.DEFAULT_BYTES_PER_S, "default"
+
+    def bandwidth(self, src=None, dst=None) -> float:
+        return self.bandwidth_info(src, dst)[0]
 
     def estimate_seconds(self, blocks: int, block_bytes: int,
                          src=None, dst=None) -> float:

@@ -82,7 +82,8 @@ class KvClusterWorker:
                         "disabled on this worker")
             return None
         endpoint = component.endpoint(KV_FETCH_ENDPOINT)
-        await endpoint.serve(make_kv_fetch_handler(core.tiered))
+        await endpoint.serve(make_kv_fetch_handler(
+            core.tiered, worker_id=drt.worker_id))
         publisher = await KvClusterPublisher(
             drt.store, namespace, component.name, drt.worker_id, drt.lease,
             core.tiered, interval=publish_interval).start()

@@ -181,6 +181,8 @@ class KvRouterService:
             blocks, _bb(src), src=src, dst=dst)
         ov.pair_seconds = lambda src, dst, blocks: cm.estimate_seconds(
             blocks, _bb(src), src=src, dst=dst)
+        ov.pair_source = lambda src, dst: cm.bandwidth_info(
+            src=src, dst=dst)[1]
         return ov
 
     async def route(self, token_ids, lora_id: int = 0,

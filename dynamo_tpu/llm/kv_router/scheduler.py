@@ -130,6 +130,12 @@ def score_candidates(tokens: Sequence[int], block_size: int,
         xfer_s = (cluster.seconds_for(donor, wid, extra)
                   if cluster is not None and donor is not None and extra
                   else 0.0)
+        # ledger provenance of the charged transfer term: which bandwidth
+        # estimate ("pair" EWMA fed by the byte-flow ledger, "into_dst"
+        # mean, "fleet" rate, optimistic "default") priced xfer_s
+        xfer_src = (cluster.source_for(donor, wid)
+                    if cluster is not None and donor is not None and extra
+                    else "")
         load = (m.request_active_slots / m.request_total_slots
                 if m.request_total_slots else 0.0)
         # bytes-resident dimension: the worker's total KV working set
@@ -152,6 +158,7 @@ def score_candidates(tokens: Sequence[int], block_size: int,
             "load": load,
             "kv_bytes_frac": bytes_frac,
             "transfer_seconds": xfer_s,
+            "transfer_src": xfer_src,
             "logit": 2.0 * overlap_norm - m.cache_usage - load
             - bytes_frac - tw * xfer_s,
             "saturated": saturated,

@@ -24,8 +24,9 @@ them: attention can never observe a half-arrived prompt.
 :class:`LayerStream` is the one assembler for the layer-major codec — the
 disagg push above and the cluster peer-fetch receive path
 (``kv_cluster/fetch.py``) both validate and dispatch arrivals through it.
-Receivers also feed :func:`observe_pair_bw`, the per-(src,dst) bandwidth
-EWMA behind the router's transfer-cost scoring.
+Both ends account their bytes through the flow ledger
+(``obs/flows.py``), which in turn feeds :func:`observe_pair_bw`, the
+per-(src,dst) bandwidth EWMA behind the router's transfer-cost scoring.
 """
 
 from __future__ import annotations
@@ -42,6 +43,7 @@ import numpy as np
 
 from ..obs import flightrec as _flightrec
 from ..obs import incidents as _incidents
+from ..obs.flows import record_flow
 from ..runtime.component import Client, StreamingRequest
 from ..runtime.engine import Context
 from ..utils.knobs import env_float
@@ -220,8 +222,12 @@ async def push_kv(client: Client, decode_worker_id: int, request_id: str,
                                           instance_id=decode_worker_id,
                                           parts=parts()):
             ack = resp
-        stage.kv_transfer.observe("send", value=time.monotonic() - t0)
+        elapsed = time.monotonic() - t0
+        stage.kv_transfer.observe("send", value=elapsed)
         stage.kv_transfer_bytes.inc("send", amount=nbytes)
+        record_flow("disagg_push", nbytes, elapsed,
+                    src=meta["src"], dst=f"{decode_worker_id:x}",
+                    trace_id=request_id)
     return ack or {}
 
 
@@ -311,6 +317,8 @@ async def push_kv_error(client: Client, decode_worker_id: int,
         return
         yield  # pragma: no cover
 
+    # dynalint: ok(flow-accounting) zero-byte error signal — the stream
+    # carries no KV payload, there are no bytes to meter
     async for _ in client.generate(meta, mode="direct",
                                    instance_id=decode_worker_id,
                                    parts=no_parts()):
@@ -467,8 +475,11 @@ class KvReceiver:
         elapsed = time.monotonic() - t0
         stage.kv_transfer.observe("recv", value=elapsed)
         stage.kv_transfer_bytes.inc("recv", amount=nbytes)
-        observe_pair_bw(meta.get("src") or ANON_SRC, self._dst,
-                        nbytes, elapsed)
+        # the ledger feeds the per-pair EWMA (observe_pair_bw) itself —
+        # one record accounts the link AND prices the router's pair
+        record_flow("disagg_stream_rx", nbytes, elapsed,
+                    src=meta.get("src") or ANON_SRC, dst=self._dst,
+                    trace_id=rid)
         self._ingests.pop(rid, None)
         fut = self._pending.pop(rid, None)
         if fut is None or fut.done():

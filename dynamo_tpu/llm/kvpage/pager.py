@@ -81,6 +81,10 @@ class _Assembled:
     n_valid: int
     ready: threading.Event = field(default_factory=threading.Event)
     error: Optional[Exception] = None
+    #: wall seconds the tier->staging assembly took — folded into the
+    #: runner's kvpage_pagein flow so the ledger's page-in seconds cover
+    #: staging + upload, not just the h2d enqueue
+    seconds: float = 0.0
 
 
 @dataclass
@@ -122,6 +126,10 @@ class PageScheduler:
         #: (lane, item) claim order, for interleave tests/debugging
         self.claim_log: Deque[Tuple[int, ItemKey]] = collections.deque(
             maxlen=1024)
+        #: assemble seconds of the most recent take() — the runner (the
+        #: single consumer) reads this right after each take to price
+        #: the page-in flow it is about to upload
+        self.last_assemble_s = 0.0
 
     # ------------------------------------------------------------------
     def begin(self, plan: PageinPlan, lane: int = 0) -> None:
@@ -198,6 +206,7 @@ class PageScheduler:
                 if st is not None:
                     st.taken += 1
                 self._wake.notify_all()
+            self.last_assemble_s = ent.seconds
             return ent.k, ent.v, ent.n_valid
         ent.ready.wait()
         if ent.error is not None:
@@ -205,6 +214,7 @@ class PageScheduler:
         self.pageins += 1
         stage.kvpage_pageins.inc()
         stage.kvpage_pagein_wait.observe(value=time.perf_counter() - t0)
+        self.last_assemble_s = ent.seconds
         return ent.k, ent.v, ent.n_valid
 
     def close(self) -> None:
@@ -220,6 +230,7 @@ class PageScheduler:
                   ) -> _Assembled:
         """Stack one segment's per-layer block slices into a fixed-shape
         staging buffer (padded to ``seg_pages``)."""
+        t0 = time.perf_counter()
         ks: List[np.ndarray] = []
         vs: List[np.ndarray] = []
         for h in hashes:
@@ -236,8 +247,8 @@ class PageScheduler:
             z = np.zeros_like(ks[0])
             ks.extend([z] * pad)
             vs.extend([z] * pad)
-        return _Assembled(np.stack(ks), np.stack(vs), n,
-                          ready=_DONE)
+        return _Assembled(np.stack(ks), np.stack(vs), n, ready=_DONE,
+                          seconds=time.perf_counter() - t0)
 
     def _claimable(self, st: _LaneSched) -> bool:
         return (st.plan is not None and st.next < len(st.order)
@@ -282,6 +293,7 @@ class PageScheduler:
             try:
                 built = self._assemble(hashes, layer=key[0])
                 ent.k, ent.v, ent.n_valid = built.k, built.v, built.n_valid
+                ent.seconds = built.seconds
                 ent.error = None
             except Exception as e:  # noqa: BLE001 - delivered to take()
                 ent.error = e

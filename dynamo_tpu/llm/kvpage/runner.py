@@ -60,6 +60,7 @@ import numpy as np
 
 from ...llm.kvbm.pool import OutOfBlocks
 from ...llm.kvbm.tiers import OutOfTierSpace
+from ...obs.flows import record_flow
 from ...llm.protocols.common import BackendInput, FinishReason
 from ...llm.tokens import TokenSequence, chain_hash, hash_tokens, \
     lora_chain_root
@@ -467,8 +468,11 @@ class PagedEngine:
         pages = seq.resident[:n]
         hashes = [seq.tokseq.blocks[seq.first_res + i].sequence_hash
                   for i in range(n)]
+        t0 = time.perf_counter()
         k, v = self.core.copy_stream.d2h_pages(
             self.core.k_pool, self.core.v_pool, pages, pipeline=n > 4)
+        record_flow("kvpage_pageout", n * self.block_bytes,
+                    time.perf_counter() - t0, trace_id=seq.seq_id)
         tiered = self.core.tiered
         for i, h in enumerate(hashes):
             tiered.deposit_pinned(h, k[i], v[i])
@@ -557,6 +561,7 @@ class PagedEngine:
         steps re-upload the same host staging slots (device staging
         stays double-buffer bounded either way)."""
         key = (l, s)
+        assemble_s = 0.0
         if cache is not None and key in cache:
             kv_st, meta_dev = cache[key]
         else:
@@ -569,6 +574,7 @@ class PagedEngine:
                     continue
                 start_blk, _hashes = pl[l][s]
                 k, v, n = self.pager.take((l, s), lane=seq.lane)
+                assemble_s += self.pager.last_assemble_s
                 if kv_st is None:
                     kv_st = np.zeros((2, B) + k.shape, k.dtype)
                 kv_st[0, row] = k
@@ -580,7 +586,14 @@ class PagedEngine:
             if cache is not None:
                 cache[key] = (kv_st, meta_dev)
         dt = self.core.cfg.model.dtype
-        return jnp.asarray(kv_st, dt), meta_dev
+        t0 = time.perf_counter()
+        kv_dev = jnp.asarray(kv_st, dt)
+        # one ledger record per lane-stacked staging upload: the shared
+        # slot's bytes once (it covers every lane), priced at assemble
+        # (tier->staging, 0 on a window-cache hit) + upload enqueue
+        record_flow("kvpage_pagein", kv_st.nbytes,
+                    assemble_s + time.perf_counter() - t0)
+        return kv_dev, meta_dev
 
     def _forward(self, parts, B: int, tokens, positions: np.ndarray,
                  write_idx: np.ndarray, read_idx: np.ndarray,

@@ -178,6 +178,12 @@ class StagePublisher:
         self.worker_id = worker_id
         self.lease = lease
         self._dump_fn = dump_fn
+        # the publishing identity IS the flow ledger's local endpoint:
+        # a worker's host/dev link labels adopt its hex id the moment it
+        # starts publishing (before that: pid)
+        from ..obs.flows import set_local_worker
+
+        set_local_worker(worker_id)
         if push_interval is None:
             try:
                 push_interval = float(

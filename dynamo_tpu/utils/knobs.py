@@ -304,6 +304,28 @@ _ALL: List[Knob] = [
        "(worker_id mod slices); regional aggregators rendezvous-own "
        "slices and read only theirs per tick instead of scanning the "
        "full prefix (must agree fleet-wide)"),
+    # byte-flow ledger (obs/flows.py): the per-process accounting
+    # chokepoint every byte-moving site records through
+    _k("DYN_FLOWS", "bool", "1", "metrics",
+       "byte-flow ledger master switch; 0 disables all link accounting "
+       "(the flows_overhead A/B arm)"),
+    _k("DYN_LINK_WINDOW", "float", "10.0", "metrics",
+       "trailing window for per-link rate/saturation, seconds"),
+    _k("DYN_LINK_SAT_THRESHOLD", "float", "0.9", "metrics",
+       "saturation level whose rising edge emits a link.congested "
+       "flight-recorder event and bumps dyn_link_congested_total"),
+    _k("DYN_LINK_CAPACITY_NET", "float", "0", "metrics",
+       "calibrated capacity for network (worker-pair) links, bytes/s "
+       "(0 = use each link's measured peak rate)"),
+    _k("DYN_LINK_CAPACITY_H2D", "float", "0", "metrics",
+       "calibrated capacity for host-to-device links, bytes/s "
+       "(0 = measured peak)"),
+    _k("DYN_LINK_CAPACITY_D2H", "float", "0", "metrics",
+       "calibrated capacity for device-to-host links, bytes/s "
+       "(0 = measured peak)"),
+    _k("DYN_LINK_CAPACITY_DISK", "float", "0", "metrics",
+       "calibrated capacity for disk/checkpoint-read links, bytes/s "
+       "(0 = measured peak)"),
     # --------------------------------------------------------------- store
     _k("DYN_STORE_METRICS_INTERVAL", "float", "2.0", "store",
        "seconds between the store server's self-telemetry dumps into its "

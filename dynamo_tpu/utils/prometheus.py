@@ -712,6 +712,33 @@ class StageMetrics:
             "dyn_incident_dumps_total",
             "Flight-recorder ring dumps this process contributed to "
             "incident bundles", ())
+        # byte-flow ledger (obs/flows.py): every byte-moving site —
+        # disagg push/receive, cluster kv_fetch, paged page-in/out, h2d
+        # prefetch, d2h write-through, weight prefetch, swap slabs —
+        # accounts (src,dst,kind,bytes,seconds) through one chokepoint;
+        # these series are its published face (dyntop links:, /v1/flows,
+        # ctl flows all fold them back via flows_from_states)
+        self.link_bytes = r.counter(
+            "dyn_link_bytes_total",
+            "Bytes moved per link and flow kind — network pairs are "
+            "worker hex endpoints (src 'q' = anonymous prefill pool), "
+            "host/device edges are host:<id> / dev:<id> / disk",
+            ("src", "dst", "kind"))
+        self.link_bw = r.gauge(
+            "dyn_link_bw_bytes_per_s",
+            "Windowed transfer rate per link: bytes recorded in the "
+            "trailing DYN_LINK_WINDOW seconds over the window length",
+            ("src", "dst"))
+        self.link_saturation = r.gauge(
+            "dyn_link_saturation",
+            "Windowed link utilization vs calibrated capacity "
+            "(DYN_LINK_CAPACITY_* override, else the link's measured "
+            "peak rate), 0..1; link label is 'src>dst'", ("link",))
+        self.link_congested = r.counter(
+            "dyn_link_congested_total",
+            "Rising-edge saturation crossings of DYN_LINK_SAT_THRESHOLD "
+            "per link — each also emits a link.congested flight-recorder "
+            "event and is incident-capture eligible", ("link",))
 
     def clear_worker(self, worker: str) -> None:
         """Drop every per-worker gauge series for ``worker`` (pid). Wired

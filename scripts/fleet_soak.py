@@ -588,6 +588,7 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
 
         targets = [max(1, round(a.workers * (i + 1) / a.steps))
                    for i in range(a.steps)]
+        flows_prev_bytes = 0
         print(f"fleet soak [{a.mode}]: ramp {targets} synthetic workers, "
               f"{a.step_duration}s/step, trace_sample={a.trace_sample}, "
               f"shards={max(a.shards, 1)}"
@@ -626,6 +627,33 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
             step_obs = probe_rows(t_wall0, time.time())
             dump1 = await read_store_dump(store)
             pipe1 = pipeline_counters()
+
+            # byte-flow ledger slice: the fleet's published link table
+            # this step (same fold dyntop/ctl/HTTP read). Bytes are
+            # lifetime counters, so the step delta is vs the previous
+            # step's total; links/congestion are the live view.
+            from dynamo_tpu.llm.metrics_aggregator import \
+                fetch_stage_states
+            from dynamo_tpu.obs.flows import flows_from_states
+            flow_links = flows_from_states(
+                await fetch_stage_states(store, NAMESPACE))
+            flows_total_bytes = sum(e["bytes"] for e in flow_links)
+            hottest = flow_links[0] if flow_links else None
+            flows_row = {
+                "links": len(flow_links),
+                "bytes_step": max(
+                    0, flows_total_bytes - flows_prev_bytes),
+                "congested_links": sum(
+                    1 for e in flow_links if e["congested"]),
+                "hottest": (f"{hottest['src']}>{hottest['dst']}"
+                            if hottest else None),
+                "hottest_bw": (round(hottest["bw"], 1)
+                               if hottest else None),
+                "max_saturation": round(
+                    max((e["saturation"] for e in flow_links),
+                        default=0.0), 3),
+            }
+            flows_prev_bytes = flows_total_bytes
 
             fams, overall = diff_op_families(dump0, dump1)
             total_ops = overall["ops"]
@@ -692,6 +720,7 @@ async def run_soak(a, logdir: str) -> Dict[str, Any]:
                     "ttft_p99_s": _percentile(step_ttfts, 0.99),
                     "requests": len(step_ttfts),
                 },
+                "flows": flows_row,
             }
             steps_out.append(row)
             print(f"step {len(fleet):>5} workers: "

@@ -1064,7 +1064,7 @@ def test_changed_mode_scopes_per_file_keeps_repo_rules(tmp_path, capsys,
     monkeypatch.setattr(cli, "changed_files", lambda: [target])
     assert cli.main(["--changed"]) == 0
     out = capsys.readouterr().out
-    assert "14 rules" in out
+    assert "15 rules" in out
 
 
 def test_full_tree_wall_time_within_budget_all_rules_registered():
@@ -1078,7 +1078,7 @@ def test_full_tree_wall_time_within_budget_all_rules_registered():
                  "store-key-drift", "wire-field-drift",
                  "await-holding-lock", "loop-blocking-path"):
         assert rule in res.rules_run
-    assert len(res.rules_run) == 14
+    assert len(res.rules_run) == 15
 
 
 def test_host_sync_statement_level_closure_scanned(tmp_path):

@@ -308,7 +308,8 @@ async def test_peer_fetch_e2e_loopback():
 
         comp_a = drt_a.namespace("dyn").component("backend")
         await comp_a.endpoint(KV_FETCH_ENDPOINT).serve(
-            make_kv_fetch_handler(core_a.tiered))
+            make_kv_fetch_handler(core_a.tiered,
+                                  worker_id=drt_a.worker_id))
         pub = await KvClusterPublisher(
             drt_a.store, "dyn", "backend", drt_a.worker_id, drt_a.lease,
             core_a.tiered, interval=0.05).start()
@@ -335,10 +336,33 @@ async def test_peer_fetch_e2e_loopback():
             token_ids=prompt,
             stop=StopConditions(max_tokens=4, ignore_eos=True),
             kv_donor=donor, kv_donor_blocks=blocks)
+        from dynamo_tpu.obs.flows import flow_ledger
+
+        ledger = flow_ledger()
+        tx0 = ledger.total_bytes("kv_fetch_tx")
+        rx0 = ledger.total_bytes("kv_fetch_rx")
         n = await fetcher.ensure_prefix(bi, Context())
         assert n == blocks
         assert core_b.tiered.stats()["host_blocks"] >= blocks
         assert stage.kv_cluster_fetches.get() == fetched0 + 1
+
+        # byte parity: the ledger's fetch flows equal the wire bytes
+        # predicted by block geometry — n blocks of [L,H,P,D] k AND v
+        shape = tuple(core_a.tiered.host.block_shape)
+        wire = blocks * 2 * int(np.prod(shape)) \
+            * np.dtype(core_a.tiered.host.dtype).itemsize
+        assert ledger.total_bytes("kv_fetch_tx") == tx0 + wire
+        assert ledger.total_bytes("kv_fetch_rx") == rx0 + wire
+        pair = (f"{drt_a.worker_id:x}", f"{drt_b.worker_id:x}")
+        assert stage.link_bytes.get(*pair, "kv_fetch_rx") == wire
+        # the EWMA blind spot, pinned: this pair has NEVER seen a disagg
+        # stream, yet cluster-fetch traffic alone priced it for routing
+        assert stage.kv_pair_bw.get(*pair) > 0
+        m_cost = TransferCostModel()
+        m_cost.update_from_states(
+            [("backend", stage.registry.state_dump())])
+        assert m_cost.bandwidth_info(drt_a.worker_id,
+                                     drt_b.worker_id)[1] == "pair"
 
         # admission restores the deposited blocks: identical output,
         # shared prefix served from cache instead of recomputed
@@ -629,11 +653,14 @@ def test_score_candidates_transfer_term_moves_placement(monkeypatch):
     ov.pair_weight = lambda s, d, n: m.weight(n, bb, src=s, dst=d)
     ov.pair_seconds = lambda s, d, n: m.estimate_seconds(n, bb, src=s,
                                                          dst=d)
+    ov.pair_source = lambda s, d: m.bandwidth_info(src=s, dst=d)[1]
     by = {c["worker_id"]: c for c in
           score_candidates(tokens, 8, _no_overlap(), sched.endpoints,
                            cluster=ov)}
     assert by[1]["kv_donor"] == by[2]["kv_donor"] == 7
     assert by[1]["transfer_seconds"] > 100 * by[2]["transfer_seconds"]
+    # ledger provenance of the charged term rides each candidate
+    assert by[1]["transfer_src"] == by[2]["transfer_src"] == "pair"
     assert by[2]["logit"] > by[1]["logit"]
     assert sched.schedule(tokens, _no_overlap(), cluster=ov) == 2
     entry = sched.decision_log(1)[0]
@@ -641,6 +668,7 @@ def test_score_candidates_transfer_term_moves_placement(monkeypatch):
     terms = {c["worker_id"]: c["transfer_seconds"]
              for c in entry["candidates"]}
     assert terms[1] > terms[2] >= 0.0      # the term is in the ring
+    assert {c["transfer_src"] for c in entry["candidates"]} == {"pair"}
 
     # A/B the policy off: without the expected-seconds charge the gap
     # collapses to the (small) pair-weighted-overlap residue — the
@@ -654,10 +682,11 @@ def test_score_candidates_transfer_term_moves_placement(monkeypatch):
     assert gap_on > 100 * gap_off > 0
 
 
-def test_dyntop_transfer_line_counts_bytes_once():
-    """The transfer: line sums receive-side bytes only (every transfer
-    is counted by both ends) and folds the pair-bandwidth gauge to a
-    range."""
+def test_dyntop_links_line_counts_bytes_once():
+    """The links: summary line (which absorbed the old transfer: line)
+    sums receive-side bytes only (every transfer is counted by both
+    ends) and folds the pair-bandwidth gauge to a range; per-link rows
+    render only when workers actually publish ledger flows."""
     from dynamo_tpu.cli.dyntop import render, transfer_totals
 
     states = [("backend", {
@@ -675,11 +704,37 @@ def test_dyntop_transfer_line_counts_bytes_once():
     assert tr["bytes"] == pytest.approx(150e6)     # recv sides only
     assert tr["pairs"] == 2.0
     text = render({"namespace": "x", "workers": {}, "transfer": tr})
-    line = next(l for l in text.splitlines() if l.startswith("transfer:"))
+    line = next(l for l in text.splitlines() if l.startswith("links:"))
     assert "moved=150MB" in line and "streamed=3" in line
     assert "stream_fallbacks=1" in line and "prefetch_hits=7" in line
     assert "stalls=2" in line and "pairs=2" in line and "bw=2..8MB/s" in line
-    # plane silent: no line
+    # ledger flows published -> top-talker rows under the summary
+    links = [{"src": "a", "dst": "b", "bytes": 3 << 20,
+              "kinds": {"disagg_push": 3 << 20}, "bw": 2e6,
+              "saturation": 0.42, "congested": 1}]
+    rows = render({"namespace": "x", "workers": {}, "transfer": tr,
+                   "links": links})
+    row = next(l for l in rows.splitlines() if l.strip().startswith("a>b"))
+    assert "3.0MB" in row and "0.42!" in row and "disagg_push" in row
+    # plane silent: no line, no rows (graceful degradation, no crash)
     off = render({"namespace": "x", "workers": {},
                   "transfer": {k: 0.0 for k in tr}})
-    assert "transfer:" not in off
+    assert "links:" not in off and "transfer:" not in off
+
+
+def test_bandwidth_info_provenance():
+    """The ledger-provenance half of the transfer term: every rung of
+    the bandwidth fallback chain names itself, and ClusterOverlap
+    surfaces it to the router's decision ring."""
+    m = TransferCostModel(base_weight=0.5)
+    assert m.bandwidth_info(src=1, dst=2) == (m.DEFAULT_BYTES_PER_S,
+                                              "default")
+    m.update_from_states(_pair_states({("1", "2"): 1e6}))
+    bw, src = m.bandwidth_info(src=1, dst=2)
+    assert bw == pytest.approx(1e6) and src == "pair"
+    assert m.bandwidth_info(dst=2)[1] == "into_dst"
+    ov = ClusterOverlap(owners={1: 4})
+    assert ov.source_for(1, 2) == ""       # unarmed: no provenance
+    ov.pair_source = lambda s, d: m.bandwidth_info(src=s, dst=d)[1]
+    assert ov.source_for(1, 2) == "pair"
+    assert ov.source_for(9, 2) == "into_dst"

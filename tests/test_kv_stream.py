@@ -123,6 +123,9 @@ async def test_streamed_ingest_token_parity(engine):
     ctx = Context("streamed-1")
     ingest = engine.kv_ingest(_bi(), ctx.id)
     fut = rec.expect(ctx.id, ingest=ingest)
+    from dynamo_tpu.obs.flows import flow_ledger
+
+    rx0 = flow_ledger().total_bytes("disagg_stream_rx")
     acks = await _drive(rec, _meta(ctx.id, k, tok, logp),
                         _full_parts(k, v))
     assert acks == [{"ok": True, "tokens": len(PROMPT), "streamed": True}]
@@ -133,7 +136,13 @@ async def test_streamed_ingest_token_parity(engine):
         toks.extend(out.token_ids)
     assert toks == local == [tok] + local[1:]
     assert stage.kv_stream_ingests.get() == n0 + 1
-    # the per-pair bandwidth EWMA observed this arrival
+    # byte parity: the ledger saw exactly the wire bytes (2L layer parts
+    # covering the full k and v arrays), on the (src -> receiver) link
+    assert flow_ledger().total_bytes("disagg_stream_rx") \
+        == rx0 + k.nbytes + v.nbytes
+    assert stage.link_bytes.get("abc", f"{0xd1:x}", "disagg_stream_rx") \
+        >= k.nbytes + v.nbytes
+    # the per-pair bandwidth EWMA observed this arrival (via the ledger)
     assert stage.kv_pair_bw.get("abc", f"{0xd1:x}") > 0
 
 

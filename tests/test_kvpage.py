@@ -827,3 +827,38 @@ def test_long_context_batch_lane_smoke(tmp_path):
     assert r["serial"]["decode_tok_s"] and r["batched"]["decode_tok_s"]
     assert r["paged_kernel"]
     assert (tmp_path / "long_context_batch.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# byte-flow ledger parity: every paged byte the lane moves is metered
+# ---------------------------------------------------------------------------
+def test_paged_ledger_byte_parity(paged_core, ref_tokens):
+    """The flow ledger's paged accounting reconciles against geometry:
+    page-out bytes equal demoted-blocks x kv_block_bytes (the d2h copies
+    the demotion counter independently counts), and page-in bytes are a
+    whole number of lane-stacked staging uploads [2, B, sp, H, page, D]
+    — nothing partial, nothing double-counted."""
+    from dynamo_tpu.models.llama import kv_block_bytes
+    from dynamo_tpu.obs.flows import flow_ledger
+    from dynamo_tpu.utils.prometheus import stage_metrics
+
+    core = paged_core
+    ledger = flow_ledger()
+    stage = stage_metrics()
+    in0 = ledger.total_bytes("kvpage_pagein")
+    out0 = ledger.total_bytes("kvpage_pageout")
+    dem0 = stage.kvpage_demotions.get()
+
+    core.submit("flows-parity", _req(PROMPT))
+    assert [so.token for so in _drain(core)] == ref_tokens
+
+    m = core.cfg.model
+    demoted = stage.kvpage_demotions.get() - dem0
+    assert demoted > 0
+    assert ledger.total_bytes("kvpage_pageout") - out0 \
+        == int(demoted) * kv_block_bytes(m, PAGE)
+    # single-lane staging slot: [2, B=1, seg_pages, Hkv, page, Dh] f32
+    quantum = (2 * 1 * 4 * m.num_kv_heads * PAGE * m.head_dim
+               * np.dtype(np.float32).itemsize)
+    moved = ledger.total_bytes("kvpage_pagein") - in0
+    assert moved > 0 and moved % quantum == 0
